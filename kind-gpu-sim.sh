@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Drop-in compatible entry point for users of the reference tool
+# (maryamtahhan/kind-gpu-sim): same subcommands, same --flag=value
+# flags, same default vendor (`create` == `create rocm`; reference
+# kind-gpu-sim.sh:382). New TPU capability is `create tpu`.
+# Implemented by the kind_tpu_sim Python orchestrator.
+set -eo pipefail
+
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+leading=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --version | --help | -h) break ;;
+    -*) leading+=("$1"); shift ;;
+    *) break ;;
+  esac
+done
+
+args=("$@")
+# reference default: `create` with no vendor means rocm
+if [ "${#args[@]}" -ge 1 ] && [ "${args[0]}" = "create" ]; then
+  if [ "${#args[@]}" -eq 1 ] || [[ "${args[1]}" == -* ]]; then
+    args=("create" "rocm" "${args[@]:1}")
+  fi
+fi
+
+exec "${REPO_DIR}/kind-tpu-sim.sh" \
+  ${args[@]+"${args[@]}"} ${leading[@]+"${leading[@]}"}

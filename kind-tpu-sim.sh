@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# kind-tpu-sim — TPU-native hardware simulation for kind clusters.
+#
+# Thin launcher for the Python orchestrator (kind_tpu_sim/). For parity
+# with the reference tool's loose flag parsing, flags given BEFORE the
+# subcommand (in --flag=value form) are moved after it for argparse;
+# everything from the subcommand onward is passed through untouched, so
+# both --flag=value and --flag value work there.
+#
+#   ./kind-tpu-sim.sh create tpu --topology=4x4
+#   ./kind-tpu-sim.sh --registry-port=5001 create rocm
+#   ./kind-tpu-sim.sh delete
+#   ./kind-tpu-sim.sh load --image-name=my/image:tag
+#   ./kind-tpu-sim.sh status
+set -eo pipefail
+
+REPO_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+
+PYTHON="${PYTHON:-python3}"
+if ! command -v "$PYTHON" >/dev/null 2>&1; then
+  echo "ERROR: python3 is required" >&2
+  exit 1
+fi
+
+leading=()
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --version | --help | -h)
+      # top-level flags stay top-level
+      export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+      exec "$PYTHON" -m kind_tpu_sim "$1"
+      ;;
+    -*) leading+=("$1"); shift ;;
+    *) break ;;
+  esac
+done
+
+export PYTHONPATH="${REPO_DIR}${PYTHONPATH:+:$PYTHONPATH}"
+exec "$PYTHON" -m kind_tpu_sim "$@" ${leading[@]+"${leading[@]}"}

@@ -1,0 +1,49 @@
+"""kind-tpu-sim — TPU-native hardware simulation for kind (Kubernetes-in-Docker).
+
+A ground-up, TPU-first rebuild of the capabilities of
+``maryamtahhan/kind-gpu-sim`` (reference: ``/root/reference/kind-gpu-sim.sh``):
+stand up a kind cluster whose worker nodes advertise fake accelerator
+capacity so that scheduling, device-plugin behavior, and accelerator-pod
+lifecycle can be developed and CI'd with zero real hardware.
+
+Where the reference is a single Bash script that fakes ``amd.com/gpu`` /
+``nvidia.com/gpu`` capacity via a one-shot node-status patch
+(kind-gpu-sim.sh:113,116), this package:
+
+* treats ``tpu`` as a first-class vendor next to ``rocm`` and ``nvidia``,
+* models real TPU slice topology (ICI coordinates, hosts, chips-per-host)
+  in :mod:`kind_tpu_sim.topology`,
+* serves durable ``google.com/tpu`` capacity from an in-repo **native C++
+  device plugin** (``plugin/``) speaking the kubelet device-plugin gRPC
+  API, rather than a fragile status patch (kept only as a fallback mode),
+* ships JAX/XLA-native workloads (``models/``, ``ops/``, ``parallel/``)
+  that exercise the simulated devices: ``psum`` collectives, sharded
+  transformer training steps, Pallas kernels, and multi-host
+  ``jax.distributed`` initialization.
+
+Layering (mirrors SURVEY.md §1 of the reference, rebuilt idiomatically):
+
+=====  ==========================================================
+L1     :mod:`kind_tpu_sim.runtime`   — docker/podman shim
+L2     :mod:`kind_tpu_sim.registry`  — local image registry
+L3     :mod:`kind_tpu_sim.cluster`   — kind cluster + fake-device prep
+L4     ``plugin/`` + :mod:`kind_tpu_sim.plugin` — device plugin build/deploy
+L5     :mod:`kind_tpu_sim.cli`       — subcommand dispatch
+L6     ``pods/``                     — workload manifests
+L7     ``.github/workflows/``        — e2e CI
+=====  ==========================================================
+"""
+
+__version__ = "0.1.0"
+
+RESOURCE_TPU = "google.com/tpu"
+RESOURCE_ROCM = "amd.com/gpu"
+RESOURCE_NVIDIA = "nvidia.com/gpu"
+
+VENDORS = ("tpu", "rocm", "nvidia")
+
+RESOURCE_BY_VENDOR = {
+    "tpu": RESOURCE_TPU,
+    "rocm": RESOURCE_ROCM,
+    "nvidia": RESOURCE_NVIDIA,
+}

@@ -1,0 +1,7 @@
+"""``python -m kind_tpu_sim`` entry point."""
+
+import sys
+
+from kind_tpu_sim.cli import main
+
+sys.exit(main())

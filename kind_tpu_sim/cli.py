@@ -1,0 +1,287 @@
+"""Command-line interface (layer L5).
+
+Subcommand surface is a superset of the reference's
+(kind-gpu-sim.sh:364-400): ``create [tpu|rocm|nvidia]`` / ``delete`` /
+``load`` keep their shapes (including ``--registry-port=`` /
+``--cluster-name=`` / ``--image-name=`` flags), and ``status`` is new —
+it reports simulated capacity and the measured schedule-to-Ready
+latency (the north-star metric in BASELINE.md).
+
+Unlike the reference, the default vendor for ``create`` is ``tpu``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import List, Optional
+
+from kind_tpu_sim import VENDORS, __version__
+from kind_tpu_sim import manifests
+from kind_tpu_sim import topology as topo
+from kind_tpu_sim.cluster import ClusterManager
+from kind_tpu_sim.config import SimConfig
+from kind_tpu_sim.metrics import PhaseTimer, ready_latency_summary
+from kind_tpu_sim.plugin import PluginManager
+from kind_tpu_sim.registry import LocalRegistry
+from kind_tpu_sim.runtime import detect_runtime, kubectl, required_binaries
+from kind_tpu_sim.utils.shell import (
+    CommandError,
+    Executor,
+    FakeExecutor,
+    SystemExecutor,
+)
+
+log = logging.getLogger("kind-tpu-sim")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="kind-tpu-sim",
+        description=(
+            "Simulate TPU (and GPU) hardware in a kind cluster: fake "
+            "device capacity, topology labels, and a native device "
+            "plugin — no accelerators required."
+        ),
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--registry-port", type=int, default=5000)
+    common.add_argument("--cluster-name", default="kind-tpu-sim")
+    common.add_argument(
+        "--runtime", choices=["auto", "docker", "podman", "fake"],
+        default="auto",
+        help="container runtime; 'fake' records commands without a daemon",
+    )
+    common.add_argument("-v", "--verbose", action="store_true")
+
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    create = sub.add_parser(
+        "create", parents=[common],
+        help="create a simulated accelerator cluster",
+    )
+    create.add_argument(
+        "vendor", nargs="?", choices=list(VENDORS), default="tpu",
+    )
+    create.add_argument(
+        "--accelerator", default=topo.DEFAULT_ACCELERATOR,
+        choices=sorted(topo.ACCELERATORS),
+        help="TPU generation to simulate",
+    )
+    create.add_argument(
+        "--topology", default=topo.DEFAULT_TOPOLOGY,
+        help="TPU slice topology, e.g. 4x4 (v5e) or 2x2x4 (v4)",
+    )
+    create.add_argument(
+        "--capacity-mode", choices=["plugin", "patch"], default="plugin",
+        help=(
+            "plugin: durable capacity from the device plugin (default); "
+            "patch: reference-style one-shot node-status patch"
+        ),
+    )
+    create.add_argument(
+        "--skip-plugin", action="store_true",
+        help="skip the device-plugin build/deploy (patch mode only)",
+    )
+    create.add_argument(
+        "--gpu-workers", type=int, default=2,
+        help="worker count for rocm/nvidia clusters",
+    )
+    create.add_argument(
+        "--gpus-per-node", type=int, default=2,
+        help="fake GPUs per worker for rocm/nvidia clusters",
+    )
+    create.add_argument(
+        "--timing-json", default=None,
+        help="write create-pipeline phase timings to this file",
+    )
+
+    delete = sub.add_parser(
+        "delete", parents=[common], help="tear down cluster and registry"
+    )
+    del delete  # flags only
+
+    load = sub.add_parser(
+        "load", parents=[common], help="side-load an image into the cluster"
+    )
+    load.add_argument("--image-name", required=True)
+
+    status = sub.add_parser(
+        "status", parents=[common],
+        help="show simulated capacity and pod Ready latency",
+    )
+    status.add_argument("--json", action="store_true", dest="as_json")
+
+    return parser
+
+
+def config_from_args(args: argparse.Namespace) -> SimConfig:
+    kwargs = dict(
+        registry_port=args.registry_port,
+        cluster_name=args.cluster_name,
+        runtime=args.runtime,
+        verbose=args.verbose,
+    )
+    if args.command == "create":
+        kwargs.update(
+            vendor=args.vendor,
+            accelerator=args.accelerator,
+            tpu_topology=args.topology,
+            capacity_mode=args.capacity_mode,
+            gpu_workers=args.gpu_workers,
+            gpus_per_node=args.gpus_per_node,
+        )
+    if getattr(args, "image_name", None):
+        kwargs["image_name"] = args.image_name
+    return SimConfig(**kwargs)
+
+
+class Simulator:
+    """Wires the layers together for one CLI invocation."""
+
+    def __init__(self, cfg: SimConfig, executor: Optional[Executor] = None):
+        self.cfg = cfg
+        if executor is None:
+            if cfg.runtime == "fake":
+                from kind_tpu_sim.fakes import dry_run_executor
+
+                executor = dry_run_executor(cfg)
+            else:
+                executor = SystemExecutor()
+        self.executor = executor
+        for binary in required_binaries(cfg.runtime):
+            if not executor.have(binary):
+                raise RuntimeError(
+                    f"required binary {binary!r} not found on PATH"
+                )
+        self.runtime = detect_runtime(executor, prefer=cfg.runtime)
+        if cfg.runtime != "fake":
+            self.runtime.configure_environment()
+        self.registry = LocalRegistry(cfg, self.runtime)
+        self.cluster = ClusterManager(cfg, self.runtime, self.registry)
+        self.plugin = PluginManager(
+            cfg, self.runtime, self.registry, self.cluster
+        )
+        self.timer = PhaseTimer()
+
+    # -- subcommands ----------------------------------------------------
+
+    def create(self, skip_plugin: bool = False) -> None:
+        cfg = self.cfg
+        if skip_plugin and cfg.capacity_mode != "patch":
+            raise RuntimeError(
+                "--skip-plugin leaves no capacity source; "
+                "use --capacity-mode=patch with it"
+            )
+        with self.timer.phase("registry"):
+            self.registry.start()
+        with self.timer.phase("cluster-create"):
+            self.cluster.create()
+        if not skip_plugin:
+            with self.timer.phase("plugin-build"):
+                image = self.plugin.build(cfg.vendor)
+            with self.timer.phase("plugin-deploy"):
+                self.plugin.deploy(cfg.vendor, image)
+        if cfg.vendor == "tpu":
+            s = cfg.slice
+            log.info(
+                "simulated %s slice ready: topology %s, %d workers x %d "
+                "google.com/tpu", s.accelerator_type,
+                topo.format_topology(s.dims), s.num_hosts, s.chips_per_host,
+            )
+        print(f"Simulated {cfg.vendor} kind cluster is ready "
+              f"('{cfg.cluster_name}')")
+        print("create pipeline timing:")
+        print(self.timer.report())
+
+    def delete(self) -> None:
+        self.cluster.delete()
+        self.registry.delete()
+
+    def load(self) -> None:
+        self.cluster.load_image(self.cfg.image_name)
+
+    def status(self, as_json: bool = False) -> dict:
+        nodes_json = kubectl(
+            self.executor, "get", "nodes", "-o", "json"
+        ).stdout
+        pods_json = kubectl(
+            self.executor, "get", "pods", "-A", "-o", "json"
+        ).stdout
+        nodes = json.loads(nodes_json).get("items", [])
+        report: dict = {"cluster": self.cfg.cluster_name, "nodes": []}
+        for node in nodes:
+            meta = node.get("metadata", {})
+            labels = meta.get("labels", {})
+            capacity = node.get("status", {}).get("capacity", {})
+            entry = {
+                "name": meta.get("name"),
+                "accelerators": {
+                    k: v for k, v in capacity.items()
+                    if k in ("google.com/tpu", "amd.com/gpu",
+                             "nvidia.com/gpu")
+                },
+                "topology": labels.get(topo.LABEL_TOPOLOGY),
+                "worker-id": labels.get(topo.LABEL_WORKER_ID),
+                "host-coord": labels.get(topo.LABEL_HOST_COORD),
+            }
+            report["nodes"].append(entry)
+        report["ready_latency"] = ready_latency_summary(pods_json)
+        if as_json:
+            print(json.dumps(report, indent=2))
+        else:
+            for entry in report["nodes"]:
+                accel = ", ".join(
+                    f"{k}={v}" for k, v in entry["accelerators"].items()
+                ) or "-"
+                extra = ""
+                if entry["worker-id"] is not None:
+                    extra = (f"  worker={entry['worker-id']} "
+                             f"coord={entry['host-coord']} "
+                             f"topo={entry['topology']}")
+                print(f"{entry['name']}: {accel}{extra}")
+            lat = report["ready_latency"]
+            if lat.get("count"):
+                print(
+                    f"pod schedule-to-Ready: p50={lat['p50_s']}s "
+                    f"max={lat['max_s']}s over {lat['count']} pods"
+                )
+        return report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(levelname)s %(name)s: %(message)s",
+    )
+    try:
+        cfg = config_from_args(args)
+        sim = Simulator(cfg)
+        if args.command == "create":
+            sim.create(skip_plugin=args.skip_plugin)
+            if args.timing_json:
+                with open(args.timing_json, "w", encoding="utf-8") as fh:
+                    json.dump(sim.timer.as_dict(), fh, indent=2)
+        elif args.command == "delete":
+            sim.delete()
+        elif args.command == "load":
+            sim.load()
+        elif args.command == "status":
+            sim.status(as_json=args.as_json)
+        if isinstance(sim.executor, FakeExecutor) and cfg.verbose:
+            print("-- fake runtime command stream --", file=sys.stderr)
+            for cmd in sim.executor.commands():
+                print(f"  {cmd}", file=sys.stderr)
+        return 0
+    except (CommandError, RuntimeError, ValueError) as exc:
+        log.error("%s", exc)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

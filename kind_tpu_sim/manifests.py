@@ -1,0 +1,242 @@
+"""Kubernetes/kind manifest generation.
+
+The reference embeds all of its YAML as heredocs inside the shell script
+(kind config at kind-gpu-sim.sh:86-97, registry ConfigMap at :131-141,
+plugin DaemonSets at :248-276 and :291-329).  Here manifests are built
+as Python structures and serialized with PyYAML, so tests can assert on
+them as data instead of grepping strings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import yaml
+
+from kind_tpu_sim import RESOURCE_BY_VENDOR
+from kind_tpu_sim import topology as topo
+from kind_tpu_sim.config import SimConfig
+
+# Kubelet's device-plugin registration directory; the plugin DaemonSet
+# must mount it to reach kubelet.sock (cf. kind-gpu-sim.sh:321-328).
+KUBELET_DP_DIR = "/var/lib/kubelet/device-plugins"
+
+PLUGIN_APP_LABEL = "tpu-sim-device-plugin"
+PLUGIN_NAMESPACE = "kube-system"
+
+
+def to_yaml(obj: object) -> str:
+    return yaml.safe_dump(obj, sort_keys=False, default_flow_style=False)
+
+
+def kind_cluster_config(cfg: SimConfig) -> str:
+    """kind Cluster config: 1 control-plane + N workers + registry mirror.
+
+    Mirror patch matches the reference's containerdConfigPatches
+    (kind-gpu-sim.sh:89-92); worker count is derived from the simulated
+    slice instead of being hardcoded (:93-97).
+    """
+    doc = {
+        "kind": "Cluster",
+        "apiVersion": "kind.x-k8s.io/v1alpha4",
+        "containerdConfigPatches": [
+            (
+                '[plugins."io.containerd.grpc.v1.cri".registry.mirrors.'
+                f'"localhost:{cfg.registry_port}"]\n'
+                f'  endpoint = ["http://{cfg.registry_name}:5000"]\n'
+            )
+        ],
+        "nodes": [{"role": "control-plane"}]
+        + [{"role": "worker"} for _ in range(cfg.workers)],
+    }
+    return to_yaml(doc)
+
+
+def registry_configmap(cfg: SimConfig) -> str:
+    """Standard local-registry-hosting ConfigMap (kind-gpu-sim.sh:131-141)."""
+    doc = {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {
+            "name": "local-registry-hosting",
+            "namespace": "kube-public",
+        },
+        "data": {
+            "localRegistryHosting.v1": (
+                f'host: "localhost:{cfg.registry_port}"\n'
+                'help: "https://kind.sigs.k8s.io/docs/user/local-registry/"\n'
+            ),
+        },
+    }
+    return to_yaml(doc)
+
+
+def containerd_hosts_toml(cfg: SimConfig) -> str:
+    """Per-node registry mirror config (kind-gpu-sim.sh:122-125)."""
+    return (
+        f'[host."http://{cfg.registry_name}:5000"]\n'
+        '  capabilities = ["pull", "resolve"]\n'
+    )
+
+
+def _taint_toleration(vendor: str) -> List[Dict[str, str]]:
+    if vendor == "tpu":
+        return [
+            {
+                "key": topo.TAINT_KEY,
+                "operator": "Equal",
+                "value": topo.TAINT_VALUE,
+                "effect": topo.TAINT_EFFECT,
+            }
+        ]
+    # reference taint: gpu=true:NoSchedule (kind-gpu-sim.sh:110)
+    return [
+        {
+            "key": "gpu",
+            "operator": "Equal",
+            "value": "true",
+            "effect": "NoSchedule",
+        }
+    ]
+
+
+def _node_selector(vendor: str) -> Dict[str, str]:
+    return {
+        topo.LABEL_HARDWARE_TYPE: "tpu" if vendor == "tpu" else "gpu"
+    }
+
+
+def tpu_plugin_daemonset(cfg: SimConfig, image: str) -> str:
+    """DaemonSet for the in-repo fake TPU device plugin.
+
+    Structure follows the reference's NVIDIA deploy (kind-gpu-sim.sh:291-329)
+    — node selector + toleration + privileged + kubelet socket-dir mount —
+    but the image is our native C++ plugin and its behavior is driven by
+    the slice-topology env block rather than FAIL_ON_INIT_ERROR.
+    """
+    s = cfg.slice
+    env = [
+        {"name": "TPU_SIM_CHIPS", "value": str(s.chips_per_host)},
+        {"name": "TPU_SIM_RESOURCE", "value": RESOURCE_BY_VENDOR["tpu"]},
+        {"name": "TPU_SIM_ACCELERATOR", "value": s.spec.gke_type},
+        {"name": "TPU_SIM_TOPOLOGY", "value": topo.format_topology(s.dims)},
+        # The plugin reads its worker identity from the node labels the
+        # orchestrator applied; pass the node name down for that lookup.
+        {
+            "name": "NODE_NAME",
+            "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}},
+        },
+    ]
+    doc = {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {
+            "name": "tpu-sim-device-plugin",
+            "namespace": PLUGIN_NAMESPACE,
+            "labels": {"app": PLUGIN_APP_LABEL},
+        },
+        "spec": {
+            "selector": {"matchLabels": {"app": PLUGIN_APP_LABEL}},
+            "template": {
+                "metadata": {"labels": {"app": PLUGIN_APP_LABEL}},
+                "spec": {
+                    "nodeSelector": _node_selector("tpu"),
+                    "tolerations": _taint_toleration("tpu"),
+                    "priorityClassName": "system-node-critical",
+                    "containers": [
+                        {
+                            "name": "tpu-device-plugin",
+                            "image": image,
+                            "imagePullPolicy": "IfNotPresent",
+                            "securityContext": {"privileged": True},
+                            "env": env,
+                            "volumeMounts": [
+                                {
+                                    "name": "device-plugin",
+                                    "mountPath": KUBELET_DP_DIR,
+                                }
+                            ],
+                        }
+                    ],
+                    "volumes": [
+                        {
+                            "name": "device-plugin",
+                            "hostPath": {
+                                "path": KUBELET_DP_DIR,
+                                "type": "DirectoryOrCreate",
+                            },
+                        }
+                    ],
+                },
+            },
+        },
+    }
+    return to_yaml(doc)
+
+
+def gpu_plugin_daemonset(cfg: SimConfig, vendor: str, image: str) -> str:
+    """ROCm/NVIDIA vendor-plugin DaemonSets (parity with :242-336)."""
+    if vendor == "rocm":
+        name = "amdgpu-device-plugin-daemonset"
+        app = "amdgpu-device-plugin"
+        container: Dict[str, object] = {
+            "name": "amdgpu-dp-ds",
+            "image": image,
+            "imagePullPolicy": "IfNotPresent",
+            "securityContext": {"privileged": True},
+        }
+        volumes: Optional[List[Dict[str, object]]] = None
+    elif vendor == "nvidia":
+        name = "nvidia-device-plugin-daemonset"
+        app = "nvidia-device-plugin"
+        container = {
+            "name": "nvidia-device-plugin-ctr",
+            "image": image,
+            "securityContext": {"privileged": True},
+            # lets the real plugin start with no NVML/GPU present
+            # (kind-gpu-sim.sh:318-320)
+            "env": [{"name": "FAIL_ON_INIT_ERROR", "value": "false"}],
+            "volumeMounts": [
+                {"name": "device-plugin", "mountPath": KUBELET_DP_DIR}
+            ],
+        }
+        volumes = [
+            {
+                "name": "device-plugin",
+                "hostPath": {
+                    "path": KUBELET_DP_DIR,
+                    "type": "DirectoryOrCreate",
+                },
+            }
+        ]
+    else:
+        raise ValueError(f"no vendor plugin DaemonSet for {vendor!r}")
+
+    pod_spec: Dict[str, object] = {
+        "nodeSelector": _node_selector(vendor),
+        "tolerations": _taint_toleration(vendor),
+        "containers": [container],
+    }
+    if vendor == "nvidia":
+        pod_spec["volumes"] = volumes
+    doc = {
+        "apiVersion": "apps/v1",
+        "kind": "DaemonSet",
+        "metadata": {"name": name, "namespace": PLUGIN_NAMESPACE},
+        "spec": {
+            "selector": {"matchLabels": {"app": app}},
+            "template": {
+                "metadata": {"labels": {"app": app}},
+                "spec": pod_spec,
+            },
+        },
+    }
+    return to_yaml(doc)
+
+
+def plugin_app_label(vendor: str) -> str:
+    return {
+        "tpu": PLUGIN_APP_LABEL,
+        "rocm": "amdgpu-device-plugin",
+        "nvidia": "nvidia-device-plugin",
+    }[vendor]

@@ -1,0 +1,106 @@
+"""Pipeline timing and schedule-to-Ready measurement.
+
+The reference records no timing at all (SURVEY.md §5 "tracing — absent");
+its only quantitative gate is CI's 60-second `kubectl wait` bound.  Here
+the tool itself measures (a) each phase of the create pipeline and (b)
+the north-star metric, pod schedule-to-Ready latency, so the number
+BASELINE.md asks for is produced by the framework rather than inferred
+from CI timeouts.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import statistics
+import time
+from typing import Dict, Iterator, List, Optional
+
+
+@dataclasses.dataclass
+class Phase:
+    name: str
+    seconds: float
+
+
+class PhaseTimer:
+    """Wall-clock timing for named pipeline phases."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self.phases: List[Phase] = []
+
+    @contextlib.contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        start = self._clock()
+        try:
+            yield
+        finally:
+            self.phases.append(Phase(name, self._clock() - start))
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(p.seconds for p in self.phases)
+
+    def as_dict(self) -> Dict[str, float]:
+        out = {p.name: round(p.seconds, 3) for p in self.phases}
+        out["total"] = round(self.total_seconds, 3)
+        return out
+
+    def report(self) -> str:
+        width = max((len(p.name) for p in self.phases), default=0)
+        lines = [
+            f"  {p.name.ljust(width)}  {p.seconds:8.2f}s" for p in self.phases
+        ]
+        lines.append(f"  {'total'.ljust(width)}  {self.total_seconds:8.2f}s")
+        return "\n".join(lines)
+
+
+def parse_k8s_time(stamp: str) -> float:
+    """RFC3339 (kubernetes) timestamp -> unix seconds."""
+    import datetime
+
+    return datetime.datetime.strptime(
+        stamp, "%Y-%m-%dT%H:%M:%SZ"
+    ).replace(tzinfo=datetime.timezone.utc).timestamp()
+
+
+def schedule_to_ready_seconds(pod: dict) -> Optional[float]:
+    """Scheduled->Ready latency from one pod's status conditions.
+
+    Computed from the ``PodScheduled`` and ``Ready`` condition transition
+    times of a pod JSON object (kubectl get pod -o json).
+    """
+    conditions = {
+        c.get("type"): c
+        for c in pod.get("status", {}).get("conditions", [])
+    }
+    sched = conditions.get("PodScheduled")
+    ready = conditions.get("Ready")
+    if not sched or not ready:
+        return None
+    if sched.get("status") != "True" or ready.get("status") != "True":
+        return None
+    return parse_k8s_time(ready["lastTransitionTime"]) - parse_k8s_time(
+        sched["lastTransitionTime"]
+    )
+
+
+def ready_latency_summary(pods_json: str) -> Dict[str, object]:
+    """Summarize schedule->Ready latency over a pod list JSON document."""
+    doc = json.loads(pods_json)
+    items = doc.get("items", [doc] if doc.get("kind") == "Pod" else [])
+    latencies = []
+    for pod in items:
+        lat = schedule_to_ready_seconds(pod)
+        if lat is not None:
+            latencies.append(lat)
+    if not latencies:
+        return {"count": 0}
+    return {
+        "count": len(latencies),
+        "p50_s": round(statistics.median(latencies), 3),
+        "max_s": round(max(latencies), 3),
+        "min_s": round(min(latencies), 3),
+    }

@@ -1,0 +1,1 @@
+"""JAX workload models for the simulated TPU cluster (filled by models.transformer)."""

@@ -1,0 +1,1 @@
+"""Meshes, collectives, and multi-host initialization for the simulated slice."""

@@ -1,0 +1,114 @@
+"""Container-runtime shim (layer L1).
+
+Reimplements the reference's environment detection
+(kind-gpu-sim.sh:45-66): podman preferred over docker, with the podman
+socket + kind provider env wiring.  Differences from the reference:
+
+* the runtime is an object wrapping an :class:`Executor`, not a global
+  shell function, so everything is unit-testable without a daemon;
+* a ``fake`` runtime exists for tests and for machines with no container
+  daemon at all (it records the command stream instead of executing).
+
+The macOS/sed shims (kind-gpu-sim.sh:8-29) have no equivalent here:
+nothing in this implementation shells out to ``sed`` or ``pidof``
+(containerd reload uses ``pkill -HUP`` which is portable).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional, Sequence
+
+from kind_tpu_sim.utils.shell import ExecResult, Executor, FakeExecutor
+
+log = logging.getLogger("kind-tpu-sim")
+
+
+class ContainerRuntime:
+    """A detected docker or podman runtime bound to an executor."""
+
+    def __init__(self, name: str, executor: Executor):
+        if name not in ("docker", "podman"):
+            raise ValueError(f"unsupported container runtime {name!r}")
+        self.name = name
+        self.executor = executor
+
+    # the `cr` equivalent (kind-gpu-sim.sh:64-66)
+    def run(
+        self,
+        *args: str,
+        input_text: Optional[str] = None,
+        check: bool = True,
+    ) -> ExecResult:
+        return self.executor.run(
+            [self.name, *args], input_text=input_text, check=check
+        )
+
+    def try_run(self, *args: str, input_text: Optional[str] = None) -> ExecResult:
+        return self.run(*args, input_text=input_text, check=False)
+
+    @property
+    def is_podman(self) -> bool:
+        return self.name == "podman"
+
+    def configure_environment(self) -> None:
+        """Export the env kind needs for this runtime.
+
+        Mirrors kind-gpu-sim.sh:49-54 (podman provider + user socket).
+        """
+        if self.is_podman:
+            os.environ["KIND_EXPERIMENTAL_PROVIDER"] = "podman"
+            uid = os.getuid()
+            os.environ.setdefault(
+                "DOCKER_HOST", f"unix:///run/user/{uid}/podman/podman.sock"
+            )
+            self.executor.try_run(
+                ["systemctl", "--user", "enable", "--now", "podman.socket"]
+            )
+
+
+def detect_runtime(
+    executor: Executor, prefer: str = "auto"
+) -> ContainerRuntime:
+    """Pick podman over docker, like the reference (kind-gpu-sim.sh:46-62).
+
+    ``prefer='fake'`` returns a docker-shaped runtime over a
+    :class:`FakeExecutor` so every layer above can run with no daemon.
+    """
+    if prefer == "fake":
+        fake = executor if isinstance(executor, FakeExecutor) else FakeExecutor()
+        return ContainerRuntime("docker", fake)
+    if prefer in ("docker", "podman"):
+        if not executor.have(prefer):
+            raise RuntimeError(f"requested runtime {prefer!r} not on PATH")
+        rt = ContainerRuntime(prefer, executor)
+    elif executor.have("podman"):
+        rt = ContainerRuntime("podman", executor)
+    elif executor.have("docker"):
+        rt = ContainerRuntime("docker", executor)
+    else:
+        raise RuntimeError("neither docker nor podman is installed")
+    log.info("using %s as container runtime", rt.name)
+    return rt
+
+
+def kubectl(executor: Executor, *args: str,
+            input_text: Optional[str] = None,
+            check: bool = True) -> ExecResult:
+    return executor.run(["kubectl", *args], input_text=input_text, check=check)
+
+
+def kind(executor: Executor, *args: str, check: bool = True) -> ExecResult:
+    return executor.run(["kind", *args], check=check)
+
+
+def kubectl_lines(executor: Executor, *args: str) -> List[str]:
+    out = kubectl(executor, *args).stdout
+    return [line for line in out.splitlines() if line.strip()]
+
+
+def required_binaries(runtime: str) -> Sequence[str]:
+    if runtime == "fake":
+        return ()
+    return ("kind", "kubectl")

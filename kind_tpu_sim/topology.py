@@ -1,0 +1,277 @@
+"""TPU slice topology math for the simulated cluster.
+
+The reference fakes a flat integer capacity per node
+(``kind-gpu-sim.sh:113,116`` — ``amd.com/gpu: 2`` / ``nvidia.com/gpu: 2``).
+TPUs are not a flat pool: a slice is a 2-D (v5e) or 3-D (v4/v5p) grid of
+chips wired by ICI, partitioned across hosts, and schedulers/GKE expose that
+structure through node labels (``cloud.google.com/gke-tpu-accelerator``,
+``cloud.google.com/gke-tpu-topology``) and through the libtpu/JAX
+environment contract (``TPU_CHIPS_PER_HOST_BOUNDS``, ``TPU_HOST_BOUNDS``,
+``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``).
+
+This module is the single source of truth for that structure in the
+simulator: the orchestrator derives node labels from it, the device plugin
+derives device IDs and Allocate env vars from it, and the JAX helpers in
+:mod:`kind_tpu_sim.parallel.mesh` derive `jax.sharding.Mesh` shapes from it.
+
+Default simulated slice (BASELINE.json "Multi-worker v5e-16 sim"):
+``tpu-v5-lite-podslice`` topology ``4x4`` — 16 chips, 2 hosts (kind
+workers), 8 ``google.com/tpu`` per host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Tuple
+
+# Node label keys.  GKE-compatible where a GKE convention exists, a
+# simulator-scoped domain otherwise.
+LABEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+LABEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+LABEL_WORKER_ID = "kind-tpu-sim.dev/worker-id"
+LABEL_HOST_COORD = "kind-tpu-sim.dev/host-coord"
+LABEL_HARDWARE_TYPE = "hardware-type"  # selector key kept from the reference
+
+# Taint applied to simulated TPU nodes (GKE uses google.com/tpu=present).
+TAINT_KEY = "google.com/tpu"
+TAINT_VALUE = "present"
+TAINT_EFFECT = "NoSchedule"
+
+
+@dataclasses.dataclass(frozen=True)
+class AcceleratorSpec:
+    """Static facts about one TPU generation as simulated here."""
+
+    gke_type: str             # value of LABEL_ACCELERATOR
+    family: str               # "v5litepod", "v4", "v5p"
+    ndims: int                # topology rank: 2 for v5e, 3 for v4/v5p
+    host_bounds: Tuple[int, ...]  # chip grid owned by one host
+    cores_per_chip: int       # naming only: v4/v5p advertise 2 cores/chip
+
+    @property
+    def chips_per_host(self) -> int:
+        return math.prod(self.host_bounds)
+
+
+ACCELERATORS: Dict[str, AcceleratorSpec] = {
+    "tpu-v5-lite-podslice": AcceleratorSpec(
+        gke_type="tpu-v5-lite-podslice",
+        family="v5litepod",
+        ndims=2,
+        host_bounds=(2, 4),
+        cores_per_chip=1,
+    ),
+    "tpu-v4-podslice": AcceleratorSpec(
+        gke_type="tpu-v4-podslice",
+        family="v4",
+        ndims=3,
+        host_bounds=(2, 2, 1),
+        cores_per_chip=2,
+    ),
+    "tpu-v5p-slice": AcceleratorSpec(
+        gke_type="tpu-v5p-slice",
+        family="v5p",
+        ndims=3,
+        host_bounds=(2, 2, 1),
+        cores_per_chip=2,
+    ),
+}
+
+DEFAULT_ACCELERATOR = "tpu-v5-lite-podslice"
+DEFAULT_TOPOLOGY = "4x4"
+
+
+def parse_topology(topology: str) -> Tuple[int, ...]:
+    """``"4x4"`` -> ``(4, 4)``; validates positive integers."""
+    try:
+        dims = tuple(int(part) for part in topology.lower().split("x"))
+    except ValueError as exc:
+        raise ValueError(f"malformed topology {topology!r}") from exc
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"malformed topology {topology!r}")
+    return dims
+
+
+def format_topology(dims: Tuple[int, ...]) -> str:
+    return "x".join(str(d) for d in dims)
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """A concrete simulated TPU slice: accelerator generation + topology.
+
+    ``hosts`` maps 1:1 onto kind worker nodes; worker IDs are assigned
+    row-major over the host grid, matching libtpu's task ordering.
+    """
+
+    spec: AcceleratorSpec
+    dims: Tuple[int, ...]
+
+    def __post_init__(self) -> None:
+        if len(self.dims) != self.spec.ndims:
+            raise ValueError(
+                f"{self.spec.gke_type} expects {self.spec.ndims}-D topology, "
+                f"got {format_topology(self.dims)}"
+            )
+        # Single-host slices (<= one host's worth of chips) may be any
+        # shape; multi-host slices must tile exactly into host blocks.
+        if self.num_chips > self.spec.chips_per_host:
+            for dim, host_dim in zip(self.dims, self.spec.host_bounds):
+                if dim < host_dim or dim % host_dim:
+                    raise ValueError(
+                        f"topology {format_topology(self.dims)} not "
+                        f"divisible by host bounds {self.spec.host_bounds}"
+                    )
+
+    # -- sizes ----------------------------------------------------------
+
+    @property
+    def num_chips(self) -> int:
+        return math.prod(self.dims)
+
+    @property
+    def host_grid(self) -> Tuple[int, ...]:
+        """How hosts tile the chip grid, e.g. 4x4 over 2x4 hosts -> (2, 1)."""
+        if self.num_chips <= self.spec.chips_per_host:
+            return (1,) * self.spec.ndims
+        return tuple(
+            dim // host_dim
+            for dim, host_dim in zip(self.dims, self.spec.host_bounds)
+        )
+
+    @property
+    def num_hosts(self) -> int:
+        if self.num_chips <= self.spec.chips_per_host:
+            return 1
+        return math.prod(self.host_grid)
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.num_chips // self.num_hosts
+
+    @property
+    def accelerator_type(self) -> str:
+        """libtpu-style name, e.g. ``v5litepod-16`` or ``v4-16``.
+
+        v4/v5p names count TensorCores (2/chip); v5e counts chips.
+        """
+        n = self.num_chips * self.spec.cores_per_chip
+        return f"{self.spec.family}-{n}"
+
+    # -- per-host structure --------------------------------------------
+
+    def host_coords(self) -> List[Tuple[int, ...]]:
+        """Row-major (last dim fastest) coordinates of each host."""
+        grid = self.host_grid
+        coords: List[Tuple[int, ...]] = []
+        for flat in range(self.num_hosts):
+            coord = []
+            rem = flat
+            for stride in _suffix_products(grid):
+                coord.append(rem // stride)
+                rem %= stride
+            coords.append(tuple(coord))
+        return coords
+
+    def chip_bounds_for_host(self) -> Tuple[int, ...]:
+        """Chip-grid block owned by each host (libtpu CHIPS_PER_HOST_BOUNDS)."""
+        if self.num_chips <= self.spec.chips_per_host:
+            return self.dims
+        return self.spec.host_bounds
+
+    # -- simulator surface ---------------------------------------------
+
+    def _check_worker(self, worker_id: int) -> None:
+        if not 0 <= worker_id < self.num_hosts:
+            raise ValueError(
+                f"worker_id {worker_id} out of range for "
+                f"{self.num_hosts}-host slice"
+            )
+
+    def node_labels(self, worker_id: int) -> Dict[str, str]:
+        """Labels the orchestrator applies to kind worker ``worker_id``."""
+        self._check_worker(worker_id)
+        coord = self.host_coords()[worker_id]
+        return {
+            LABEL_HARDWARE_TYPE: "tpu",
+            LABEL_ACCELERATOR: self.spec.gke_type,
+            LABEL_TOPOLOGY: format_topology(self.dims),
+            LABEL_WORKER_ID: str(worker_id),
+            LABEL_HOST_COORD: ",".join(str(c) for c in coord),
+        }
+
+    def worker_env(
+        self, worker_id: int, hostnames: List[str] | None = None
+    ) -> Dict[str, str]:
+        """The libtpu/JAX environment contract for one simulated worker.
+
+        These are the variables a real TPU VM exposes and that
+        ``jax.distributed`` / libtpu probe at startup; the device plugin
+        injects them via its Allocate response so a pod landing on the
+        node sees a coherent TPU worker identity.
+        """
+        self._check_worker(worker_id)
+        if hostnames is None:
+            hostnames = default_hostnames(self.num_hosts)
+        bounds = self.chip_bounds_for_host()
+        host_grid = self.host_grid
+        # libtpu bounds strings are always 3-D; pad 2-D (v5e) with 1.
+        pad = (1,) * (3 - len(bounds))
+        env = {
+            "TPU_ACCELERATOR_TYPE": self.accelerator_type,
+            "TPU_CHIPS_PER_HOST_BOUNDS": ",".join(
+                str(d) for d in bounds + pad
+            ),
+            "TPU_HOST_BOUNDS": ",".join(
+                str(d) for d in host_grid + (1,) * (3 - len(host_grid))
+            ),
+            "TPU_WORKER_ID": str(worker_id),
+            "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+            "TPU_SKIP_MDS_QUERY": "true",
+        }
+        return env
+
+    def device_ids(self, worker_id: int) -> List[str]:
+        """Stable device-plugin IDs for one host's chips, e.g. ``tpu-0-3``."""
+        self._check_worker(worker_id)
+        base = worker_id * self.chips_per_host
+        return [
+            f"tpu-{worker_id}-{base + i}"
+            for i in range(self.chips_per_host)
+        ]
+
+
+def _suffix_products(grid: Tuple[int, ...]) -> List[int]:
+    out: List[int] = []
+    acc = 1
+    for d in reversed(grid):
+        out.append(acc)
+        acc *= d
+    return list(reversed(out))
+
+
+def default_hostnames(num_hosts: int) -> List[str]:
+    """Stable in-cluster DNS names for the multi-host JAX StatefulSet.
+
+    Matches ``pods/jax-multihost.yaml`` (headless service ``tpu-sim`` in
+    the default namespace).
+    """
+    return [
+        f"jax-tpu-{i}.tpu-sim.default.svc.cluster.local"
+        for i in range(num_hosts)
+    ]
+
+
+def make_slice(
+    accelerator: str = DEFAULT_ACCELERATOR,
+    topology: str = DEFAULT_TOPOLOGY,
+) -> SliceTopology:
+    try:
+        spec = ACCELERATORS[accelerator]
+    except KeyError as exc:
+        raise ValueError(
+            f"unknown accelerator {accelerator!r}; "
+            f"known: {sorted(ACCELERATORS)}"
+        ) from exc
+    return SliceTopology(spec=spec, dims=parse_topology(topology))

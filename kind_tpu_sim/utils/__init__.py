@@ -1,0 +1,9 @@
+"""Shared utilities: subprocess execution layer and tiny YAML emission."""
+
+from kind_tpu_sim.utils.shell import (  # noqa: F401
+    CommandError,
+    ExecResult,
+    Executor,
+    FakeExecutor,
+    SystemExecutor,
+)

@@ -1,0 +1,166 @@
+"""Subprocess execution layer for the orchestrator.
+
+The reference shells out directly (``cr()`` at kind-gpu-sim.sh:64-66),
+which makes it untestable without docker.  Here every external command
+goes through an :class:`Executor`, so unit tests swap in
+:class:`FakeExecutor` and assert on the exact command stream — the test
+strategy upgrade called out in SURVEY.md §4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import subprocess
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("kind-tpu-sim")
+
+
+@dataclasses.dataclass
+class ExecResult:
+    returncode: int
+    stdout: str = ""
+    stderr: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.returncode == 0
+
+
+class CommandError(RuntimeError):
+    def __init__(self, argv: Sequence[str], result: ExecResult):
+        self.argv = list(argv)
+        self.result = result
+        super().__init__(
+            f"command failed ({result.returncode}): {' '.join(argv)}\n"
+            f"{result.stderr.strip()}"
+        )
+
+
+class Executor:
+    """Interface: run an external command, optionally with stdin text.
+
+    ``env`` adds variables on top of the inherited environment for that
+    one command only (never mutates ``os.environ``).
+    """
+
+    def run(
+        self,
+        argv: Sequence[str],
+        *,
+        input_text: Optional[str] = None,
+        check: bool = True,
+        capture: bool = True,
+        env: Optional[Dict[str, str]] = None,
+    ) -> ExecResult:
+        raise NotImplementedError
+
+    def try_run(
+        self, argv: Sequence[str], *, input_text: Optional[str] = None
+    ) -> ExecResult:
+        """Like run() but never raises."""
+        return self.run(argv, input_text=input_text, check=False)
+
+    def have(self, binary: str) -> bool:
+        """Is `binary` on PATH?"""
+        raise NotImplementedError
+
+
+class SystemExecutor(Executor):
+    def run(
+        self,
+        argv: Sequence[str],
+        *,
+        input_text: Optional[str] = None,
+        check: bool = True,
+        capture: bool = True,
+        env: Optional[Dict[str, str]] = None,
+    ) -> ExecResult:
+        log.debug("exec: %s", " ".join(argv))
+        full_env = None
+        if env:
+            import os
+
+            full_env = {**os.environ, **env}
+        proc = subprocess.run(
+            list(argv),
+            input=input_text,
+            text=True,
+            capture_output=capture,
+            env=full_env,
+        )
+        result = ExecResult(proc.returncode, proc.stdout or "", proc.stderr or "")
+        if check and not result.ok:
+            raise CommandError(argv, result)
+        return result
+
+    def have(self, binary: str) -> bool:
+        import shutil
+
+        return shutil.which(binary) is not None
+
+
+Responder = Callable[[List[str], Optional[str]], ExecResult]
+
+
+class FakeExecutor(Executor):
+    """Records commands; answers from a table of (prefix -> responder).
+
+    ``rules`` maps a space-joined argv *prefix* to either a static
+    :class:`ExecResult` or a callable ``(argv, input_text) -> ExecResult``.
+    The longest matching prefix wins; unmatched commands succeed with
+    empty output (so tests only specify what they care about).
+    """
+
+    def __init__(self, rules: Optional[Dict[str, object]] = None,
+                 binaries: Optional[Sequence[str]] = None):
+        self.rules: Dict[str, object] = dict(rules or {})
+        self.calls: List[Tuple[List[str], Optional[str]]] = []
+        self.binaries = set(
+            binaries
+            if binaries is not None
+            else ["docker", "kind", "kubectl"]
+        )
+
+    def run(
+        self,
+        argv: Sequence[str],
+        *,
+        input_text: Optional[str] = None,
+        check: bool = True,
+        capture: bool = True,
+        env: Optional[Dict[str, str]] = None,
+    ) -> ExecResult:
+        argv = list(argv)
+        self.calls.append((argv, input_text))
+        joined = " ".join(argv)
+        best: Optional[object] = None
+        best_len = -1
+        for prefix, resp in self.rules.items():
+            if joined.startswith(prefix) and len(prefix) > best_len:
+                best, best_len = resp, len(prefix)
+        if best is None:
+            result = ExecResult(0)
+        elif callable(best):
+            result = best(argv, input_text)
+        else:
+            result = best  # type: ignore[assignment]
+        if check and not result.ok:
+            raise CommandError(argv, result)
+        return result
+
+    def have(self, binary: str) -> bool:
+        return binary in self.binaries
+
+    # test helpers ------------------------------------------------------
+
+    def commands(self) -> List[str]:
+        return [" ".join(argv) for argv, _ in self.calls]
+
+    def find(self, prefix: str) -> List[Tuple[List[str], Optional[str]]]:
+        return [
+            (argv, stdin)
+            for argv, stdin in self.calls
+            if " ".join(argv).startswith(prefix)
+        ]

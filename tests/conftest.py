@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+JAX-facing tests run on a virtual 8-device CPU backend — the same trick
+the simulated JAX pods use (pods/jax-tpu-pod.yaml): XLA's host platform
+is forced to expose 8 devices so collectives, meshes, and shardings are
+exercised for real, with zero TPU hardware in the loop.
+
+Must run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))

@@ -1,0 +1,78 @@
+"""Manifest generation tests: parse the YAML back and assert structure."""
+
+import yaml
+
+from kind_tpu_sim import manifests
+from kind_tpu_sim import topology as topo
+from kind_tpu_sim.config import SimConfig
+
+
+def test_kind_config_tpu_worker_count_follows_topology():
+    cfg = SimConfig(vendor="tpu", tpu_topology="4x8")
+    doc = yaml.safe_load(manifests.kind_cluster_config(cfg))
+    roles = [n["role"] for n in doc["nodes"]]
+    assert roles.count("control-plane") == 1
+    assert roles.count("worker") == 4  # 32 chips / 8 per host
+    patch = doc["containerdConfigPatches"][0]
+    assert "localhost:5000" in patch
+    assert "kind-registry:5000" in patch
+
+
+def test_kind_config_gpu_worker_count():
+    cfg = SimConfig(vendor="rocm", gpu_workers=3)
+    doc = yaml.safe_load(manifests.kind_cluster_config(cfg))
+    assert [n["role"] for n in doc["nodes"]].count("worker") == 3
+
+
+def test_registry_configmap_round_trips():
+    cfg = SimConfig(registry_port=5555)
+    doc = yaml.safe_load(manifests.registry_configmap(cfg))
+    assert doc["metadata"]["namespace"] == "kube-public"
+    hosting = yaml.safe_load(doc["data"]["localRegistryHosting.v1"])
+    assert hosting["host"] == "localhost:5555"
+
+
+def test_tpu_plugin_daemonset_structure():
+    cfg = SimConfig(vendor="tpu")
+    doc = yaml.safe_load(
+        manifests.tpu_plugin_daemonset(cfg, "localhost:5000/tpu-device-plugin:dev")
+    )
+    spec = doc["spec"]["template"]["spec"]
+    assert spec["nodeSelector"] == {"hardware-type": "tpu"}
+    tol = spec["tolerations"][0]
+    assert tol["key"] == topo.TAINT_KEY and tol["effect"] == "NoSchedule"
+    ctr = spec["containers"][0]
+    assert ctr["securityContext"]["privileged"] is True
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["TPU_SIM_CHIPS"] == "8"
+    assert env["TPU_SIM_RESOURCE"] == "google.com/tpu"
+    assert env["TPU_SIM_TOPOLOGY"] == "4x4"
+    mounts = ctr["volumeMounts"]
+    assert mounts[0]["mountPath"] == manifests.KUBELET_DP_DIR
+    host_path = spec["volumes"][0]["hostPath"]
+    assert host_path["path"] == manifests.KUBELET_DP_DIR
+
+
+def test_gpu_plugin_daemonsets():
+    cfg = SimConfig(vendor="nvidia")
+    doc = yaml.safe_load(
+        manifests.gpu_plugin_daemonset(cfg, "nvidia", "img:dev")
+    )
+    ctr = doc["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in ctr.get("env", [])}
+    assert env["FAIL_ON_INIT_ERROR"] == "false"
+    assert doc["spec"]["template"]["spec"]["nodeSelector"] == {
+        "hardware-type": "gpu"
+    }
+
+    cfg = SimConfig(vendor="rocm")
+    doc = yaml.safe_load(manifests.gpu_plugin_daemonset(cfg, "rocm", "img:dev"))
+    assert doc["metadata"]["name"] == "amdgpu-device-plugin-daemonset"
+    assert "volumes" not in doc["spec"]["template"]["spec"]
+
+
+def test_containerd_hosts_toml():
+    cfg = SimConfig()
+    toml = manifests.containerd_hosts_toml(cfg)
+    assert 'host."http://kind-registry:5000"' in toml
+    assert '"pull", "resolve"' in toml

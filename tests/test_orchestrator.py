@@ -1,0 +1,236 @@
+"""Orchestrator pipeline tests over the fake runtime.
+
+The reference is untestable without docker (SURVEY.md §4: no unit
+tests).  Here every layer runs against :class:`FakeExecutor`, and tests
+assert on the exact external-command stream the pipeline would issue.
+"""
+
+import json
+
+import pytest
+
+from kind_tpu_sim.cli import Simulator, main
+from kind_tpu_sim.config import SimConfig
+from kind_tpu_sim.utils.shell import ExecResult, FakeExecutor
+
+NODES = (
+    "kind-tpu-sim-control-plane\n"
+    "kind-tpu-sim-worker\n"
+    "kind-tpu-sim-worker2\n"
+)
+
+
+def fake_executor(workers: int = 2) -> FakeExecutor:
+    names = ["kind-tpu-sim-control-plane"] + [
+        "kind-tpu-sim-worker" + ("" if i == 0 else str(i + 1))
+        for i in range(workers)
+    ]
+    node_list = "\n".join(names) + "\n"
+    return FakeExecutor(
+        rules={
+            "kubectl get nodes -o jsonpath": ExecResult(0, node_list),
+            "kind get nodes": ExecResult(0, node_list),
+            "kind get clusters": ExecResult(0, "kind-tpu-sim\n"),
+            "docker inspect -f {{.State.Running}}": ExecResult(1, "", "no such"),
+        }
+    )
+
+
+def make_sim(tmp_path, monkeypatch, **cfg_kwargs) -> Simulator:
+    monkeypatch.chdir(tmp_path)
+    cfg = SimConfig(runtime="fake", **cfg_kwargs)
+    ex = fake_executor(workers=cfg.workers)
+    return Simulator(cfg, executor=ex)
+
+
+def test_create_tpu_plugin_mode_command_stream(tmp_path, monkeypatch):
+    sim = make_sim(tmp_path, monkeypatch, vendor="tpu")
+    sim.create()
+    cmds = sim.executor.commands()
+
+    # L2: registry started and connected to the kind network
+    assert any(c.startswith("docker run -d --restart=always -p 5000:5000")
+               for c in cmds)
+    assert "docker network connect kind kind-registry" in cmds
+
+    # L3: cluster created from the generated config
+    assert any(c.startswith("kind create cluster --name kind-tpu-sim")
+               for c in cmds)
+    # Both workers labeled with the full topology set, in worker-id order
+    assert any("kind-tpu-sim.dev/worker-id=0" in c and "worker " in c
+               for c in cmds)
+    assert any("kind-tpu-sim.dev/worker-id=1" in c and "worker2" in c
+               for c in cmds)
+    assert any(
+        "cloud.google.com/gke-tpu-accelerator=tpu-v5-lite-podslice" in c
+        for c in cmds
+    )
+    assert any("google.com/tpu=present:NoSchedule" in c for c in cmds)
+
+    # plugin mode: no status-capacity patch
+    assert not any("--subresource=status" in c for c in cmds)
+
+    # L4: in-repo plugin built, pushed, deployed, rolled out
+    assert any(
+        c.startswith("docker build -t localhost:5000/tpu-device-plugin:dev")
+        for c in cmds
+    )
+    assert "docker push localhost:5000/tpu-device-plugin:dev" in cmds
+    applies = sim.executor.find("kubectl apply -f -")
+    assert any(
+        stdin and "tpu-sim-device-plugin" in stdin for _, stdin in applies
+    )
+    assert any("rollout status daemonset/tpu-sim-device-plugin" in c
+               for c in cmds)
+
+    # containerd mirror configured on every node
+    assert sum(1 for c in cmds if "mkdir -p /etc/containerd/certs.d" in c) == 3
+
+
+def test_create_tpu_patch_mode_skip_plugin(tmp_path, monkeypatch):
+    sim = make_sim(
+        tmp_path, monkeypatch, vendor="tpu", capacity_mode="patch"
+    )
+    sim.create(skip_plugin=True)
+    cmds = sim.executor.commands()
+    patches = [c for c in cmds if "--subresource=status" in c]
+    assert len(patches) == 2
+    assert all("google.com~1tpu" in c and '"8"' in c for c in patches)
+    assert not any("docker build" in c for c in cmds)
+
+
+def test_skip_plugin_requires_patch_mode(tmp_path, monkeypatch):
+    sim = make_sim(tmp_path, monkeypatch, vendor="tpu")
+    with pytest.raises(RuntimeError, match="capacity-mode=patch"):
+        sim.create(skip_plugin=True)
+
+
+def test_create_rocm_parity(tmp_path, monkeypatch):
+    sim = make_sim(tmp_path, monkeypatch, vendor="rocm")
+    sim.create()
+    cmds = sim.executor.commands()
+    assert any("hardware-type=gpu" in c for c in cmds)
+    assert any("rocm.amd.com/gpu.present=true" in c for c in cmds)
+    assert any("gpu=true:NoSchedule" in c for c in cmds)
+    patches = [c for c in cmds if "amd.com~1gpu" in c]
+    assert len(patches) == 2 and all('"2"' in c for c in patches)
+    # vendor plugin repo cloned (fake executor just records it)
+    assert any(c.startswith("git clone") for c in cmds)
+
+
+def test_create_larger_slice_scales_workers(tmp_path, monkeypatch):
+    sim = make_sim(tmp_path, monkeypatch, vendor="tpu", tpu_topology="4x8")
+    sim.create()
+    label_cmds = [
+        c for c in sim.executor.commands()
+        if "kind-tpu-sim.dev/worker-id=" in c
+    ]
+    assert len(label_cmds) == 4
+
+
+def test_worker_count_mismatch_fails(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = SimConfig(runtime="fake", vendor="tpu", tpu_topology="4x8")
+    ex = fake_executor(workers=2)  # cluster with 2 workers, slice needs 4
+    sim = Simulator(cfg, executor=ex)
+    with pytest.raises(RuntimeError, match="needs 4"):
+        sim.create()
+
+
+def test_delete_idempotent(tmp_path, monkeypatch):
+    sim = make_sim(tmp_path, monkeypatch)
+    sim.delete()
+    cmds = sim.executor.commands()
+    assert "kind delete cluster --name kind-tpu-sim" in cmds
+    assert "docker stop kind-registry" in cmds
+    assert "docker rm kind-registry" in cmds
+
+    # cluster absent -> no kind delete issued
+    sim2 = Simulator(
+        SimConfig(runtime="fake"),
+        executor=FakeExecutor(rules={
+            "kind get clusters": ExecResult(0, "other-cluster\n"),
+        }),
+    )
+    sim2.delete()
+    assert not any(
+        c.startswith("kind delete") for c in sim2.executor.commands()
+    )
+
+
+def test_load_image_docker_and_podman(tmp_path, monkeypatch):
+    sim = make_sim(tmp_path, monkeypatch, image_name="example/image:1")
+    sim.load()
+    assert (
+        "kind load docker-image example/image:1 --name kind-tpu-sim"
+        in sim.executor.commands()
+    )
+
+    with pytest.raises(ValueError):
+        make_sim(tmp_path, monkeypatch).load()
+
+
+def test_plugin_rollout_failure_raises(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = SimConfig(runtime="fake", vendor="tpu")
+    ex = fake_executor()
+    ex.rules["kubectl -n kube-system rollout status"] = ExecResult(
+        1, "", "timed out"
+    )
+    sim = Simulator(cfg, executor=ex)
+    with pytest.raises(RuntimeError, match="not ready"):
+        sim.create()
+
+
+def test_cli_end_to_end_fake(tmp_path, monkeypatch, capsys):
+    monkeypatch.chdir(tmp_path)
+    rc = main([
+        "create", "tpu", "--runtime=fake",
+        "--capacity-mode=patch", "--skip-plugin",
+        "--timing-json", str(tmp_path / "timing.json"),
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "Simulated tpu kind cluster is ready" in captured.out
+    timing = json.loads((tmp_path / "timing.json").read_text())
+    assert "cluster-create" in timing and "total" in timing
+
+    rc = main(["create", "tpu", "--runtime=fake", "--skip-plugin"])
+    assert rc == 1  # skip-plugin without patch mode
+
+
+def test_cli_status_fake(monkeypatch, capsys):
+    node = {
+        "metadata": {
+            "name": "w0",
+            "labels": {
+                "cloud.google.com/gke-tpu-topology": "4x4",
+                "kind-tpu-sim.dev/worker-id": "0",
+                "kind-tpu-sim.dev/host-coord": "0,0",
+            },
+        },
+        "status": {"capacity": {"google.com/tpu": "8", "cpu": "4"}},
+    }
+    pod = {
+        "kind": "Pod",
+        "status": {"conditions": [
+            {"type": "PodScheduled", "status": "True",
+             "lastTransitionTime": "2026-07-29T00:00:00Z"},
+            {"type": "Ready", "status": "True",
+             "lastTransitionTime": "2026-07-29T00:00:07Z"},
+        ]},
+    }
+    ex = FakeExecutor(rules={
+        "kubectl get nodes -o json": ExecResult(
+            0, json.dumps({"items": [node]})
+        ),
+        "kubectl get pods -A -o json": ExecResult(
+            0, json.dumps({"items": [pod]})
+        ),
+    })
+    sim = Simulator(SimConfig(runtime="fake"), executor=ex)
+    report = sim.status()
+    out = capsys.readouterr().out
+    assert report["nodes"][0]["accelerators"] == {"google.com/tpu": "8"}
+    assert report["ready_latency"]["p50_s"] == 7.0
+    assert "google.com/tpu=8" in out

@@ -1,0 +1,122 @@
+"""Unit tests for TPU slice topology math.
+
+The reference has no unit tests at all (SURVEY.md §4); topology math is
+new surface the TPU build introduces, so it gets direct coverage.
+"""
+
+import pytest
+
+from kind_tpu_sim import topology as T
+
+
+def test_default_slice_is_v5e16_two_hosts():
+    s = T.make_slice()
+    assert s.spec.gke_type == "tpu-v5-lite-podslice"
+    assert s.num_chips == 16
+    assert s.num_hosts == 2
+    assert s.chips_per_host == 8
+    assert s.accelerator_type == "v5litepod-16"
+
+
+def test_parse_topology_rejects_garbage():
+    for bad in ("", "4x", "x4", "4x-1", "0x4", "axb"):
+        with pytest.raises(ValueError):
+            T.parse_topology(bad)
+    assert T.parse_topology("2X4") == (2, 4)
+
+
+def test_single_host_topologies():
+    for topo, chips in (("1x1", 1), ("2x2", 4), ("2x4", 8)):
+        s = T.make_slice(topology=topo)
+        assert s.num_hosts == 1
+        assert s.chips_per_host == chips
+        assert s.chip_bounds_for_host() == T.parse_topology(topo)
+
+
+def test_multi_host_v5e_grids():
+    cases = {
+        "4x4": (2, (2, 1)),
+        "4x8": (4, (2, 2)),
+        "8x8": (8, (4, 2)),
+        "8x16": (16, (4, 4)),
+        "16x16": (32, (8, 4)),
+    }
+    for topo, (hosts, grid) in cases.items():
+        s = T.make_slice(topology=topo)
+        assert s.num_hosts == hosts, topo
+        assert s.host_grid == grid, topo
+        assert s.chips_per_host == 8, topo
+
+
+def test_v4_3d_topology():
+    s = T.make_slice("tpu-v4-podslice", "2x2x4")
+    assert s.num_chips == 16
+    assert s.chips_per_host == 4
+    assert s.num_hosts == 4
+    # v4 names count TensorCores (2 per chip).
+    assert s.accelerator_type == "v4-32"
+    env = s.worker_env(0)
+    assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+    assert env["TPU_HOST_BOUNDS"] == "1,1,4"
+
+
+def test_worker_env_contract():
+    s = T.make_slice()  # v5e 4x4, 2 hosts
+    env0 = s.worker_env(0)
+    env1 = s.worker_env(1)
+    assert env0["TPU_ACCELERATOR_TYPE"] == "v5litepod-16"
+    assert env0["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,4,1"
+    assert env0["TPU_HOST_BOUNDS"] == "2,1,1"
+    assert env0["TPU_WORKER_ID"] == "0"
+    assert env1["TPU_WORKER_ID"] == "1"
+    assert env0["TPU_WORKER_HOSTNAMES"] == env1["TPU_WORKER_HOSTNAMES"]
+    assert len(env0["TPU_WORKER_HOSTNAMES"].split(",")) == 2
+    with pytest.raises(ValueError):
+        s.worker_env(2)
+
+
+def test_node_labels_and_coords():
+    s = T.make_slice()
+    labels0 = s.node_labels(0)
+    labels1 = s.node_labels(1)
+    assert labels0[T.LABEL_HARDWARE_TYPE] == "tpu"
+    assert labels0[T.LABEL_ACCELERATOR] == "tpu-v5-lite-podslice"
+    assert labels0[T.LABEL_TOPOLOGY] == "4x4"
+    assert labels0[T.LABEL_WORKER_ID] == "0"
+    assert labels0[T.LABEL_HOST_COORD] == "0,0"
+    assert labels1[T.LABEL_HOST_COORD] == "1,0"
+
+
+def test_device_ids_stable_and_disjoint():
+    s = T.make_slice()
+    ids0 = s.device_ids(0)
+    ids1 = s.device_ids(1)
+    assert len(ids0) == len(ids1) == 8
+    assert not set(ids0) & set(ids1)
+    assert ids0[0] == "tpu-0-0"
+    assert ids1[0] == "tpu-1-8"
+
+
+def test_invalid_multihost_shapes_rejected():
+    # 1x16 is multi-host-sized (16 chips) but can't tile into 2x4 hosts.
+    for bad in ("1x16", "8x2", "16x1"):
+        with pytest.raises(ValueError):
+            T.make_slice(topology=bad)
+
+
+def test_out_of_range_worker_rejected_everywhere():
+    s = T.make_slice()
+    for fn in (s.node_labels, s.worker_env, s.device_ids):
+        with pytest.raises(ValueError):
+            fn(2)
+        with pytest.raises(ValueError):
+            fn(-1)
+
+
+def test_mismatched_rank_rejected():
+    with pytest.raises(ValueError):
+        T.make_slice("tpu-v4-podslice", "4x4")
+    with pytest.raises(ValueError):
+        T.make_slice("tpu-v5-lite-podslice", "2x2x2")
+    with pytest.raises(ValueError):
+        T.make_slice(accelerator="tpu-v9")
