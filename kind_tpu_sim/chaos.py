@@ -1,0 +1,80 @@
+"""Fault injection for the simulated cluster.
+
+The reference has no fault-injection capability (SURVEY.md §5 "failure
+detection — minimal"); this subsystem exceeds it deliberately:
+
+* ``fail`` / ``heal`` — drive the device plugin's health channel by
+  writing device IDs into the node's unhealthy file
+  (manifests.UNHEALTHY_FILE). The plugin's ListAndWatch poller picks
+  the change up within ~1s and kubelet reduces the node's allocatable
+  count — the durable-capacity behavior a one-shot status patch
+  (kind-gpu-sim.sh:113,116) cannot model.
+* ``kill-node`` / ``start-node`` — stop/start the kind node container
+  itself to exercise scheduler failover of accelerator pods.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from kind_tpu_sim import manifests
+from kind_tpu_sim.cluster import ClusterManager
+from kind_tpu_sim.config import SimConfig
+from kind_tpu_sim.runtime import ContainerRuntime
+
+log = logging.getLogger("kind-tpu-sim")
+
+
+class ChaosManager:
+    def __init__(self, cfg: SimConfig, runtime: ContainerRuntime,
+                 cluster: ClusterManager):
+        self.cfg = cfg
+        self.rt = runtime
+        self.cluster = cluster
+
+    def resolve_node(self, node: Optional[str],
+                     worker: Optional[int]) -> str:
+        if node:
+            return node
+        workers = self.cluster.worker_nodes()
+        if worker is None:
+            raise ValueError("specify --node or --worker")
+        if not 0 <= worker < len(workers):
+            raise ValueError(
+                f"--worker {worker} out of range ({len(workers)} workers)"
+            )
+        return workers[worker]
+
+    def fail_devices(self, node: str, device_ids: List[str]) -> None:
+        """Mark devices unhealthy on a node (empty list = all)."""
+        if not device_ids:
+            s = self.cfg.slice
+            workers = self.cluster.worker_nodes()
+            device_ids = s.device_ids(workers.index(node))
+        content = "\n".join(device_ids) + "\n"
+        self.rt.run(
+            "exec", node, "mkdir", "-p", manifests.SIM_STATE_DIR
+        )
+        self.rt.run(
+            "exec", "-i", node, "sh", "-c",
+            f"cat > {manifests.UNHEALTHY_FILE}",
+            input_text=content,
+        )
+        log.info("marked %d device(s) unhealthy on %s",
+                 len(device_ids), node)
+
+    def heal(self, node: str) -> None:
+        self.rt.run(
+            "exec", node, "sh", "-c",
+            f"rm -f {manifests.UNHEALTHY_FILE}",
+        )
+        log.info("healed all devices on %s", node)
+
+    def kill_node(self, node: str) -> None:
+        self.rt.run("stop", node)
+        log.info("stopped node container %s", node)
+
+    def start_node(self, node: str) -> None:
+        self.rt.run("start", node)
+        log.info("started node container %s", node)

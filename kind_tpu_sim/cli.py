@@ -116,6 +116,28 @@ def build_parser() -> argparse.ArgumentParser:
     )
     status.add_argument("--json", action="store_true", dest="as_json")
 
+    chaos = sub.add_parser(
+        "chaos", parents=[common],
+        help="fault injection against the simulated devices/nodes",
+    )
+    chaos.add_argument(
+        "action",
+        choices=["fail", "heal", "kill-node", "start-node"],
+    )
+    chaos.add_argument("--node", default=None,
+                       help="target node container name")
+    chaos.add_argument("--worker", type=int, default=None,
+                       help="target worker by id (alternative to --node)")
+    chaos.add_argument(
+        "--devices", default="",
+        help="comma-separated device IDs for 'fail' (default: all)",
+    )
+    chaos.add_argument("--topology", default=topo.DEFAULT_TOPOLOGY)
+    chaos.add_argument(
+        "--accelerator", default=topo.DEFAULT_ACCELERATOR,
+        choices=sorted(topo.ACCELERATORS),
+    )
+
     return parser
 
 
@@ -134,6 +156,11 @@ def config_from_args(args: argparse.Namespace) -> SimConfig:
             capacity_mode=args.capacity_mode,
             gpu_workers=args.gpu_workers,
             gpus_per_node=args.gpus_per_node,
+        )
+    elif args.command == "chaos":
+        kwargs.update(
+            accelerator=args.accelerator,
+            tpu_topology=args.topology,
         )
     if getattr(args, "image_name", None):
         kwargs["image_name"] = args.image_name
@@ -205,6 +232,22 @@ class Simulator:
     def load(self) -> None:
         self.cluster.load_image(self.cfg.image_name)
 
+    def chaos(self, action: str, node: Optional[str] = None,
+              worker: Optional[int] = None,
+              devices: Optional[List[str]] = None) -> None:
+        from kind_tpu_sim.chaos import ChaosManager
+
+        mgr = ChaosManager(self.cfg, self.runtime, self.cluster)
+        target = mgr.resolve_node(node, worker)
+        if action == "fail":
+            mgr.fail_devices(target, devices or [])
+        elif action == "heal":
+            mgr.heal(target)
+        elif action == "kill-node":
+            mgr.kill_node(target)
+        elif action == "start-node":
+            mgr.start_node(target)
+
     def status(self, as_json: bool = False) -> dict:
         nodes_json = kubectl(
             self.executor, "get", "nodes", "-o", "json"
@@ -273,6 +316,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             sim.load()
         elif args.command == "status":
             sim.status(as_json=args.as_json)
+        elif args.command == "chaos":
+            sim.chaos(
+                args.action, node=args.node, worker=args.worker,
+                devices=[d for d in args.devices.split(",") if d],
+            )
         if isinstance(sim.executor, FakeExecutor) and cfg.verbose:
             print("-- fake runtime command stream --", file=sys.stderr)
             for cmd in sim.executor.commands():

@@ -33,6 +33,22 @@ log = logging.getLogger("kind-tpu-sim")
 KIND_CONFIG_FILE = "kind-config.yaml"
 
 
+def worker_order_key(node_name: str) -> tuple:
+    """Sort key matching the C++ plugin's WorkerIdFromNodeName: the
+    numeric suffix after '-worker' ('' counts as 1)."""
+    marker = "-worker"
+    pos = node_name.rfind(marker)
+    if pos < 0:
+        return (node_name, 0)
+    prefix = node_name[:pos]
+    suffix = node_name[pos + len(marker):]
+    if suffix == "":
+        return (prefix, 1)
+    if suffix.isdigit():
+        return (prefix, int(suffix))
+    return (node_name, 0)
+
+
 class ClusterManager:
     def __init__(self, cfg: SimConfig, runtime: ContainerRuntime,
                  registry: LocalRegistry):
@@ -66,10 +82,16 @@ class ClusterManager:
             self.ex, "get", "nodes", "-o",
             "jsonpath={range .items[*]}{.metadata.name}{\"\\n\"}{end}",
         ).stdout
-        return sorted(
+        workers = [
             n for n in out.splitlines()
             if n.strip() and "control-plane" not in n
-        )
+        ]
+        # Natural order by kind's worker numbering (worker, worker2,
+        # worker3, ...) so enumerate() agrees with the plugin's
+        # NODE_NAME-derived worker id (device_plugin.cc
+        # WorkerIdFromNodeName) even past 10 workers, where plain
+        # lexicographic sort would interleave worker10 before worker2.
+        return sorted(workers, key=worker_order_key)
 
     def prepare_worker_nodes(self) -> None:
         """Label/taint workers and (optionally) patch fake capacity."""

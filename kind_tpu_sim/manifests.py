@@ -24,6 +24,11 @@ KUBELET_DP_DIR = "/var/lib/kubelet/device-plugins"
 PLUGIN_APP_LABEL = "tpu-sim-device-plugin"
 PLUGIN_NAMESPACE = "kube-system"
 
+# Host directory for simulator runtime state; the chaos subcommand
+# writes device IDs into UNHEALTHY_FILE on a node to fail them.
+SIM_STATE_DIR = "/var/run/tpu-sim"
+UNHEALTHY_FILE = SIM_STATE_DIR + "/unhealthy"
+
 
 def to_yaml(obj: object) -> str:
     return yaml.safe_dump(obj, sort_keys=False, default_flow_style=False)
@@ -115,13 +120,26 @@ def tpu_plugin_daemonset(cfg: SimConfig, image: str) -> str:
     the slice-topology env block rather than FAIL_ON_INIT_ERROR.
     """
     s = cfg.slice
+    # Slice-global identity shared by every worker; the plugin derives
+    # the per-node TPU_WORKER_ID from NODE_NAME (plugin/src/
+    # device_plugin.cc WorkerIdFromNodeName).
+    w0 = s.worker_env(0)
     env = [
         {"name": "TPU_SIM_CHIPS", "value": str(s.chips_per_host)},
         {"name": "TPU_SIM_RESOURCE", "value": RESOURCE_BY_VENDOR["tpu"]},
         {"name": "TPU_SIM_ACCELERATOR", "value": s.spec.gke_type},
         {"name": "TPU_SIM_TOPOLOGY", "value": topo.format_topology(s.dims)},
-        # The plugin reads its worker identity from the node labels the
-        # orchestrator applied; pass the node name down for that lookup.
+        {
+            "name": "TPU_SIM_ACCELERATOR_TYPE",
+            "value": w0["TPU_ACCELERATOR_TYPE"],
+        },
+        {
+            "name": "TPU_SIM_CHIPS_PER_HOST_BOUNDS",
+            "value": w0["TPU_CHIPS_PER_HOST_BOUNDS"],
+        },
+        {"name": "TPU_SIM_HOST_BOUNDS", "value": w0["TPU_HOST_BOUNDS"]},
+        {"name": "TPU_SIM_HOSTNAMES", "value": w0["TPU_WORKER_HOSTNAMES"]},
+        {"name": "TPU_SIM_UNHEALTHY_FILE", "value": UNHEALTHY_FILE},
         {
             "name": "NODE_NAME",
             "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}},
@@ -154,7 +172,11 @@ def tpu_plugin_daemonset(cfg: SimConfig, image: str) -> str:
                                 {
                                     "name": "device-plugin",
                                     "mountPath": KUBELET_DP_DIR,
-                                }
+                                },
+                                {
+                                    "name": "sim-state",
+                                    "mountPath": SIM_STATE_DIR,
+                                },
                             ],
                         }
                     ],
@@ -165,7 +187,14 @@ def tpu_plugin_daemonset(cfg: SimConfig, image: str) -> str:
                                 "path": KUBELET_DP_DIR,
                                 "type": "DirectoryOrCreate",
                             },
-                        }
+                        },
+                        {
+                            "name": "sim-state",
+                            "hostPath": {
+                                "path": SIM_STATE_DIR,
+                                "type": "DirectoryOrCreate",
+                            },
+                        },
                     ],
                 },
             },

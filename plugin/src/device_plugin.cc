@@ -1,0 +1,394 @@
+#include "device_plugin.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <chrono>
+#include <fstream>
+#include <limits>
+#include <sstream>
+
+#include "deviceplugin.pb.h"
+
+namespace tpusim {
+namespace {
+
+constexpr char kApiVersion[] = "v1beta1";
+constexpr char kServicePrefix[] = "/v1beta1.DevicePlugin/";
+
+void LogLine(const std::string& msg) {
+  fprintf(stderr, "[tpu-device-plugin] %s\n", msg.c_str());
+}
+
+std::string GetEnv(const char* name, const std::string& fallback = "") {
+  const char* v = getenv(name);
+  return v && *v ? std::string(v) : fallback;
+}
+
+std::vector<std::string> SplitLines(const std::string& text) {
+  std::vector<std::string> out;
+  std::istringstream is(text);
+  std::string line;
+  while (std::getline(is, line)) {
+    while (!line.empty() && (line.back() == '\r' || line.back() == ' ')) {
+      line.pop_back();
+    }
+    if (!line.empty()) out.push_back(line);
+  }
+  return out;
+}
+
+// Local chip index within this host, parsed from "tpu-<w>-<global>".
+int LocalChipIndex(const std::string& device_id, int worker_id, int chips) {
+  auto pos = device_id.rfind('-');
+  if (pos == std::string::npos) return 0;
+  int global = atoi(device_id.c_str() + pos + 1);
+  int local = global - worker_id * chips;
+  return local < 0 ? 0 : local;
+}
+
+}  // namespace
+
+int WorkerIdFromNodeName(const std::string& node_name) {
+  const std::string marker = "-worker";
+  auto pos = node_name.rfind(marker);
+  if (pos == std::string::npos ||
+      pos + marker.size() > node_name.size()) {
+    return 0;
+  }
+  std::string suffix = node_name.substr(pos + marker.size());
+  if (suffix.empty()) return 0;          // "...-worker" is worker 0
+  for (char c : suffix) {
+    if (!isdigit(c)) return 0;
+  }
+  return atoi(suffix.c_str()) - 1;       // "...-worker2" is worker 1
+}
+
+PluginConfig PluginConfig::FromEnv() {
+  PluginConfig cfg;
+  cfg.socket_dir = GetEnv("TPU_SIM_SOCKET_DIR", cfg.socket_dir);
+  cfg.socket_name = GetEnv("TPU_SIM_SOCKET_NAME", cfg.socket_name);
+  cfg.resource = GetEnv("TPU_SIM_RESOURCE", cfg.resource);
+  cfg.chips = atoi(GetEnv("TPU_SIM_CHIPS", "8").c_str());
+  if (cfg.chips < 1) cfg.chips = 1;
+  cfg.worker_id = WorkerIdFromNodeName(GetEnv("NODE_NAME"));
+  cfg.accelerator_type = GetEnv("TPU_SIM_ACCELERATOR_TYPE");
+  cfg.chips_per_host_bounds = GetEnv("TPU_SIM_CHIPS_PER_HOST_BOUNDS");
+  cfg.host_bounds = GetEnv("TPU_SIM_HOST_BOUNDS");
+  cfg.hostnames = GetEnv("TPU_SIM_HOSTNAMES");
+  cfg.unhealthy_file = GetEnv("TPU_SIM_UNHEALTHY_FILE");
+
+  // Single-host defaults matching kind_tpu_sim.topology for a
+  // standalone plugin (v5e host shapes).
+  if (cfg.chips_per_host_bounds.empty()) {
+    switch (cfg.chips) {
+      case 1: cfg.chips_per_host_bounds = "1,1,1"; break;
+      case 4: cfg.chips_per_host_bounds = "2,2,1"; break;
+      case 8: cfg.chips_per_host_bounds = "2,4,1"; break;
+      default:
+        cfg.chips_per_host_bounds = std::to_string(cfg.chips) + ",1,1";
+    }
+  }
+  if (cfg.host_bounds.empty()) cfg.host_bounds = "1,1,1";
+  if (cfg.accelerator_type.empty()) {
+    cfg.accelerator_type = "v5litepod-" + std::to_string(cfg.chips);
+  }
+  if (cfg.hostnames.empty()) cfg.hostnames = "localhost";
+  return cfg;
+}
+
+DevicePlugin::DevicePlugin(PluginConfig cfg) : cfg_(std::move(cfg)) {}
+
+DevicePlugin::~DevicePlugin() { Stop(); }
+
+std::vector<std::string> DevicePlugin::DeviceIds() const {
+  std::vector<std::string> ids;
+  int base = cfg_.worker_id * cfg_.chips;
+  for (int i = 0; i < cfg_.chips; ++i) {
+    ids.push_back("tpu-" + std::to_string(cfg_.worker_id) + "-" +
+                  std::to_string(base + i));
+  }
+  return ids;
+}
+
+std::set<std::string> DevicePlugin::UnhealthySet() const {
+  std::set<std::string> out;
+  if (cfg_.unhealthy_file.empty()) return out;
+  std::ifstream fh(cfg_.unhealthy_file);
+  if (!fh) return out;
+  std::stringstream buf;
+  buf << fh.rdbuf();
+  for (const auto& line : SplitLines(buf.str())) out.insert(line);
+  return out;
+}
+
+std::vector<std::pair<std::string, std::string>> DevicePlugin::AllocateEnv(
+    const std::vector<std::string>& device_ids) const {
+  std::string visible;
+  std::string id_list;
+  for (const auto& id : device_ids) {
+    if (!visible.empty()) {
+      visible += ",";
+      id_list += ",";
+    }
+    visible +=
+        std::to_string(LocalChipIndex(id, cfg_.worker_id, cfg_.chips));
+    id_list += id;
+  }
+  return {
+      {"TPU_ACCELERATOR_TYPE", cfg_.accelerator_type},
+      {"TPU_CHIPS_PER_HOST_BOUNDS", cfg_.chips_per_host_bounds},
+      {"TPU_HOST_BOUNDS", cfg_.host_bounds},
+      {"TPU_WORKER_ID", std::to_string(cfg_.worker_id)},
+      {"TPU_WORKER_HOSTNAMES", cfg_.hostnames},
+      {"TPU_SKIP_MDS_QUERY", "true"},
+      {"TPU_VISIBLE_CHIPS", visible},
+      {"TPU_SIM_DEVICE_IDS", id_list},
+  };
+}
+
+void DevicePlugin::InstallHandlers() {
+  using grpc::Status;
+
+  server_->RegisterUnary(
+      std::string(kServicePrefix) + "GetDevicePluginOptions",
+      [](const std::string&, std::string* response) -> Status {
+        v1beta1::DevicePluginOptions options;
+        options.set_pre_start_required(false);
+        options.set_get_preferred_allocation_available(true);
+        options.SerializeToString(response);
+        return {};
+      });
+
+  server_->RegisterUnary(
+      std::string(kServicePrefix) + "PreStartContainer",
+      [](const std::string&, std::string* response) -> Status {
+        v1beta1::PreStartContainerResponse resp;
+        resp.SerializeToString(response);
+        return {};
+      });
+
+  server_->RegisterUnary(
+      std::string(kServicePrefix) + "Allocate",
+      [this](const std::string& request, std::string* response) -> Status {
+        v1beta1::AllocateRequest req;
+        if (!req.ParseFromString(request)) {
+          return {grpc::kInvalidArgument, "bad AllocateRequest"};
+        }
+        v1beta1::AllocateResponse resp;
+        for (const auto& creq : req.container_requests()) {
+          auto* cresp = resp.add_container_responses();
+          std::vector<std::string> ids(creq.devicesids().begin(),
+                                       creq.devicesids().end());
+          for (const auto& [key, value] : AllocateEnv(ids)) {
+            (*cresp->mutable_envs())[key] = value;
+          }
+          // One /dev/accelN per allocated chip. Backed by /dev/null on
+          // the host: kind nodes have no real accelerator files, and a
+          // bind-mount of an existing char device is all containerd
+          // needs to materialize the path in the container.
+          for (const auto& id : ids) {
+            int local = LocalChipIndex(id, cfg_.worker_id, cfg_.chips);
+            auto* dev = cresp->add_devices();
+            dev->set_container_path("/dev/accel" + std::to_string(local));
+            dev->set_host_path("/dev/null");
+            dev->set_permissions("rw");
+          }
+        }
+        std::string log = "Allocate: ";
+        for (const auto& creq : req.container_requests()) {
+          log += "[" + std::to_string(creq.devicesids_size()) + " chips]";
+        }
+        LogLine(log);
+        resp.SerializeToString(response);
+        return {};
+      });
+
+  server_->RegisterUnary(
+      std::string(kServicePrefix) + "GetPreferredAllocation",
+      [this](const std::string& request, std::string* response) -> Status {
+        v1beta1::PreferredAllocationRequest req;
+        if (!req.ParseFromString(request)) {
+          return {grpc::kInvalidArgument, "bad PreferredAllocationRequest"};
+        }
+        v1beta1::PreferredAllocationResponse resp;
+        for (const auto& creq : req.container_requests()) {
+          auto* cresp = resp.add_container_responses();
+          // ICI-locality simulation: prefer a contiguous run of chip
+          // indexes (a compact sub-grid of the host's 2x4 block)
+          // containing all must-include devices.
+          std::vector<std::string> available(
+              creq.available_deviceids().begin(),
+              creq.available_deviceids().end());
+          std::sort(available.begin(), available.end(),
+                    [this](const std::string& a, const std::string& b) {
+                      return LocalChipIndex(a, cfg_.worker_id, cfg_.chips) <
+                             LocalChipIndex(b, cfg_.worker_id, cfg_.chips);
+                    });
+          std::set<std::string> must(creq.must_include_deviceids().begin(),
+                                     creq.must_include_deviceids().end());
+          size_t want = static_cast<size_t>(creq.allocation_size());
+          if (want > available.size()) want = available.size();
+          size_t best_start = 0;
+          int best_spread = std::numeric_limits<int>::max();
+          for (size_t start = 0; start + want <= available.size();
+               ++start) {
+            std::set<std::string> window(available.begin() + start,
+                                         available.begin() + start + want);
+            bool has_must = true;
+            for (const auto& m : must) {
+              if (!window.count(m)) {
+                has_must = false;
+                break;
+              }
+            }
+            if (!has_must) continue;
+            int spread =
+                LocalChipIndex(available[start + want - 1],
+                               cfg_.worker_id, cfg_.chips) -
+                LocalChipIndex(available[start], cfg_.worker_id,
+                               cfg_.chips);
+            if (spread < best_spread) {
+              best_spread = spread;
+              best_start = start;
+            }
+          }
+          for (size_t i = best_start;
+               i < best_start + want && i < available.size(); ++i) {
+            cresp->add_deviceids(available[i]);
+          }
+        }
+        resp.SerializeToString(response);
+        return {};
+      });
+
+  server_->RegisterServerStreaming(
+      std::string(kServicePrefix) + "ListAndWatch",
+      [this](const std::string&, grpc::ServerStream* stream)
+          -> grpc::Status {
+        LogLine("ListAndWatch stream opened");
+        std::set<std::string> last_unhealthy = {"\x01__force_send__"};
+        while (!stopping_.load() && !stream->Cancelled()) {
+          std::set<std::string> unhealthy = UnhealthySet();
+          if (unhealthy != last_unhealthy) {
+            last_unhealthy = unhealthy;
+            v1beta1::ListAndWatchResponse resp;
+            for (const auto& id : DeviceIds()) {
+              auto* dev = resp.add_devices();
+              dev->set_id(id);
+              dev->set_health(unhealthy.count(id) ? "Unhealthy"
+                                                  : "Healthy");
+            }
+            std::string payload;
+            resp.SerializeToString(&payload);
+            if (!stream->Write(payload)) break;
+            LogLine("ListAndWatch: advertised " +
+                    std::to_string(resp.devices_size()) + " devices (" +
+                    std::to_string(unhealthy.size()) + " unhealthy)");
+            health_generation_.fetch_add(1);
+          }
+          std::this_thread::sleep_for(std::chrono::milliseconds(500));
+        }
+        LogLine("ListAndWatch stream closed");
+        return {};
+      });
+}
+
+bool DevicePlugin::Start() {
+  server_ = std::make_unique<grpc::Server>();
+  InstallHandlers();
+  if (!server_->Start(cfg_.endpoint_path())) {
+    LogLine("FATAL: cannot bind " + cfg_.endpoint_path());
+    return false;
+  }
+  LogLine("serving " + cfg_.resource + " (" + std::to_string(cfg_.chips) +
+          " chips, worker " + std::to_string(cfg_.worker_id) + ") on " +
+          cfg_.endpoint_path());
+  if (cfg_.register_with_kubelet) {
+    register_thread_ = std::thread([this] { RegisterLoop(); });
+  }
+  watchdog_thread_ = std::thread([this] { WatchdogLoop(); });
+  return true;
+}
+
+bool DevicePlugin::RegisterOnce(std::string* error) {
+  grpc::Client client;
+  if (!client.Connect(cfg_.kubelet_path())) {
+    *error = "cannot connect to " + cfg_.kubelet_path();
+    return false;
+  }
+  v1beta1::RegisterRequest req;
+  req.set_version(kApiVersion);
+  req.set_endpoint(cfg_.socket_name);
+  req.set_resource_name(cfg_.resource);
+  req.mutable_options()->set_pre_start_required(false);
+  req.mutable_options()->set_get_preferred_allocation_available(true);
+  std::string payload;
+  req.SerializeToString(&payload);
+  std::string response;
+  auto status =
+      client.Call("/v1beta1.Registration/Register", payload, &response);
+  if (!status.ok()) {
+    *error = "Register failed: " + status.message;
+    return false;
+  }
+  return true;
+}
+
+void DevicePlugin::RegisterLoop() {
+  int backoff_ms = 500;
+  while (!stopping_.load()) {
+    std::string error;
+    if (RegisterOnce(&error)) {
+      LogLine("registered with kubelet as " + cfg_.resource);
+      return;
+    }
+    LogLine("registration retry: " + error);
+    std::this_thread::sleep_for(std::chrono::milliseconds(backoff_ms));
+    if (backoff_ms < 8000) backoff_ms *= 2;
+  }
+}
+
+void DevicePlugin::WatchdogLoop() {
+  // A kubelet restart wipes the device-plugin directory; when our
+  // socket disappears we must re-bind and re-register (the restart
+  // resilience the reference gets for free from the battle-tested
+  // vendor plugins; SURVEY.md §5 failure detection).
+  while (!stopping_.load()) {
+    std::this_thread::sleep_for(std::chrono::seconds(2));
+    if (stopping_.load()) break;
+    struct stat st;
+    if (stat(cfg_.endpoint_path().c_str(), &st) != 0) {
+      LogLine("socket vanished (kubelet restart?); re-serving");
+      server_->Shutdown();
+      server_ = std::make_unique<grpc::Server>();
+      InstallHandlers();
+      if (!server_->Start(cfg_.endpoint_path())) {
+        LogLine("re-bind failed; will retry");
+        continue;
+      }
+      if (cfg_.register_with_kubelet) {
+        if (register_thread_.joinable()) register_thread_.join();
+        register_thread_ = std::thread([this] { RegisterLoop(); });
+      }
+    }
+  }
+}
+
+void DevicePlugin::Stop() {
+  if (stopping_.exchange(true)) return;
+  if (server_) server_->Shutdown();
+  if (register_thread_.joinable()) register_thread_.join();
+  if (watchdog_thread_.joinable()) watchdog_thread_.join();
+}
+
+void DevicePlugin::Wait() {
+  while (!stopping_.load()) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(200));
+  }
+}
+
+}  // namespace tpusim

@@ -1,0 +1,99 @@
+// Fake TPU device plugin: service logic.
+//
+// The simulator's source of durable google.com/tpu capacity — the
+// in-repo native replacement for the external vendor plugins the
+// reference clones and builds (kind-gpu-sim.sh:185,212; SURVEY.md §2
+// N1/N2). Serves the kubelet device-plugin v1beta1 API from
+// plugin/proto/deviceplugin.proto over the hand-rolled gRPC transport,
+// advertising N fake TPU chips whose identity (worker id, ICI bounds,
+// hostnames) mirrors kind_tpu_sim.topology.SliceTopology.
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "grpc_transport.h"
+
+namespace tpusim {
+
+struct PluginConfig {
+  std::string socket_dir = "/var/lib/kubelet/device-plugins";
+  std::string socket_name = "tpu-sim.sock";
+  std::string kubelet_socket;  // defaults to <socket_dir>/kubelet.sock
+  std::string resource = "google.com/tpu";
+  int chips = 8;
+  int worker_id = 0;
+
+  // libtpu identity forwarded into Allocate env (slice-global values
+  // are passed by the DaemonSet; worker_id is derived per node).
+  std::string accelerator_type;        // e.g. "v5litepod-16"
+  std::string chips_per_host_bounds;   // e.g. "2,4,1"
+  std::string host_bounds;             // e.g. "2,1,1"
+  std::string hostnames;               // comma-separated worker DNS names
+
+  // Fault injection: file listing unhealthy device IDs (one per line),
+  // polled by ListAndWatch. Absent/empty file = all healthy.
+  std::string unhealthy_file;
+
+  bool register_with_kubelet = true;
+
+  std::string endpoint_path() const {
+    return socket_dir + "/" + socket_name;
+  }
+  std::string kubelet_path() const {
+    return kubelet_socket.empty() ? socket_dir + "/kubelet.sock"
+                                  : kubelet_socket;
+  }
+
+  // Populate from TPU_SIM_* / NODE_NAME environment (DaemonSet
+  // contract established in kind_tpu_sim/manifests.py), then apply
+  // single-host defaults for anything still unset.
+  static PluginConfig FromEnv();
+};
+
+// Derives worker id from a kind node name: "...-worker" -> 0,
+// "...-workerN" -> N-1; anything else -> 0.
+int WorkerIdFromNodeName(const std::string& node_name);
+
+class DevicePlugin {
+ public:
+  explicit DevicePlugin(PluginConfig cfg);
+  ~DevicePlugin();
+
+  // Starts serving on the plugin socket (and registering with the
+  // kubelet if configured). Returns false if the socket can't bind.
+  bool Start();
+  void Stop();
+
+  // Blocks until Stop() (or a fatal serving error); runs the
+  // kubelet-restart watchdog meanwhile.
+  void Wait();
+
+  // Current device IDs (stable, matches SliceTopology.device_ids).
+  std::vector<std::string> DeviceIds() const;
+  std::set<std::string> UnhealthySet() const;
+
+  // Computed Allocate env for a set of allocated device IDs.
+  std::vector<std::pair<std::string, std::string>> AllocateEnv(
+      const std::vector<std::string>& device_ids) const;
+
+ private:
+  void RegisterLoop();
+  void WatchdogLoop();
+  bool RegisterOnce(std::string* error);
+  void InstallHandlers();
+
+  PluginConfig cfg_;
+  std::unique_ptr<grpc::Server> server_;
+  std::atomic<bool> stopping_{false};
+  std::atomic<uint64_t> health_generation_{0};
+  std::thread register_thread_;
+  std::thread watchdog_thread_;
+};
+
+}  // namespace tpusim

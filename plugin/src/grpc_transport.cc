@@ -1,0 +1,362 @@
+#include "grpc_transport.h"
+
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <cstdio>
+
+namespace tpusim::grpc {
+namespace {
+
+int ConnectUnix(const std::string& path) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (path.size() >= sizeof(addr.sun_path)) {
+    ::close(fd);
+    return -1;
+  }
+  strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int ListenUnix(const std::string& path) {
+  ::unlink(path.c_str());
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (path.size() >= sizeof(addr.sun_path)) {
+    ::close(fd);
+    return -1;
+  }
+  strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 16) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+std::vector<hpack::Header> ResponseHeaders() {
+  return {{":status", "200"}, {"content-type", "application/grpc"}};
+}
+
+std::vector<hpack::Header> Trailers(const Status& status) {
+  std::vector<hpack::Header> t = {
+      {"grpc-status", std::to_string(status.code)}};
+  if (!status.message.empty()) {
+    t.push_back({"grpc-message", status.message});
+  }
+  return t;
+}
+
+}  // namespace
+
+std::string EncodeMessage(const std::string& payload) {
+  std::string out;
+  out.reserve(5 + payload.size());
+  out.push_back('\0');  // uncompressed
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  out.push_back(static_cast<char>((len >> 24) & 0xff));
+  out.push_back(static_cast<char>((len >> 16) & 0xff));
+  out.push_back(static_cast<char>((len >> 8) & 0xff));
+  out.push_back(static_cast<char>(len & 0xff));
+  out.append(payload);
+  return out;
+}
+
+bool DrainMessages(std::string* buffer, std::vector<std::string>* out) {
+  while (buffer->size() >= 5) {
+    const uint8_t* p = reinterpret_cast<const uint8_t*>(buffer->data());
+    if (p[0] != 0) return false;  // compression never negotiated
+    uint32_t len = (static_cast<uint32_t>(p[1]) << 24) |
+                   (static_cast<uint32_t>(p[2]) << 16) |
+                   (static_cast<uint32_t>(p[3]) << 8) | p[4];
+    if (buffer->size() < 5 + static_cast<size_t>(len)) break;
+    out->push_back(buffer->substr(5, len));
+    buffer->erase(0, 5 + len);
+  }
+  return true;
+}
+
+// ---------------------------------------------------------------------
+// Server
+
+namespace {
+
+struct IncomingStream {
+  std::string path;
+  std::string body;
+};
+
+class StreamImpl : public ServerStream {
+ public:
+  StreamImpl(std::shared_ptr<http2::Connection> conn, uint32_t stream_id)
+      : conn_(std::move(conn)), stream_id_(stream_id) {}
+
+  bool Write(const std::string& message) override {
+    if (Cancelled()) return false;
+    return conn_->SendData(stream_id_, EncodeMessage(message), false);
+  }
+
+  bool Cancelled() const override {
+    return conn_->closed() || conn_->StreamReset(stream_id_);
+  }
+
+ private:
+  std::shared_ptr<http2::Connection> conn_;
+  uint32_t stream_id_;
+};
+
+}  // namespace
+
+Server::~Server() { Shutdown(); }
+
+void Server::RegisterUnary(const std::string& path, UnaryHandler handler) {
+  unary_[path] = std::move(handler);
+}
+
+void Server::RegisterServerStreaming(const std::string& path,
+                                     ServerStreamingHandler handler) {
+  streaming_[path] = std::move(handler);
+}
+
+bool Server::Start(const std::string& socket_path) {
+  listen_fd_ = ListenUnix(socket_path);
+  if (listen_fd_ < 0) return false;
+  socket_path_ = socket_path;
+  running_.store(true);
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  return true;
+}
+
+void Server::AcceptLoop() {
+  while (running_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    std::lock_guard<std::mutex> lock(conn_mu_);
+    conn_threads_.emplace_back([this, fd] { HandleConnection(fd); });
+  }
+}
+
+void Server::HandleConnection(int fd) {
+  auto conn = std::make_shared<http2::Connection>(fd, /*is_server=*/true);
+  auto streams = std::make_shared<std::map<uint32_t, IncomingStream>>();
+
+  auto dispatch = [this, conn, streams](uint32_t stream_id) {
+    auto it = streams->find(stream_id);
+    if (it == streams->end()) return;
+    IncomingStream in = std::move(it->second);
+    streams->erase(it);
+
+    std::vector<std::string> messages;
+    std::string body = std::move(in.body);
+    if (!DrainMessages(&body, &messages)) {
+      conn->SendHeaders(stream_id, {{":status", "200"},
+                                    {"content-type", "application/grpc"},
+                                    {"grpc-status",
+                                     std::to_string(kInternal)},
+                                    {"grpc-message", "bad message framing"}},
+                        true);
+      return;
+    }
+    std::string request = messages.empty() ? "" : messages.front();
+
+    auto uit = unary_.find(in.path);
+    if (uit != unary_.end()) {
+      std::string response;
+      Status status = uit->second(request, &response);
+      conn->SendHeaders(stream_id, ResponseHeaders(), false);
+      if (status.ok()) {
+        conn->SendData(stream_id, EncodeMessage(response), false);
+      }
+      conn->SendHeaders(stream_id, Trailers(status), true);
+      return;
+    }
+    auto sit = streaming_.find(in.path);
+    if (sit != streaming_.end()) {
+      ServerStreamingHandler handler = sit->second;
+      conn->SendHeaders(stream_id, ResponseHeaders(), false);
+      std::lock_guard<std::mutex> lock(conn_mu_);
+      conn_threads_.emplace_back(
+          [conn, stream_id, handler, request] {
+            StreamImpl stream(conn, stream_id);
+            Status status = handler(request, &stream);
+            if (!stream.Cancelled()) {
+              conn->SendHeaders(stream_id, Trailers(status), true);
+            }
+          });
+      return;
+    }
+    // Unknown method: trailers-only response.
+    conn->SendHeaders(stream_id,
+                      {{":status", "200"},
+                       {"content-type", "application/grpc"},
+                       {"grpc-status", std::to_string(kUnimplemented)},
+                       {"grpc-message", "unknown method " + in.path}},
+                      true);
+  };
+
+  http2::ConnectionCallbacks cb;
+  cb.on_headers = [streams, dispatch](uint32_t stream_id,
+                                      std::vector<hpack::Header> headers,
+                                      bool end_stream) {
+    IncomingStream& in = (*streams)[stream_id];
+    for (const auto& h : headers) {
+      if (h.name == ":path") in.path = h.value;
+    }
+    if (end_stream) dispatch(stream_id);
+  };
+  cb.on_data = [streams, dispatch](uint32_t stream_id, std::string data,
+                                   bool end_stream) {
+    (*streams)[stream_id].body.append(data);
+    if (end_stream) dispatch(stream_id);
+  };
+  conn->set_callbacks(std::move(cb));
+
+  if (conn->Start()) conn->Run();
+}
+
+void Server::Shutdown() {
+  if (!running_.exchange(false)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  std::vector<std::thread> threads;
+  {
+    std::lock_guard<std::mutex> lock(conn_mu_);
+    threads.swap(conn_threads_);
+  }
+  for (auto& t : threads) {
+    if (t.joinable()) t.join();
+  }
+  if (!socket_path_.empty()) ::unlink(socket_path_.c_str());
+}
+
+// ---------------------------------------------------------------------
+// Client
+
+Client::~Client() { Close(); }
+
+bool Client::Connect(const std::string& socket_path) {
+  int fd = ConnectUnix(socket_path);
+  if (fd < 0) return false;
+  conn_ = std::make_shared<http2::Connection>(fd, /*is_server=*/false);
+
+  http2::ConnectionCallbacks cb;
+  cb.on_headers = [this](uint32_t stream_id,
+                         std::vector<hpack::Header> headers,
+                         bool end_stream) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto& call = calls_[stream_id];
+    for (const auto& h : headers) {
+      if (h.name == "grpc-status") call.grpc_status = atoi(h.value.c_str());
+      if (h.name == "grpc-message") call.grpc_message = h.value;
+    }
+    if (end_stream) {
+      call.done = true;
+      cv_.notify_all();
+    }
+  };
+  cb.on_data = [this](uint32_t stream_id, std::string data,
+                      bool end_stream) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto& call = calls_[stream_id];
+    call.body.append(data);
+    if (end_stream) {
+      call.done = true;
+      cv_.notify_all();
+    }
+  };
+  cb.on_close = [this] {
+    std::lock_guard<std::mutex> lock(mu_);
+    for (auto& [id, call] : calls_) {
+      if (!call.done) {
+        call.done = true;
+        if (call.grpc_status < 0) {
+          call.grpc_status = kUnavailable;
+          call.grpc_message = "connection closed";
+        }
+      }
+    }
+    cv_.notify_all();
+  };
+  conn_->set_callbacks(std::move(cb));
+
+  if (!conn_->Start()) {
+    conn_.reset();
+    return false;
+  }
+  auto conn = conn_;
+  reader_ = std::thread([conn] { conn->Run(); });
+  return true;
+}
+
+Status Client::Call(const std::string& path, const std::string& request,
+                    std::string* response, int timeout_ms) {
+  if (!conn_ || conn_->closed()) {
+    return {kUnavailable, "not connected"};
+  }
+  uint32_t stream_id = conn_->NextStreamId();
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    calls_[stream_id] = PendingCall{};
+  }
+  std::vector<hpack::Header> headers = {
+      {":method", "POST"},       {":scheme", "http"},
+      {":path", path},           {":authority", "localhost"},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+  };
+  if (!conn_->SendHeaders(stream_id, headers, false) ||
+      !conn_->SendData(stream_id, EncodeMessage(request), true)) {
+    return {kUnavailable, "send failed"};
+  }
+  std::unique_lock<std::mutex> lock(mu_);
+  bool ok = cv_.wait_for(lock, std::chrono::milliseconds(timeout_ms),
+                         [this, stream_id] {
+                           auto it = calls_.find(stream_id);
+                           return it != calls_.end() && it->second.done;
+                         });
+  PendingCall call = calls_[stream_id];
+  calls_.erase(stream_id);
+  lock.unlock();
+  if (!ok) {
+    conn_->SendRstStream(stream_id, http2::kCancel);
+    return {kDeadlineExceeded, "timeout"};
+  }
+  if (call.grpc_status != 0) {
+    return {call.grpc_status < 0 ? kUnknown : call.grpc_status,
+            call.grpc_message};
+  }
+  std::vector<std::string> messages;
+  if (!DrainMessages(&call.body, &messages) || messages.empty()) {
+    if (response) response->clear();
+    return {kOk, ""};
+  }
+  if (response) *response = messages.front();
+  return {kOk, ""};
+}
+
+void Client::Close() {
+  if (conn_) conn_->Close();
+  if (reader_.joinable()) reader_.join();
+  conn_.reset();
+}
+
+}  // namespace tpusim::grpc

@@ -1,0 +1,120 @@
+// gRPC-over-HTTP/2 transport (unix domain sockets only).
+//
+// Implements the gRPC wire protocol — length-prefixed messages in DATA
+// frames, ':path'-based method dispatch, grpc-status trailers — on top
+// of the local http2 layer. Enough for the kubelet device-plugin API:
+// unary methods, server-streaming (ListAndWatch), and a unary client
+// (Register against kubelet.sock).
+#pragma once
+
+#include <atomic>
+#include <condition_variable>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "http2.h"
+
+namespace tpusim::grpc {
+
+// gRPC status codes (subset).
+enum StatusCode : int {
+  kOk = 0,
+  kUnknown = 2,
+  kInvalidArgument = 3,
+  kDeadlineExceeded = 4,
+  kUnimplemented = 12,
+  kInternal = 13,
+  kUnavailable = 14,
+};
+
+struct Status {
+  int code = kOk;
+  std::string message;
+  bool ok() const { return code == kOk; }
+};
+
+// 5-byte length-prefixed gRPC message framing.
+std::string EncodeMessage(const std::string& payload);
+// Pops complete messages off the front of *buffer. Returns false on a
+// malformed prefix (compressed flag set — we never negotiate it).
+bool DrainMessages(std::string* buffer, std::vector<std::string>* out);
+
+// ---------------------------------------------------------------------
+// Server
+
+class ServerStream {
+ public:
+  // Sends one message on the stream; false once cancelled/closed.
+  virtual bool Write(const std::string& message) = 0;
+  virtual bool Cancelled() const = 0;
+  virtual ~ServerStream() = default;
+};
+
+using UnaryHandler =
+    std::function<Status(const std::string& request, std::string* response)>;
+// Runs on a dedicated thread; return status becomes the trailer.
+using ServerStreamingHandler =
+    std::function<Status(const std::string& request, ServerStream* stream)>;
+
+class Server {
+ public:
+  ~Server();
+
+  void RegisterUnary(const std::string& path, UnaryHandler handler);
+  void RegisterServerStreaming(const std::string& path,
+                               ServerStreamingHandler handler);
+
+  // Binds the unix socket (removing any stale file) and starts the
+  // accept loop on a background thread.
+  bool Start(const std::string& socket_path);
+  void Shutdown();
+  bool running() const { return running_.load(); }
+
+ private:
+  void AcceptLoop();
+  void HandleConnection(int fd);
+
+  std::map<std::string, UnaryHandler> unary_;
+  std::map<std::string, ServerStreamingHandler> streaming_;
+  int listen_fd_ = -1;
+  std::string socket_path_;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+};
+
+// ---------------------------------------------------------------------
+// Client (unary only)
+
+class Client {
+ public:
+  ~Client();
+
+  bool Connect(const std::string& socket_path);
+  // Blocking unary call; authority is the ':authority' pseudo-header.
+  Status Call(const std::string& path, const std::string& request,
+              std::string* response, int timeout_ms = 10000);
+  void Close();
+
+ private:
+  struct PendingCall {
+    std::string body;
+    int grpc_status = -1;
+    std::string grpc_message;
+    bool done = false;
+  };
+
+  std::shared_ptr<http2::Connection> conn_;
+  std::thread reader_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<uint32_t, PendingCall> calls_;
+};
+
+}  // namespace tpusim::grpc

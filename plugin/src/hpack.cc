@@ -1,0 +1,273 @@
+#include "hpack.h"
+
+#include <array>
+#include <memory>
+
+#include "hpack_tables.h"
+
+namespace tpusim::hpack {
+namespace {
+
+constexpr size_t kEntryOverhead = 32;  // RFC 7541 §4.1
+
+size_t EntrySize(const Header& h) {
+  return h.name.size() + h.value.size() + kEntryOverhead;
+}
+
+// ---- Huffman decoding ------------------------------------------------
+//
+// A binary trie over the 257 canonical codes, built once. Walking one
+// bit at a time is plenty fast for header-sized inputs.
+
+struct HuffNode {
+  int16_t child[2] = {-1, -1};
+  int16_t symbol = -1;  // 0..255 byte, 256 = EOS
+};
+
+class HuffTree {
+ public:
+  HuffTree() {
+    nodes_.reserve(2 * 257);
+    nodes_.emplace_back();
+    for (int sym = 0; sym < 257; ++sym) {
+      const auto& hc = kHuffmanCodes[sym];
+      int node = 0;
+      for (int bit = hc.bits - 1; bit >= 0; --bit) {
+        int b = (hc.code >> bit) & 1;
+        int next = nodes_[node].child[b];
+        if (next < 0) {
+          next = static_cast<int>(nodes_.size());
+          nodes_.emplace_back();
+          nodes_[node].child[b] = static_cast<int16_t>(next);
+        }
+        node = next;
+      }
+      nodes_[node].symbol = static_cast<int16_t>(sym);
+    }
+  }
+
+  const HuffNode& at(int i) const { return nodes_[i]; }
+
+ private:
+  std::vector<HuffNode> nodes_;
+};
+
+const HuffTree& Tree() {
+  static const HuffTree* tree = new HuffTree();
+  return *tree;
+}
+
+}  // namespace
+
+bool HuffmanDecode(const uint8_t* data, size_t len, std::string* out) {
+  const HuffTree& tree = Tree();
+  int node = 0;
+  int bits_since_symbol = 0;   // bits consumed in the current partial code
+  bool all_ones = true;        // partial code must be a prefix of EOS
+  for (size_t i = 0; i < len; ++i) {
+    for (int bit = 7; bit >= 0; --bit) {
+      int b = (data[i] >> bit) & 1;
+      int next = tree.at(node).child[b];
+      if (next < 0) return false;
+      node = next;
+      ++bits_since_symbol;
+      if (!b) all_ones = false;
+      int16_t sym = tree.at(node).symbol;
+      if (sym >= 0) {
+        if (sym == 256) return false;  // EOS inside the stream
+        out->push_back(static_cast<char>(sym));
+        node = 0;
+        bits_since_symbol = 0;
+        all_ones = true;
+      }
+    }
+  }
+  // Valid padding: strictly fewer than 8 leftover bits, all ones.
+  return bits_since_symbol < 8 && all_ones;
+}
+
+// ---- integers (RFC 7541 §5.1) ---------------------------------------
+
+bool DecodeInteger(const uint8_t* data, size_t len, uint8_t prefix_bits,
+                   uint64_t* value, size_t* consumed) {
+  if (len == 0) return false;
+  const uint64_t max_prefix = (1u << prefix_bits) - 1;
+  uint64_t v = data[0] & max_prefix;
+  size_t i = 1;
+  if (v == max_prefix) {
+    uint64_t shift = 0;
+    while (true) {
+      if (i >= len || shift > 56) return false;
+      uint8_t byte = data[i++];
+      v += static_cast<uint64_t>(byte & 0x7f) << shift;
+      if (v > (1ull << 32)) return false;  // sanity cap
+      if (!(byte & 0x80)) break;
+      shift += 7;
+    }
+  }
+  *value = v;
+  *consumed = i;
+  return true;
+}
+
+void EncodeInteger(uint64_t value, uint8_t prefix_bits,
+                   uint8_t first_byte_flags, std::string* out) {
+  const uint64_t max_prefix = (1u << prefix_bits) - 1;
+  if (value < max_prefix) {
+    out->push_back(static_cast<char>(first_byte_flags | value));
+    return;
+  }
+  out->push_back(static_cast<char>(first_byte_flags | max_prefix));
+  value -= max_prefix;
+  while (value >= 128) {
+    out->push_back(static_cast<char>((value & 0x7f) | 0x80));
+    value >>= 7;
+  }
+  out->push_back(static_cast<char>(value));
+}
+
+// ---- string literals -------------------------------------------------
+
+namespace {
+
+bool DecodeString(const uint8_t* data, size_t len, std::string* out,
+                  size_t* consumed) {
+  if (len == 0) return false;
+  bool huffman = data[0] & 0x80;
+  uint64_t str_len = 0;
+  size_t n = 0;
+  if (!DecodeInteger(data, len, 7, &str_len, &n)) return false;
+  if (n + str_len > len) return false;
+  out->clear();
+  if (huffman) {
+    if (!HuffmanDecode(data + n, str_len, out)) return false;
+  } else {
+    out->assign(reinterpret_cast<const char*>(data + n), str_len);
+  }
+  *consumed = n + str_len;
+  return true;
+}
+
+void EncodeString(const std::string& s, std::string* out) {
+  EncodeInteger(s.size(), 7, 0x00, out);  // H=0: raw
+  out->append(s);
+}
+
+}  // namespace
+
+// ---- decoder ---------------------------------------------------------
+
+bool Decoder::LookupIndex(uint64_t index, Header* out) const {
+  if (index == 0) return false;
+  if (index <= kStaticTableSize) {
+    const auto& e = kStaticTable[index - 1];
+    out->name = e.name;
+    out->value = e.value;
+    return true;
+  }
+  size_t dyn_index = index - kStaticTableSize - 1;
+  if (dyn_index >= dynamic_.size()) return false;
+  *out = dynamic_[dyn_index];
+  return true;
+}
+
+void Decoder::Insert(Header h) {
+  size_t sz = EntrySize(h);
+  if (sz > max_size_) {
+    // An entry larger than the table empties it (RFC 7541 §4.4).
+    dynamic_.clear();
+    dynamic_bytes_ = 0;
+    return;
+  }
+  EvictTo(max_size_ - sz);
+  dynamic_bytes_ += sz;
+  dynamic_.push_front(std::move(h));
+}
+
+void Decoder::EvictTo(size_t target) {
+  while (dynamic_bytes_ > target && !dynamic_.empty()) {
+    dynamic_bytes_ -= EntrySize(dynamic_.back());
+    dynamic_.pop_back();
+  }
+}
+
+bool Decoder::Decode(const uint8_t* data, size_t len,
+                     std::vector<Header>* out) {
+  size_t i = 0;
+  while (i < len) {
+    uint8_t b = data[i];
+    if (b & 0x80) {
+      // Indexed header field.
+      uint64_t index = 0;
+      size_t n = 0;
+      if (!DecodeInteger(data + i, len - i, 7, &index, &n)) return false;
+      i += n;
+      Header h;
+      if (!LookupIndex(index, &h)) return false;
+      out->push_back(std::move(h));
+    } else if (b & 0x40) {
+      // Literal with incremental indexing.
+      uint64_t index = 0;
+      size_t n = 0;
+      if (!DecodeInteger(data + i, len - i, 6, &index, &n)) return false;
+      i += n;
+      Header h;
+      if (index) {
+        Header base;
+        if (!LookupIndex(index, &base)) return false;
+        h.name = std::move(base.name);
+      } else {
+        size_t c = 0;
+        if (!DecodeString(data + i, len - i, &h.name, &c)) return false;
+        i += c;
+      }
+      size_t c = 0;
+      if (!DecodeString(data + i, len - i, &h.value, &c)) return false;
+      i += c;
+      out->push_back(h);
+      Insert(std::move(h));
+    } else if (b & 0x20) {
+      // Dynamic table size update.
+      uint64_t size = 0;
+      size_t n = 0;
+      if (!DecodeInteger(data + i, len - i, 5, &size, &n)) return false;
+      i += n;
+      if (size > protocol_max_size_) return false;
+      max_size_ = size;
+      EvictTo(max_size_);
+    } else {
+      // Literal without indexing (0x0X) or never indexed (0x1X).
+      uint64_t index = 0;
+      size_t n = 0;
+      if (!DecodeInteger(data + i, len - i, 4, &index, &n)) return false;
+      i += n;
+      Header h;
+      if (index) {
+        Header base;
+        if (!LookupIndex(index, &base)) return false;
+        h.name = std::move(base.name);
+      } else {
+        size_t c = 0;
+        if (!DecodeString(data + i, len - i, &h.name, &c)) return false;
+        i += c;
+      }
+      size_t c = 0;
+      if (!DecodeString(data + i, len - i, &h.value, &c)) return false;
+      i += c;
+      out->push_back(std::move(h));
+    }
+  }
+  return true;
+}
+
+std::string EncodeHeaders(const std::vector<Header>& headers) {
+  std::string out;
+  for (const auto& h : headers) {
+    out.push_back('\0');  // literal without indexing, new name
+    EncodeString(h.name, &out);
+    EncodeString(h.value, &out);
+  }
+  return out;
+}
+
+}  // namespace tpusim::hpack

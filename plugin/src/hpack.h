@@ -1,0 +1,60 @@
+// HPACK (RFC 7541) header compression for the plugin's gRPC transport.
+//
+// Scope: a full decoder (static + dynamic table, Huffman strings,
+// table-size updates) — required because gRPC peers (kubelet's grpc-go,
+// test grpcio) use indexing and Huffman freely — and a deliberately
+// minimal encoder (literal-without-indexing, no Huffman), which is
+// always legal to emit and keeps our side stateless.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace tpusim::hpack {
+
+struct Header {
+  std::string name;
+  std::string value;
+};
+
+// Decodes one complete header block (after CONTINUATION reassembly).
+// Stateful across blocks on a connection (dynamic table).
+class Decoder {
+ public:
+  // Returns false on a malformed block (connection error per RFC).
+  bool Decode(const uint8_t* data, size_t len, std::vector<Header>* out);
+
+  // Cap advertised via our SETTINGS_HEADER_TABLE_SIZE (we use 4096).
+  void set_max_table_size(size_t n) { protocol_max_size_ = n; }
+
+  size_t dynamic_size() const { return dynamic_bytes_; }
+
+ private:
+  bool LookupIndex(uint64_t index, Header* out) const;
+  void Insert(Header h);
+  void EvictTo(size_t target);
+
+  std::deque<Header> dynamic_;           // most recent at front
+  size_t dynamic_bytes_ = 0;
+  size_t max_size_ = 4096;               // current (peer-controlled) limit
+  size_t protocol_max_size_ = 4096;      // our advertised cap
+};
+
+// Huffman-decode per RFC 7541 §5.2 / Appendix B. Returns false on a
+// malformed sequence (EOS in stream, bad padding).
+bool HuffmanDecode(const uint8_t* data, size_t len, std::string* out);
+
+// Minimal encoder: every field is emitted as "literal without
+// indexing — new name" with raw (non-Huffman) strings.
+std::string EncodeHeaders(const std::vector<Header>& headers);
+
+// RFC 7541 §5.1 primitives, exposed for tests.
+bool DecodeInteger(const uint8_t* data, size_t len, uint8_t prefix_bits,
+                   uint64_t* value, size_t* consumed);
+void EncodeInteger(uint64_t value, uint8_t prefix_bits, uint8_t first_byte_flags,
+                   std::string* out);
+
+}  // namespace tpusim::hpack

@@ -1,0 +1,408 @@
+#include "http2.h"
+
+#include <errno.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+
+namespace tpusim::http2 {
+namespace {
+
+constexpr char kClientPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr size_t kMaxFramePayload = 1 << 20;  // defensive read cap
+constexpr uint16_t kSettingsInitialWindowSize = 0x4;
+constexpr uint16_t kSettingsMaxFrameSize = 0x5;
+
+void PutU24(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>((v >> 16) & 0xff));
+  out->push_back(static_cast<char>((v >> 8) & 0xff));
+  out->push_back(static_cast<char>(v & 0xff));
+}
+
+void PutU32(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>((v >> 24) & 0xff));
+  out->push_back(static_cast<char>((v >> 16) & 0xff));
+  out->push_back(static_cast<char>((v >> 8) & 0xff));
+  out->push_back(static_cast<char>(v & 0xff));
+}
+
+uint32_t GetU32(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | p[3];
+}
+
+}  // namespace
+
+Connection::Connection(int fd, bool is_server)
+    : fd_(fd), is_server_(is_server) {
+  if (!is_server_) next_client_stream_ = 1;
+}
+
+Connection::~Connection() { Close(); }
+
+bool Connection::ReadExact(uint8_t* buf, size_t len) {
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::read(fd_, buf + got, len - got);
+    if (n == 0) return false;
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    got += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool Connection::WriteAllLocked(const uint8_t* buf, size_t len) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::write(fd_, buf + sent, len - sent);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    sent += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool Connection::WriteFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                            const std::string& payload) {
+  std::string frame;
+  frame.reserve(9 + payload.size());
+  PutU24(&frame, static_cast<uint32_t>(payload.size()));
+  frame.push_back(static_cast<char>(type));
+  frame.push_back(static_cast<char>(flags));
+  PutU32(&frame, stream_id & 0x7fffffff);
+  frame.append(payload);
+  std::lock_guard<std::mutex> lock(write_mu_);
+  return WriteAllLocked(reinterpret_cast<const uint8_t*>(frame.data()),
+                        frame.size());
+}
+
+bool Connection::Start() {
+  if (is_server_) {
+    uint8_t preface[kPrefaceLen];
+    if (!ReadExact(preface, kPrefaceLen)) return false;
+    if (memcmp(preface, kClientPreface, kPrefaceLen) != 0) return false;
+  } else {
+    std::lock_guard<std::mutex> lock(write_mu_);
+    if (!WriteAllLocked(
+            reinterpret_cast<const uint8_t*>(kClientPreface), kPrefaceLen)) {
+      return false;
+    }
+  }
+  // Empty SETTINGS: all defaults (header table 4096, window 65535,
+  // max frame 16384).
+  return WriteFrame(kSettings, 0, 0, "");
+}
+
+bool Connection::ReadFrame(Frame* frame) {
+  uint8_t head[9];
+  if (!ReadExact(head, 9)) return false;
+  uint32_t len = (static_cast<uint32_t>(head[0]) << 16) |
+                 (static_cast<uint32_t>(head[1]) << 8) | head[2];
+  if (len > kMaxFramePayload) return false;
+  frame->type = head[3];
+  frame->flags = head[4];
+  frame->stream_id = GetU32(head + 5) & 0x7fffffff;
+  frame->payload.resize(len);
+  if (len > 0 &&
+      !ReadExact(reinterpret_cast<uint8_t*>(frame->payload.data()), len)) {
+    return false;
+  }
+  return true;
+}
+
+void Connection::Run() {
+  Frame frame;
+  while (!closed() && ReadFrame(&frame)) {
+    if (!HandleFrame(std::move(frame))) break;
+    frame = Frame();
+  }
+  Close();
+  if (cb_.on_close) cb_.on_close();
+}
+
+bool Connection::HandleFrame(Frame frame) {
+  // A header block in flight admits only CONTINUATION for that stream.
+  if (hb_active_ &&
+      (frame.type != kContinuation || frame.stream_id != hb_stream_)) {
+    return false;
+  }
+  switch (frame.type) {
+    case kSettings:
+      return HandleSettings(frame);
+    case kWindowUpdate:
+      return HandleWindowUpdate(frame);
+    case kPing:
+      if (!(frame.flags & kFlagAck)) {
+        return WriteFrame(kPing, kFlagAck, 0, frame.payload);
+      }
+      return true;
+    case kHeaders:
+      return HandleHeadersStart(frame);
+    case kContinuation: {
+      hb_buf_.append(frame.payload);
+      if (frame.flags & kFlagEndHeaders) return FinishHeaderBlock();
+      return true;
+    }
+    case kData:
+      return HandleData(std::move(frame));
+    case kRstStream: {
+      if (frame.payload.size() != 4) return false;
+      uint32_t code =
+          GetU32(reinterpret_cast<const uint8_t*>(frame.payload.data()));
+      {
+        std::lock_guard<std::mutex> lock(state_mu_);
+        reset_streams_[frame.stream_id] = true;
+      }
+      window_cv_.notify_all();
+      if (cb_.on_rst) cb_.on_rst(frame.stream_id, code);
+      return true;
+    }
+    case kGoAway:
+      return false;  // peer is going away; unwind the loop
+    case kPriority:
+    case kPushPromise:
+    default:
+      return true;  // tolerated and ignored
+  }
+}
+
+bool Connection::HandleSettings(const Frame& frame) {
+  if (frame.flags & kFlagAck) return true;
+  if (frame.payload.size() % 6 != 0) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(frame.payload.data());
+  for (size_t i = 0; i + 6 <= frame.payload.size(); i += 6) {
+    uint16_t id = static_cast<uint16_t>((p[i] << 8) | p[i + 1]);
+    uint32_t value = GetU32(p + i + 2);
+    if (id == kSettingsInitialWindowSize) {
+      if (value > 0x7fffffff) return false;
+      std::lock_guard<std::mutex> lock(state_mu_);
+      int64_t delta =
+          static_cast<int64_t>(value) - peer_initial_window_;
+      peer_initial_window_ = static_cast<int32_t>(value);
+      for (auto& [id2, win] : stream_send_window_) win += delta;
+    } else if (id == kSettingsMaxFrameSize) {
+      if (value >= 16384 && value <= 16777215) {
+        std::lock_guard<std::mutex> lock(state_mu_);
+        peer_max_frame_ = value;
+      }
+    }
+  }
+  window_cv_.notify_all();
+  return WriteFrame(kSettings, kFlagAck, 0, "");
+}
+
+bool Connection::HandleWindowUpdate(const Frame& frame) {
+  if (frame.payload.size() != 4) return false;
+  uint32_t inc =
+      GetU32(reinterpret_cast<const uint8_t*>(frame.payload.data())) &
+      0x7fffffff;
+  if (inc == 0) return frame.stream_id != 0;  // conn-level 0 is fatal
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (frame.stream_id == 0) {
+      conn_send_window_ += inc;
+    } else {
+      auto it = stream_send_window_.find(frame.stream_id);
+      if (it == stream_send_window_.end()) {
+        stream_send_window_[frame.stream_id] =
+            static_cast<int64_t>(peer_initial_window_) + inc;
+      } else {
+        it->second += inc;
+      }
+    }
+  }
+  window_cv_.notify_all();
+  return true;
+}
+
+bool Connection::HandleHeadersStart(const Frame& frame) {
+  if (frame.stream_id == 0) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(frame.payload.data());
+  size_t len = frame.payload.size();
+  size_t pad = 0;
+  size_t off = 0;
+  if (frame.flags & kFlagPadded) {
+    if (len < 1) return false;
+    pad = p[0];
+    off += 1;
+  }
+  if (frame.flags & kFlagPriority) {
+    if (len < off + 5) return false;
+    off += 5;
+  }
+  if (off + pad > len) return false;
+  hb_active_ = true;
+  hb_stream_ = frame.stream_id;
+  hb_end_stream_ = frame.flags & kFlagEndStream;
+  hb_buf_.assign(frame.payload, off, len - off - pad);
+  {
+    // Ensure the stream has a send window for the response path.
+    std::lock_guard<std::mutex> lock(state_mu_);
+    stream_send_window_.emplace(frame.stream_id, peer_initial_window_);
+  }
+  if (frame.flags & kFlagEndHeaders) return FinishHeaderBlock();
+  return true;
+}
+
+bool Connection::FinishHeaderBlock() {
+  hb_active_ = false;
+  std::vector<hpack::Header> headers;
+  if (!hpack_decoder_.Decode(
+          reinterpret_cast<const uint8_t*>(hb_buf_.data()), hb_buf_.size(),
+          &headers)) {
+    return false;
+  }
+  hb_buf_.clear();
+  if (cb_.on_headers) {
+    cb_.on_headers(hb_stream_, std::move(headers), hb_end_stream_);
+  }
+  return true;
+}
+
+bool Connection::HandleData(Frame frame) {
+  if (frame.stream_id == 0) return false;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(frame.payload.data());
+  size_t len = frame.payload.size();
+  size_t pad = 0;
+  size_t off = 0;
+  if (frame.flags & kFlagPadded) {
+    if (len < 1) return false;
+    pad = p[0];
+    off = 1;
+  }
+  if (off + pad > len) return false;
+  // Replenish receive windows eagerly: we never apply backpressure
+  // (device-plugin messages are tiny).
+  if (len > 0) {
+    std::string inc;
+    PutU32(&inc, static_cast<uint32_t>(frame.payload.size()));
+    WriteFrame(kWindowUpdate, 0, 0, inc);
+    if (!(frame.flags & kFlagEndStream)) {
+      WriteFrame(kWindowUpdate, 0, frame.stream_id, inc);
+    }
+  }
+  if (cb_.on_data) {
+    cb_.on_data(frame.stream_id,
+                frame.payload.substr(off, len - off - pad),
+                frame.flags & kFlagEndStream);
+  }
+  return true;
+}
+
+bool Connection::SendHeaders(uint32_t stream_id,
+                             const std::vector<hpack::Header>& headers,
+                             bool end_stream, bool end_headers) {
+  if (closed()) return false;
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    stream_send_window_.emplace(stream_id, peer_initial_window_);
+  }
+  std::string block = hpack::EncodeHeaders(headers);
+  uint8_t flags = 0;
+  if (end_stream) flags |= kFlagEndStream;
+  if (end_headers) flags |= kFlagEndHeaders;
+  return WriteFrame(kHeaders, flags, stream_id, block);
+}
+
+bool Connection::WaitForWindow(uint32_t stream_id, size_t want,
+                               size_t* granted) {
+  std::unique_lock<std::mutex> lock(state_mu_);
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(30);
+  while (true) {
+    if (closed_) return false;
+    if (reset_streams_.count(stream_id)) return false;
+    int64_t stream_win = peer_initial_window_;
+    auto it = stream_send_window_.find(stream_id);
+    if (it != stream_send_window_.end()) stream_win = it->second;
+    int64_t avail = std::min(conn_send_window_, stream_win);
+    if (avail > 0) {
+      size_t take = std::min({want, static_cast<size_t>(avail),
+                              peer_max_frame_});
+      conn_send_window_ -= static_cast<int64_t>(take);
+      stream_send_window_[stream_id] =
+          stream_win - static_cast<int64_t>(take);
+      *granted = take;
+      return true;
+    }
+    if (window_cv_.wait_until(lock, deadline) ==
+        std::cv_status::timeout) {
+      return false;
+    }
+  }
+}
+
+bool Connection::SendData(uint32_t stream_id, const std::string& data,
+                          bool end_stream) {
+  size_t off = 0;
+  if (data.empty() && end_stream) {
+    return WriteFrame(kData, kFlagEndStream, stream_id, "");
+  }
+  while (off < data.size()) {
+    size_t granted = 0;
+    if (!WaitForWindow(stream_id, data.size() - off, &granted)) {
+      return false;
+    }
+    bool last = (off + granted == data.size());
+    uint8_t flags = (last && end_stream) ? kFlagEndStream : 0;
+    if (!WriteFrame(kData, flags, stream_id,
+                    data.substr(off, granted))) {
+      return false;
+    }
+    off += granted;
+  }
+  return true;
+}
+
+bool Connection::SendRstStream(uint32_t stream_id, uint32_t error_code) {
+  std::string payload;
+  PutU32(&payload, error_code);
+  return WriteFrame(kRstStream, 0, stream_id, payload);
+}
+
+bool Connection::SendGoAway(uint32_t error_code) {
+  std::string payload;
+  PutU32(&payload, 0);
+  PutU32(&payload, error_code);
+  return WriteFrame(kGoAway, 0, 0, payload);
+}
+
+uint32_t Connection::NextStreamId() {
+  std::lock_guard<std::mutex> lock(state_mu_);
+  uint32_t id = next_client_stream_;
+  next_client_stream_ += 2;
+  return id;
+}
+
+void Connection::Close() {
+  {
+    std::lock_guard<std::mutex> lock(state_mu_);
+    if (closed_) return;
+    closed_ = true;
+  }
+  window_cv_.notify_all();
+  ::shutdown(fd_, SHUT_RDWR);
+  ::close(fd_);
+}
+
+bool Connection::closed() const {
+  std::lock_guard<std::mutex> lock(state_mu_);
+  return closed_;
+}
+
+bool Connection::StreamReset(uint32_t stream_id) const {
+  std::lock_guard<std::mutex> lock(state_mu_);
+  return reset_streams_.count(stream_id) > 0;
+}
+
+}  // namespace tpusim::http2

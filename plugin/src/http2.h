@@ -1,0 +1,153 @@
+// Minimal HTTP/2 (RFC 7540) connection layer — just enough protocol to
+// interoperate with gRPC peers (kubelet's grpc-go, grpcio test
+// clients) over unix sockets: connection preface, SETTINGS/PING/
+// WINDOW_UPDATE/GOAWAY handling, HEADERS+CONTINUATION reassembly with
+// HPACK, DATA with both-direction flow control, RST_STREAM.
+//
+// Deliberately out of scope (never used by gRPC over a local socket):
+// TLS, server push, priority scheduling, upgrade from HTTP/1.1.
+#pragma once
+
+#include <cstdint>
+#include <condition_variable>
+#include <functional>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "hpack.h"
+
+namespace tpusim::http2 {
+
+enum FrameType : uint8_t {
+  kData = 0x0,
+  kHeaders = 0x1,
+  kPriority = 0x2,
+  kRstStream = 0x3,
+  kSettings = 0x4,
+  kPushPromise = 0x5,
+  kPing = 0x6,
+  kGoAway = 0x7,
+  kWindowUpdate = 0x8,
+  kContinuation = 0x9,
+};
+
+enum Flags : uint8_t {
+  kFlagEndStream = 0x1,
+  kFlagAck = 0x1,
+  kFlagEndHeaders = 0x4,
+  kFlagPadded = 0x8,
+  kFlagPriority = 0x20,
+};
+
+enum ErrorCode : uint32_t {
+  kNoError = 0x0,
+  kProtocolError = 0x1,
+  kInternalError = 0x2,
+  kFlowControlError = 0x3,
+  kCancel = 0x8,
+};
+
+struct Frame {
+  uint8_t type = 0;
+  uint8_t flags = 0;
+  uint32_t stream_id = 0;
+  std::string payload;
+};
+
+// Events delivered from the read loop. All callbacks run on the
+// Run() thread; they must not block on connection writes that need
+// window updates from the same loop (unary gRPC responses are fine:
+// they are small relative to the initial 64KiB windows).
+struct ConnectionCallbacks {
+  // Complete header block for a stream (after CONTINUATION joins).
+  std::function<void(uint32_t stream_id,
+                     std::vector<hpack::Header> headers,
+                     bool end_stream)>
+      on_headers;
+  // A chunk of DATA for a stream.
+  std::function<void(uint32_t stream_id, std::string data,
+                     bool end_stream)>
+      on_data;
+  std::function<void(uint32_t stream_id, uint32_t error_code)> on_rst;
+  std::function<void()> on_close;
+};
+
+class Connection {
+ public:
+  Connection(int fd, bool is_server);
+  ~Connection();
+
+  Connection(const Connection&) = delete;
+  Connection& operator=(const Connection&) = delete;
+
+  void set_callbacks(ConnectionCallbacks cb) { cb_ = std::move(cb); }
+
+  // Performs the connection preface + initial SETTINGS exchange
+  // (non-blocking on the peer's SETTINGS: those are handled in Run).
+  bool Start();
+
+  // Read loop; returns when the peer closes or a fatal error occurs.
+  void Run();
+
+  // Thread-safe senders. SendData blocks until flow-control window is
+  // available (fed by the Run loop), so it must not be called from the
+  // Run thread with payloads larger than the current window.
+  bool SendHeaders(uint32_t stream_id,
+                   const std::vector<hpack::Header>& headers,
+                   bool end_stream, bool end_headers = true);
+  bool SendData(uint32_t stream_id, const std::string& data,
+                bool end_stream);
+  bool SendRstStream(uint32_t stream_id, uint32_t error_code);
+  bool SendGoAway(uint32_t error_code);
+
+  // Client half: allocate the next odd stream id.
+  uint32_t NextStreamId();
+
+  void Close();
+  bool closed() const;
+
+  // Streams the peer reset (delivered asynchronously to writers).
+  bool StreamReset(uint32_t stream_id) const;
+
+ private:
+  bool ReadExact(uint8_t* buf, size_t len);
+  bool WriteAllLocked(const uint8_t* buf, size_t len);
+  bool WriteFrame(uint8_t type, uint8_t flags, uint32_t stream_id,
+                  const std::string& payload);
+  bool ReadFrame(Frame* frame);
+  bool HandleFrame(Frame frame);
+  bool HandleSettings(const Frame& frame);
+  bool HandleWindowUpdate(const Frame& frame);
+  bool HandleHeadersStart(const Frame& frame);
+  bool FinishHeaderBlock();
+  bool HandleData(Frame frame);
+  bool WaitForWindow(uint32_t stream_id, size_t want, size_t* granted);
+
+  const int fd_;
+  const bool is_server_;
+  ConnectionCallbacks cb_;
+
+  hpack::Decoder hpack_decoder_;  // read side, Run-thread only
+
+  mutable std::mutex write_mu_;   // serializes frame writes + hpack enc
+
+  mutable std::mutex state_mu_;
+  std::condition_variable window_cv_;
+  bool closed_ = false;
+  int64_t conn_send_window_ = 65535;
+  int32_t peer_initial_window_ = 65535;
+  size_t peer_max_frame_ = 16384;
+  std::map<uint32_t, int64_t> stream_send_window_;
+  std::map<uint32_t, bool> reset_streams_;
+  uint32_t next_client_stream_ = 1;
+
+  // in-flight header block (HEADERS + CONTINUATION*)
+  uint32_t hb_stream_ = 0;
+  bool hb_end_stream_ = false;
+  bool hb_active_ = false;
+  std::string hb_buf_;
+};
+
+}  // namespace tpusim::http2

@@ -1,0 +1,95 @@
+// tpu-device-plugin — entry point.
+//
+// Flags override the TPU_SIM_* environment (see PluginConfig::FromEnv).
+// `--print-env` dumps the computed Allocate environment and exits; the
+// Python test suite uses it to cross-check the C++ topology defaults
+// against kind_tpu_sim.topology.
+
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <string>
+
+#include "device_plugin.h"
+
+namespace {
+
+tpusim::DevicePlugin* g_plugin = nullptr;
+
+void HandleSignal(int) {
+  if (g_plugin) g_plugin->Stop();
+}
+
+bool ParseFlag(const char* arg, const char* name, std::string* out) {
+  std::string prefix = std::string("--") + name + "=";
+  if (strncmp(arg, prefix.c_str(), prefix.size()) == 0) {
+    *out = arg + prefix.size();
+    return true;
+  }
+  return false;
+}
+
+void Usage() {
+  fprintf(stderr,
+          "usage: tpu-device-plugin [--socket-dir=DIR] [--socket-name=F]\n"
+          "  [--kubelet-socket=PATH] [--resource=NAME] [--chips=N]\n"
+          "  [--worker-id=N] [--unhealthy-file=PATH] [--no-register]\n"
+          "  [--print-env]\n");
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  tpusim::PluginConfig cfg = tpusim::PluginConfig::FromEnv();
+  bool print_env = false;
+  std::string value;
+  for (int i = 1; i < argc; ++i) {
+    const char* arg = argv[i];
+    if (ParseFlag(arg, "socket-dir", &cfg.socket_dir) ||
+        ParseFlag(arg, "socket-name", &cfg.socket_name) ||
+        ParseFlag(arg, "kubelet-socket", &cfg.kubelet_socket) ||
+        ParseFlag(arg, "resource", &cfg.resource) ||
+        ParseFlag(arg, "unhealthy-file", &cfg.unhealthy_file)) {
+      continue;
+    } else if (ParseFlag(arg, "chips", &value)) {
+      cfg.chips = atoi(value.c_str());
+      if (cfg.chips < 1) {
+        fprintf(stderr, "--chips must be >= 1\n");
+        return 2;
+      }
+    } else if (ParseFlag(arg, "worker-id", &value)) {
+      cfg.worker_id = atoi(value.c_str());
+    } else if (strcmp(arg, "--no-register") == 0) {
+      cfg.register_with_kubelet = false;
+    } else if (strcmp(arg, "--print-env") == 0) {
+      print_env = true;
+    } else if (strcmp(arg, "--help") == 0 || strcmp(arg, "-h") == 0) {
+      Usage();
+      return 0;
+    } else {
+      fprintf(stderr, "unknown flag: %s\n", arg);
+      Usage();
+      return 2;
+    }
+  }
+
+  tpusim::DevicePlugin plugin(cfg);
+
+  if (print_env) {
+    for (const auto& [key, val] :
+         plugin.AllocateEnv(plugin.DeviceIds())) {
+      printf("%s=%s\n", key.c_str(), val.c_str());
+    }
+    return 0;
+  }
+
+  g_plugin = &plugin;
+  signal(SIGINT, HandleSignal);
+  signal(SIGTERM, HandleSignal);
+
+  if (!plugin.Start()) return 1;
+  plugin.Wait();
+  return 0;
+}

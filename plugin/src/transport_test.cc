@@ -1,0 +1,246 @@
+// Native unit tests for the hand-rolled HPACK/gRPC transport and the
+// device-plugin service logic. Plain asserts — no test framework in
+// the base image. HPACK cases are the worked examples from RFC 7541
+// Appendix C, which exercise Huffman coding and the dynamic table.
+
+#undef NDEBUG
+#include <assert.h>
+#include <stdio.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+#include "device_plugin.h"
+#include "deviceplugin.pb.h"
+#include "grpc_transport.h"
+#include "hpack.h"
+
+namespace {
+
+std::string FromHex(const std::string& hex) {
+  std::string out;
+  for (size_t i = 0; i + 1 < hex.size(); i += 2) {
+    out.push_back(static_cast<char>(
+        std::stoi(hex.substr(i, 2), nullptr, 16)));
+  }
+  return out;
+}
+
+void TestIntegerCoding() {
+  using namespace tpusim::hpack;
+  // RFC 7541 C.1.1: 10 with 5-bit prefix -> 0x0a
+  std::string out;
+  EncodeInteger(10, 5, 0, &out);
+  assert(out == std::string("\x0a", 1));
+  uint64_t v = 0;
+  size_t n = 0;
+  assert(DecodeInteger(reinterpret_cast<const uint8_t*>(out.data()),
+                       out.size(), 5, &v, &n));
+  assert(v == 10 && n == 1);
+  // C.1.2: 1337 with 5-bit prefix -> 1f 9a 0a
+  out.clear();
+  EncodeInteger(1337, 5, 0, &out);
+  assert(out == FromHex("1f9a0a"));
+  assert(DecodeInteger(reinterpret_cast<const uint8_t*>(out.data()),
+                       out.size(), 5, &v, &n));
+  assert(v == 1337 && n == 3);
+  printf("ok TestIntegerCoding\n");
+}
+
+void TestHuffmanDecode() {
+  // RFC 7541 C.4.1: "www.example.com"
+  std::string bytes = FromHex("f1e3c2e5f23a6ba0ab90f4ff");
+  std::string out;
+  assert(tpusim::hpack::HuffmanDecode(
+      reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size(), &out));
+  assert(out == "www.example.com");
+  // C.6.1: "302" -> 6402
+  bytes = FromHex("6402");
+  out.clear();
+  assert(tpusim::hpack::HuffmanDecode(
+      reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size(), &out));
+  assert(out == "302");
+  // Bad padding (a zero bit in padding) must fail.
+  bytes = FromHex("f1e3c2e5f23a6ba0ab90f400");
+  out.clear();
+  assert(!tpusim::hpack::HuffmanDecode(
+      reinterpret_cast<const uint8_t*>(bytes.data()), bytes.size(), &out));
+  printf("ok TestHuffmanDecode\n");
+}
+
+void DecodeBlock(tpusim::hpack::Decoder* dec, const std::string& hex,
+                 std::vector<tpusim::hpack::Header>* out) {
+  std::string bytes = FromHex(hex);
+  out->clear();
+  assert(dec->Decode(reinterpret_cast<const uint8_t*>(bytes.data()),
+                     bytes.size(), out));
+}
+
+void TestHpackRfcExamples() {
+  using tpusim::hpack::Header;
+  // RFC 7541 C.3: three requests without Huffman, shared dynamic table.
+  tpusim::hpack::Decoder dec;
+  std::vector<Header> h;
+  DecodeBlock(&dec, "828684410f7777772e6578616d706c652e636f6d", &h);
+  assert(h.size() == 4);
+  assert(h[0].name == ":method" && h[0].value == "GET");
+  assert(h[1].name == ":scheme" && h[1].value == "http");
+  assert(h[2].name == ":path" && h[2].value == "/");
+  assert(h[3].name == ":authority" && h[3].value == "www.example.com");
+
+  DecodeBlock(&dec, "828684be58086e6f2d6361636865", &h);
+  assert(h.size() == 5);
+  assert(h[3].value == "www.example.com");  // dynamic table hit
+  assert(h[4].name == "cache-control" && h[4].value == "no-cache");
+
+  DecodeBlock(&dec,
+              "828785bf400a637573746f6d2d6b65790c637573746f6d2d76616c7565",
+              &h);
+  assert(h.size() == 5);
+  assert(h[1].value == "https");
+  assert(h[2].value == "/index.html");
+  assert(h[4].name == "custom-key" && h[4].value == "custom-value");
+
+  // C.4: the same requests Huffman-coded, fresh decoder.
+  tpusim::hpack::Decoder dec2;
+  DecodeBlock(&dec2, "828684418cf1e3c2e5f23a6ba0ab90f4ff", &h);
+  assert(h.size() == 4 && h[3].value == "www.example.com");
+  DecodeBlock(&dec2, "828684be5886a8eb10649cbf", &h);
+  assert(h.size() == 5 && h[4].value == "no-cache");
+  DecodeBlock(&dec2,
+              "828785bf408825a849e95ba97d7f8925a849e95bb8e8b4bf", &h);
+  assert(h.size() == 5 && h[4].name == "custom-key" &&
+         h[4].value == "custom-value");
+  printf("ok TestHpackRfcExamples\n");
+}
+
+void TestHpackEncodeDecodeRoundTrip() {
+  using tpusim::hpack::Header;
+  std::vector<Header> in = {
+      {":status", "200"},
+      {"content-type", "application/grpc"},
+      {"grpc-status", "0"},
+  };
+  std::string block = tpusim::hpack::EncodeHeaders(in);
+  tpusim::hpack::Decoder dec;
+  std::vector<Header> out;
+  assert(dec.Decode(reinterpret_cast<const uint8_t*>(block.data()),
+                    block.size(), &out));
+  assert(out.size() == in.size());
+  for (size_t i = 0; i < in.size(); ++i) {
+    assert(out[i].name == in[i].name && out[i].value == in[i].value);
+  }
+  printf("ok TestHpackEncodeDecodeRoundTrip\n");
+}
+
+void TestGrpcFraming() {
+  std::string framed = tpusim::grpc::EncodeMessage("hello");
+  assert(framed.size() == 10);
+  std::string buf = framed + tpusim::grpc::EncodeMessage("world!");
+  std::vector<std::string> msgs;
+  assert(tpusim::grpc::DrainMessages(&buf, &msgs));
+  assert(msgs.size() == 2 && msgs[0] == "hello" && msgs[1] == "world!");
+  assert(buf.empty());
+  // partial message stays buffered
+  buf = framed.substr(0, 7);
+  msgs.clear();
+  assert(tpusim::grpc::DrainMessages(&buf, &msgs));
+  assert(msgs.empty() && buf.size() == 7);
+  printf("ok TestGrpcFraming\n");
+}
+
+void TestLoopbackUnaryAndStreaming() {
+  std::string dir = "/tmp/tpusim-test-XXXXXX";
+  assert(mkdtemp(dir.data()) != nullptr);
+  std::string sock = dir + "/loop.sock";
+
+  tpusim::grpc::Server server;
+  server.RegisterUnary(
+      "/test.Svc/Echo",
+      [](const std::string& req, std::string* resp) -> tpusim::grpc::Status {
+        *resp = "echo:" + req;
+        return {};
+      });
+  server.RegisterServerStreaming(
+      "/test.Svc/Count",
+      [](const std::string&, tpusim::grpc::ServerStream* stream)
+          -> tpusim::grpc::Status {
+        for (int i = 0; i < 3; ++i) {
+          assert(stream->Write("msg" + std::to_string(i)));
+        }
+        return {};
+      });
+  assert(server.Start(sock));
+
+  tpusim::grpc::Client client;
+  assert(client.Connect(sock));
+  std::string resp;
+  auto status = client.Call("/test.Svc/Echo", "payload", &resp);
+  assert(status.ok());
+  assert(resp == "echo:payload");
+
+  status = client.Call("/test.Svc/Nope", "x", &resp);
+  assert(status.code == tpusim::grpc::kUnimplemented);
+
+  client.Close();
+  server.Shutdown();
+  unlink(sock.c_str());
+  rmdir(dir.c_str());
+  printf("ok TestLoopbackUnaryAndStreaming\n");
+}
+
+void TestWorkerIdParsing() {
+  assert(tpusim::WorkerIdFromNodeName("kind-tpu-sim-worker") == 0);
+  assert(tpusim::WorkerIdFromNodeName("kind-tpu-sim-worker2") == 1);
+  assert(tpusim::WorkerIdFromNodeName("kind-tpu-sim-worker10") == 9);
+  assert(tpusim::WorkerIdFromNodeName("control-plane") == 0);
+  assert(tpusim::WorkerIdFromNodeName("") == 0);
+  printf("ok TestWorkerIdParsing\n");
+}
+
+void TestDevicePluginLogic() {
+  tpusim::PluginConfig cfg;
+  cfg.chips = 8;
+  cfg.worker_id = 1;
+  cfg.accelerator_type = "v5litepod-16";
+  cfg.chips_per_host_bounds = "2,4,1";
+  cfg.host_bounds = "2,1,1";
+  cfg.hostnames = "h0,h1";
+  cfg.register_with_kubelet = false;
+  tpusim::DevicePlugin plugin(cfg);
+
+  auto ids = plugin.DeviceIds();
+  assert(ids.size() == 8);
+  assert(ids.front() == "tpu-1-8" && ids.back() == "tpu-1-15");
+
+  auto env = plugin.AllocateEnv({"tpu-1-8", "tpu-1-9"});
+  bool saw_worker = false, saw_visible = false;
+  for (const auto& [k, v] : env) {
+    if (k == "TPU_WORKER_ID") {
+      assert(v == "1");
+      saw_worker = true;
+    }
+    if (k == "TPU_VISIBLE_CHIPS") {
+      assert(v == "0,1");
+      saw_visible = true;
+    }
+  }
+  assert(saw_worker && saw_visible);
+  printf("ok TestDevicePluginLogic\n");
+}
+
+}  // namespace
+
+int main() {
+  TestIntegerCoding();
+  TestHuffmanDecode();
+  TestHpackRfcExamples();
+  TestHpackEncodeDecodeRoundTrip();
+  TestGrpcFraming();
+  TestLoopbackUnaryAndStreaming();
+  TestWorkerIdParsing();
+  TestDevicePluginLogic();
+  printf("all transport tests passed\n");
+  return 0;
+}

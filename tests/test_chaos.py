@@ -1,0 +1,69 @@
+"""Chaos subcommand tests over the fake runtime."""
+
+import pytest
+
+from kind_tpu_sim.cli import Simulator, main
+from kind_tpu_sim.cluster import worker_order_key
+from kind_tpu_sim.config import SimConfig
+from kind_tpu_sim.fakes import dry_run_executor
+
+
+def make_sim(**cfg_kwargs):
+    cfg = SimConfig(runtime="fake", **cfg_kwargs)
+    return Simulator(cfg, executor=dry_run_executor(cfg))
+
+
+def test_worker_order_key_natural_order():
+    names = [f"kind-tpu-sim-worker{i}" for i in range(2, 17)]
+    names.append("kind-tpu-sim-worker")
+    ordered = sorted(names, key=worker_order_key)
+    assert ordered[0] == "kind-tpu-sim-worker"
+    assert ordered[1] == "kind-tpu-sim-worker2"
+    assert ordered[9] == "kind-tpu-sim-worker10"
+    assert ordered[-1] == "kind-tpu-sim-worker16"
+
+
+def test_chaos_fail_all_devices_on_worker():
+    sim = make_sim()
+    sim.chaos("fail", worker=1)
+    writes = sim.executor.find("docker exec -i kind-tpu-sim-worker2")
+    assert len(writes) == 1
+    _, stdin = writes[0]
+    ids = stdin.strip().splitlines()
+    assert ids == [f"tpu-1-{i}" for i in range(8, 16)]
+
+
+def test_chaos_fail_specific_device_and_heal():
+    sim = make_sim()
+    sim.chaos("fail", worker=0, devices=["tpu-0-3"])
+    _, stdin = sim.executor.find("docker exec -i kind-tpu-sim-worker")[0]
+    assert stdin == "tpu-0-3\n"
+
+    sim.chaos("heal", worker=0)
+    cmds = sim.executor.commands()
+    assert any("rm -f /var/run/tpu-sim/unhealthy" in c for c in cmds)
+
+
+def test_chaos_kill_and_start_node():
+    sim = make_sim()
+    sim.chaos("kill-node", node="kind-tpu-sim-worker2")
+    sim.chaos("start-node", node="kind-tpu-sim-worker2")
+    cmds = sim.executor.commands()
+    assert "docker stop kind-tpu-sim-worker2" in cmds
+    assert "docker start kind-tpu-sim-worker2" in cmds
+
+
+def test_chaos_requires_target():
+    sim = make_sim()
+    with pytest.raises(ValueError, match="--node or --worker"):
+        sim.chaos("fail")
+    with pytest.raises(ValueError, match="out of range"):
+        sim.chaos("fail", worker=7)
+
+
+def test_chaos_cli_end_to_end():
+    rc = main(["chaos", "fail", "--worker=0", "--runtime=fake",
+               "--devices=tpu-0-1,tpu-0-2"])
+    assert rc == 0
+    rc = main(["chaos", "fail", "--runtime=fake"])  # no target
+    assert rc == 1
