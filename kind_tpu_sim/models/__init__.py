@@ -1,1 +1,3 @@
-"""JAX workload models for the simulated TPU cluster (filled by models.transformer)."""
+"""JAX workload models for the simulated TPU cluster."""
+
+from kind_tpu_sim.models import transformer  # noqa: F401

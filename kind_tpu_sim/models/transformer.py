@@ -1,0 +1,324 @@
+"""Flagship workload: a decoder-only transformer LM, TPU-first.
+
+The reference runs no model math — its "models" are busybox/vLLM pods
+(SURVEY.md §2 #14-17). The simulator's JAX pods need a real workload to
+prove the fake slice behaves like TPU hardware, so this module provides
+one, written the TPU way:
+
+* pure-functional params pytree + jitted step (one trace, static shapes);
+* bf16 activations/matmuls (MXU-friendly), fp32 params and reductions;
+* RMSNorm + rotary attention, all expressible as fused XLA ops;
+* sharding by `PartitionSpec` over a named mesh — data parallel over
+  'data', Megatron-style tensor parallel over 'model', sequence
+  sharding over 'seq' — with XLA GSPMD inserting the collectives;
+* `jax.checkpoint` on each block to trade FLOPs for HBM when training
+  deeper configs.
+
+Used by the jax-tpu pods, `bench.py`, and `__graft_entry__.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 512
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_seq: int = 128
+    dtype: str = "bfloat16"       # activation/matmul dtype
+    remat: bool = False           # jax.checkpoint each block
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def tiny_config() -> ModelConfig:
+    return ModelConfig()
+
+
+def pod_config() -> ModelConfig:
+    """The in-pod smoke config: small enough for kind-node CPUs."""
+    return ModelConfig(vocab_size=256, d_model=64, n_heads=4,
+                       n_layers=2, d_ff=256, max_seq=64)
+
+
+def bench_config() -> ModelConfig:
+    """Single-chip benchmark config: MXU-sized matmuls."""
+    return ModelConfig(vocab_size=32768, d_model=1024, n_heads=16,
+                       n_layers=8, d_ff=4096, max_seq=1024, remat=False)
+
+
+# ---------------------------------------------------------------------
+# init
+
+
+def init_params(key, cfg: ModelConfig) -> Params:
+    import jax
+    import jax.numpy as jnp
+
+    def dense(key, shape, scale):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * scale)
+
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    scale = cfg.d_model ** -0.5
+    params: Params = {
+        "embed": dense(keys[0], (cfg.vocab_size, cfg.d_model), 1.0),
+        "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        bkey = jax.random.split(keys[2 + i], 4)
+        params["blocks"].append({
+            "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "wqkv": dense(bkey[0], (cfg.d_model, 3 * cfg.d_model), scale),
+            "wo": dense(bkey[1], (cfg.d_model, cfg.d_model), scale),
+            "w_up": dense(bkey[2], (cfg.d_model, cfg.d_ff), scale),
+            "w_down": dense(bkey[3], (cfg.d_ff, cfg.d_model),
+                            cfg.d_ff ** -0.5),
+        })
+    return params
+
+
+# ---------------------------------------------------------------------
+# forward
+
+
+def _rms_norm(x, weight, eps=1e-6):
+    import jax.numpy as jnp
+
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    normed = x.astype(jnp.float32) * jnp.reciprocal(
+        jnp.sqrt(var + eps))
+    return (normed * weight).astype(x.dtype)
+
+
+def _rotary(x, positions):
+    """Rotary position embedding over the last (head_dim) axis."""
+    import jax.numpy as jnp
+
+    *_, head_dim = x.shape
+    half = head_dim // 2
+    freqs = jnp.exp(
+        -jnp.arange(0, half, dtype=jnp.float32) *
+        (jnp.log(10000.0) / half)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B,T,half)
+    angles = angles[:, :, None, :]                             # (B,T,1,half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.astype(x.dtype)
+
+
+def _attention(q, k, v, causal=True):
+    import jax.numpy as jnp
+
+    *_, t, _, head_dim = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (head_dim ** -0.5)
+    scores = scores.astype(jnp.float32)
+    if causal:
+        mask = jnp.tril(jnp.ones((t, k.shape[1]), bool))
+        scores = jnp.where(mask, scores, -1e30)
+    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    probs = probs / probs.sum(axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def _block(x, bparams, cfg: ModelConfig, positions):
+    import jax.numpy as jnp
+
+    b, t, _ = x.shape
+    h = _rms_norm(x, bparams["attn_norm"])
+    qkv = h @ bparams["wqkv"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+    attn = _attention(q, k, v).reshape(b, t, cfg.d_model)
+    x = x + attn @ bparams["wo"].astype(attn.dtype)
+
+    h = _rms_norm(x, bparams["mlp_norm"])
+    up = h @ bparams["w_up"].astype(h.dtype)
+    import jax
+
+    act = jax.nn.gelu(up)
+    return x + act @ bparams["w_down"].astype(act.dtype)
+
+
+def forward(params: Params, tokens, cfg: ModelConfig):
+    """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(cfg.dtype)
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t), (b, t))
+    x = params["embed"][tokens].astype(dtype)
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            _block, static_argnums=(2,), prevent_cse=False
+        )
+    for bparams in params["blocks"]:
+        x = block(x, bparams, cfg, positions)
+    x = _rms_norm(x, params["final_norm"])
+    # weight-tied readout in fp32 for a stable softmax
+    return (x.astype(jnp.float32) @
+            params["embed"].T.astype(jnp.float32))
+
+
+def loss_fn(params: Params, tokens, cfg: ModelConfig):
+    """Next-token cross-entropy (shifted within the batch)."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(picked)
+
+
+# ---------------------------------------------------------------------
+# sharding
+
+
+def param_specs(cfg: ModelConfig, mesh=None):
+    """PartitionSpec pytree: Megatron TP over the 'model' axis.
+
+    wqkv/w_up column-parallel, wo/w_down row-parallel, embedding
+    vocab-sharded, norms replicated. Safe for any mesh that has a
+    'model' axis; with no mesh, everything is replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    has_model = mesh is not None and "model" in mesh.axis_names
+    m = "model" if has_model else None
+    return {
+        "embed": P(m, None),
+        "final_norm": P(None),
+        "blocks": [
+            {
+                "attn_norm": P(None),
+                "mlp_norm": P(None),
+                "wqkv": P(None, m),
+                "wo": P(m, None),
+                "w_up": P(None, m),
+                "w_down": P(m, None),
+            }
+            for _ in range(cfg.n_layers)
+        ],
+    }
+
+
+def batch_spec(mesh=None):
+    """Tokens (batch, seq): batch over 'data', seq over 'seq' if present."""
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        return P(None, None)
+    names = mesh.axis_names
+    return P(
+        "data" if "data" in names else None,
+        "seq" if "seq" in names else None,
+    )
+
+
+# ---------------------------------------------------------------------
+# training
+
+
+def sgd_step(params, grads, lr):
+    import jax
+
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+
+
+def make_train_step(cfg: ModelConfig, mesh=None, learning_rate=1e-2,
+                    use_optax: bool = True):
+    """Returns (step_fn, init_state).
+
+    step_fn(state, tokens) -> (state, loss); jitted, with params and
+    batch sharded over the mesh when one is given (GSPMD inserts the
+    dp gradient psum and tp collectives).
+    """
+    import jax
+
+    if use_optax:
+        try:
+            import optax
+        except ImportError:  # pragma: no cover
+            use_optax = False
+
+    if use_optax:
+        tx = optax.adamw(learning_rate)
+    else:
+        tx = None
+
+    def init_state(key):
+        params = init_params(key, cfg)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            specs = param_specs(cfg, mesh)
+            params = jax.tree_util.tree_map(
+                lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+                params, specs,
+                is_leaf=lambda x: not isinstance(x, (dict, list)),
+            )
+        opt_state = tx.init(params) if tx else None
+        return {"params": params, "opt": opt_state}
+
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], tokens, cfg)
+        if tx:
+            updates, new_opt = tx.update(
+                grads, state["opt"], state["params"])
+            import optax as _optax
+
+            new_params = _optax.apply_updates(state["params"], updates)
+            return {"params": new_params, "opt": new_opt}, loss
+        return (
+            {"params": sgd_step(state["params"], grads, learning_rate),
+             "opt": None},
+            loss,
+        )
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        tokens_sharding = NamedSharding(mesh, batch_spec(mesh))
+        step_fn = jax.jit(step, in_shardings=(None, tokens_sharding))
+    else:
+        step_fn = jax.jit(step)
+    return step_fn, init_state
+
+
+def sample_batch(key, cfg: ModelConfig, batch: int,
+                 seq: Optional[int] = None):
+    """Synthetic structured data (ramps mod vocab) the LM can learn."""
+    import jax
+    import jax.numpy as jnp
+
+    seq = seq or cfg.max_seq
+    starts = jax.random.randint(key, (batch, 1), 0, cfg.vocab_size)
+    ramp = jnp.arange(seq)[None, :]
+    return (starts + ramp) % cfg.vocab_size

@@ -1,1 +1,3 @@
-"""Pallas/Mosaic TPU kernels and compile smokes."""
+"""Pallas/Mosaic TPU kernels and the kernel-toolchain smoke."""
+
+from kind_tpu_sim.ops import pallas_kernels  # noqa: F401

@@ -1,1 +1,7 @@
-"""Meshes, collectives, and multi-host initialization for the simulated slice."""
+"""Meshes, collectives, ring attention, and multi-host init for the
+simulated TPU slice."""
+
+from kind_tpu_sim.parallel import collectives  # noqa: F401
+from kind_tpu_sim.parallel import mesh  # noqa: F401
+from kind_tpu_sim.parallel import multihost  # noqa: F401
+from kind_tpu_sim.parallel import ring_attention  # noqa: F401

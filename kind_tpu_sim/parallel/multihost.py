@@ -1,0 +1,102 @@
+"""Multi-host (multi-pod) JAX initialization for the simulated slice.
+
+The DCN analog of the simulator (SURVEY.md §5 "distributed
+communication backend"): one JAX process per kind worker node, wired
+together with `jax.distributed.initialize` over the pod network. The
+coordinator address and process identity come from the env contract the
+device plugin injects at Allocate time (TPU_WORKER_ID /
+TPU_WORKER_HOSTNAMES), so a pod that requests `google.com/tpu` is born
+knowing its place in the slice — exactly how real TPU pods discover
+their slice via the metadata server.
+
+Used by pods/jax-multihost.yaml (StatefulSet, one replica per worker).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import List, Optional
+
+log = logging.getLogger("kind-tpu-sim")
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass
+class HostIdentity:
+    worker_id: int
+    hostnames: List[str]
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.hostnames)
+
+    @property
+    def coordinator_address(self) -> str:
+        return f"{self.hostnames[0]}:{self.coordinator_port}"
+
+
+def identity_from_env(environ=None) -> Optional[HostIdentity]:
+    """Parse the plugin-injected worker identity; None if not present."""
+    env = os.environ if environ is None else environ
+    hostnames = [
+        h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+    ]
+    worker_id_raw = env.get("TPU_WORKER_ID")
+    if not hostnames or worker_id_raw is None:
+        return None
+    try:
+        worker_id = int(worker_id_raw)
+    except ValueError:
+        return None
+    if not 0 <= worker_id < len(hostnames):
+        return None
+    port_raw = env.get("TPU_SIM_COORDINATOR_PORT",
+                       str(DEFAULT_COORDINATOR_PORT))
+    try:
+        port = int(port_raw)
+    except ValueError:
+        port = DEFAULT_COORDINATOR_PORT
+    return HostIdentity(worker_id=worker_id, hostnames=hostnames,
+                        coordinator_port=port)
+
+
+def initialize_from_env(environ=None) -> HostIdentity:
+    """`jax.distributed.initialize` from the simulated TPU identity.
+
+    Single-host identities (or none at all) skip initialization and
+    return a 1-process identity, so the same workload runs unchanged on
+    one pod or across the whole slice.
+    """
+    import jax
+
+    identity = identity_from_env(environ)
+    if identity is None or identity.num_processes == 1:
+        log.info("single-process mode (no multi-host identity in env)")
+        return identity or HostIdentity(worker_id=0, hostnames=["localhost"])
+    log.info(
+        "initializing jax.distributed: process %d/%d, coordinator %s",
+        identity.worker_id, identity.num_processes,
+        identity.coordinator_address,
+    )
+    jax.distributed.initialize(
+        coordinator_address=identity.coordinator_address,
+        num_processes=identity.num_processes,
+        process_id=identity.worker_id,
+    )
+    return identity
+
+
+def global_device_report() -> dict:
+    """Post-init summary a multi-host pod logs for CI to assert on."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }

@@ -12,7 +12,10 @@ import os
 import pathlib
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment preselects a TPU platform
+# (e.g. JAX_PLATFORMS=axon on the bench host) — tests always run on the
+# virtual 8-device backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,3 +25,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
+
+# Some TPU platform plugins (axon) register themselves regardless of
+# JAX_PLATFORMS; pin the config explicitly before any backend init.
+# jax stays optional: the orchestrator/topology/plugin tests run fine
+# without it.
+try:
+    import jax  # noqa: E402
+except ImportError:
+    pass
+else:
+    jax.config.update("jax_platforms", "cpu")
