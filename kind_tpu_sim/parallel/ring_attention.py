@@ -105,18 +105,9 @@ def ring_attention(q, k, v, mesh, axis_name: str = "chip",
 
 
 def reference_attention(q, k, v, causal: bool = True):
-    """Single-device attention for correctness checks."""
-    import jax.numpy as jnp
+    """Single-device attention oracle — the flagship model's own
+    attention, so ring attention is checked against the exact numerics
+    the transformer uses."""
+    from kind_tpu_sim.models.transformer import _attention
 
-    _, t, _, head_dim = q.shape
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * (head_dim ** -0.5)
-    if causal:
-        mask = jnp.tril(jnp.ones((t, k.shape[1]), bool))
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
-    probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
-    probs = probs / probs.sum(axis=-1, keepdims=True)
-    return jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v.astype(probs.dtype)
-    ).astype(q.dtype)
+    return _attention(q, k, v, causal=causal).astype(q.dtype)
