@@ -264,6 +264,77 @@ def test_unknown_method_unimplemented(plugin_env, pb):
     channel.close()
 
 
+def test_concurrent_clients_and_streams(plugin_env, pb):
+    """Several clients + ListAndWatch streams at once; plus unary
+    traffic interleaved on the same connection as a live stream."""
+    import concurrent.futures
+
+    def one_client(i):
+        channel = make_channel(plugin_env["socket"])
+        stream = channel.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )(pb.Empty(), timeout=15)
+        first = next(stream)
+        assert len(first.devices) == 8
+        # unary call on the same channel while the stream is open
+        options = call_unary(channel, pb, "GetDevicePluginOptions",
+                             pb.Empty(), pb.Empty,
+                             pb.DevicePluginOptions)
+        assert options.get_preferred_allocation_available
+        stream.cancel()
+        channel.close()
+        return i
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+        results = list(pool.map(one_client, range(4)))
+    assert results == [0, 1, 2, 3]
+
+
+def test_large_metadata_exercises_continuation(plugin_env, pb):
+    """>16KB of request metadata forces HEADERS+CONTINUATION frames
+    through the hand-rolled HPACK path."""
+    channel = make_channel(plugin_env["socket"])
+    stub = channel.unary_unary(
+        "/v1beta1.DevicePlugin/GetDevicePluginOptions",
+        request_serializer=pb.Empty.SerializeToString,
+        response_deserializer=pb.DevicePluginOptions.FromString,
+    )
+    big = "x" * 20000
+    options = stub(pb.Empty(), timeout=10,
+                   metadata=(("big-bin-header", big),))
+    assert options.get_preferred_allocation_available
+    channel.close()
+
+
+def test_allocate_multiple_containers(plugin_env, pb):
+    channel = make_channel(plugin_env["socket"])
+    req = pb.AllocateRequest()
+    req.container_requests.add().devicesIDs.extend(["tpu-1-8"])
+    req.container_requests.add().devicesIDs.extend(
+        ["tpu-1-9", "tpu-1-10"])
+    resp = call_unary(channel, pb, "Allocate", req,
+                      pb.AllocateRequest, pb.AllocateResponse)
+    assert len(resp.container_responses) == 2
+    assert len(resp.container_responses[0].devices) == 1
+    assert len(resp.container_responses[1].devices) == 2
+    assert dict(resp.container_responses[1].envs)[
+        "TPU_VISIBLE_CHIPS"] == "1,2"
+    channel.close()
+
+
+def test_prestart_container_noop(plugin_env, pb):
+    channel = make_channel(plugin_env["socket"])
+    req = pb.PreStartContainerRequest()
+    req.devicesIDs.append("tpu-1-8")
+    resp = call_unary(channel, pb, "PreStartContainer", req,
+                      pb.PreStartContainerRequest,
+                      pb.PreStartContainerResponse)
+    assert resp is not None
+    channel.close()
+
+
 def test_reregisters_after_kubelet_restart(plugin_env, pb):
     # First registration.
     plugin_env["kubelet"].requests.get(timeout=10)
