@@ -212,6 +212,7 @@ def model_throughput() -> dict | None:
     """Flagship model step throughput on the local accelerator."""
     try:
         import jax
+        import numpy as np
 
         from kind_tpu_sim.models import transformer as tf
 
@@ -243,11 +244,32 @@ def model_throughput() -> dict | None:
         total = float(run(params, tokens))
         dt = (time.monotonic() - t0) / steps
         assert total == total  # NaN guard
-        return {
+        result = {
             "backend": backend,
             "model": f"d{cfg.d_model}xL{cfg.n_layers}",
             "fwd_tokens_per_s": round(batch * cfg.max_seq / dt),
         }
+
+        # Greedy decode throughput (KV-cache scan; single readback).
+        # Best-effort: a decode failure must not discard the forward
+        # number already measured.
+        try:
+            from kind_tpu_sim.models import decode
+
+            new_tokens = 64 if backend == "tpu" else 8
+            prompt = tokens[:, :16]
+            gen = jax.jit(lambda p, t: decode.greedy_generate(
+                p, cfg, t, new_tokens))
+            np.asarray(gen(params, prompt))  # compile + warm
+            t0 = time.monotonic()
+            out = np.asarray(gen(params, prompt))
+            dt = time.monotonic() - t0
+            assert out.shape[1] == 16 + new_tokens
+            result["decode_tokens_per_s"] = round(
+                batch * new_tokens / dt)
+        except Exception as exc:  # pragma: no cover - best effort
+            result["decode_error"] = str(exc)[:100]
+        return result
     except Exception as exc:  # pragma: no cover - best effort
         return {"error": str(exc)[:100]}
 

@@ -36,6 +36,7 @@ class ModelConfig:
     max_seq: int = 128
     dtype: str = "bfloat16"       # activation/matmul dtype
     remat: bool = False           # jax.checkpoint each block
+    n_experts: int = 0            # >0: Switch-MoE MLP (expert parallel)
 
     @property
     def head_dim(self) -> int:
@@ -79,15 +80,23 @@ def init_params(key, cfg: ModelConfig) -> Params:
     }
     for i in range(cfg.n_layers):
         bkey = jax.random.split(keys[2 + i], 4)
-        params["blocks"].append({
+        block = {
             "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
             "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
             "wqkv": dense(bkey[0], (cfg.d_model, 3 * cfg.d_model), scale),
             "wo": dense(bkey[1], (cfg.d_model, cfg.d_model), scale),
-            "w_up": dense(bkey[2], (cfg.d_model, cfg.d_ff), scale),
-            "w_down": dense(bkey[3], (cfg.d_ff, cfg.d_model),
-                            cfg.d_ff ** -0.5),
-        })
+        }
+        if cfg.n_experts > 0:
+            from kind_tpu_sim.models.moe import MoeConfig, init_moe_params
+
+            block["moe"] = init_moe_params(
+                bkey[2], cfg.d_model, cfg.d_ff,
+                MoeConfig(n_experts=cfg.n_experts))
+        else:
+            block["w_up"] = dense(bkey[2], (cfg.d_model, cfg.d_ff), scale)
+            block["w_down"] = dense(bkey[3], (cfg.d_ff, cfg.d_model),
+                                    cfg.d_ff ** -0.5)
+        params["blocks"].append(block)
     return params
 
 
@@ -139,6 +148,7 @@ def _attention(q, k, v, causal=True):
 
 
 def _block(x, bparams, cfg: ModelConfig, positions):
+    import jax
     import jax.numpy as jnp
 
     b, t, _ = x.shape
@@ -154,15 +164,24 @@ def _block(x, bparams, cfg: ModelConfig, positions):
     x = x + attn @ bparams["wo"].astype(attn.dtype)
 
     h = _rms_norm(x, bparams["mlp_norm"])
+    if "moe" in bparams:
+        from kind_tpu_sim.models.moe import MoeConfig, moe_mlp
+
+        out, aux = moe_mlp(h, bparams["moe"],
+                           MoeConfig(n_experts=cfg.n_experts))
+        return x + out, aux
     up = h @ bparams["w_up"].astype(h.dtype)
-    import jax
-
     act = jax.nn.gelu(up)
-    return x + act @ bparams["w_down"].astype(act.dtype)
+    return x + act @ bparams["w_down"].astype(act.dtype), jnp.float32(0)
 
 
-def forward(params: Params, tokens, cfg: ModelConfig):
-    """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32."""
+def forward(params: Params, tokens, cfg: ModelConfig,
+            return_aux: bool = False):
+    """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32.
+
+    With ``return_aux`` also returns the summed MoE load-balancing
+    loss (zero for dense configs).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -175,25 +194,30 @@ def forward(params: Params, tokens, cfg: ModelConfig):
         block = jax.checkpoint(
             _block, static_argnums=(2,), prevent_cse=False
         )
+    aux_total = jnp.float32(0)
     for bparams in params["blocks"]:
-        x = block(x, bparams, cfg, positions)
+        x, aux = block(x, bparams, cfg, positions)
+        aux_total = aux_total + aux
     x = _rms_norm(x, params["final_norm"])
     # weight-tied readout in fp32 for a stable softmax
-    return (x.astype(jnp.float32) @
-            params["embed"].T.astype(jnp.float32))
+    logits = (x.astype(jnp.float32) @
+              params["embed"].T.astype(jnp.float32))
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(params: Params, tokens, cfg: ModelConfig):
-    """Next-token cross-entropy (shifted within the batch)."""
+    """Next-token cross-entropy (+ MoE aux loss when configured)."""
     import jax
     import jax.numpy as jnp
 
-    logits = forward(params, tokens[:, :-1], cfg)
+    logits, aux = forward(params, tokens[:, :-1], cfg, return_aux=True)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(
         logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(picked)
+    return -jnp.mean(picked) + aux
 
 
 # ---------------------------------------------------------------------
@@ -211,6 +235,12 @@ def param_specs(cfg: ModelConfig, mesh=None):
 
     has_model = mesh is not None and "model" in mesh.axis_names
     m = "model" if has_model else None
+    if cfg.n_experts > 0:
+        from kind_tpu_sim.models.moe import moe_param_specs
+
+        mlp_spec = {"moe": moe_param_specs(mesh)}
+    else:
+        mlp_spec = {"w_up": P(None, m), "w_down": P(m, None)}
     return {
         "embed": P(m, None),
         "final_norm": P(None),
@@ -220,8 +250,7 @@ def param_specs(cfg: ModelConfig, mesh=None):
                 "mlp_norm": P(None),
                 "wqkv": P(None, m),
                 "wo": P(m, None),
-                "w_up": P(None, m),
-                "w_down": P(m, None),
+                **mlp_spec,
             }
             for _ in range(cfg.n_layers)
         ],

@@ -1,0 +1,157 @@
+"""Pipeline parallelism (PP): GPipe-style microbatching over a
+'stage' mesh axis.
+
+Each stage owns a contiguous slice of transformer blocks (the block
+params are stacked and sharded over the stage axis), activations flow
+stage-to-stage with neighbor `ppermute` — ICI traffic only — and a
+single `lax.scan` runs the M + S - 1 pipeline ticks, bubbles included,
+as one compiled loop. Composes with data parallelism by adding a
+'data' axis to the same mesh (microbatches shard over it untouched).
+
+The reference has no parallelism of any kind (SURVEY.md §2
+"parallelism strategies"); this module, with the tensor/sequence
+shardings in models/transformer.py and the expert dispatch in
+models/moe.py, completes the dp/tp/sp/pp/ep set over the simulated
+slice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+
+def stack_stage_params(params, n_stages: int):
+    """Stack per-block param dicts -> arrays with a leading
+    (n_stages, layers_per_stage) prefix, shardable over 'stage'."""
+    import jax
+    import jax.numpy as jnp
+
+    blocks = params["blocks"]
+    n_layers = len(blocks)
+    if n_layers % n_stages:
+        raise ValueError(
+            f"{n_layers} layers not divisible into {n_stages} stages")
+    per_stage = n_layers // n_stages
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *blocks)
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape(n_stages, per_stage, *x.shape[1:]), stacked)
+
+
+def _apply_stage(local_blocks, x, cfg):
+    """Run this stage's layers over activations x (mb, t, d)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.transformer import _block
+
+    positions = jnp.broadcast_to(
+        jnp.arange(x.shape[1]), x.shape[:2])
+
+    def body(h, layer_params):
+        h, _aux = _block(h, layer_params, cfg, positions)
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, local_blocks)
+    return x
+
+
+def _pipeline_local(x_mb, stage_blocks, *, cfg, axis, n_micro):
+    """Per-device pipeline body. x_mb: (M, mb, t, d) replicated over
+    the stage axis; stage_blocks: this stage's (1, per_stage, ...)
+    params (leading stage dim of the sharded stack)."""
+    import jax
+    import jax.numpy as jnp
+
+    local_blocks = jax.tree_util.tree_map(
+        lambda x: x[0], stage_blocks)
+    stages = jax.lax.psum(1, axis)
+    idx = jax.lax.axis_index(axis)
+
+    pvary = functools.partial(jax.lax.pcast, axis_name=axis,
+                              to="varying")
+    state = pvary(jnp.zeros_like(x_mb[0]))
+    outputs = pvary(jnp.zeros_like(x_mb))
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 injects microbatch t (clamped; extras are discarded)
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        inject = jax.lax.dynamic_index_in_dim(
+            x_mb, mb_idx, 0, keepdims=False)
+        state = jnp.where(idx == 0, inject, state)
+
+        state = _apply_stage(local_blocks, state, cfg)
+
+        # last stage emits microbatch t - (stages - 1)
+        out_idx = jnp.clip(t - (stages - 1), 0, n_micro - 1)
+        emitted = jax.lax.dynamic_update_index_in_dim(
+            outputs, state, out_idx, 0)
+        should_emit = (idx == stages - 1) & (t >= stages - 1)
+        outputs = jnp.where(should_emit, emitted, outputs)
+
+        # hand activations to the next stage (no wraparound)
+        perm = [(i, i + 1) for i in range(stages - 1)]
+        state = jax.lax.ppermute(state, axis, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state, outputs),
+        jnp.arange(n_micro + stages - 1))
+
+    # broadcast the last stage's collected outputs to every stage
+    outputs = jnp.where(idx == stages - 1, outputs,
+                        jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis)
+
+
+@functools.lru_cache(maxsize=16)
+def _build_pipeline(mesh, cfg, stage_axis: str, n_micro: int):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    data_axis = "data" if "data" in mesh.axis_names else None
+    x_spec = P(None, data_axis, None, None)   # (M, mb, t, d)
+    block_spec = P(stage_axis)                # leading stage dim
+    fn = functools.partial(
+        _pipeline_local, cfg=cfg, axis=stage_axis, n_micro=n_micro)
+    sharded = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(x_spec, block_spec),
+        out_specs=x_spec,
+    )
+    return jax.jit(sharded)
+
+
+def pipeline_forward(params, tokens, cfg, mesh,
+                     stage_axis: str = "stage",
+                     n_microbatches: Optional[int] = None):
+    """Full forward with the blocks pipelined over `stage_axis`.
+
+    tokens (batch, seq); batch must divide by n_microbatches
+    (default: number of stages). Returns logits like
+    ``transformer.forward``.
+    """
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.transformer import _rms_norm
+
+    stages = mesh.devices.shape[mesh.axis_names.index(stage_axis)]
+    n_micro = n_microbatches or stages
+    b, t = tokens.shape
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} "
+                         "microbatches")
+    dtype = jnp.dtype(cfg.dtype)
+    x = params["embed"][tokens].astype(dtype)
+    x_mb = x.reshape(n_micro, b // n_micro, t, cfg.d_model)
+
+    stage_blocks = stack_stage_params(params, stages)
+    out = _build_pipeline(mesh, cfg, stage_axis, n_micro)(
+        x_mb, stage_blocks)
+
+    x = out.reshape(b, t, cfg.d_model)
+    x = _rms_norm(x, params["final_norm"])
+    return (x.astype(jnp.float32) @
+            params["embed"].T.astype(jnp.float32))
