@@ -1,10 +1,10 @@
 """Autoregressive decoding with a static KV cache.
 
 The serving-side counterpart of the training step (the role vLLM plays
-in the reference's pods): greedy generation with a preallocated
-(batch, max_len) cache, one fused `lax.scan` over positions — no
-Python loop per token, no dynamic shapes, so the whole decode compiles
-to a single XLA while-loop that keeps the MXU busy.
+in the reference's pods): a batched prefill pass fills a preallocated
+(batch, max_len) cache in one forward (MXU-shaped matmuls), then a
+single fused `lax.scan` generates greedily — no Python loop per token,
+no dynamic shapes, so the decode compiles to one XLA while-loop.
 
 Numerical contract (dense configs): a token generated through the
 cache path must equal the argmax of the full (uncached) forward at
@@ -17,11 +17,12 @@ MoE decode is a functional path, not a bit-identical one.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 from kind_tpu_sim.models.transformer import (
     ModelConfig,
     Params,
+    _block_core,
     _rms_norm,
     _rotary,
 )
@@ -89,6 +90,42 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
     return x, {"k": cache_k, "v": cache_v}
 
 
+def _block_prefill(x, bparams, cfg: ModelConfig, layer_cache, positions):
+    """One block over the whole prompt. x: (b, t, d); fills cache[:t]."""
+    import jax
+
+    x, _, k, v = _block_core(x, bparams, cfg, positions)
+    cache_k = jax.lax.dynamic_update_slice(
+        layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, 0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(
+        layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, 0, 0))
+    return x, {"k": cache_k, "v": cache_v}
+
+
+def prefill(params: Params, cfg: ModelConfig, prompt, max_len: int):
+    """prompt (b, t_p) -> (last-position logits (b, vocab), filled cache).
+
+    One batched forward pass over the whole prompt (MXU-shaped matmuls,
+    t_p-long attention) instead of t_p serial single-token cache steps.
+    """
+    import jax.numpy as jnp
+
+    b, t_p = prompt.shape
+    dtype = jnp.dtype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(t_p), (b, t_p))
+    x = params["embed"][prompt].astype(dtype)
+    cache = init_cache(cfg, b, max_len)
+    new_cache = []
+    for bparams, layer_cache in zip(params["blocks"], cache):
+        x, updated = _block_prefill(x, bparams, cfg, layer_cache,
+                                    positions)
+        new_cache.append(updated)
+    last = _rms_norm(x[:, -1, :], params["final_norm"])
+    logits = (last.astype(jnp.float32) @
+              params["embed"].T.astype(jnp.float32))
+    return logits, new_cache
+
+
 def decode_step(params: Params, cfg: ModelConfig, token, cache, pos):
     """token (b,) int32 at position `pos` -> (logits (b, vocab), cache)."""
     import jax.numpy as jnp
@@ -104,39 +141,46 @@ def decode_step(params: Params, cfg: ModelConfig, token, cache, pos):
     return logits, new_cache
 
 
+def generate_from_cache(params: Params, cfg: ModelConfig, first_token,
+                        cache, start_pos: int, num_new: int):
+    """Pure decode loop: `first_token` (b,) sits at `start_pos`; emits
+    (b, num_new) greedy tokens starting with it. One fused scan."""
+    import jax
+    import jax.numpy as jnp
+
+    if num_new <= 0:
+        return jnp.zeros((first_token.shape[0], 0), first_token.dtype)
+
+    def step(carry, i):
+        token, cache = carry
+        logits, cache = decode_step(params, cfg, token, cache,
+                                    start_pos + i)
+        nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
+        return (nxt, cache), nxt
+
+    (_, _), rest = jax.lax.scan(
+        step, (first_token, cache), jnp.arange(num_new - 1))
+    return jnp.concatenate(
+        [first_token[:, None], rest.swapaxes(0, 1)], axis=1)
+
+
 def greedy_generate(params: Params, cfg: ModelConfig, prompt,
                     num_new: int):
     """prompt (b, t_p) int32 -> (b, t_p + num_new) greedy continuation.
 
-    Prefill and generation share one scan: positions < t_p consume the
-    prompt (filling the cache), later positions feed back the argmax.
+    Batched prefill over the prompt (one forward pass filling the
+    cache), then a decode-only scan for the generated positions.
     """
-    import jax
     import jax.numpy as jnp
 
     b, t_p = prompt.shape
-    total = t_p + num_new
-    buffer = jnp.concatenate(
-        [prompt, jnp.zeros((b, num_new), prompt.dtype)], axis=1)
-    cache = init_cache(cfg, b, total)
-
-    def step(carry, pos):
-        buffer, cache = carry
-        token = jax.lax.dynamic_slice(buffer, (0, pos), (b, 1))[:, 0]
-        logits, cache = decode_step(params, cfg, token, cache, pos)
-        next_token = jnp.argmax(logits, axis=-1).astype(buffer.dtype)
-        # keep prompt tokens; write generated ones past the prompt
-        write_pos = pos + 1
-        current = jax.lax.dynamic_slice(
-            buffer, (0, write_pos), (b, 1))[:, 0]
-        new_val = jnp.where(write_pos >= t_p, next_token, current)
-        buffer = jax.lax.dynamic_update_slice(
-            buffer, new_val[:, None], (0, write_pos))
-        return (buffer, cache), None
-
-    (buffer, _), _ = jax.lax.scan(
-        step, (buffer, cache), jnp.arange(total - 1))
-    return buffer
+    if num_new <= 0:
+        return prompt
+    logits, cache = prefill(params, cfg, prompt, t_p + num_new)
+    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    generated = generate_from_cache(params, cfg, first, cache,
+                                    t_p, num_new)
+    return jnp.concatenate([prompt, generated], axis=1)
 
 
 def generate_report(cfg: ModelConfig = None, batch: int = 2,

@@ -73,12 +73,17 @@ def moe_mlp(x, mparams, moe: MoeConfig) -> Tuple[Any, Any]:
     dispatch = (onehot * keep_any[:, None])[:, :, None] * \
         pos_onehot[:, None, :]
 
-    xf = tokens.astype(jnp.float32)
-    expert_in = jnp.einsum("sec,sd->ecd", dispatch, xf)
+    # Router math stays fp32 (softmax/argmax stability); the expert
+    # matmuls run in the activation dtype so bf16 configs hit the MXU
+    # the same way the dense MLP path does.
+    dispatch_c = dispatch.astype(x.dtype)
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch_c, tokens)
     hidden = jax.nn.gelu(
-        jnp.einsum("ecd,edf->ecf", expert_in, mparams["w_up"]))
-    expert_out = jnp.einsum("ecf,efd->ecd", hidden, mparams["w_down"])
-    combine = dispatch * (gate * keep_any)[:, None, None]
+        jnp.einsum("ecd,edf->ecf", expert_in,
+                   mparams["w_up"].astype(x.dtype)))
+    expert_out = jnp.einsum("ecf,efd->ecd", hidden,
+                            mparams["w_down"].astype(x.dtype))
+    combine = dispatch_c * (gate * keep_any).astype(x.dtype)[:, None, None]
     out = jnp.einsum("sec,ecd->sd", combine, expert_out)
 
     # Load-balancing loss (Switch eq. 4): E * sum_e f_e * P_e.

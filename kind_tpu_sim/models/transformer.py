@@ -137,8 +137,12 @@ def _attention(q, k, v, causal=True):
     import jax.numpy as jnp
 
     *_, t, _, head_dim = q.shape
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (head_dim ** -0.5)
-    scores = scores.astype(jnp.float32)
+    # fp32 accumulation (MXU native) — and the cached decode path in
+    # models/decode.py accumulates fp32 too, which keeps the
+    # cache-vs-full-forward argmax contract exact in bf16 configs.
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32,
+    ) * (head_dim ** -0.5)
     if causal:
         mask = jnp.tril(jnp.ones((t, k.shape[1]), bool))
         scores = jnp.where(mask, scores, -1e30)
@@ -147,7 +151,10 @@ def _attention(q, k, v, causal=True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
 
 
-def _block(x, bparams, cfg: ModelConfig, positions):
+def _block_core(x, bparams, cfg: ModelConfig, positions):
+    """Block body, also exposing the rotated k/v so the decode prefill
+    (models/decode.py) can fill its cache without duplicating this.
+    Returns (x_out, aux_loss, k, v)."""
     import jax
     import jax.numpy as jnp
 
@@ -169,10 +176,16 @@ def _block(x, bparams, cfg: ModelConfig, positions):
 
         out, aux = moe_mlp(h, bparams["moe"],
                            MoeConfig(n_experts=cfg.n_experts))
-        return x + out, aux
+        return x + out, aux, k, v
     up = h @ bparams["w_up"].astype(h.dtype)
     act = jax.nn.gelu(up)
-    return x + act @ bparams["w_down"].astype(act.dtype), jnp.float32(0)
+    return (x + act @ bparams["w_down"].astype(act.dtype),
+            jnp.float32(0), k, v)
+
+
+def _block(x, bparams, cfg: ModelConfig, positions):
+    x, aux, _, _ = _block_core(x, bparams, cfg, positions)
+    return x, aux
 
 
 def forward(params: Params, tokens, cfg: ModelConfig,

@@ -126,12 +126,15 @@ def _build_pipeline(mesh, cfg, stage_axis: str, n_micro: int):
 
 def pipeline_forward(params, tokens, cfg, mesh,
                      stage_axis: str = "stage",
-                     n_microbatches: Optional[int] = None):
+                     n_microbatches: Optional[int] = None,
+                     stacked_params=None):
     """Full forward with the blocks pipelined over `stage_axis`.
 
     tokens (batch, seq); batch must divide by n_microbatches
     (default: number of stages). Returns logits like
-    ``transformer.forward``.
+    ``transformer.forward``. Callers invoking this repeatedly should
+    pass ``stacked_params=stack_stage_params(params, stages)`` once —
+    otherwise the block tree is re-stacked on every call.
     """
     import jax.numpy as jnp
 
@@ -143,11 +146,19 @@ def pipeline_forward(params, tokens, cfg, mesh,
     if b % n_micro:
         raise ValueError(f"batch {b} not divisible into {n_micro} "
                          "microbatches")
+    data_size = (mesh.devices.shape[mesh.axis_names.index("data")]
+                 if "data" in mesh.axis_names else 1)
+    if (b // n_micro) % data_size:
+        raise ValueError(
+            f"microbatch size {b // n_micro} (batch {b} / {n_micro} "
+            f"microbatches) not divisible over the 'data' mesh axis "
+            f"of size {data_size}")
     dtype = jnp.dtype(cfg.dtype)
     x = params["embed"][tokens].astype(dtype)
     x_mb = x.reshape(n_micro, b // n_micro, t, cfg.d_model)
 
-    stage_blocks = stack_stage_params(params, stages)
+    stage_blocks = (stacked_params if stacked_params is not None
+                    else stack_stage_params(params, stages))
     out = _build_pipeline(mesh, cfg, stage_axis, n_micro)(
         x_mb, stage_blocks)
 

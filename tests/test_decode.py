@@ -55,6 +55,30 @@ def test_greedy_generate_preserves_prompt(cfg):
                                   np.array(prompt))
 
 
+def test_greedy_generate_bf16_consistency():
+    """The cache path and the full forward accumulate scores in fp32,
+    so the argmax contract holds in the default bf16 config too."""
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=32)
+    report = decode.generate_report(cfg, batch=2, prompt_len=8,
+                                    num_new=8)
+    assert report["ok"], report
+
+
+def test_generate_from_cache_zero_tokens(cfg):
+    import jax
+    import jax.numpy as jnp
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch=2,
+                             seq=8)
+    logits, cache = decode.prefill(params, cfg, prompt, 8)
+    first = jnp.argmax(logits, -1).astype(prompt.dtype)
+    out = decode.generate_from_cache(params, cfg, first, cache, 8, 0)
+    assert out.shape == (2, 0)
+    assert decode.greedy_generate(params, cfg, prompt, 0).shape == (2, 8)
+
+
 def test_moe_decode_runs():
     cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
                          n_layers=2, d_ff=64, max_seq=32, n_experts=2)
