@@ -23,9 +23,38 @@ from kind_tpu_sim.models.transformer import (
     ModelConfig,
     Params,
     _block_core,
+    _readout,
     _rms_norm,
     _rotary,
 )
+
+
+def serving_params(params: Params, cfg: ModelConfig) -> Params:
+    """One-time cast of the matmul weights to the activation dtype.
+
+    Halves the HBM bytes a decode step reads (decode is weight-
+    bandwidth-bound on TPU: every generated token re-reads every
+    weight). For wqkv/wo/w_up/w_down the per-use ``.astype`` casts in
+    the forward/decode paths make this a numerics no-op; the readout,
+    however, follows the embedding's dtype, so a snapshot's logits are
+    bf16-rounded and greedy tokens can differ from the fp32 originals
+    near argmax ties — the consistency contract holds snapshot-vs-
+    snapshot, not snapshot-vs-original. Norm scales (1-D) and the MoE
+    router stay fp32 — routing argmax stability is worth 0.01% of the
+    bytes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.dtype(cfg.dtype)
+
+    def cast(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else None
+        if leaf.ndim >= 2 and name != "router":
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(cast, params)
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int):
@@ -34,9 +63,9 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int):
     dtype = jnp.dtype(cfg.dtype)
     return [
         {
-            "k": jnp.zeros((batch, max_len, cfg.n_heads, cfg.head_dim),
+            "k": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim),
                            dtype),
-            "v": jnp.zeros((batch, max_len, cfg.n_heads, cfg.head_dim),
+            "v": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim),
                            dtype),
         }
         for _ in range(cfg.n_layers)
@@ -51,10 +80,12 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
     b, _ = x.shape
     h = _rms_norm(x, bparams["attn_norm"])
     qkv = h @ bparams["wqkv"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q_dim = cfg.n_heads * cfg.head_dim
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    q, k, v = jnp.split(qkv, [q_dim, q_dim + kv_dim], axis=-1)
     q = q.reshape(b, 1, cfg.n_heads, cfg.head_dim)
-    k = k.reshape(b, 1, cfg.n_heads, cfg.head_dim)
-    v = v.reshape(b, 1, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, 1, cfg.kv_heads, cfg.head_dim)
+    v = v.reshape(b, 1, cfg.kv_heads, cfg.head_dim)
     positions = jnp.full((b, 1), pos)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
@@ -65,15 +96,17 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
         layer_cache["v"], v, (0, pos, 0, 0))
 
     max_len = cache_k.shape[1]
+    group = cfg.n_heads // cfg.kv_heads
+    qg = q.reshape(b, cfg.kv_heads, group, cfg.head_dim)
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, cache_k,
+        "bkgd,bskd->bkgs", qg, cache_k,
         preferred_element_type=jnp.float32,
     ) * (cfg.head_dim ** -0.5)
     valid = jnp.arange(max_len) <= pos
     scores = jnp.where(valid[None, None, None, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum(
-        "bhqk,bkhd->bqhd", probs.astype(cache_v.dtype), cache_v
+        "bkgs,bskd->bkgd", probs.astype(cache_v.dtype), cache_v
     ).reshape(b, cfg.d_model)
     x = x + attn @ bparams["wo"].astype(attn.dtype)
 
@@ -121,8 +154,7 @@ def prefill(params: Params, cfg: ModelConfig, prompt, max_len: int):
                                     positions)
         new_cache.append(updated)
     last = _rms_norm(x[:, -1, :], params["final_norm"])
-    logits = (last.astype(jnp.float32) @
-              params["embed"].T.astype(jnp.float32))
+    logits = _readout(last, params["embed"])
     return logits, new_cache
 
 
@@ -137,7 +169,7 @@ def decode_step(params: Params, cfg: ModelConfig, token, cache, pos):
         x, updated = _block_decode(x, bparams, cfg, layer_cache, pos)
         new_cache.append(updated)
     x = _rms_norm(x, params["final_norm"])
-    logits = x.astype(jnp.float32) @ params["embed"].T.astype(jnp.float32)
+    logits = _readout(x, params["embed"])
     return logits, new_cache
 
 

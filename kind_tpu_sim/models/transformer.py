@@ -37,11 +37,20 @@ class ModelConfig:
     dtype: str = "bfloat16"       # activation/matmul dtype
     remat: bool = False           # jax.checkpoint each block
     n_experts: int = 0            # >0: Switch-MoE MLP (expert parallel)
+    n_kv_heads: Optional[int] = None  # grouped-query attention; None = MHA
 
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        """KV head count (GQA). Serving on TPU is HBM-bound on the KV
+        cache; fewer KV heads cut that traffic n_heads/kv_heads-fold."""
+        kv = self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+        assert kv > 0 and self.n_heads % kv == 0
+        return kv
 
 
 def tiny_config() -> ModelConfig:
@@ -55,9 +64,12 @@ def pod_config() -> ModelConfig:
 
 
 def bench_config() -> ModelConfig:
-    """Single-chip benchmark config: MXU-sized matmuls."""
+    """Single-chip benchmark config: MXU-sized matmuls, Llama-style
+    4:1 grouped-query attention (serving is KV-cache-bandwidth-bound;
+    GQA cuts that traffic 4x)."""
     return ModelConfig(vocab_size=32768, d_model=1024, n_heads=16,
-                       n_layers=8, d_ff=4096, max_seq=1024, remat=False)
+                       n_layers=8, d_ff=4096, max_seq=1024, remat=False,
+                       n_kv_heads=4)
 
 
 # ---------------------------------------------------------------------
@@ -83,7 +95,11 @@ def init_params(key, cfg: ModelConfig) -> Params:
         block = {
             "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
             "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
-            "wqkv": dense(bkey[0], (cfg.d_model, 3 * cfg.d_model), scale),
+            "wqkv": dense(
+                bkey[0],
+                (cfg.d_model,
+                 (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim),
+                scale),
             "wo": dense(bkey[1], (cfg.d_model, cfg.d_model), scale),
         }
         if cfg.n_experts > 0:
@@ -102,6 +118,19 @@ def init_params(key, cfg: ModelConfig) -> Params:
 
 # ---------------------------------------------------------------------
 # forward
+
+
+def _readout(x, embed):
+    """Weight-tied logits in the embedding's dtype with fp32
+    accumulation. The single definition shared by forward, prefill and
+    decode_step — the cached-decode-vs-full-forward argmax contract
+    requires the readout math to stay bit-identical across them."""
+    import jax.numpy as jnp
+
+    return jnp.einsum(
+        "...d,vd->...v", x.astype(embed.dtype), embed,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.float32)
 
 
 def _rms_norm(x, weight, eps=1e-6):
@@ -134,21 +163,28 @@ def _rotary(x, positions):
 
 
 def _attention(q, k, v, causal=True):
+    """q: (b, t, h, d); k/v: (b, s, kv, d) with kv dividing h (GQA —
+    kv == h is plain MHA). fp32 score accumulation (MXU native) — and
+    the cached decode path in models/decode.py accumulates fp32 too,
+    which keeps the cache-vs-full-forward argmax contract exact in
+    bf16 configs."""
     import jax.numpy as jnp
 
-    *_, t, _, head_dim = q.shape
-    # fp32 accumulation (MXU native) — and the cached decode path in
-    # models/decode.py accumulates fp32 too, which keeps the
-    # cache-vs-full-forward argmax contract exact in bf16 configs.
+    b, t, h, head_dim = q.shape
+    kv = k.shape[2]
+    group = h // kv
+    qg = q.reshape(b, t, kv, group, head_dim)
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32,
+        "btkgd,bskd->bkgts", qg, k,
+        preferred_element_type=jnp.float32,
     ) * (head_dim ** -0.5)
     if causal:
         mask = jnp.tril(jnp.ones((t, k.shape[1]), bool))
         scores = jnp.where(mask, scores, -1e30)
     probs = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
     probs = probs / probs.sum(axis=-1, keepdims=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(v.dtype), v)
+    return out.reshape(b, t, h, head_dim)
 
 
 def _block_core(x, bparams, cfg: ModelConfig, positions):
@@ -161,10 +197,12 @@ def _block_core(x, bparams, cfg: ModelConfig, positions):
     b, t, _ = x.shape
     h = _rms_norm(x, bparams["attn_norm"])
     qkv = h @ bparams["wqkv"].astype(h.dtype)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q_dim = cfg.n_heads * cfg.head_dim
+    kv_dim = cfg.kv_heads * cfg.head_dim
+    q, k, v = jnp.split(qkv, [q_dim, q_dim + kv_dim], axis=-1)
     q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
-    k = k.reshape(b, t, cfg.n_heads, cfg.head_dim)
-    v = v.reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, t, cfg.kv_heads, cfg.head_dim)
+    v = v.reshape(b, t, cfg.kv_heads, cfg.head_dim)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
     attn = _attention(q, k, v).reshape(b, t, cfg.d_model)
@@ -212,9 +250,10 @@ def forward(params: Params, tokens, cfg: ModelConfig,
         x, aux = block(x, bparams, cfg, positions)
         aux_total = aux_total + aux
     x = _rms_norm(x, params["final_norm"])
-    # weight-tied readout in fp32 for a stable softmax
-    logits = (x.astype(jnp.float32) @
-              params["embed"].T.astype(jnp.float32))
+    # fp32 params keep the historical fp32 readout numerics; a bf16
+    # serving snapshot (models/decode.py serving_params) halves the
+    # HBM read of the largest weight and runs the MXU at full rate.
+    logits = _readout(x, params["embed"])
     if return_aux:
         return logits, aux_total
     return logits

@@ -79,6 +79,77 @@ def test_generate_from_cache_zero_tokens(cfg):
     assert decode.greedy_generate(params, cfg, prompt, 0).shape == (2, 8)
 
 
+def test_gqa_decode_matches_forward():
+    """Grouped-query attention (2 KV heads under 4 Q heads): the cached
+    path still reproduces the full forward exactly."""
+    import jax
+
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=4,
+                         n_layers=2, d_ff=64, max_seq=32,
+                         dtype="float32", n_kv_heads=2)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    assert params["blocks"][0]["wqkv"].shape == (32, 32 + 2 * 16)
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch=2, seq=10)
+    full_logits = np.array(tf.forward(params, tokens, cfg))
+
+    cache = decode.init_cache(cfg, batch=2, max_len=10)
+    assert cache[0]["k"].shape == (2, 10, 2, cfg.head_dim)
+    step = jax.jit(
+        lambda tok, cache, pos: decode.decode_step(
+            params, cfg, tok, cache, pos))
+    for pos in range(10):
+        logits, cache = step(tokens[:, pos], cache, pos)
+        np.testing.assert_allclose(
+            np.array(logits), full_logits[:, pos],
+            atol=2e-4, rtol=2e-4,
+        )
+
+
+def test_gqa_greedy_consistency_bf16():
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=4,
+                         n_layers=2, d_ff=64, max_seq=32, n_kv_heads=1)
+    report = decode.generate_report(cfg, batch=2, prompt_len=8,
+                                    num_new=8)
+    assert report["ok"], report
+
+
+def test_serving_params_self_consistent():
+    """The bf16 snapshot casts matmul weights once (norms stay fp32),
+    and the cached-decode-vs-full-forward argmax contract holds with
+    the snapshot on both sides (forward's readout follows the
+    embedding's dtype, so both paths see identical bf16 math)."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=32)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    sparams = decode.serving_params(params, cfg)
+    assert sparams["embed"].dtype == jnp.bfloat16
+    assert sparams["blocks"][0]["wqkv"].dtype == jnp.bfloat16
+    assert sparams["blocks"][0]["attn_norm"].dtype == jnp.float32
+    assert sparams["final_norm"].dtype == jnp.float32
+
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch=2, seq=8)
+    out = decode.greedy_generate(sparams, cfg, prompt, 8)
+    logits = tf.forward(sparams, out[:, :-1], cfg)
+    expected_last = np.argmax(np.array(logits[:, -1]), axis=-1)
+    np.testing.assert_array_equal(np.array(out[:, -1]), expected_last)
+
+
+def test_serving_params_moe_router_fp32():
+    import jax
+    import jax.numpy as jnp
+
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=32, n_experts=2)
+    sparams = decode.serving_params(
+        tf.init_params(jax.random.PRNGKey(0), cfg), cfg)
+    moe = sparams["blocks"][0]["moe"]
+    assert moe["router"].dtype == jnp.float32
+    assert moe["w_up"].dtype == jnp.bfloat16
+
+
 def test_moe_decode_runs():
     cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
                          n_layers=2, d_ff=64, max_seq=32, n_experts=2)
