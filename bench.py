@@ -263,8 +263,21 @@ def model_throughput() -> dict | None:
 
             sparams = decode.serving_params(params, cfg)
             new_tokens = 512 if backend == "tpu" else 8
-            prompt = tokens[:, :512] if backend == "tpu" else tokens[:, :16]
+            prompt = tokens if backend == "tpu" else tokens[:, :16]
             total = prompt.shape[1] + new_tokens
+
+            # K sequential prefills per dispatch (lax.map; the stacked
+            # live outputs force every cache write), so the dispatch
+            # overhead is amortized K-fold instead of subtracted from
+            # a single comparable-sized sample.
+            K = 4 if backend == "tpu" else 1
+            prompts = jax.numpy.stack(
+                [(prompt + i) % cfg.vocab_size for i in range(K)])
+
+            @jax.jit
+            def pre_k(p, ts):
+                return jax.lax.map(
+                    lambda t: decode.prefill(p, cfg, t, total), ts)
 
             pre = jax.jit(
                 lambda p, t: decode.prefill(p, cfg, t, total))
@@ -281,33 +294,49 @@ def model_throughput() -> dict | None:
 
             # Per-dispatch overhead (remote-tunnel platforms pay
             # ~60ms/call RPC latency): calibrate with a null dispatch
-            # and subtract, so the numbers measure device time.
+            # and subtract, so the numbers measure device time. Medians
+            # over several samples tame per-call RPC variance, and a
+            # metric is reported only when the residual clearly rises
+            # above the overhead noise floor — a measurement dominated
+            # by calibration error must be dropped, not published.
+            def med(fn, n):
+                samples = []
+                for _ in range(n):
+                    t0 = time.monotonic()
+                    fn()
+                    samples.append(time.monotonic() - t0)
+                samples.sort()
+                return samples[len(samples) // 2]
+
             null = jax.jit(lambda: jax.numpy.zeros(()))
             jax.block_until_ready(null())
-            t0 = time.monotonic()
-            for _ in range(3):
-                jax.block_until_ready(null())
-            null_dt = (time.monotonic() - t0) / 3
+            null_dt = med(lambda: jax.block_until_ready(null()), 5)
 
-            t0 = time.monotonic()
-            for _ in range(3):
-                logits, cache = jax.block_until_ready(
-                    pre(sparams, prompt))
-            prefill_dt = (time.monotonic() - t0) / 3 - null_dt
-            t0 = time.monotonic()
-            for _ in range(3):
-                out = np.asarray(dec(sparams, logits, cache))
-            dt = (time.monotonic() - t0) / 3 - null_dt
-            assert out.shape[1] == new_tokens
-            # If the measured time is swamped by dispatch overhead
-            # (tiny CPU configs), drop the metric rather than report
-            # a clamped-denominator absurdity.
-            if prefill_dt > 0:
+            state = {}
+            jax.block_until_ready(pre_k(sparams, prompts))  # warm
+
+            def run_prefill():
+                state["pre_k"] = jax.block_until_ready(
+                    pre_k(sparams, prompts))
+
+            raw_prefill = med(run_prefill, 3)
+            logits, cache = jax.block_until_ready(pre(sparams, prompt))
+
+            def run_decode():
+                state["out"] = np.asarray(dec(sparams, logits, cache))
+
+            raw_decode = med(run_decode, 3)
+            assert state["out"].shape[1] == new_tokens
+
+            residual = raw_prefill - null_dt
+            if residual > 0.3 * raw_prefill:
+                prefill_dt = residual / K
                 result["prefill_tokens_per_s"] = round(
                     batch * prompt.shape[1] / prefill_dt)
-            if dt > 0:
+            decode_dt = raw_decode - null_dt
+            if decode_dt > 0.3 * raw_decode:
                 result["decode_tokens_per_s"] = round(
-                    batch * new_tokens / dt)
+                    batch * new_tokens / decode_dt)
         except Exception as exc:  # pragma: no cover - best effort
             result["decode_error"] = str(exc)[:100]
         return result

@@ -365,6 +365,23 @@ def make_train_step(cfg: ModelConfig, mesh=None, learning_rate=1e-2,
                 is_leaf=lambda x: not isinstance(x, (dict, list)),
             )
         opt_state = tx.init(params) if tx else None
+        if mesh is not None and opt_state is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            # Moment trees inherit the params' meshed shardings via
+            # zeros_like, but optax scalars (adam's `count`) are born
+            # on the default device; a jitted step refuses that mix of
+            # placements. Replicate any single-device leaf.
+            rep = NamedSharding(mesh, PartitionSpec())
+
+            def fix(leaf):
+                placed = getattr(leaf, "sharding", None)
+                if (placed is not None and mesh.size > 1
+                        and len(placed.device_set) == 1):
+                    return jax.device_put(leaf, rep)
+                return leaf
+
+            opt_state = jax.tree_util.tree_map(fix, opt_state)
         return {"params": params, "opt": opt_state}
 
     def step(state, tokens):
