@@ -344,6 +344,28 @@ def model_throughput() -> dict | None:
         return {"error": str(exc)[:100]}
 
 
+def multihost_smoke() -> dict | None:
+    """DCN-tier proof: a 2-host simulated slice (one process per host,
+    gloo collectives over loopback) comes up and passes cross-host
+    psum + ppermute. Extras-only — the headline value stays the
+    reference-comparable single-host ready path."""
+    try:
+        from kind_tpu_sim.parallel import multihost
+
+        t0 = time.monotonic()
+        reports = multihost.launch_local_slice(
+            topology="2x2x2", accelerator="tpu-v4-podslice")
+        elapsed = time.monotonic() - t0
+        return {
+            "workers": len(reports),
+            "global_devices": reports[0]["global_devices"],
+            "ok": all(r["ok"] for r in reports),
+            "seconds": round(elapsed, 3),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def main() -> int:
     mode = os.environ.get("BENCH_MODE", "auto")
     if mode == "auto":
@@ -376,6 +398,9 @@ def main() -> int:
     throughput = model_throughput()
     if throughput:
         phases["model"] = throughput
+    multihost = multihost_smoke()
+    if multihost:
+        phases["multihost"] = multihost
 
     value = round(
         t_orch + (t_plugin or 0.0) + (t_jax or 0.0), 3)

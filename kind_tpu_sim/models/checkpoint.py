@@ -37,23 +37,23 @@ def _manager(directory, max_to_keep: int = 3):
     )
 
 
-def save(directory, step: int, state: Any, *, max_to_keep: int = 3,
-         wait: bool = True) -> None:
+def _save_with(mgr, step: int, state: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    mgr.save(step, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+
+
+def save(directory, step: int, state: Any, *,
+         max_to_keep: int = 3) -> None:
     """Write `state` (any pytree of jax/np arrays) for `step`.
 
     Atomic: a crash mid-write leaves no visible step directory, so
-    `latest_step` never points at a torn checkpoint. `wait=False`
-    returns while the write streams in the background (call
-    `wait_until_finished` via a kept manager for long runs; here we
-    keep the one-shot API simple and block by default).
+    `latest_step` never points at a torn checkpoint.
     """
-    import orbax.checkpoint as ocp
-
     mgr = _manager(directory, max_to_keep)
     try:
-        mgr.save(step, args=ocp.args.StandardSave(state))
-        if wait:
-            mgr.wait_until_finished()
+        _save_with(mgr, step, state)
     finally:
         mgr.close()
 
@@ -132,22 +132,33 @@ def train_with_checkpointing(cfg, directory, *, total_steps: int,
 
     from kind_tpu_sim.models import transformer as tf
 
+    import orbax.checkpoint as ocp
+
     step_fn, init_state = tf.make_train_step(
         cfg, mesh=mesh, learning_rate=learning_rate)
     state = init_state(jax.random.PRNGKey(seed))
-    start = 0
-    resumed = latest_step(directory)
-    if resumed is not None:
-        state = restore(directory, abstract_like(state), resumed)
-        start = resumed
-    losses = {}
-    for i in range(start, total_steps):
-        tokens = tf.sample_batch(
-            jax.random.fold_in(jax.random.PRNGKey(seed), i),
-            cfg, batch, cfg.max_seq)
-        state, loss = step_fn(state, tokens)
-        losses[i] = float(loss)
-        done = i + 1
-        if done % checkpoint_every == 0 or done == total_steps:
-            save(directory, done, state)
+    # One manager for the whole run — per-save construction would
+    # re-scan the directory and restart orbax's async machinery at
+    # every checkpoint.
+    mgr = _manager(directory)
+    try:
+        start = 0
+        resumed = mgr.latest_step()
+        if resumed is not None:
+            state = mgr.restore(
+                resumed,
+                args=ocp.args.StandardRestore(abstract_like(state)))
+            start = resumed
+        losses = {}
+        for i in range(start, total_steps):
+            tokens = tf.sample_batch(
+                jax.random.fold_in(jax.random.PRNGKey(seed), i),
+                cfg, batch, cfg.max_seq)
+            state, loss = step_fn(state, tokens)
+            losses[i] = float(loss)
+            done = i + 1
+            if done % checkpoint_every == 0 or done == total_steps:
+                _save_with(mgr, done, state)
+    finally:
+        mgr.close()
     return state, losses

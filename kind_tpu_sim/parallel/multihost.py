@@ -100,3 +100,179 @@ def global_device_report() -> dict:
         "local_devices": jax.local_device_count(),
         "global_devices": jax.device_count(),
     }
+
+
+def slice_smoke() -> dict:
+    """Cross-host collective proof over the (host, chip) mesh.
+
+    Two fabrics, two checks: a global sum whose all-reduce must cross
+    the DCN axis (each host contributes a distinct value), and a
+    `ppermute` ring rotation over 'host' — real point-to-point traffic
+    between processes, not just a reduction. Runs identically under a
+    single process (trivial ring) so the same pod image works on one
+    worker or the whole slice.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n_proc = jax.process_count()
+    local = jax.local_device_count()
+    me = jax.process_index()
+    devs = np.array(jax.devices()).reshape(n_proc, local)
+    mesh = Mesh(devs, ("host", "chip"))
+    sharded = NamedSharding(mesh, P("host", "chip"))
+
+    # Host i contributes the value i+1 from each of its chips.
+    arr = jax.make_array_from_process_local_data(
+        sharded, np.full((1, local), float(me + 1), np.float32))
+
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    total = float(np.asarray(jax.block_until_ready(total)))
+    want_total = local * n_proc * (n_proc + 1) / 2
+
+    @jax.jit
+    @functools.partial(jax.shard_map, mesh=mesh,
+                       in_specs=P("host", "chip"),
+                       out_specs=P("host", "chip"))
+    def rotate(x):
+        perm = [(i, (i + 1) % n_proc) for i in range(n_proc)]
+        return jax.lax.ppermute(x, "host", perm)
+
+    rotated = jax.block_until_ready(rotate(arr))
+    got = {float(np.asarray(s.data).reshape(-1)[0])
+           for s in rotated.addressable_shards}
+    want_rot = float((me - 1) % n_proc + 1)
+
+    ok = abs(total - want_total) < 1e-6 and got == {want_rot}
+    return {
+        "psum_total": total,
+        "psum_expected": want_total,
+        "ppermute_got": sorted(got),
+        "ppermute_expected": want_rot,
+        "ok": ok,
+    }
+
+
+def _chips_from_env(environ=None) -> int:
+    env = os.environ if environ is None else environ
+    bounds = env.get("TPU_CHIPS_PER_HOST_BOUNDS", "1,1,1")
+    chips = 1
+    for dim in bounds.split(","):
+        chips *= int(dim)
+    return max(1, chips)
+
+
+def _worker_main() -> int:
+    """One simulated TPU worker: the exact code path a jax-multihost
+    pod runs, driven purely by the plugin-injected env contract."""
+    import json
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # The simulated host exposes its slice share as XLA host devices;
+    # gloo carries the cross-process ("DCN") collectives.
+    jax.config.update("jax_num_cpu_devices", _chips_from_env())
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    initialize_from_env()
+    report = global_device_report()
+    report.update(slice_smoke())
+    print(json.dumps(report), flush=True)
+    return 0 if report["ok"] else 1
+
+
+def _launch_once(s, timeout: float) -> List[dict]:
+    import json
+    import pathlib
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    n = s.num_hosts
+    # Ephemeral-port pick is bind-then-close, so a rare TOCTOU race
+    # with another process exists; launch_local_slice retries with a
+    # fresh port when a launch dies.
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+
+    repo_root = str(pathlib.Path(__file__).resolve().parents[2])
+    procs = []
+    for worker in range(n):
+        env = dict(os.environ)
+        env.update(s.worker_env(worker, hostnames=["127.0.0.1"] * n))
+        env["TPU_SIM_COORDINATOR_PORT"] = str(port)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+            "PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "kind_tpu_sim.parallel.multihost"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        ))
+    try:
+        # Wait on ALL workers concurrently: one crashed worker leaves
+        # its peers blocked in the rendezvous, so waiting in rank order
+        # would burn the whole timeout and blame the wrong process.
+        deadline = time.monotonic() + timeout
+        pending = set(range(n))
+        while pending:
+            for worker in sorted(pending):
+                rc = procs[worker].poll()
+                if rc is not None:
+                    pending.discard(worker)
+                    if rc != 0:
+                        err = procs[worker].stderr.read()
+                        raise RuntimeError(
+                            f"slice worker {worker} failed "
+                            f"(rc={rc}):\n{err[-2000:]}")
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"slice workers {sorted(pending)} still running "
+                    f"after {timeout}s")
+            if pending:
+                time.sleep(0.05)
+        return [
+            json.loads(proc.stdout.read().splitlines()[-1])
+            for proc in procs
+        ]
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+
+def launch_local_slice(topology: str = "2x2x2",
+                       accelerator: str = "tpu-v4-podslice",
+                       timeout: float = 300.0,
+                       attempts: int = 2) -> List[dict]:
+    """Stand up a whole simulated multi-host slice on this machine.
+
+    Spawns one worker process per simulated host, each configured ONLY
+    through the env contract the device plugin injects in-cluster
+    (worker_env + coordinator port), rendezvoused over loopback. The
+    local, no-kind proof of the DCN path that pods/jax-multihost.yaml
+    exercises in-cluster. Returns each worker's report.
+    """
+    from kind_tpu_sim import topology as topo
+
+    s = topo.make_slice(accelerator=accelerator, topology=topology)
+    last_error: Exception | None = None
+    for _ in range(max(1, attempts)):
+        try:
+            return _launch_once(s, timeout)
+        except (RuntimeError, TimeoutError) as exc:
+            last_error = exc
+    raise last_error
+
+
+if __name__ == "__main__":
+    raise SystemExit(_worker_main())

@@ -1,0 +1,57 @@
+"""Local multi-host slice: real jax.distributed across processes.
+
+The DCN tier of SURVEY.md §5's "distributed communication backend" —
+until now only exercised in-cluster (pods/jax-multihost.yaml); these
+tests prove it on any machine: one OS process per simulated host,
+rendezvous over loopback, gloo-backed cross-process collectives.
+Subprocess-based on purpose: jax.distributed can initialize only once
+per process, so the pytest process itself must stay uninitialized.
+"""
+
+import pytest
+
+from kind_tpu_sim.parallel import multihost
+
+
+def test_local_slice_v4_two_hosts():
+    reports = multihost.launch_local_slice(
+        topology="2x2x2", accelerator="tpu-v4-podslice")
+    assert len(reports) == 2
+    for rank, rep in enumerate(reports):
+        assert rep["ok"], rep
+        assert rep["process_index"] == rank
+        assert rep["process_count"] == 2
+        assert rep["local_devices"] == 4
+        assert rep["global_devices"] == 8
+
+
+@pytest.mark.slow
+def test_north_star_v5e16():
+    """BASELINE.json acceptance shape: the v5e-16 slice — 2 simulated
+    hosts x 8 chips, 16 global devices, collectives crossing the DCN."""
+    reports = multihost.launch_local_slice(
+        topology="4x4", accelerator="tpu-v5-lite-podslice")
+    assert len(reports) == 2
+    for rep in reports:
+        assert rep["ok"], rep
+        assert rep["local_devices"] == 8
+        assert rep["global_devices"] == 16
+        assert rep["psum_total"] == 8 * (1 + 2)
+
+
+def test_local_slice_single_host():
+    """A 1-host topology runs the same worker path in single-process
+    mode (no coordinator, trivial ring)."""
+    reports = multihost.launch_local_slice(
+        topology="2x2", accelerator="tpu-v5-lite-podslice")
+    assert len(reports) == 1
+    rep = reports[0]
+    assert rep["ok"], rep
+    assert rep["process_count"] == 1
+    assert rep["global_devices"] == rep["local_devices"] == 4
+
+
+def test_chips_from_env():
+    assert multihost._chips_from_env({"TPU_CHIPS_PER_HOST_BOUNDS":
+                                      "2,2,1"}) == 4
+    assert multihost._chips_from_env({}) == 1
