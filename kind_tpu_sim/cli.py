@@ -138,7 +138,74 @@ def build_parser() -> argparse.ArgumentParser:
         choices=sorted(topo.ACCELERATORS),
     )
 
+    smoke = sub.add_parser(
+        "slice-smoke",
+        help=(
+            "no-cluster DCN proof: launch a local multi-host slice "
+            "(one process per simulated host) and run cross-host "
+            "collectives"
+        ),
+    )
+    smoke.add_argument("--topology", default="2x2x2")
+    smoke.add_argument(
+        "--accelerator", default="tpu-v4-podslice",
+        choices=sorted(topo.ACCELERATORS),
+    )
+    smoke.add_argument("--json", action="store_true", dest="as_json")
+
+    profile = sub.add_parser(
+        "profile",
+        help=(
+            "trace one flagship-model step with jax.profiler and "
+            "print the top device ops"
+        ),
+    )
+    profile.add_argument(
+        "--out", default="tpu-sim-trace",
+        help="trace output directory (TensorBoard-loadable)",
+    )
+    profile.add_argument("--json", action="store_true", dest="as_json")
+
     return parser
+
+
+def run_slice_smoke(args: argparse.Namespace) -> int:
+    from kind_tpu_sim.parallel import multihost
+
+    reports = multihost.launch_local_slice(
+        topology=args.topology, accelerator=args.accelerator)
+    ok = all(r["ok"] for r in reports)
+    if args.as_json:
+        print(json.dumps({"ok": ok, "workers": reports}))
+    else:
+        for rank, rep in enumerate(reports):
+            print(
+                f"worker {rank}: {rep['local_devices']} local / "
+                f"{rep['global_devices']} global devices, "
+                f"psum {rep['psum_total']} "
+                f"(want {rep['psum_expected']}) "
+                f"{'OK' if rep['ok'] else 'FAILED'}"
+            )
+        print("SLICE SMOKE " + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+def run_profile(args: argparse.Namespace) -> int:
+    from kind_tpu_sim import profiling
+
+    report = profiling.profile_flagship(args.out)
+    if args.as_json:
+        print(json.dumps(report))
+        return 0
+    print(f"model {report['model']}: one step in "
+          f"{report['wall_s']}s, trace in {report['log_dir']}")
+    summary = report["summary"]
+    scope = "device" if summary["device_tracks"] else "host"
+    print(f"top {scope} ops:")
+    for op in summary["top_ops"]:
+        print(f"  {op['total_us']:>12.1f} us  x{op['count']:<4} "
+              f"{op['name']}")
+    return 0
 
 
 def config_from_args(args: argparse.Namespace) -> SimConfig:
@@ -299,10 +366,20 @@ class Simulator:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
+        level=(logging.DEBUG if getattr(args, "verbose", False)
+               else logging.INFO),
         format="%(levelname)s %(name)s: %(message)s",
     )
     try:
+        # Cluster-free subcommands: no Simulator, no container runtime.
+        if args.command == "slice-smoke":
+            try:
+                return run_slice_smoke(args)
+            except TimeoutError as exc:
+                log.error("%s", exc)
+                return 1
+        if args.command == "profile":
+            return run_profile(args)
         cfg = config_from_args(args)
         sim = Simulator(cfg)
         if args.command == "create":

@@ -1,0 +1,151 @@
+"""Device-level tracing/profiling for the simulated TPU stack.
+
+SURVEY.md §5: the reference's only observability is echo lines — its
+TPU build should time itself. The orchestrator side is covered by
+`metrics.PhaseTimer` (create-pipeline phases); this module covers the
+workload side with `jax.profiler`:
+
+* `trace(log_dir)` — capture an XLA/device trace of a code region
+  (TensorBoard-loadable xplane.pb + Chrome trace.json.gz);
+* `capture(fn, *args)` — warm, then trace exactly one call;
+* `summarize(log_dir)` — dependency-free top-ops table parsed from the
+  Chrome trace (no tensorboard needed), preferring device-track events
+  when the platform separates them;
+* `profile_flagship()` — one traced flagship-model step, the workload
+  the `profile` CLI subcommand ships.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import pathlib
+import time
+from typing import Any, Dict, List, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir):
+    """jax.profiler.trace with the directory created up front."""
+    import jax
+
+    path = pathlib.Path(log_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(path)):
+        yield path
+
+
+def annotation(name: str):
+    """Named region that shows up on the trace timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def capture(fn, *args, log_dir, warmup: int = 1,
+            label: str = "captured-step") -> Dict[str, Any]:
+    """Run `fn(*args)` once under the tracer (after `warmup` untraced
+    calls so compilation stays off the timeline); returns a report."""
+    import jax
+
+    for _ in range(max(0, warmup)):
+        jax.block_until_ready(fn(*args))
+    t0 = time.monotonic()
+    with trace(log_dir) as path:
+        with annotation(label):
+            jax.block_until_ready(fn(*args))
+    elapsed = time.monotonic() - t0
+    return {
+        "log_dir": str(path),
+        "wall_s": round(elapsed, 4),
+        "trace_files": [os.path.basename(p) for p in
+                        _trace_files(path)],
+    }
+
+
+def _trace_files(log_dir) -> List[str]:
+    return sorted(
+        glob.glob(str(pathlib.Path(log_dir) /
+                      "**" / "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime,
+    )
+
+
+def summarize(log_dir, top: int = 10) -> Dict[str, Any]:
+    """Top ops by total duration from the newest Chrome trace.
+
+    Prefers events on device tracks (process name contains 'device:',
+    as on TPU); host-only platforms (CPU) fall back to all non-Python
+    events. Durations are microseconds.
+    """
+    files = _trace_files(log_dir)
+    if not files:
+        raise FileNotFoundError(f"no trace under {log_dir}")
+    with gzip.open(files[-1], "rt") as fh:
+        events = json.load(fh).get("traceEvents", [])
+
+    process_names: Dict[int, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            process_names[ev.get("pid")] = ev["args"].get("name", "")
+    device_pids = {
+        pid for pid, name in process_names.items()
+        if "device:" in name.lower()
+    }
+
+    def aggregate(device_only: bool) -> Dict[str, List[float]]:
+        totals: Dict[str, List[float]] = {}
+        for ev in events:
+            if ev.get("ph") != "X" or not ev.get("dur"):
+                continue
+            name = ev.get("name", "")
+            if device_only:
+                if ev.get("pid") not in device_pids:
+                    continue
+            elif name.startswith("$"):  # python frame, host traces
+                continue
+            bucket = totals.setdefault(name, [0.0, 0])
+            bucket[0] += ev["dur"]
+            bucket[1] += 1
+        return totals
+
+    use_device = bool(device_pids)
+    totals = aggregate(use_device)
+    if use_device and not totals:
+        # Device tracks registered but carried no ops (e.g. a platform
+        # plugin that initialized without executing) — fall back to
+        # host events rather than print an empty table.
+        use_device = False
+        totals = aggregate(False)
+
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])[:top]
+    return {
+        "trace_file": files[-1],
+        "device_tracks": use_device,
+        "top_ops": [
+            {"name": name, "total_us": round(total, 1), "count": count}
+            for name, (total, count) in ranked
+        ],
+    }
+
+
+def profile_flagship(log_dir, cfg=None, batch: int = 2,
+                     top: int = 10) -> Dict[str, Any]:
+    """Trace one jitted flagship forward+loss step and summarize it."""
+    import jax
+
+    from kind_tpu_sim.models import transformer as tf
+
+    cfg = cfg or tf.ModelConfig()
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch,
+                             cfg.max_seq)
+    step = jax.jit(lambda p, t: tf.loss_fn(p, t, cfg))
+    report = capture(step, params, tokens, log_dir=log_dir,
+                     label="flagship-loss-step")
+    report["summary"] = summarize(log_dir, top=top)
+    report["model"] = f"d{cfg.d_model}xL{cfg.n_layers}"
+    return report

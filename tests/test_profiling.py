@@ -1,0 +1,63 @@
+"""Profiling subsystem: trace capture + dependency-free summary."""
+
+import json
+
+import pytest
+
+from kind_tpu_sim import profiling
+
+
+def test_capture_and_summarize(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a, b: jnp.tanh(a @ b).sum())
+    a = jnp.ones((64, 64))
+    report = profiling.capture(f, a, a, log_dir=tmp_path,
+                               label="unit-step")
+    assert report["wall_s"] > 0
+    assert report["trace_files"], "no trace written"
+
+    summary = profiling.summarize(tmp_path, top=5)
+    assert summary["top_ops"], "empty op table"
+    assert len(summary["top_ops"]) <= 5
+    names = [op["name"] for op in summary["top_ops"]]
+    # The annotation region must appear on the timeline.
+    all_summary = profiling.summarize(tmp_path, top=100)
+    all_names = [op["name"] for op in all_summary["top_ops"]]
+    assert any("unit-step" in n for n in all_names), all_names
+    for op in summary["top_ops"]:
+        assert op["total_us"] > 0 and op["count"] >= 1
+    assert not any(n.startswith("$") for n in names), (
+        "python frames leaked into the op table")
+
+
+def test_summarize_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        profiling.summarize(tmp_path / "nothing")
+
+
+def test_profile_flagship(tmp_path):
+    report = profiling.profile_flagship(tmp_path)
+    assert report["model"] == "d128xL2"
+    assert report["summary"]["top_ops"]
+
+
+def test_cli_profile_json(tmp_path, capsys):
+    from kind_tpu_sim.cli import main
+
+    rc = main(["profile", "--out", str(tmp_path), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["summary"]["top_ops"]
+
+
+@pytest.mark.slow
+def test_cli_slice_smoke_json(capsys):
+    from kind_tpu_sim.cli import main
+
+    rc = main(["slice-smoke", "--topology", "2x2x2",
+               "--accelerator", "tpu-v4-podslice", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["ok"] and len(out["workers"]) == 2
