@@ -373,11 +373,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         # Cluster-free subcommands: no Simulator, no container runtime.
         if args.command == "slice-smoke":
-            try:
-                return run_slice_smoke(args)
-            except TimeoutError as exc:
-                log.error("%s", exc)
-                return 1
+            return run_slice_smoke(args)
         if args.command == "profile":
             return run_profile(args)
         cfg = config_from_args(args)
@@ -403,7 +399,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             for cmd in sim.executor.commands():
                 print(f"  {cmd}", file=sys.stderr)
         return 0
-    except (CommandError, RuntimeError, ValueError) as exc:
+    except (CommandError, RuntimeError, ValueError,
+            TimeoutError) as exc:
         log.error("%s", exc)
         return 1
 

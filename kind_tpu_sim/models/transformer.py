@@ -38,6 +38,7 @@ class ModelConfig:
     remat: bool = False           # jax.checkpoint each block
     n_experts: int = 0            # >0: Switch-MoE MLP (expert parallel)
     n_kv_heads: Optional[int] = None  # grouped-query attention; None = MHA
+    flash: bool = False           # Pallas flash attention (long-context)
 
     @property
     def head_dim(self) -> int:
@@ -205,7 +206,17 @@ def _block_core(x, bparams, cfg: ModelConfig, positions):
     v = v.reshape(b, t, cfg.kv_heads, cfg.head_dim)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-    attn = _attention(q, k, v).reshape(b, t, cfg.d_model)
+    if cfg.flash:
+        # Fused online-softmax attention (ops/pallas_kernels): no
+        # (t, t) score matrix in HBM. Pays off from ~2k tokens; the
+        # XLA path below is faster at short sequence on dispatch-
+        # bound platforms.
+        from kind_tpu_sim.ops.pallas_kernels import flash_attention
+
+        attn = flash_attention(q, k, v, causal=True)
+    else:
+        attn = _attention(q, k, v)
+    attn = attn.reshape(b, t, cfg.d_model)
     x = x + attn @ bparams["wo"].astype(attn.dtype)
 
     h = _rms_norm(x, bparams["mlp_norm"])

@@ -126,6 +126,156 @@ def softmax(x, interpret: Optional[bool] = None):
     )(x)
 
 
+# ---------------------------------------------------------------------
+# flash attention (fused online-softmax attention)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = 128, block_kv: int = 128,
+                    interpret: Optional[bool] = None):
+    """Fused attention: softmax(QK^T/sqrt(d))V without materializing
+    the (t, s) score matrix in HBM.
+
+    q: (b, t, h, d); k/v: (b, s, kv, d) with kv dividing h (GQA).
+    Online-softmax accumulation (the flash algorithm): the kv axis is
+    the innermost grid dimension, and running max/denominator/
+    accumulator live in VMEM scratch across its steps. Scores
+    accumulate in fp32 on the MXU; fully-masked causal blocks skip
+    their compute (their DMAs still run — acceptable at these sizes).
+    Matches transformer._attention numerics to bf16 tolerance.
+
+    Differentiable: the backward pass recomputes through the XLA
+    reference attention from the saved (q, k, v) — mathematically the
+    same function, so gradients are correct to fp tolerance, at the
+    cost of materializing the score matrix in the backward (flash-
+    style fused backward is future work).
+    """
+    import jax
+
+    @jax.custom_vjp
+    def fa(q, k, v):
+        return _flash_impl(q, k, v, causal, block_q, block_kv,
+                           interpret)
+
+    def fwd(q, k, v):
+        return fa(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        from kind_tpu_sim.models.transformer import _attention
+
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q, k, v: _attention(q, k, v, causal=causal),
+            q, k, v)
+        return vjp(g)
+
+    fa.defvjp(fwd, bwd)
+    return fa(q, k, v)
+
+
+def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
+                interpret: Optional[bool]):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    kv = k.shape[2]
+    group = h // kv
+
+    def fit(size, requested):
+        blk = min(requested, size)
+        while size % blk:
+            blk //= 2
+        return max(blk, 1)
+
+    block_q = fit(t, block_q)
+    block_kv = fit(s, block_kv)
+    scale = d ** -0.5
+
+    # Mosaic tiles the LAST TWO dims of a block (sublane x lane), so
+    # blocks must be (1, 1, block, d): head-major layout. XLA fuses
+    # the transposes into the surrounding projections.
+    q = q.transpose(0, 2, 1, 3)    # (b, h, t, d)
+    k = k.transpose(0, 2, 1, 3)    # (b, kv, s, d)
+    v = v.transpose(0, 2, 1, 3)
+
+    def kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref):
+        qi = pl.program_id(2)
+        kj = pl.program_id(3)
+
+        @pl.when(kj == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, -1e30)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        first_row = qi * block_q
+        first_col = kj * block_kv
+        # In causal mode a block whose first column is past the last
+        # row is entirely masked; skip its matmuls.
+        live = (not causal) or (first_col <= first_row + block_q - 1)
+
+        @pl.when(live)
+        def _step():
+            scores = jax.lax.dot_general(
+                q_ref[0, 0], k_ref[0, 0],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                  # (bq, bkv)
+            if causal:
+                rows = first_row + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_kv), 0)
+                cols = first_col + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_kv), 1)
+                scores = jnp.where(cols <= rows, scores, -1e30)
+
+            m_prev = m_ref[:, 0:1]                     # (bq, 1)
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=-1, keepdims=True))
+            p = jnp.exp(scores - m_new)                # (bq, bkv)
+            alpha = jnp.exp(m_prev - m_new)            # (bq, 1)
+            l_ref[:] = jnp.broadcast_to(
+                alpha * l_ref[:, 0:1] +
+                jnp.sum(p, axis=-1, keepdims=True), l_ref.shape)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0, 0],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+
+        @pl.when(kj == pl.num_programs(3) - 1)
+        def _finalize():
+            out_ref[0, 0] = (
+                acc_ref[:] / l_ref[:, 0:1]).astype(out_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, t // block_q, s // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, qi, kj: (bi, hi // group, kj, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, qi, kj: (bi, hi // group, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 128), jnp.float32),   # denominator
+            pltpu.VMEM((block_q, d), jnp.float32),     # accumulator
+        ],
+        interpret=_interpret(interpret),
+    )(q, k, v)
+    return out.transpose(0, 2, 1, 3)                   # (b, t, h, d)
+
+
 def toolchain_smoke() -> dict:
     """The pallas-pod gate: kernels execute and match XLA numerics."""
     import jax

@@ -212,25 +212,27 @@ def _launch_once(s, timeout: float) -> List[dict]:
     with tempfile.TemporaryDirectory() as logdir:
         logs = pathlib.Path(logdir)
         procs = []
-        for worker in range(n):
-            env = dict(os.environ)
-            env.update(s.worker_env(worker,
-                                    hostnames=["127.0.0.1"] * n))
-            env["TPU_SIM_COORDINATOR_PORT"] = str(port)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
-                "PYTHONPATH", "")
-            # Files, not pipes: a worker chatty enough to fill a 64KB
-            # pipe buffer would block mid-rendezvous and hang the
-            # whole slice.
-            out = open(logs / f"worker-{worker}.out", "w+")
-            err = open(logs / f"worker-{worker}.err", "w+")
-            procs.append((subprocess.Popen(
-                [sys.executable, "-m",
-                 "kind_tpu_sim.parallel.multihost"],
-                env=env, stdout=out, stderr=err, text=True,
-            ), out, err))
         try:
+            for worker in range(n):
+                env = dict(os.environ)
+                env.update(s.worker_env(worker,
+                                        hostnames=["127.0.0.1"] * n))
+                env["TPU_SIM_COORDINATOR_PORT"] = str(port)
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
+                    "PYTHONPATH", "")
+                # Files, not pipes: a worker chatty enough to fill a
+                # 64KB pipe buffer would block mid-rendezvous and hang
+                # the whole slice. Spawning happens inside the
+                # try/finally: a mid-loop failure must still kill the
+                # workers already launched.
+                out = open(logs / f"worker-{worker}.out", "w+")
+                err = open(logs / f"worker-{worker}.err", "w+")
+                procs.append((subprocess.Popen(
+                    [sys.executable, "-m",
+                     "kind_tpu_sim.parallel.multihost"],
+                    env=env, stdout=out, stderr=err, text=True,
+                ), out, err))
             # Wait on ALL workers concurrently: one crashed worker
             # leaves its peers blocked in the rendezvous, so waiting
             # in rank order would burn the whole timeout and blame
@@ -291,7 +293,8 @@ def launch_local_slice(topology: str = "2x2x2",
     from kind_tpu_sim import topology as topo
 
     s = topo.make_slice(accelerator=accelerator, topology=topology)
-    for _ in range(max(1, attempts - 1)):
+    attempts = max(1, attempts)
+    for attempt in range(attempts):
         try:
             return _launch_once(s, timeout)
         except RuntimeError as exc:
@@ -299,9 +302,10 @@ def launch_local_slice(topology: str = "2x2x2",
             # failure is deterministic and rerunning it just doubles
             # the latency to the real error.
             msg = str(exc).lower()
-            if not any(pat in msg for pat in _BIND_ERRORS):
+            retryable = any(pat in msg for pat in _BIND_ERRORS)
+            if not retryable or attempt == attempts - 1:
                 raise
-    return _launch_once(s, timeout)
+    raise AssertionError("unreachable")
 
 
 if __name__ == "__main__":
