@@ -337,6 +337,30 @@ def model_throughput() -> dict | None:
             if decode_dt > 0.3 * raw_decode:
                 result["decode_tokens_per_s"] = round(
                     batch * new_tokens / decode_dt)
+
+            # Int8 weight-only snapshot: halves the weight bytes a
+            # decode step reads (the bf16 path already sits at the
+            # HBM roof). Own try: an int8-only failure must not be
+            # attributed to the (already-recorded) bf16 numbers.
+            try:
+                from kind_tpu_sim.models import quant
+
+                qparams = quant.quantize_params(params, cfg)
+                logits_q, cache_q = jax.block_until_ready(
+                    pre(qparams, prompt))
+                np.asarray(dec(qparams, logits_q, cache_q))  # warm
+
+                def run_decode_q():
+                    state["out_q"] = np.asarray(
+                        dec(qparams, logits_q, cache_q))
+
+                raw_q = med(run_decode_q, 3)
+                dt_q = raw_q - null_dt
+                if dt_q > 0.3 * raw_q:
+                    result["decode_int8_tokens_per_s"] = round(
+                        batch * new_tokens / dt_q)
+            except Exception as exc:  # pragma: no cover
+                result["decode_int8_error"] = str(exc)[:100]
         except Exception as exc:  # pragma: no cover - best effort
             result["decode_error"] = str(exc)[:100]
         return result

@@ -50,7 +50,8 @@ def serving_params(params: Params, cfg: ModelConfig) -> Params:
 
     def cast(path, leaf):
         name = path[-1].key if hasattr(path[-1], "key") else None
-        if leaf.ndim >= 2 and name != "router":
+        if (leaf.ndim >= 2 and name != "router"
+                and leaf.dtype != jnp.int8):  # quantized already
             return leaf.astype(dtype)
         return leaf
 
@@ -77,9 +78,11 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
     import jax
     import jax.numpy as jnp
 
+    from kind_tpu_sim.models.quant import linear
+
     b, _ = x.shape
     h = _rms_norm(x, bparams["attn_norm"])
-    qkv = h @ bparams["wqkv"].astype(h.dtype)
+    qkv = linear(h, bparams["wqkv"])
     q_dim = cfg.n_heads * cfg.head_dim
     kv_dim = cfg.kv_heads * cfg.head_dim
     q, k, v = jnp.split(qkv, [q_dim, q_dim + kv_dim], axis=-1)
@@ -108,7 +111,7 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
     attn = jnp.einsum(
         "bkgs,bskd->bkgd", probs.astype(cache_v.dtype), cache_v
     ).reshape(b, cfg.d_model)
-    x = x + attn @ bparams["wo"].astype(attn.dtype)
+    x = x + linear(attn, bparams["wo"])
 
     h = _rms_norm(x, bparams["mlp_norm"])
     if "moe" in bparams:
@@ -118,8 +121,8 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
                          MoeConfig(n_experts=cfg.n_experts))
         x = x + out[:, 0, :]
     else:
-        up = h @ bparams["w_up"].astype(h.dtype)
-        x = x + jax.nn.gelu(up) @ bparams["w_down"].astype(h.dtype)
+        x = x + linear(jax.nn.gelu(linear(h, bparams["w_up"])),
+                       bparams["w_down"])
     return x, {"k": cache_k, "v": cache_v}
 
 
@@ -143,10 +146,12 @@ def prefill(params: Params, cfg: ModelConfig, prompt, max_len: int):
     """
     import jax.numpy as jnp
 
+    from kind_tpu_sim.models.quant import embed_lookup
+
     b, t_p = prompt.shape
     dtype = jnp.dtype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(t_p), (b, t_p))
-    x = params["embed"][prompt].astype(dtype)
+    x = embed_lookup(params["embed"], prompt, dtype)
     cache = init_cache(cfg, b, max_len)
     new_cache = []
     for bparams, layer_cache in zip(params["blocks"], cache):
@@ -162,8 +167,10 @@ def decode_step(params: Params, cfg: ModelConfig, token, cache, pos):
     """token (b,) int32 at position `pos` -> (logits (b, vocab), cache)."""
     import jax.numpy as jnp
 
+    from kind_tpu_sim.models.quant import embed_lookup
+
     dtype = jnp.dtype(cfg.dtype)
-    x = params["embed"][token].astype(dtype)
+    x = embed_lookup(params["embed"], token, dtype)
     new_cache = []
     for bparams, layer_cache in zip(params["blocks"], cache):
         x, updated = _block_decode(x, bparams, cfg, layer_cache, pos)

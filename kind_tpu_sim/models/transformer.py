@@ -122,16 +122,14 @@ def init_params(key, cfg: ModelConfig) -> Params:
 
 
 def _readout(x, embed):
-    """Weight-tied logits in the embedding's dtype with fp32
-    accumulation. The single definition shared by forward, prefill and
-    decode_step — the cached-decode-vs-full-forward argmax contract
-    requires the readout math to stay bit-identical across them."""
-    import jax.numpy as jnp
+    """Weight-tied logits with fp32 accumulation (plain or int8-
+    quantized embedding). The single definition shared by forward,
+    prefill and decode_step — the cached-decode-vs-full-forward argmax
+    contract requires the readout math to stay bit-identical across
+    them."""
+    from kind_tpu_sim.models.quant import readout
 
-    return jnp.einsum(
-        "...d,vd->...v", x.astype(embed.dtype), embed,
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.float32)
+    return readout(x, embed)
 
 
 def _rms_norm(x, weight, eps=1e-6):
@@ -195,9 +193,11 @@ def _block_core(x, bparams, cfg: ModelConfig, positions):
     import jax
     import jax.numpy as jnp
 
+    from kind_tpu_sim.models.quant import linear
+
     b, t, _ = x.shape
     h = _rms_norm(x, bparams["attn_norm"])
-    qkv = h @ bparams["wqkv"].astype(h.dtype)
+    qkv = linear(h, bparams["wqkv"])
     q_dim = cfg.n_heads * cfg.head_dim
     kv_dim = cfg.kv_heads * cfg.head_dim
     q, k, v = jnp.split(qkv, [q_dim, q_dim + kv_dim], axis=-1)
@@ -217,7 +217,7 @@ def _block_core(x, bparams, cfg: ModelConfig, positions):
     else:
         attn = _attention(q, k, v)
     attn = attn.reshape(b, t, cfg.d_model)
-    x = x + attn @ bparams["wo"].astype(attn.dtype)
+    x = x + linear(attn, bparams["wo"])
 
     h = _rms_norm(x, bparams["mlp_norm"])
     if "moe" in bparams:
@@ -226,9 +226,8 @@ def _block_core(x, bparams, cfg: ModelConfig, positions):
         out, aux = moe_mlp(h, bparams["moe"],
                            MoeConfig(n_experts=cfg.n_experts))
         return x + out, aux, k, v
-    up = h @ bparams["w_up"].astype(h.dtype)
-    act = jax.nn.gelu(up)
-    return (x + act @ bparams["w_down"].astype(act.dtype),
+    act = jax.nn.gelu(linear(h, bparams["w_up"]))
+    return (x + linear(act, bparams["w_down"]),
             jnp.float32(0), k, v)
 
 
@@ -247,10 +246,12 @@ def forward(params: Params, tokens, cfg: ModelConfig,
     import jax
     import jax.numpy as jnp
 
+    from kind_tpu_sim.models.quant import embed_lookup
+
     dtype = jnp.dtype(cfg.dtype)
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t), (b, t))
-    x = params["embed"][tokens].astype(dtype)
+    x = embed_lookup(params["embed"], tokens, dtype)
     block = _block
     if cfg.remat:
         block = jax.checkpoint(

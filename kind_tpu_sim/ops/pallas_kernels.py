@@ -185,11 +185,23 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
     kv = k.shape[2]
     group = h // kv
 
+    run_interpreted = _interpret(interpret)
+    # Mosaic tiles the sublane dim: fp32 wants multiples of 8, bf16 of
+    # 16 (pallas_guide "Tiling Constraints"). Interpret mode has no
+    # such constraint.
+    align = 1 if run_interpreted else (
+        16 if q.dtype == jnp.bfloat16 else 8)
+
     def fit(size, requested):
         blk = min(requested, size)
-        while size % blk:
-            blk //= 2
-        return max(blk, 1)
+        while blk >= align and (size % blk or blk % align):
+            blk -= align if blk % align == 0 else blk % align
+        if blk < align or size % blk:
+            raise ValueError(
+                f"flash_attention: no {align}-aligned block divides "
+                f"sequence length {size}; pad the sequence or use the "
+                f"XLA attention path")
+        return blk
 
     block_q = fit(t, block_q)
     block_kv = fit(s, block_kv)
