@@ -24,11 +24,14 @@ from typing import Any, NamedTuple
 
 
 class QuantArray(NamedTuple):
-    """Per-channel symmetric int8 weight: w ≈ q * scale."""
+    """Per-channel symmetric int8 weight: w ≈ q * scale.
+
+    `scale` keeps the reduced axis as size 1 (keepdims), so
+    `q * scale` broadcasts correctly whichever axis was quantized —
+    per-output-channel for matmul weights, per-row for embeddings."""
 
     q: Any        # int8, same shape as the original weight
-    scale: Any    # f32, shape = (out_channels,) = w.shape[-1],
-    #               except embeddings where it is per-row (vocab,)
+    scale: Any    # f32, w.shape with the quantized axis collapsed to 1
 
     @property
     def shape(self):
@@ -48,7 +51,7 @@ def quantize(w, axis: int = 0):
     absmax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
     scale = jnp.maximum(absmax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
-    return QuantArray(q=q, scale=jnp.squeeze(scale, axis=axis))
+    return QuantArray(q=q, scale=scale)
 
 
 def dequantize(qa: QuantArray, dtype=None):
@@ -72,7 +75,7 @@ def linear(x, w, dtype=None):
             "...d,df->...f", x, w.q.astype(x.dtype),
             preferred_element_type=jnp.float32,
         )
-        return (out * w.scale).astype(x.dtype)
+        return (out * w.scale[0]).astype(x.dtype)
     return x @ w.astype(dtype or x.dtype)
 
 
@@ -81,7 +84,7 @@ def embed_lookup(embed, tokens, dtype):
     scaled) embedding table."""
     if isinstance(embed, QuantArray):
         rows = embed.q[tokens].astype(dtype)
-        return rows * embed.scale[tokens][..., None].astype(dtype)
+        return rows * embed.scale[tokens].astype(dtype)  # (..., 1)
     return embed[tokens].astype(dtype)
 
 
@@ -98,7 +101,7 @@ def readout(x, embed):
             "...d,vd->...v", x, embed.q.astype(x.dtype),
             preferred_element_type=jnp.float32,
         )
-        return (logits * embed.scale).astype(jnp.float32)
+        return (logits * embed.scale[:, 0]).astype(jnp.float32)
     return jnp.einsum(
         "...d,vd->...v", x.astype(embed.dtype), embed,
         preferred_element_type=jnp.float32,

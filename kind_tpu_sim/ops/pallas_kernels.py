@@ -194,6 +194,10 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
 
     def fit(size, requested):
         blk = min(requested, size)
+        if blk == size:
+            # One block spanning the whole dimension is always legal:
+            # Mosaic pads partial tiles when block == array dim.
+            return blk
         while blk >= align and (size % blk or blk % align):
             blk -= align if blk % align == 0 else blk % align
         if blk < align or size % blk:

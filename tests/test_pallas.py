@@ -177,3 +177,15 @@ def test_flash_config_train_step_runs():
     tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, 2, cfg.max_seq)
     state, loss = step_fn(state, tokens)
     assert float(loss) == float(loss), "NaN loss"
+
+
+def test_flash_attention_short_seq_full_block():
+    """A sequence shorter than the sublane alignment still runs: one
+    block spanning the whole dim is always legal (Mosaic pads)."""
+    from kind_tpu_sim.models.transformer import _attention
+
+    q, k, v = _rand_qkv(1, 8, 2, 2, 64)
+    out = pk.flash_attention(q, k, v, causal=True)
+    ref = _attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.array(out), np.array(ref),
+                               atol=2e-5, rtol=2e-5)

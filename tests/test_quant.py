@@ -26,7 +26,7 @@ def test_quantize_roundtrip_error():
     w = jax.random.normal(jax.random.PRNGKey(0), (64, 128))
     qa = quant.quantize(w)
     assert qa.q.dtype == jnp.int8
-    assert qa.scale.shape == (128,)
+    assert qa.scale.shape == (1, 128)
     deq = quant.dequantize(qa)
     # Symmetric per-channel int8: error bounded by scale/2 per entry.
     max_err = float(jnp.abs(deq - w).max())
@@ -53,7 +53,7 @@ def test_quantized_params_structure(cfg, params):
 
     qp = quant.quantize_params(params, cfg)
     assert isinstance(qp["embed"], quant.QuantArray)
-    assert qp["embed"].scale.shape == (cfg.vocab_size,)
+    assert qp["embed"].scale.shape == (cfg.vocab_size, 1)
     assert isinstance(qp["blocks"][0]["wqkv"], quant.QuantArray)
     assert qp["blocks"][0]["attn_norm"].dtype == jnp.float32
 
@@ -108,3 +108,15 @@ def test_quantized_moe_params(params):
     prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, 1, 4)
     out = decode.greedy_generate(qp, cfg, prompt, 4)
     assert out.shape == (1, 8)
+
+
+def test_dequantize_per_row_embedding(cfg, params):
+    """Per-row (embedding) scales dequantize correctly — regression:
+    the scale used to be applied along the wrong axis."""
+    import jax.numpy as jnp
+
+    qa = quant.quantize(params["embed"], axis=1)  # (vocab, d), non-square
+    deq = quant.dequantize(qa)
+    assert deq.shape == params["embed"].shape
+    max_err = float(jnp.abs(deq - params["embed"]).max())
+    assert max_err <= float(qa.scale.max()) * 0.51, max_err
