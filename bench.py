@@ -45,6 +45,13 @@ def have(binary: str) -> bool:
     return shutil.which(binary) is not None
 
 
+def cpu_child_env() -> dict:
+    """CPU-only child env with TPU-tunnel startup hooks stripped."""
+    from kind_tpu_sim.utils.shell import cpu_subprocess_env
+
+    return cpu_subprocess_env()
+
+
 # ---------------------------------------------------------------------
 # e2e mode
 
@@ -202,6 +209,7 @@ def phase_jax_smoke() -> float | None:
         subprocess.run(
             [sys.executable, "-c", JAX_SMOKE.format(repo=str(REPO))],
             check=True, capture_output=True, timeout=300,
+            env=cpu_child_env(),
         )
     except (subprocess.SubprocessError, OSError):
         return None

@@ -46,16 +46,20 @@ def serving_params(params: Params, cfg: ModelConfig) -> Params:
     import jax
     import jax.numpy as jnp
 
+    from kind_tpu_sim.models.quant import QuantArray
+
     dtype = jnp.dtype(cfg.dtype)
 
     def cast(path, leaf):
+        if isinstance(leaf, QuantArray):
+            return leaf  # int8 weights + fp32 scales stay as-is
         name = path[-1].key if hasattr(path[-1], "key") else None
-        if (leaf.ndim >= 2 and name != "router"
-                and leaf.dtype != jnp.int8):  # quantized already
+        if leaf.ndim >= 2 and name != "router":
             return leaf.astype(dtype)
         return leaf
 
-    return jax.tree_util.tree_map_with_path(cast, params)
+    return jax.tree_util.tree_map_with_path(
+        cast, params, is_leaf=lambda x: isinstance(x, QuantArray))
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int):

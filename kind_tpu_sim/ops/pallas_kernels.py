@@ -130,6 +130,23 @@ def softmax(x, interpret: Optional[bool] = None):
 # flash attention (fused online-softmax attention)
 
 
+def _fit_block(size: int, requested: int, align: int) -> int:
+    """Largest block <= requested that divides `size` and respects the
+    sublane alignment. A block spanning the whole dimension is always
+    legal (Mosaic pads partial tiles when block == array dim)."""
+    blk = min(requested, size)
+    if blk == size:
+        return blk
+    while blk >= align and (size % blk or blk % align):
+        blk -= align if blk % align == 0 else blk % align
+    if blk < align or size % blk:
+        raise ValueError(
+            f"flash_attention: no {align}-aligned block divides "
+            f"sequence length {size}; pad the sequence or use the "
+            f"XLA attention path")
+    return blk
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = 128, block_kv: int = 128,
                     interpret: Optional[bool] = None):
@@ -191,24 +208,8 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
     # such constraint.
     align = 1 if run_interpreted else (
         16 if q.dtype == jnp.bfloat16 else 8)
-
-    def fit(size, requested):
-        blk = min(requested, size)
-        if blk == size:
-            # One block spanning the whole dimension is always legal:
-            # Mosaic pads partial tiles when block == array dim.
-            return blk
-        while blk >= align and (size % blk or blk % align):
-            blk -= align if blk % align == 0 else blk % align
-        if blk < align or size % blk:
-            raise ValueError(
-                f"flash_attention: no {align}-aligned block divides "
-                f"sequence length {size}; pad the sequence or use the "
-                f"XLA attention path")
-        return blk
-
-    block_q = fit(t, block_q)
-    block_kv = fit(s, block_kv)
+    block_q = _fit_block(t, block_q, align)
+    block_kv = _fit_block(s, block_kv, align)
     scale = d ** -0.5
 
     # Mosaic tiles the LAST TWO dims of a block (sublane x lane), so

@@ -213,8 +213,10 @@ def _launch_once(s, timeout: float) -> List[dict]:
         logs = pathlib.Path(logdir)
         procs = []
         try:
+            from kind_tpu_sim.utils.shell import cpu_subprocess_env
+
             for worker in range(n):
-                env = dict(os.environ)
+                env = cpu_subprocess_env()
                 env.update(s.worker_env(worker,
                                         hostnames=["127.0.0.1"] * n))
                 env["TPU_SIM_COORDINATOR_PORT"] = str(port)

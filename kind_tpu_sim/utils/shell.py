@@ -16,6 +16,24 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 log = logging.getLogger("kind-tpu-sim")
 
+# Env-var prefixes of TPU-tunnel sitecustomize hooks (axon): when
+# present they register themselves in EVERY new interpreter, taxing
+# startup ~0.6-1.7s. CPU-only Python subprocesses strip them.
+TUNNEL_ENV_PREFIXES = ("_AXON", "PALLAS_AXON")
+
+
+def cpu_subprocess_env(base: Optional[Dict[str, str]] = None
+                       ) -> Dict[str, str]:
+    """Copy of the environment for a CPU-only Python child, with
+    TPU-tunnel startup hooks stripped (see TUNNEL_ENV_PREFIXES)."""
+    import os
+
+    env = dict(os.environ if base is None else base)
+    for key in list(env):
+        if key.startswith(TUNNEL_ENV_PREFIXES):
+            del env[key]
+    return env
+
 
 @dataclasses.dataclass
 class ExecResult:

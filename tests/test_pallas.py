@@ -189,3 +189,22 @@ def test_flash_attention_short_seq_full_block():
     ref = _attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.array(out), np.array(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_fit_block_alignment_rules():
+    """Block fitting under compiled-mode (Mosaic) alignment — the CI
+    suite runs flash in interpret mode (align=1), so the compiled
+    rules are pinned here directly."""
+    # Full-dimension block is legal even below the alignment.
+    assert pk._fit_block(8, 128, 16) == 8
+    assert pk._fit_block(4, 128, 8) == 4
+    # Aligned divisors are found (192 -> 96 under 8-alignment).
+    assert pk._fit_block(192, 128, 8) == 96
+    assert pk._fit_block(1024, 128, 16) == 128
+    # No aligned divisor and not full-dim: clear error, not a Mosaic
+    # lowering failure.
+    with pytest.raises(ValueError):
+        pk._fit_block(100, 64, 8)
+    # Interpret mode accepts any divisor.
+    assert pk._fit_block(100, 128, 1) == 100
+    assert pk._fit_block(192, 128, 1) in (64, 96, 128)

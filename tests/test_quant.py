@@ -120,3 +120,17 @@ def test_dequantize_per_row_embedding(cfg, params):
     assert deq.shape == params["embed"].shape
     max_err = float(jnp.abs(deq - params["embed"]).max())
     assert max_err <= float(qa.scale.max()) * 0.51, max_err
+
+
+def test_serving_params_preserves_quant_scales(cfg, params):
+    """serving_params over an int8 snapshot is a no-op on QuantArrays:
+    scales must stay fp32 (regression: the keepdims 2-D scales were
+    being bf16-cast by the generic >=2-D rule)."""
+    import jax.numpy as jnp
+
+    qp = quant.quantize_params(params, cfg)
+    sp = decode.serving_params(qp, cfg)
+    assert isinstance(sp["blocks"][0]["wqkv"], quant.QuantArray)
+    assert sp["blocks"][0]["wqkv"].scale.dtype == jnp.float32
+    assert sp["embed"].scale.dtype == jnp.float32
+    assert sp["blocks"][0]["wqkv"].q.dtype == jnp.int8
