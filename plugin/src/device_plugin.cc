@@ -199,9 +199,13 @@ void DevicePlugin::InstallHandlers() {
           }
         }
         std::string log = "Allocate: ";
+        uint64_t chips = 0;
         for (const auto& creq : req.container_requests()) {
           log += "[" + std::to_string(creq.devicesids_size()) + " chips]";
+          chips += static_cast<uint64_t>(creq.devicesids_size());
         }
+        allocations_.fetch_add(1);
+        allocated_chips_.fetch_add(chips);
         LogLine(log);
         resp.SerializeToString(response);
         return {};
@@ -262,6 +266,39 @@ void DevicePlugin::InstallHandlers() {
           }
         }
         resp.SerializeToString(response);
+        return {};
+      });
+
+  server_->RegisterUnary(
+      "/tpusim.v1.Introspection/State",
+      [this](const std::string&, std::string* response) -> Status {
+        // Raw JSON as the gRPC message body: the transport treats
+        // messages as opaque bytes, so any client with identity
+        // (de)serializers — kind_tpu_sim.plugin_client, bench.py —
+        // reads it without a proto schema.
+        auto unhealthy = UnhealthySet();
+        auto uptime_s =
+            std::chrono::duration_cast<std::chrono::seconds>(
+                std::chrono::steady_clock::now() - start_time_)
+                .count();
+        std::string json = "{";
+        json += "\"resource\":\"" + cfg_.resource + "\",";
+        json += "\"worker_id\":" + std::to_string(cfg_.worker_id) + ",";
+        json += "\"chips\":" + std::to_string(cfg_.chips) + ",";
+        json += "\"unhealthy\":" + std::to_string(unhealthy.size()) + ",";
+        json += "\"uptime_seconds\":" + std::to_string(uptime_s) + ",";
+        json += "\"allocations\":" +
+                std::to_string(allocations_.load()) + ",";
+        json += "\"allocated_chips\":" +
+                std::to_string(allocated_chips_.load()) + ",";
+        json += "\"kubelet_registrations\":" +
+                std::to_string(registrations_.load()) + ",";
+        json += "\"socket_rebinds\":" +
+                std::to_string(rebinds_.load()) + ",";
+        json += "\"health_updates\":" +
+                std::to_string(health_generation_.load());
+        json += "}";
+        *response = json;
         return {};
       });
 
@@ -343,6 +380,7 @@ void DevicePlugin::RegisterLoop() {
   while (!stopping_.load()) {
     std::string error;
     if (RegisterOnce(&error)) {
+      registrations_.fetch_add(1);
       LogLine("registered with kubelet as " + cfg_.resource);
       return;
     }
@@ -363,6 +401,7 @@ void DevicePlugin::WatchdogLoop() {
     struct stat st;
     if (stat(cfg_.endpoint_path().c_str(), &st) != 0) {
       LogLine("socket vanished (kubelet restart?); re-serving");
+      rebinds_.fetch_add(1);
       server_->Shutdown();
       server_ = std::make_unique<grpc::Server>();
       InstallHandlers();

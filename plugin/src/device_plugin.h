@@ -10,6 +10,7 @@
 #pragma once
 
 #include <atomic>
+#include <chrono>
 #include <memory>
 #include <mutex>
 #include <set>
@@ -92,6 +93,14 @@ class DevicePlugin {
   std::unique_ptr<grpc::Server> server_;
   std::atomic<bool> stopping_{false};
   std::atomic<uint64_t> health_generation_{0};
+  // Introspection counters (served by /tpusim.v1.Introspection/State —
+  // the observability surface SURVEY.md §5 notes the reference lacks).
+  std::atomic<uint64_t> allocations_{0};
+  std::atomic<uint64_t> allocated_chips_{0};
+  std::atomic<uint64_t> registrations_{0};
+  std::atomic<uint64_t> rebinds_{0};
+  std::chrono::steady_clock::time_point start_time_{
+      std::chrono::steady_clock::now()};
   std::thread register_thread_;
   std::thread watchdog_thread_;
 };

@@ -351,3 +351,39 @@ def test_reregisters_after_kubelet_restart(plugin_env, pb):
                          pb.Empty(), pb.Empty, pb.DevicePluginOptions)
     assert options.get_preferred_allocation_available
     channel.close()
+
+
+def test_introspection_state(plugin_env, pb):
+    """The native observability surface: raw-JSON gRPC method with
+    allocation/registration/health counters (SURVEY.md §5 notes the
+    reference has no metrics of any kind)."""
+    import json as jsonlib
+
+    channel = make_channel(plugin_env["socket"])
+
+    def state():
+        stub = channel.unary_unary(
+            "/tpusim.v1.Introspection/State",
+            request_serializer=lambda x: x,
+            response_deserializer=bytes,
+        )
+        return jsonlib.loads(stub(b"", timeout=10))
+
+    before = state()
+    assert before["resource"] == "google.com/tpu"
+    assert before["worker_id"] == 1
+    assert before["chips"] == 8
+    assert before["unhealthy"] == 0
+    assert before["uptime_seconds"] >= 0
+
+    req = pb.AllocateRequest()
+    creq = req.container_requests.add()
+    creq.devicesIDs.extend(["tpu-1-8", "tpu-1-9"])
+    call_unary(channel, pb, "Allocate", req,
+               pb.AllocateRequest, pb.AllocateResponse)
+
+    after = state()
+    assert after["allocations"] == before["allocations"] + 1
+    assert after["allocated_chips"] == before["allocated_chips"] + 2
+    assert after["health_updates"] >= before["health_updates"]
+    channel.close()
