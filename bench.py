@@ -260,6 +260,48 @@ def model_throughput() -> dict | None:
             "fwd_tokens_per_s": round(batch * cfg.max_seq / dt),
         }
 
+        # Long-context forward: 4k tokens, Pallas flash attention vs
+        # the XLA path (flash pays off once the (t,t) score matrix
+        # stops fitting the fusion budget). TPU-only: interpret-mode
+        # flash on CPU measures nothing.
+        if backend == "tpu":
+            try:
+                import dataclasses
+
+                long_cfg = dataclasses.replace(cfg, max_seq=4096)
+                long_tokens = tf.sample_batch(
+                    jax.random.PRNGKey(2), long_cfg, 2, 4096)
+
+                def fwd_time(use_flash):
+                    run_cfg = dataclasses.replace(long_cfg,
+                                                  flash=use_flash)
+                    # forward (not loss_fn): loss_fn's next-token
+                    # shift would leave 4095 tokens, which no
+                    # 16-aligned flash block divides.
+                    f = jax.jit(
+                        lambda p, t: tf.forward(p, t, run_cfg).sum())
+                    jax.block_until_ready(f(params, long_tokens))
+                    best = None
+                    for _ in range(3):
+                        t0 = time.monotonic()
+                        jax.block_until_ready(f(params, long_tokens))
+                        dt = time.monotonic() - t0
+                        best = dt if best is None else min(best, dt)
+                    return best
+
+                try:
+                    result["fwd_4k_tokens_per_s"] = round(
+                        2 * 4096 / fwd_time(False))
+                except Exception as exc:  # pragma: no cover
+                    result["fwd_4k_error"] = str(exc)[:100]
+                try:
+                    result["fwd_4k_flash_tokens_per_s"] = round(
+                        2 * 4096 / fwd_time(True))
+                except Exception as exc:  # pragma: no cover
+                    result["fwd_4k_flash_error"] = str(exc)[:100]
+            except Exception as exc:  # pragma: no cover
+                result["fwd_4k_error"] = str(exc)[:100]
+
         # Greedy decode throughput (KV-cache scan; single readback),
         # on the bf16 serving snapshot (decode is weight-bandwidth-
         # bound; the snapshot halves the bytes per step). Prefill is

@@ -148,7 +148,7 @@ def _fit_block(size: int, requested: int, align: int) -> int:
 
 
 def flash_attention(q, k, v, causal: bool = True,
-                    block_q: int = 128, block_kv: int = 128,
+                    block_q: int = 512, block_kv: int = 1024,
                     interpret: Optional[bool] = None):
     """Fused attention: softmax(QK^T/sqrt(d))V without materializing
     the (t, s) score matrix in HBM.

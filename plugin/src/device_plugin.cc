@@ -281,8 +281,13 @@ void DevicePlugin::InstallHandlers() {
             std::chrono::duration_cast<std::chrono::seconds>(
                 std::chrono::steady_clock::now() - start_time_)
                 .count();
+        std::string escaped;
+        for (char c : cfg_.resource) {  // minimal JSON string escape
+          if (c == '"' || c == '\\') escaped += '\\';
+          escaped += c;
+        }
         std::string json = "{";
-        json += "\"resource\":\"" + cfg_.resource + "\",";
+        json += "\"resource\":\"" + escaped + "\",";
         json += "\"worker_id\":" + std::to_string(cfg_.worker_id) + ",";
         json += "\"chips\":" + std::to_string(cfg_.chips) + ",";
         json += "\"unhealthy\":" + std::to_string(unhealthy.size()) + ",";

@@ -105,13 +105,31 @@ def test_flash_attention_non_causal():
 
 
 def test_flash_attention_odd_seq_fits_blocks():
-    """Sequence not divisible by the default 128 block: block sizes
-    self-fit (192 -> 64)."""
+    """Sequence not divisible by the requested block: block sizes
+    self-fit (192 with block 128 -> 96/64). Explicit blocks so the
+    fitting (not the whole-dim fast path) is exercised."""
     from kind_tpu_sim.models.transformer import _attention
 
     q, k, v = _rand_qkv(1, 192, 2, 2, 64)
-    out = pk.flash_attention(q, k, v, causal=True)
+    out = pk.flash_attention(q, k, v, causal=True,
+                             block_q=128, block_kv=128)
     ref = _attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.array(out), np.array(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_attention_multi_block_accumulation(causal):
+    """Small explicit blocks force a multi-step kv grid, covering the
+    online-softmax cross-block path (init/rescale/finalize and the
+    causal dead-block skip) that the 512/1024 defaults clamp away on
+    CI-sized sequences."""
+    from kind_tpu_sim.models.transformer import _attention
+
+    q, k, v = _rand_qkv(2, 256, 4, 2, 64)
+    out = pk.flash_attention(q, k, v, causal=causal,
+                             block_q=64, block_kv=64)
+    ref = _attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.array(out), np.array(ref),
                                atol=2e-5, rtol=2e-5)
 
