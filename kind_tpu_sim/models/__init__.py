@@ -1,3 +1,10 @@
-"""JAX workload models for the simulated TPU cluster."""
+"""JAX workload models for the simulated TPU cluster.
+
+transformer — flagship decoder LM (GQA, bf16, Megatron-TP specs)
+decode      — KV-cache serving (prefill + fused greedy scan, snapshots)
+quant       — int8 weight-only serving snapshot
+checkpoint  — orbax checkpoint/resume
+moe         — Switch-MoE expert-parallel MLP
+"""
 
 from kind_tpu_sim.models import transformer  # noqa: F401

@@ -17,6 +17,7 @@ MoE decode is a functional path, not a bit-identical one.
 
 from __future__ import annotations
 
+import dataclasses as _dataclasses
 from typing import Any, Dict
 
 from kind_tpu_sim.models.transformer import (
@@ -205,6 +206,74 @@ def generate_from_cache(params: Params, cfg: ModelConfig, first_token,
         step, (first_token, cache), jnp.arange(num_new - 1))
     return jnp.concatenate(
         [first_token[:, None], rest.swapaxes(0, 1)], axis=1)
+
+
+@_dataclasses.dataclass(frozen=True)
+class SamplingConfig:
+    """vLLM-style sampling knobs. temperature<=0 means greedy; top_k=0
+    means full vocab; top_p=1.0 disables nucleus filtering."""
+
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+
+def _sample_token(logits, sampling: SamplingConfig, key, dtype):
+    """One sampling step over fp32 logits (b, vocab) -> tokens (b,)."""
+    import jax
+    import jax.numpy as jnp
+
+    if sampling.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    logits = logits / sampling.temperature
+    if sampling.top_k > 0:
+        kth = jax.lax.top_k(logits, sampling.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if sampling.top_p < 1.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        sorted_probs = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        # Keep tokens while the mass BEFORE them is < top_p (the
+        # first token always survives); cutoff = smallest kept prob.
+        keep = (cum - sorted_probs) < sampling.top_p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_probs, 2.0), axis=-1, keepdims=True)
+        logits = jnp.where(probs < cutoff, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(dtype)
+
+
+def sample_generate(params: Params, cfg: ModelConfig, prompt,
+                    num_new: int, key,
+                    sampling: SamplingConfig = SamplingConfig()):
+    """prompt (b, t_p) int32 -> (b, t_p + num_new) sampled
+    continuation. Same fused prefill+scan shape as greedy_generate;
+    per-step keys derive from `key` by fold_in, so a fixed key gives a
+    reproducible sequence."""
+    import jax
+    import jax.numpy as jnp
+
+    b, t_p = prompt.shape
+    if num_new <= 0:
+        return prompt
+    logits, cache = prefill(params, cfg, prompt, t_p + num_new)
+    first = _sample_token(logits, sampling, jax.random.fold_in(key, 0),
+                          prompt.dtype)
+
+    def step(carry, i):
+        token, cache = carry
+        logits, cache = decode_step(params, cfg, token, cache, t_p + i)
+        nxt = _sample_token(logits, sampling,
+                            jax.random.fold_in(key, i + 1), token.dtype)
+        return (nxt, cache), nxt
+
+    if num_new == 1:
+        generated = first[:, None]
+    else:
+        (_, _), rest = jax.lax.scan(
+            step, (first, cache), jnp.arange(num_new - 1))
+        generated = jnp.concatenate(
+            [first[:, None], rest.swapaxes(0, 1)], axis=1)
+    return jnp.concatenate([prompt, generated], axis=1)
 
 
 def greedy_generate(params: Params, cfg: ModelConfig, prompt,

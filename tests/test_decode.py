@@ -161,3 +161,70 @@ def test_moe_decode_runs():
     out = decode.greedy_generate(params, cfg, prompt, num_new=4)
     assert out.shape == (1, 8)
     assert (np.array(out) < cfg.vocab_size).all()
+
+
+# ---------------------------------------------------------------------
+# sampling
+
+
+def test_sample_generate_greedy_modes_match(cfg):
+    """temperature=0 and top_k=1 both reduce sampling to greedy."""
+    import jax
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, 2, 8)
+    greedy = np.array(decode.greedy_generate(params, cfg, prompt, 8))
+    key = jax.random.PRNGKey(7)
+    t0 = np.array(decode.sample_generate(
+        params, cfg, prompt, 8, key,
+        decode.SamplingConfig(temperature=0.0)))
+    k1 = np.array(decode.sample_generate(
+        params, cfg, prompt, 8, key,
+        decode.SamplingConfig(top_k=1)))
+    np.testing.assert_array_equal(greedy, t0)
+    np.testing.assert_array_equal(greedy, k1)
+
+
+def test_sample_generate_reproducible_and_valid(cfg):
+    import jax
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, 2, 8)
+    scfg = decode.SamplingConfig(temperature=1.0, top_k=8, top_p=0.9)
+    key = jax.random.PRNGKey(3)
+    a = np.array(decode.sample_generate(params, cfg, prompt, 12, key,
+                                        scfg))
+    b = np.array(decode.sample_generate(params, cfg, prompt, 12, key,
+                                        scfg))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 20)
+    assert (a < cfg.vocab_size).all() and (a >= 0).all()
+    np.testing.assert_array_equal(a[:, :8], np.array(prompt))
+    c = np.array(decode.sample_generate(
+        params, cfg, prompt, 12, jax.random.PRNGKey(4), scfg))
+    assert not np.array_equal(a, c), "different keys gave same tokens"
+
+
+def test_sample_generate_jits_and_single_token(cfg):
+    import jax
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, 2, 8)
+    out = jax.jit(
+        lambda p, t, k: decode.sample_generate(
+            p, cfg, t, 1, k, decode.SamplingConfig(top_p=0.5))
+    )(params, prompt, jax.random.PRNGKey(0))
+    assert out.shape == (2, 9)
+
+
+def test_top_p_tiny_keeps_argmax(cfg):
+    """top_p smaller than any single prob keeps only the argmax."""
+    import jax
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, 2, 8)
+    greedy = np.array(decode.greedy_generate(params, cfg, prompt, 8))
+    nucleus = np.array(decode.sample_generate(
+        params, cfg, prompt, 8, jax.random.PRNGKey(9),
+        decode.SamplingConfig(temperature=1.0, top_p=1e-6)))
+    np.testing.assert_array_equal(greedy, nucleus)
