@@ -59,15 +59,24 @@ def save(directory, step: int, state: Any, *,
 
 
 def latest_step(directory) -> Optional[int]:
-    """Newest complete checkpoint step, or None when none exists."""
+    """Newest complete checkpoint step, or None when none exists.
+
+    Pure query: scans step subdirectories directly instead of opening a
+    CheckpointManager, which (with create=True) would materialize the
+    directory tree as a side effect of a read.
+    """
     path = pathlib.Path(directory)
     if not path.exists():
         return None
-    mgr = _manager(directory)
-    try:
-        return mgr.latest_step()
-    finally:
-        mgr.close()
+    steps = []
+    for child in path.iterdir():
+        if not child.is_dir() or child.name.startswith("."):
+            continue
+        try:
+            steps.append(int(child.name))
+        except ValueError:
+            continue
+    return max(steps) if steps else None
 
 
 def restore(directory, abstract_state: Any,

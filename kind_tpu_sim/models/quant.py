@@ -71,6 +71,10 @@ def linear(x, w, dtype=None):
     import jax.numpy as jnp
 
     if isinstance(w, QuantArray):
+        if w.scale.shape[0] != 1:
+            raise ValueError(
+                "linear() needs a weight quantized along axis 0 "
+                f"(scale shape (1, out)); got scale {w.scale.shape}")
         out = jnp.einsum(
             "...d,df->...f", x, w.q.astype(x.dtype),
             preferred_element_type=jnp.float32,

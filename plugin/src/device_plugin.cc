@@ -283,6 +283,13 @@ void DevicePlugin::InstallHandlers() {
                 .count();
         std::string escaped;
         for (char c : cfg_.resource) {  // minimal JSON string escape
+          unsigned char uc = static_cast<unsigned char>(c);
+          if (uc < 0x20) {  // control chars would emit invalid JSON
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", uc);
+            escaped += buf;
+            continue;
+          }
           if (c == '"' || c == '\\') escaped += '\\';
           escaped += c;
         }
