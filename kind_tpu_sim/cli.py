@@ -153,6 +153,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     smoke.add_argument("--json", action="store_true", dest="as_json")
 
+    man = sub.add_parser(
+        "manifests",
+        help=(
+            "print a topology-derived workload manifest "
+            "(no cluster needed)"
+        ),
+    )
+    man.add_argument("which", choices=["jax-multihost"])
+    man.add_argument("--topology", default=topo.DEFAULT_TOPOLOGY)
+    man.add_argument(
+        "--accelerator", default=topo.DEFAULT_ACCELERATOR,
+        choices=sorted(topo.ACCELERATORS),
+    )
+    man.add_argument(
+        "--out", default=None,
+        help="write to this file instead of stdout",
+    )
+
     profile = sub.add_parser(
         "profile",
         help=(
@@ -188,6 +206,22 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
             )
         print("SLICE SMOKE " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
+
+
+def run_manifests(args: argparse.Namespace) -> int:
+    cfg = SimConfig(
+        vendor="tpu",
+        accelerator=args.accelerator,
+        tpu_topology=args.topology,
+    )
+    text = manifests.jax_multihost_manifest(cfg)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        print(f"wrote {args.out}")
+    else:
+        print(text, end="")
+    return 0
 
 
 def run_profile(args: argparse.Namespace) -> int:
@@ -374,6 +408,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Cluster-free subcommands: no Simulator, no container runtime.
         if args.command == "slice-smoke":
             return run_slice_smoke(args)
+        if args.command == "manifests":
+            return run_manifests(args)
         if args.command == "profile":
             return run_profile(args)
         cfg = config_from_args(args)

@@ -76,3 +76,29 @@ def test_containerd_hosts_toml():
     toml = manifests.containerd_hosts_toml(cfg)
     assert 'host."http://kind-registry:5000"' in toml
     assert '"pull", "resolve"' in toml
+
+
+def test_jax_multihost_manifest_derives_from_topology():
+    # 4x8 v5e = 32 chips over 4 hosts of 2x4 (8 chips each).
+    cfg = SimConfig(vendor="tpu", tpu_topology="4x8")
+    text = manifests.jax_multihost_manifest(cfg)
+    service, statefulset = list(yaml.safe_load_all(text))
+    assert service["kind"] == "Service"
+    assert statefulset["spec"]["replicas"] == 4
+    ctr = statefulset["spec"]["template"]["spec"]["containers"][0]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == 8
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert env["TPU_SIM_REPLICAS"] == "4"
+    payload = ctr["args"][0]
+    assert "--xla_force_host_platform_device_count=8" in payload
+    assert "jax-tpu-0.tpu-sim.default.svc.cluster.local:8476" in payload
+
+
+def test_jax_multihost_manifest_matches_committed_default():
+    # pods/jax-multihost.yaml is generated from the default slice; keep
+    # the committed file in sync with the generator.
+    cfg = SimConfig(vendor="tpu")
+    text = manifests.jax_multihost_manifest(cfg)
+    with open("pods/jax-multihost.yaml", encoding="utf-8") as fh:
+        committed = fh.read()
+    assert committed == text
