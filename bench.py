@@ -16,9 +16,11 @@ measures the same thing at the strongest level the host allows:
        (subprocess on the virtual CPU backend),
   value = total seconds until the simulated slice is proven usable.
 
-vs_baseline = 60 / value: how many times faster than the reference's
-Ready bound the simulated TPU stack comes up. Extras report flagship-
-model throughput on the local accelerator when one is present.
+vs_baseline compares against the reference's 60s Ready bound — but
+only in e2e mode, where both sides measure a real kind cluster. In sim
+mode it is null and the ratio appears as the explicitly-labeled extra
+``sim_vs_reference_bound``. Extras report flagship-model throughput
+with MFU / HBM-roofline attribution when a real TPU is present.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
@@ -217,14 +219,25 @@ def phase_jax_smoke() -> float | None:
 
 
 def model_throughput() -> dict | None:
-    """Flagship model step throughput on the local accelerator."""
+    """Flagship model step throughput on the local accelerator.
+
+    Every phase carries its roofline: MFU (fraction of peak bf16
+    FLOPs, from models/flops.py's analytic accounting) for the
+    compute-bound fwd and train-step phases, achieved HBM GB/s for
+    the bandwidth-bound decode phases.
+    """
     try:
         import jax
         import numpy as np
 
+        from kind_tpu_sim.models import flops as F
         from kind_tpu_sim.models import transformer as tf
 
         backend = jax.default_backend()
+        # MFU/roofline numbers are only meaningful against a real
+        # chip's datasheet; never label a CPU/GPU host as a TPU.
+        spec = (F.chip_spec(jax.devices()[0].device_kind)
+                if backend == "tpu" else None)
         cfg = (tf.bench_config() if backend == "tpu"
                else tf.ModelConfig())
         batch = 8 if backend == "tpu" else 2
@@ -252,13 +265,59 @@ def model_throughput() -> dict | None:
         total = float(run(params, tokens))
         dt = (time.monotonic() - t0) / steps
         assert total == total  # NaN guard
+        # loss_fn's next-token shift processes max_seq-1 positions;
+        # count those for both the rate and the MFU so they agree.
+        fwd_seq = cfg.max_seq - 1
+        fwd_tps = batch * fwd_seq / dt
         result = {
             "backend": backend,
             "model": (f"d{cfg.d_model}xL{cfg.n_layers}"
                       + (f"-gqa{cfg.kv_heads}"
                          if cfg.kv_heads != cfg.n_heads else "")),
-            "fwd_tokens_per_s": round(batch * cfg.max_seq / dt),
+            "fwd_tokens_per_s": round(fwd_tps),
         }
+        if spec is not None:
+            result["chip"] = spec.name
+            result["fwd_mfu_pct"] = round(
+                F.mfu(fwd_tps, F.fwd_flops_per_token(cfg, fwd_seq),
+                      spec), 1)
+
+        # Full train step (fwd + bwd + AdamW update) — the flagship
+        # number. Scanned on-device like the forward so per-dispatch
+        # RPC latency cannot pollute it.
+        try:
+            import jax.numpy as jnp
+
+            step_fn, init_state = tf.make_train_step(cfg)
+            state = init_state(jax.random.PRNGKey(3))
+            train_steps = 5 if backend == "tpu" else 2
+
+            @jax.jit
+            def run_train(state, tokens):
+                def body(st, i):
+                    shifted = (tokens + i) % cfg.vocab_size
+                    return step_fn(st, shifted)
+
+                return jax.lax.scan(body, state,
+                                    jnp.arange(train_steps))
+
+            out_state, losses = run_train(state, tokens)
+            jax.block_until_ready(losses)  # compile + warm
+            t0 = time.monotonic()
+            out_state, losses = run_train(state, tokens)
+            jax.block_until_ready(losses)
+            train_dt = (time.monotonic() - t0) / train_steps
+            assert float(losses[-1]) == float(losses[-1])  # NaN guard
+            train_tps = batch * fwd_seq / train_dt
+            result["train_step_tokens_per_s"] = round(train_tps)
+            if spec is not None:
+                result["train_mfu_pct"] = round(
+                    F.mfu(train_tps,
+                          F.train_flops_per_token(cfg, fwd_seq),
+                          spec), 1)
+            del out_state, state  # free the optimizer tree
+        except Exception as exc:  # pragma: no cover - best effort
+            result["train_step_error"] = str(exc)[:100]
 
         # Long-context forward: 4k tokens, Pallas flash attention vs
         # the XLA path (flash pays off once the (t,t) score matrix
@@ -385,8 +444,17 @@ def model_throughput() -> dict | None:
                     batch * prompt.shape[1] / prefill_dt)
             decode_dt = raw_decode - null_dt
             if decode_dt > 0.3 * raw_decode:
-                result["decode_tokens_per_s"] = round(
-                    batch * new_tokens / decode_dt)
+                dec_tps = batch * new_tokens / decode_dt
+                result["decode_tokens_per_s"] = round(dec_tps)
+                # Bandwidth roofline: decode re-reads every weight
+                # and the full allocated KV cache (length `total`)
+                # each step; the achieved GB/s that implies is the
+                # honest "fast or just correct?" answer.
+                if spec is not None:
+                    roof = F.decode_roofline(cfg, batch, total,
+                                             dec_tps, spec)
+                    result["decode_gbps"] = roof["achieved_gbps"]
+                    result["decode_roofline"] = roof
 
             # Int8 weight-only snapshot: halves the weight bytes a
             # decode step reads (the bf16 path already sits at the
@@ -407,8 +475,15 @@ def model_throughput() -> dict | None:
                 raw_q = med(run_decode_q, 3)
                 dt_q = raw_q - null_dt
                 if dt_q > 0.3 * raw_q:
-                    result["decode_int8_tokens_per_s"] = round(
-                        batch * new_tokens / dt_q)
+                    q_tps = batch * new_tokens / dt_q
+                    result["decode_int8_tokens_per_s"] = round(q_tps)
+                    if spec is not None:
+                        roof_q = F.decode_roofline(
+                            cfg, batch, total, q_tps, spec,
+                            weight_bytes=1)
+                        result["decode_int8_gbps"] = \
+                            roof_q["achieved_gbps"]
+                        result["decode_int8_roofline"] = roof_q
             except Exception as exc:  # pragma: no cover
                 result["decode_int8_error"] = str(exc)[:100]
         except Exception as exc:  # pragma: no cover - best effort
@@ -478,13 +553,23 @@ def main() -> int:
 
     value = round(
         t_orch + (t_plugin or 0.0) + (t_jax or 0.0), 3)
+    # vs_baseline is only an apples-to-apples number in e2e mode
+    # (real kind vs the reference's real 60s CI bound). The sim-mode
+    # stack-ready time is a virtualized cluster; publish the ratio as
+    # an explicitly-labeled sim extra, not the headline comparison.
     out = {
         "metric": "sim_tpu_stack_ready_seconds",
         "value": value,
         "unit": "s",
-        "vs_baseline": round(BASELINE_READY_BOUND_S / value, 2),
+        "vs_baseline": None,
         "mode": "sim",
-        "extras": phases,
+        "note": ("sim-mode: virtualized control plane; not comparable "
+                 "to the reference's real-kind 60s Ready bound"),
+        "extras": dict(
+            phases,
+            sim_vs_reference_bound=round(
+                BASELINE_READY_BOUND_S / value, 2),
+        ),
     }
     print(json.dumps(out))
     return 0

@@ -1,0 +1,195 @@
+"""Analytic FLOP and HBM-byte accounting for the flagship model.
+
+The reference publishes no performance numbers (SURVEY.md §6), so the
+bar for this framework's bench is its own roofline: every throughput
+number in ``bench.py`` is reported alongside the fraction of the
+hardware ceiling it achieves — MFU for compute-bound phases (training,
+prefill), achieved GB/s for bandwidth-bound phases (decode).
+
+All accounting is exact matmul arithmetic derived from ``ModelConfig``
+(2 FLOPs per multiply-accumulate); elementwise work (norms, rotary,
+softmax, residuals) is O(d) per token and deliberately excluded, which
+makes the reported MFU slightly conservative — the honest direction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict
+
+from kind_tpu_sim.models.transformer import ModelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Peak numbers for one TPU generation (public datasheet values)."""
+
+    name: str
+    peak_bf16_tflops: float
+    peak_int8_tops: float
+    hbm_gib: float
+    hbm_gbps: float          # GB/s (decimal)
+
+
+# Keyed by jax Device.device_kind. Public Google Cloud datasheet specs.
+CHIPS: Dict[str, ChipSpec] = {
+    "TPU v5 lite": ChipSpec("v5e", 197.0, 394.0, 16.0, 819.0),
+    "TPU v5e": ChipSpec("v5e", 197.0, 394.0, 16.0, 819.0),
+    "TPU v4": ChipSpec("v4", 275.0, 275.0, 32.0, 1228.0),
+    "TPU v5p": ChipSpec("v5p", 459.0, 918.0, 95.0, 2765.0),
+    "TPU v6 lite": ChipSpec("v6e", 918.0, 1836.0, 32.0, 1640.0),
+}
+
+_FALLBACK = CHIPS["TPU v5 lite"]
+
+
+def chip_spec(device_kind: str | None) -> ChipSpec:
+    """Spec for the local chip; unknown kinds fall back to v5e (the
+    bench host's chip). Overridable for odd hosts via
+    ``TPU_SIM_PEAK_TFLOPS`` / ``TPU_SIM_PEAK_GBPS``."""
+    spec = CHIPS.get(device_kind or "", _FALLBACK)
+    tflops = os.environ.get("TPU_SIM_PEAK_TFLOPS")
+    gbps = os.environ.get("TPU_SIM_PEAK_GBPS")
+    if tflops or gbps:
+        spec = dataclasses.replace(
+            spec,
+            name=spec.name + "-override",
+            peak_bf16_tflops=float(tflops or spec.peak_bf16_tflops),
+            hbm_gbps=float(gbps or spec.hbm_gbps),
+        )
+    return spec
+
+
+# ---------------------------------------------------------------------
+# parameter / FLOP accounting
+
+
+def matmul_params(cfg: ModelConfig) -> Dict[str, int]:
+    """Element counts of every matmul weight the forward pass reads.
+
+    MoE configs count all experts for storage ('total') but only the
+    per-token-active expert weights for FLOPs ('active' — Switch
+    routing is top-1, so one expert's up+down per token).
+    """
+    d, ff = cfg.d_model, cfg.d_ff
+    wqkv = d * (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim
+    wo = d * d
+    if cfg.n_experts > 0:
+        mlp_total = cfg.n_experts * 2 * d * ff + d * cfg.n_experts
+        mlp_active = 2 * d * ff + d * cfg.n_experts
+    else:
+        mlp_total = mlp_active = 2 * d * ff
+    readout = cfg.vocab_size * d  # weight-tied embedding, read as logits
+    return {
+        "per_layer_total": wqkv + wo + mlp_total,
+        "per_layer_active": wqkv + wo + mlp_active,
+        "readout": readout,
+        "total": cfg.n_layers * (wqkv + wo + mlp_total) + readout,
+        "active": cfg.n_layers * (wqkv + wo + mlp_active) + readout,
+    }
+
+
+def fwd_flops_per_token(cfg: ModelConfig, seq: int) -> float:
+    """Forward matmul FLOPs per token at sequence length ``seq``.
+
+    2 * active matmul params, plus causal attention: each token at
+    position p attends to p+1 keys; averaged over the sequence that is
+    (seq+1)/2 positions, with 2*d FLOPs for q·k and 2*d for probs·v
+    per (query, key) pair.
+    """
+    p = matmul_params(cfg)
+    t_eff = (seq + 1) / 2.0
+    attn = cfg.n_layers * 4.0 * cfg.d_model * t_eff
+    return 2.0 * p["active"] + attn
+
+
+def train_flops_per_token(cfg: ModelConfig, seq: int) -> float:
+    """Full train-step FLOPs per token: fwd + bwd (2x fwd) = 3x.
+
+    The optimizer update is elementwise (O(params) per *step*, not per
+    token) and excluded, consistent with the standard 6N+attention MFU
+    convention.
+    """
+    return 3.0 * fwd_flops_per_token(cfg, seq)
+
+
+def mfu(tokens_per_s: float, flops_per_token: float,
+        spec: ChipSpec) -> float:
+    """Model FLOPs utilization as a percentage of bf16 peak."""
+    achieved = tokens_per_s * flops_per_token
+    return 100.0 * achieved / (spec.peak_bf16_tflops * 1e12)
+
+
+# ---------------------------------------------------------------------
+# decode byte accounting (bandwidth roofline)
+
+
+def decode_bytes_per_step(
+    cfg: ModelConfig,
+    batch: int,
+    cache_len: int,
+    weight_bytes: int = 2,
+    kv_bytes: int = 2,
+) -> Dict[str, float]:
+    """HBM bytes one greedy decode step moves, split by source.
+
+    Every step re-reads every matmul weight once (weights are shared
+    across the batch) and the full live KV cache (which scales with
+    batch). Scales for int8 tensors are fp32 with one element per
+    quantized row/channel — included, they are what separates the int8
+    theory (2x) from int8 practice.
+    """
+    p = matmul_params(cfg)
+    weights = float(p["active"]) * weight_bytes
+    scale_bytes = 0.0
+    if weight_bytes == 1:
+        # per-out-channel scales for block matmuls; per-row for embed
+        d, ff = cfg.d_model, cfg.d_ff
+        per_layer = (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim \
+            + d + ff + d
+        scale_bytes = 4.0 * (cfg.n_layers * per_layer + cfg.vocab_size)
+    kv_elems = (2.0 * cfg.n_layers * batch * cache_len
+                * cfg.kv_heads * cfg.head_dim)
+    kv_read = kv_elems * kv_bytes
+    kv_scale_read = 0.0
+    if kv_bytes == 1:
+        # one fp32 scale per (layer, k/v, batch, position, kv_head) row
+        kv_scale_read = (2.0 * cfg.n_layers * batch * cache_len
+                        * cfg.kv_heads * 4.0)
+    kv_write = (2.0 * cfg.n_layers * batch
+                * cfg.kv_heads * cfg.head_dim * kv_bytes)
+    total = weights + scale_bytes + kv_read + kv_scale_read + kv_write
+    return {
+        "weights": weights + scale_bytes,
+        "kv": kv_read + kv_scale_read + kv_write,
+        "total": total,
+    }
+
+
+def decode_roofline(
+    cfg: ModelConfig,
+    batch: int,
+    cache_len: int,
+    tokens_per_s: float,
+    spec: ChipSpec,
+    weight_bytes: int = 2,
+    kv_bytes: int = 2,
+) -> Dict[str, float]:
+    """Achieved HBM bandwidth implied by a measured decode rate.
+
+    ``tokens_per_s`` counts generated tokens across the batch; one
+    step generates ``batch`` tokens, so steps/s = tokens_per_s/batch.
+    """
+    b = decode_bytes_per_step(cfg, batch, cache_len, weight_bytes,
+                              kv_bytes)
+    steps_per_s = tokens_per_s / batch
+    achieved = b["total"] * steps_per_s
+    return {
+        "bytes_per_step_mb": round(b["total"] / 1e6, 1),
+        "weight_mb": round(b["weights"] / 1e6, 1),
+        "kv_mb": round(b["kv"] / 1e6, 1),
+        "achieved_gbps": round(achieved / 1e9, 1),
+        "roof_gbps": spec.hbm_gbps,
+        "roof_frac": round(achieved / (spec.hbm_gbps * 1e9), 3),
+    }

@@ -1,0 +1,93 @@
+"""FLOP/byte accounting (models/flops.py) vs XLA's own cost model.
+
+The MFU and roofline numbers bench.py reports are only as good as this
+accounting, so pin it against jax's compiled cost analysis: analytic
+matmul FLOPs must sit just below XLA's total (we exclude elementwise
+work on purpose — the conservative direction) and never above it.
+"""
+
+import jax
+
+from kind_tpu_sim.models import flops as F
+from kind_tpu_sim.models import transformer as tf
+
+CFG = tf.ModelConfig(vocab_size=512, d_model=128, n_heads=4,
+                     n_layers=2, d_ff=512, max_seq=128)
+
+
+def _xla_flops(fn, *args):
+    cost = jax.jit(fn).lower(*args).compile().cost_analysis()
+    assert cost and cost.get("flops"), "cost analysis unavailable"
+    return float(cost["flops"])
+
+
+def test_fwd_flops_match_xla():
+    params = tf.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), CFG, 4, CFG.max_seq)
+    xla = _xla_flops(lambda p, t: tf.loss_fn(p, t, CFG), params, tokens)
+    # loss_fn's forward runs on seq-1 tokens (next-token shift)
+    analytic = F.fwd_flops_per_token(CFG, CFG.max_seq - 1) \
+        * 4 * (CFG.max_seq - 1)
+    assert 0.75 * xla <= analytic <= xla
+
+
+def test_train_flops_match_xla():
+    params = tf.init_params(jax.random.PRNGKey(0), CFG)
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), CFG, 4, CFG.max_seq)
+    xla = _xla_flops(
+        lambda p, t: jax.value_and_grad(tf.loss_fn)(p, t, CFG),
+        params, tokens)
+    analytic = F.train_flops_per_token(CFG, CFG.max_seq - 1) \
+        * 4 * (CFG.max_seq - 1)
+    assert 0.75 * xla <= analytic <= xla
+
+
+def test_gqa_reduces_wqkv_params():
+    mha = F.matmul_params(CFG)
+    gqa = F.matmul_params(
+        tf.ModelConfig(vocab_size=512, d_model=128, n_heads=4,
+                       n_layers=2, d_ff=512, n_kv_heads=2))
+    assert gqa["per_layer_total"] < mha["per_layer_total"]
+
+
+def test_decode_bytes_int8_weights():
+    bf16 = F.decode_bytes_per_step(CFG, batch=2, cache_len=64)
+    int8 = F.decode_bytes_per_step(CFG, batch=2, cache_len=64,
+                                   weight_bytes=1)
+    # int8 weights halve weight traffic (modulo fp32 scales)...
+    assert int8["weights"] < 0.55 * bf16["weights"]
+    # ...but KV traffic is untouched, so total shrinks by less
+    assert int8["total"] > 0.5 * bf16["total"]
+    assert int8["kv"] == bf16["kv"]
+
+
+def test_decode_bytes_int8_kv():
+    bf16 = F.decode_bytes_per_step(CFG, batch=2, cache_len=64)
+    q = F.decode_bytes_per_step(CFG, batch=2, cache_len=64,
+                                weight_bytes=1, kv_bytes=1)
+    assert q["kv"] < 0.6 * bf16["kv"]
+    assert q["total"] < 0.56 * bf16["total"]
+
+
+def test_chip_spec_fallback_and_override(monkeypatch):
+    assert F.chip_spec("TPU v5 lite").name == "v5e"
+    assert F.chip_spec("something-new").name == "v5e"  # fallback
+    monkeypatch.setenv("TPU_SIM_PEAK_TFLOPS", "100")
+    spec = F.chip_spec("TPU v5 lite")
+    assert spec.peak_bf16_tflops == 100.0
+    assert spec.hbm_gbps == 819.0
+
+
+def test_mfu_formula():
+    spec = F.ChipSpec("test", 100.0, 200.0, 16.0, 800.0)
+    # 1e12 flop/token * 50 tok/s = 5e13 = 50% of 1e14
+    assert abs(F.mfu(50.0, 1e12, spec) - 50.0) < 1e-9
+
+
+def test_decode_roofline_shape():
+    spec = F.chip_spec("TPU v5 lite")
+    r = F.decode_roofline(CFG, batch=2, cache_len=64,
+                          tokens_per_s=1000.0, spec=spec)
+    assert r["roof_gbps"] == 819.0
+    assert r["achieved_gbps"] > 0
+    assert abs(r["weight_mb"] + r["kv_mb"] - r["bytes_per_step_mb"]) < 0.25
