@@ -456,21 +456,36 @@ def model_throughput() -> dict | None:
                     result["decode_gbps"] = roof["achieved_gbps"]
                     result["decode_roofline"] = roof
 
-            # Int8 weight-only snapshot: halves the weight bytes a
-            # decode step reads (the bf16 path already sits at the
-            # HBM roof). Own try: an int8-only failure must not be
-            # attributed to the (already-recorded) bf16 numbers.
+            # Int8 serving snapshot: int8 weights AND int8 KV cache
+            # (decode is pure HBM bandwidth; both halvings are real
+            # byte reductions). Own try: an int8-only failure must
+            # not be attributed to the (already-recorded) bf16
+            # numbers.
             try:
+                import dataclasses as _dc
+
                 from kind_tpu_sim.models import quant
 
-                qparams = quant.quantize_params(params, cfg)
+                cfg_q = _dc.replace(cfg, int8_kv=True)
+                qparams = quant.quantize_params(params, cfg_q)
+                pre_q = jax.jit(
+                    lambda p, t: decode.prefill(p, cfg_q, t, total))
+
+                def _dec_q(p, logits, cache):
+                    first = jax.numpy.argmax(logits, -1).astype(
+                        prompt.dtype)
+                    return decode.generate_from_cache(
+                        p, cfg_q, first, cache, prompt.shape[1],
+                        new_tokens)
+
+                dec_q = jax.jit(_dec_q)
                 logits_q, cache_q = jax.block_until_ready(
-                    pre(qparams, prompt))
-                np.asarray(dec(qparams, logits_q, cache_q))  # warm
+                    pre_q(qparams, prompt))
+                np.asarray(dec_q(qparams, logits_q, cache_q))  # warm
 
                 def run_decode_q():
                     state["out_q"] = np.asarray(
-                        dec(qparams, logits_q, cache_q))
+                        dec_q(qparams, logits_q, cache_q))
 
                 raw_q = med(run_decode_q, 3)
                 dt_q = raw_q - null_dt
@@ -480,7 +495,7 @@ def model_throughput() -> dict | None:
                     if spec is not None:
                         roof_q = F.decode_roofline(
                             cfg, batch, total, q_tps, spec,
-                            weight_bytes=1)
+                            weight_bytes=1, kv_bytes=1)
                         result["decode_int8_gbps"] = \
                             roof_q["achieved_gbps"]
                         result["decode_int8_roofline"] = roof_q

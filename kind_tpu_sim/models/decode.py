@@ -1,18 +1,31 @@
-"""Autoregressive decoding with a static KV cache.
+"""Autoregressive decoding with a static, chunked KV cache.
 
 The serving-side counterpart of the training step (the role vLLM plays
 in the reference's pods): a batched prefill pass fills a preallocated
-(batch, max_len) cache in one forward (MXU-shaped matmuls), then a
-single fused `lax.scan` generates greedily — no Python loop per token,
-no dynamic shapes, so the decode compiles to one XLA while-loop.
+(batch, max_len) cache in one forward (MXU-shaped matmuls), then fused
+`lax.scan`s generate — no Python loop per token, no dynamic shapes.
+
+Generation is CHUNKED for the HBM roofline's sake: updating a big
+cache carried through a scan makes XLA materialize a full cache copy
+every step (round-1 profiling: ~7.5us x 2 x n_layers per token). So
+the big cache stays loop-invariant across a chunk's inner scan while
+new k/v accumulate in a small bf16 chunk buffer, and one merge per
+chunk amortizes the copy 64-fold. Each token attends over three
+exactly-partitioned score groups: big cache (< chunk base), chunk
+buffer (earlier in-chunk tokens), and its own in-flight k/v. With
+``ModelConfig(int8_kv=True)`` the big cache stores int8 + per-row
+scales, halving decode's dominant KV traffic (quant.py's roofline).
 
 Numerical contract (dense configs): a token generated through the
 cache path must equal the argmax of the full (uncached) forward at
-that position — tests/test_decode.py enforces it. MoE configs are
-exempt: Switch routing capacity and dispatch priority are computed
-from the tokens in the current call (b*1 during decode vs b*t in the
-full forward), so drop decisions can differ between the two paths;
-MoE decode is a functional path, not a bit-identical one.
+that position — tests/test_decode.py enforces it, including across
+chunk boundaries. Two carve-outs: MoE configs (Switch routing
+capacity/priority are computed from the tokens in the current call —
+b*1 during decode vs b*t in the full forward — so drop decisions can
+differ), and ``int8_kv`` configs (in-chunk tokens are attended at
+bf16 from the chunk buffer but at int8 precision once merged, so
+tokens near argmax ties can depend on the chunk size; int8 serving
+trades exactness for bytes by definition).
 """
 
 from __future__ import annotations
@@ -64,23 +77,97 @@ def serving_params(params: Params, cfg: ModelConfig) -> Params:
 
 
 def init_cache(cfg: ModelConfig, batch: int, max_len: int):
+    """Preallocated KV cache; with ``cfg.int8_kv`` each k/v tensor is a
+    QuantArray (int8 values + one fp32 scale per (batch, position,
+    kv_head) row), halving decode's KV HBM traffic."""
     import jax.numpy as jnp
 
+    from kind_tpu_sim.models.quant import QuantArray
+
+    shape = (batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if cfg.int8_kv:
+        def qzeros():
+            return QuantArray(
+                q=jnp.zeros(shape, jnp.int8),
+                scale=jnp.ones(shape[:3] + (1,), jnp.float32),
+            )
+
+        return [{"k": qzeros(), "v": qzeros()}
+                for _ in range(cfg.n_layers)]
     dtype = jnp.dtype(cfg.dtype)
     return [
-        {
-            "k": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim),
-                           dtype),
-            "v": jnp.zeros((batch, max_len, cfg.kv_heads, cfg.head_dim),
-                           dtype),
-        }
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
         for _ in range(cfg.n_layers)
     ]
 
 
-def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
-    """One block for one token. x: (b, d); pos: scalar position."""
+def _store(cache_arr, update, start_idx):
+    """Write ``update`` (b, t, kv, hd) into a cache tensor at position
+    ``start_idx`` along the sequence axis, quantizing per (b, t, kv)
+    row when the cache is int8."""
     import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import QuantArray, quantize
+
+    if isinstance(cache_arr, QuantArray):
+        qa = quantize(update, axis=3)
+        return QuantArray(
+            q=jax.lax.dynamic_update_slice(
+                cache_arr.q, qa.q, (0, start_idx, 0, 0)),
+            scale=jax.lax.dynamic_update_slice(
+                cache_arr.scale, qa.scale, (0, start_idx, 0, 0)),
+        )
+    return jax.lax.dynamic_update_slice(
+        cache_arr, update.astype(cache_arr.dtype), (0, start_idx, 0, 0))
+
+
+def _cache_scores(qg, cache_k, scale):
+    """Attention scores of qg (b, kv, group, hd) against a cache
+    tensor (b, s, kv, hd), plain or int8. Returns fp32 (b, kv, g, s).
+
+    Int8 path: only the int8 bytes cross the HBM bus; the per-row
+    fp32 scale multiplies the (much smaller) score matrix after the
+    MXU contraction.
+    """
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import QuantArray
+
+    if isinstance(cache_k, QuantArray):
+        sc = jnp.einsum(
+            "bkgd,bskd->bkgs", qg, cache_k.q.astype(qg.dtype),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        row = jnp.transpose(cache_k.scale[..., 0], (0, 2, 1))
+        return sc * row[:, :, None, :]
+    return jnp.einsum(
+        "bkgd,bskd->bkgs", qg, cache_k,
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+
+def _cache_values(probs, cache_v, dtype):
+    """probs (b, kv, g, s) fp32 x cache values (b, s, kv, hd) ->
+    (b, kv, g, hd). For an int8 cache the per-row value scale folds
+    into probs before the contraction (scale is constant along hd),
+    so the cache is read as raw int8."""
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import QuantArray
+
+    if isinstance(cache_v, QuantArray):
+        row = jnp.transpose(cache_v.scale[..., 0], (0, 2, 1))
+        p = (probs * row[:, :, None, :]).astype(dtype)
+        return jnp.einsum("bkgs,bskd->bkgd", p,
+                          cache_v.q.astype(dtype))
+    return jnp.einsum("bkgs,bskd->bkgd", probs.astype(dtype), cache_v)
+
+
+def _attend_token(x, bparams, cfg: ModelConfig, positions):
+    """Shared decode-step front half: norm + qkv projection + rotary
+    for ONE token per batch row. Returns (qg, k1, v1) with qg grouped
+    (b, kv, group, hd) and k1/v1 shaped (b, 1, kv, hd)."""
     import jax.numpy as jnp
 
     from kind_tpu_sim.models.quant import linear
@@ -94,53 +181,74 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
     q = q.reshape(b, 1, cfg.n_heads, cfg.head_dim)
     k = k.reshape(b, 1, cfg.kv_heads, cfg.head_dim)
     v = v.reshape(b, 1, cfg.kv_heads, cfg.head_dim)
-    positions = jnp.full((b, 1), pos)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-
-    cache_k = jax.lax.dynamic_update_slice(
-        layer_cache["k"], k, (0, pos, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(
-        layer_cache["v"], v, (0, pos, 0, 0))
-
-    max_len = cache_k.shape[1]
     group = cfg.n_heads // cfg.kv_heads
     qg = q.reshape(b, cfg.kv_heads, group, cfg.head_dim)
-    scores = jnp.einsum(
-        "bkgd,bskd->bkgs", qg, cache_k,
-        preferred_element_type=jnp.float32,
-    ) * (cfg.head_dim ** -0.5)
-    valid = jnp.arange(max_len) <= pos
-    scores = jnp.where(valid[None, None, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum(
-        "bkgs,bskd->bkgd", probs.astype(cache_v.dtype), cache_v
-    ).reshape(b, cfg.d_model)
-    x = x + linear(attn, bparams["wo"])
+    return qg, k, v
 
+
+def _finish_block(x, attn, bparams, cfg: ModelConfig):
+    """Shared decode-step back half: output projection + MLP/MoE."""
+    import jax
+
+    from kind_tpu_sim.models.quant import linear
+
+    x = x + linear(attn, bparams["wo"])
     h = _rms_norm(x, bparams["mlp_norm"])
     if "moe" in bparams:
         from kind_tpu_sim.models.moe import MoeConfig, moe_mlp
 
         out, _ = moe_mlp(h[:, None, :], bparams["moe"],
                          MoeConfig(n_experts=cfg.n_experts))
-        x = x + out[:, 0, :]
-    else:
-        x = x + linear(jax.nn.gelu(linear(h, bparams["w_up"])),
-                       bparams["w_down"])
+        return x + out[:, 0, :]
+    return x + linear(jax.nn.gelu(linear(h, bparams["w_up"])),
+                      bparams["w_down"])
+
+
+def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
+    """One block for one token. x: (b, d); pos: scalar position.
+
+    The cache is read STALE (positions < pos) and the in-flight
+    token's k/v attend directly, so the cache write has no
+    read-after-write hazard — on TPU that hazard makes XLA materialize
+    a full cache copy every step instead of updating in place, which
+    round-1 profiling measured at ~7.5us per layer per step.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, _ = x.shape
+    dtype = jnp.dtype(cfg.dtype)
+    positions = jnp.full((b, 1), pos)
+    qg, k, v = _attend_token(x, bparams, cfg, positions)
+    scale = cfg.head_dim ** -0.5
+
+    max_len = layer_cache["k"].shape[1]
+    sc_past = _cache_scores(qg, layer_cache["k"], scale)
+    valid = jnp.arange(max_len) < pos
+    sc_past = jnp.where(valid[None, None, None, :], sc_past, -1e30)
+    scores = jnp.concatenate([sc_past, _cache_scores(qg, k, scale)],
+                             -1)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = (
+        _cache_values(probs[..., :max_len], layer_cache["v"], dtype)
+        + _cache_values(probs[..., max_len:], v, dtype)
+    ).reshape(b, cfg.d_model)
+
+    cache_k = _store(layer_cache["k"], k, pos)
+    cache_v = _store(layer_cache["v"], v, pos)
+    x = _finish_block(x, attn, bparams, cfg)
     return x, {"k": cache_k, "v": cache_v}
 
 
 def _block_prefill(x, bparams, cfg: ModelConfig, layer_cache, positions):
     """One block over the whole prompt. x: (b, t, d); fills cache[:t]."""
-    import jax
-
     x, _, k, v = _block_core(x, bparams, cfg, positions)
-    cache_k = jax.lax.dynamic_update_slice(
-        layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, 0, 0, 0))
-    cache_v = jax.lax.dynamic_update_slice(
-        layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, 0, 0, 0))
-    return x, {"k": cache_k, "v": cache_v}
+    return x, {
+        "k": _store(layer_cache["k"], k, 0),
+        "v": _store(layer_cache["v"], v, 0),
+    }
 
 
 def prefill(params: Params, cfg: ModelConfig, prompt, max_len: int):
@@ -185,27 +293,160 @@ def decode_step(params: Params, cfg: ModelConfig, token, cache, pos):
     return logits, new_cache
 
 
-def generate_from_cache(params: Params, cfg: ModelConfig, first_token,
-                        cache, start_pos: int, num_new: int):
-    """Pure decode loop: `first_token` (b,) sits at `start_pos`; emits
-    (b, num_new) greedy tokens starting with it. One fused scan."""
+def _block_decode_chunk(x, bparams, cfg: ModelConfig, big, small,
+                        base, i):
+    """One block for one token inside a decode chunk.
+
+    ``big`` is the full cache (positions < ``base``; possibly int8)
+    and is NOT written here — it stays loop-invariant across the
+    chunk's inner scan, so XLA never copies it per step. ``small`` is
+    the bf16 chunk buffer holding this chunk's tokens (positions
+    base..base+i-1); the in-flight token attends directly. Exact
+    causal math: the three score groups partition positions <= pos.
+    """
     import jax
+    import jax.numpy as jnp
+
+    b, _ = x.shape
+    dtype = jnp.dtype(cfg.dtype)
+    pos = base + i
+    positions = jnp.full((b, 1), pos)
+    qg, k, v = _attend_token(x, bparams, cfg, positions)
+    scale = cfg.head_dim ** -0.5
+
+    s_big = big["k"].shape[1]
+    c_len = small["k"].shape[1]
+    sc_big = _cache_scores(qg, big["k"], scale)
+    sc_big = jnp.where(
+        (jnp.arange(s_big) < base)[None, None, None, :], sc_big, -1e30)
+    sc_sm = _cache_scores(qg, small["k"], scale)
+    sc_sm = jnp.where(
+        (jnp.arange(c_len) < i)[None, None, None, :], sc_sm, -1e30)
+    scores = jnp.concatenate(
+        [sc_big, sc_sm, _cache_scores(qg, k, scale)], -1)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = (
+        _cache_values(probs[..., :s_big], big["v"], dtype)
+        + _cache_values(probs[..., s_big:s_big + c_len], small["v"],
+                        dtype)
+        + _cache_values(probs[..., s_big + c_len:], v, dtype)
+    ).reshape(b, cfg.d_model)
+
+    small = {
+        "k": jax.lax.dynamic_update_slice(small["k"], k, (0, i, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(small["v"], v, (0, i, 0, 0)),
+    }
+    return _finish_block(x, attn, bparams, cfg), small
+
+
+def _run_chunk(params, cfg: ModelConfig, token, cache, base,
+               size: int, step0, select_fn):
+    """Generate ``size`` tokens with the big cache frozen; merge the
+    chunk buffer into it once at the end. Returns
+    (next_token, cache, emitted (b, size))."""
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import embed_lookup
+
+    b = token.shape[0]
+    dtype = jnp.dtype(cfg.dtype)
+    small0 = [
+        {
+            "k": jnp.zeros((b, size, cfg.kv_heads, cfg.head_dim),
+                           dtype),
+            "v": jnp.zeros((b, size, cfg.kv_heads, cfg.head_dim),
+                           dtype),
+        }
+        for _ in range(cfg.n_layers)
+    ]
+
+    def step(carry, i):
+        token, small = carry
+        x = embed_lookup(params["embed"], token, dtype)
+        new_small = []
+        for bparams, big_lc, small_lc in zip(params["blocks"], cache,
+                                             small):
+            x, small_lc = _block_decode_chunk(
+                x, bparams, cfg, big_lc, small_lc, base, i)
+            new_small.append(small_lc)
+        x = _rms_norm(x, params["final_norm"])
+        logits = _readout(x, params["embed"])
+        nxt = select_fn(logits, step0 + i, token.dtype)
+        return (nxt, new_small), nxt
+
+    (token, small), emitted = jax.lax.scan(
+        step, (token, small0), jnp.arange(size))
+    cache = [
+        {
+            "k": _store(big_lc["k"], small_lc["k"], base),
+            "v": _store(big_lc["v"], small_lc["v"], base),
+        }
+        for big_lc, small_lc in zip(cache, small)
+    ]
+    return token, cache, emitted.swapaxes(0, 1)
+
+
+def _chunked_generate(params, cfg: ModelConfig, first_token, cache,
+                      start_pos, num_new: int, select_fn,
+                      chunk: int = 64):
+    """Decode engine: ``first_token`` sits at ``start_pos``; runs
+    ``num_new - 1`` token steps in chunks of ``chunk``, keeping the
+    big KV cache loop-invariant within each chunk (the TPU-friendly
+    structure — per-step in-carry cache updates make XLA copy the
+    whole cache every step)."""
+    import jax
+    import jax.numpy as jnp
+
+    steps = num_new - 1
+    if steps <= 0:
+        return first_token[:, None]
+    size = min(chunk, steps)
+    n_full, rem = divmod(steps, size)
+
+    token = first_token
+    outs = [first_token[:, None]]
+    if n_full == 1 and rem == 0:
+        token, cache, emitted = _run_chunk(
+            params, cfg, token, cache, start_pos, size, 0, select_fn)
+        outs.append(emitted)
+    else:
+        def chunk_body(carry, c):
+            token, cache = carry
+            token, cache, emitted = _run_chunk(
+                params, cfg, token, cache, start_pos + c * size,
+                size, c * size, select_fn)
+            return (token, cache), emitted
+
+        (token, cache), stacked = jax.lax.scan(
+            chunk_body, (token, cache), jnp.arange(n_full))
+        # (n_full, b, size) -> (b, n_full*size)
+        outs.append(stacked.swapaxes(0, 1).reshape(
+            token.shape[0], n_full * size))
+        if rem:
+            token, cache, emitted = _run_chunk(
+                params, cfg, token, cache,
+                start_pos + n_full * size, rem, n_full * size,
+                select_fn)
+            outs.append(emitted)
+    return jnp.concatenate(outs, axis=1)
+
+
+def generate_from_cache(params: Params, cfg: ModelConfig, first_token,
+                        cache, start_pos: int, num_new: int,
+                        chunk: int = 64):
+    """Pure greedy decode loop: `first_token` (b,) sits at
+    `start_pos`; emits (b, num_new) greedy tokens starting with it."""
     import jax.numpy as jnp
 
     if num_new <= 0:
         return jnp.zeros((first_token.shape[0], 0), first_token.dtype)
 
-    def step(carry, i):
-        token, cache = carry
-        logits, cache = decode_step(params, cfg, token, cache,
-                                    start_pos + i)
-        nxt = jnp.argmax(logits, axis=-1).astype(token.dtype)
-        return (nxt, cache), nxt
+    def greedy(logits, _, dtype):
+        return jnp.argmax(logits, axis=-1).astype(dtype)
 
-    (_, _), rest = jax.lax.scan(
-        step, (first_token, cache), jnp.arange(num_new - 1))
-    return jnp.concatenate(
-        [first_token[:, None], rest.swapaxes(0, 1)], axis=1)
+    return _chunked_generate(params, cfg, first_token, cache,
+                             start_pos, num_new, greedy, chunk)
 
 
 @_dataclasses.dataclass(frozen=True)
@@ -259,20 +500,12 @@ def sample_generate(params: Params, cfg: ModelConfig, prompt,
     first = _sample_token(logits, sampling, jax.random.fold_in(key, 0),
                           prompt.dtype)
 
-    def step(carry, i):
-        token, cache = carry
-        logits, cache = decode_step(params, cfg, token, cache, t_p + i)
-        nxt = _sample_token(logits, sampling,
-                            jax.random.fold_in(key, i + 1), token.dtype)
-        return (nxt, cache), nxt
+    def select(logits, i, dtype):
+        return _sample_token(logits, sampling,
+                             jax.random.fold_in(key, i + 1), dtype)
 
-    if num_new == 1:
-        generated = first[:, None]
-    else:
-        (_, _), rest = jax.lax.scan(
-            step, (first, cache), jnp.arange(num_new - 1))
-        generated = jnp.concatenate(
-            [first[:, None], rest.swapaxes(0, 1)], axis=1)
+    generated = _chunked_generate(params, cfg, first, cache, t_p,
+                                  num_new, select)
     return jnp.concatenate([prompt, generated], axis=1)
 
 

@@ -1,11 +1,20 @@
-"""Int8 weight-only quantization for the serving path.
+"""Int8 quantization for the serving path (weights and KV cache).
 
 Decode on TPU is HBM-bandwidth-bound: every generated token re-reads
-every weight, and the measured bf16 decode already sits at the v5e
-bandwidth roof (~790 GB/s observed, 819 peak). The remaining lever is
-bytes: per-channel symmetric int8 halves the weight traffic again. The
-int8 tensors are read from HBM and dequantized in VMEM right at the
-matmul, so the saving is real, not cosmetic.
+every weight AND the full live KV cache, and the measured bf16 decode
+already sits at the v5e bandwidth roof (~790 GB/s observed, 819 peak).
+The remaining lever is bytes — but the roofline says weights alone are
+not enough: at the bench shape the step traffic is ~243 MB of weights
+plus ~101 MB of KV, so int8 weights alone cap the speedup at ~1.55x.
+Halving BOTH (int8 weights here, int8 KV cache via
+``ModelConfig(int8_kv=True)`` + models/decode.py) cuts the step bytes
+1.96x; measured v5e decode gets 1.62x of it (int8 runs at ~82% of the
+HBM roof vs bf16's ~100% — the residual is VPU dequant work on 175 MB
+of int8 per step, the price of keeping activations bf16). The int8
+tensors are read from HBM and dequantized in VMEM right at the matmul,
+so the saving is real, not cosmetic; see
+models/flops.py:decode_bytes_per_step for the accounting bench.py
+reports against.
 
 Representation: `QuantArray(q=int8, scale=f32)` — a NamedTuple, hence
 a native JAX pytree that flows through jit/scan/sharding untouched.

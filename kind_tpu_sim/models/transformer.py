@@ -39,6 +39,7 @@ class ModelConfig:
     n_experts: int = 0            # >0: Switch-MoE MLP (expert parallel)
     n_kv_heads: Optional[int] = None  # grouped-query attention; None = MHA
     flash: bool = False           # Pallas flash attention (long-context)
+    int8_kv: bool = False         # int8 KV cache (serving; halves KV HBM)
 
     @property
     def head_dim(self) -> int:

@@ -240,3 +240,105 @@ def test_top_p_tiny_keeps_argmax(cfg):
         params, cfg, prompt, 8, jax.random.PRNGKey(9),
         decode.SamplingConfig(temperature=1.0, top_p=1e-6)))
     np.testing.assert_array_equal(greedy, nucleus)
+
+
+def test_chunked_generate_chunk_size_invariant(cfg):
+    """Multi-chunk decode (full chunks + remainder) must emit exactly
+    the same tokens as a single-chunk run — the chunk boundary is a
+    performance structure, not a semantic one."""
+    import jax
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch=2,
+                             seq=6)
+    num_new = 11
+    logits, cache = decode.prefill(params, cfg, prompt, 6 + num_new)
+    first = np.argmax(np.array(logits), -1).astype(np.int32)
+    first = jax.numpy.asarray(first)
+
+    runs = {}
+    for chunk in (4, 64):
+        logits, cache = decode.prefill(params, cfg, prompt,
+                                       6 + num_new)
+        runs[chunk] = np.array(decode.generate_from_cache(
+            params, cfg, first, cache, 6, num_new, chunk=chunk))
+    assert runs[4].shape == (2, num_new)
+    np.testing.assert_array_equal(runs[4], runs[64])
+
+
+def test_chunked_generate_matches_forward_across_boundary(cfg):
+    """Greedy tokens generated across REAL chunk boundaries (chunk=3,
+    so full chunks + remainder + merges all execute) still satisfy
+    the cache-vs-full-forward argmax contract at every generated
+    position."""
+    import jax
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch=2,
+                             seq=5)
+    num_new = 11  # 10 decode steps = 3 full chunks of 3 + remainder
+
+    @jax.jit
+    def gen(p, t):
+        logits, cache = decode.prefill(p, cfg, t, 5 + num_new)
+        first = jax.numpy.argmax(logits, -1).astype(t.dtype)
+        return decode.generate_from_cache(p, cfg, first, cache, 5,
+                                          num_new, chunk=3)
+
+    generated = np.array(gen(params, prompt))
+    out = np.concatenate([np.array(prompt), generated], axis=1)
+    # replay the full (uncached) forward: every generated token must
+    # be the argmax of the forward at its position
+    logits = np.array(tf.forward(params, jax.numpy.asarray(out), cfg))
+    for j in range(num_new):
+        pos = 5 + j - 1  # token at 5+j is predicted from position 4+j
+        np.testing.assert_array_equal(
+            out[:, 5 + j], np.argmax(logits[:, pos], axis=-1),
+            err_msg=f"generated token {j}")
+
+
+def test_int8_kv_cache_decode():
+    """Int8 KV cache: generation runs end to end and the cached
+    logits track the full forward within int8 quantization error."""
+    import dataclasses
+
+    import jax
+
+    cfg_q = dataclasses.replace(
+        tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                       n_layers=2, d_ff=64, max_seq=32,
+                       dtype="float32"),
+        int8_kv=True)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg_q)
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg_q, batch=2,
+                             seq=12)
+    full_logits = np.array(tf.forward(params, tokens, cfg_q))
+
+    cache = decode.init_cache(cfg_q, batch=2, max_len=12)
+    from kind_tpu_sim.models.quant import QuantArray
+    assert isinstance(cache[0]["k"], QuantArray)
+    step = jax.jit(
+        lambda tok, cache, pos: decode.decode_step(
+            params, cfg_q, tok, cache, pos))
+    for pos in range(12):
+        logits, cache = step(tokens[:, pos], cache, pos)
+    # int8 rounding perturbs attention; logits stay close, not exact
+    np.testing.assert_allclose(
+        np.array(logits), full_logits[:, -1], atol=0.05, rtol=0.05)
+
+
+def test_int8_kv_generate_shapes_and_range():
+    import dataclasses
+
+    import jax
+
+    cfg_q = dataclasses.replace(tf.ModelConfig(), int8_kv=True)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg_q)
+    prompt = tf.sample_batch(jax.random.PRNGKey(1), cfg_q, batch=2,
+                             seq=8)
+    out = np.array(jax.jit(
+        lambda p, t: decode.greedy_generate(p, cfg_q, t, 9)
+    )(params, prompt))
+    assert out.shape == (2, 17)
+    assert (out >= 0).all() and (out < cfg_q.vocab_size).all()
+    np.testing.assert_array_equal(out[:, :8], np.array(prompt))
