@@ -292,18 +292,24 @@ def jax_multihost_manifest(cfg: SimConfig) -> str:
     :func:`kind_tpu_sim.topology.default_hostnames` (StatefulSet ordinal
     DNS under the headless ``tpu-sim`` service).
     """
+    from kind_tpu_sim.tpu_platform import (
+        POD_JAX_REQUIREMENT,
+        POD_SNIPPET,
+    )
+
     s = cfg.slice
     replicas = s.num_hosts
     chips = s.chips_per_host
     coordinator = topo.default_hostnames(replicas)[0]
     payload = f"""\
-pip install --quiet jax
+pip install --quiet {POD_JAX_REQUIREMENT}
 export XLA_FLAGS="--xla_force_host_platform_device_count={chips}"
 export JAX_PLATFORMS=cpu
 python - <<'PYEOF'
 import os
 import socket
 
+{POD_SNIPPET}
 import jax
 import jax.numpy as jnp
 
@@ -322,6 +328,8 @@ local = jax.local_device_count()
 print("global devices:", n, "local:", local)
 assert local == {chips}, local
 assert n == {chips} * replicas, n
+assert jax.devices()[0].platform == "tpu", jax.devices()[0].platform
+print("PLATFORM OK:", jax.devices()[0].platform)
 
 result = jax.pmap(
     lambda x: jax.lax.psum(x, "i"), axis_name="i"

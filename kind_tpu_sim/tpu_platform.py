@@ -1,0 +1,116 @@
+"""Simulated TPU platform identity for JAX (`platform == "tpu"`).
+
+The reference never faces this problem — its pods assert log lines,
+not accelerator identity (pods/nvidia-gpu-test-pod.yaml:9). The TPU
+sim's BASELINE asks for more: a JAX pod on a simulated node should
+*look like* a TPU worker, including `jax.devices()[0].platform`.
+
+What the PJRT probing established (reproduce with
+``python tools/probe_pjrt.py``; transcript in docs/PJRT.md):
+
+1. A rename/delegating C shim over jaxlib is impossible: jaxlib ships
+   no PJRT C API entry point (``nm -D`` over ``_jax.so`` and
+   ``libjax_common.so`` shows no ``GetPjrtApi``) — the CPU client is
+   in-process C++ only.
+2. ``libtpu.so`` DOES export ``GetPjrtApi`` and its client is named
+   "tpu", but client creation requires real hardware: on a
+   hardware-less host it fails with ``TPU initialization failed: No
+   jellyfish device found``.
+3. ``xla_bridge.register_backend_factory("tpu", <cpu factory>)``
+   works as an *alias* — ``JAX_PLATFORMS=tpu`` selects it and
+   collectives run — but ``Device.platform`` still reads "cpu": the
+   attribute is owned by the C++ PJRT client, not the registry name.
+4. The nanobind ``Device`` class accepts a class-level property
+   override, which closes the remaining gap at the user-facing
+   surface.
+
+So the simulation tier is layered (this module): the CPU-backed
+"tpu" backend alias (3) plus the ``Device.platform``/``device_kind``
+override (4). Deliberately NOT patched: the PJRT *client*'s platform
+name, which jax's lowering consults — compilation must keep
+targeting the host CPU, otherwise XLA would emit TPU-only ops for
+hardware that isn't there. The identity is skin-deep by design and
+honest about it.
+"""
+
+from __future__ import annotations
+
+import os
+
+_ACTIVATED = False
+
+SIMULATED_DEVICE_KIND = "TPU v5 lite (simulated)"
+
+
+def activate(device_kind: str | None = None) -> None:
+    """Make JAX's CPU devices identify as simulated TPU chips.
+
+    Idempotent. Call before or after jax initialization; with
+    ``JAX_PLATFORMS=tpu`` set before the first jax use, the CPU-backed
+    alias backend is selected under the "tpu" name too.
+    """
+    global _ACTIVATED
+    if _ACTIVATED:
+        return
+    import jaxlib._jax as _jax
+    from jax._src import xla_bridge as xb
+
+    kind = device_kind or os.environ.get(
+        "TPU_SIM_DEVICE_KIND", SIMULATED_DEVICE_KIND)
+
+    # (3) "tpu" backend alias over the CPU client, unless a real tpu
+    # factory (libtpu/plugin) is already registered. Direct attribute
+    # access on purpose: if jax renames the registry, fail loudly
+    # instead of silently clobbering a real TPU backend.
+    if "tpu" not in xb._backend_factories:
+        def _cpu_as_tpu():
+            return _jax.get_tfrt_cpu_client(asynchronous=True)
+
+        xb.register_backend_factory("tpu", _cpu_as_tpu, priority=300)
+
+    # (4) user-facing identity override, CPU devices only — a real
+    # accelerator (or the axon tunnel) keeps its own identity.
+    orig_platform = _jax.Device.platform
+    orig_kind = _jax.Device.device_kind
+    _jax.Device.platform = property(
+        lambda self: "tpu"
+        if orig_platform.__get__(self) == "cpu"
+        else orig_platform.__get__(self))
+    _jax.Device.device_kind = property(
+        lambda self: kind
+        if orig_platform.__get__(self) == "cpu"
+        else orig_kind.__get__(self))
+    _ACTIVATED = True
+
+
+# The self-contained copy of activate() that pod manifests embed
+# (pods pip-install jax only; kind_tpu_sim is not on their path).
+# The shim leans on jax internals (jaxlib._jax, get_tfrt_cpu_client),
+# so pods embedding it must pin the jax version it was validated
+# against (POD_JAX_REQUIREMENT).
+POD_JAX_REQUIREMENT = "jax==0.9.0"
+
+POD_SNIPPET = f'''\
+def _sim_tpu_platform():
+    """kind-tpu-sim platform shim (kind_tpu_sim/tpu_platform.py)."""
+    import jaxlib._jax as _jax
+    from jax._src import xla_bridge as xb
+
+    if "tpu" not in xb._backend_factories:
+        xb.register_backend_factory(
+            "tpu", lambda: _jax.get_tfrt_cpu_client(asynchronous=True),
+            priority=300)
+    orig_platform = _jax.Device.platform
+    orig_kind = _jax.Device.device_kind
+    _jax.Device.platform = property(
+        lambda self: "tpu"
+        if orig_platform.__get__(self) == "cpu"
+        else orig_platform.__get__(self))
+    _jax.Device.device_kind = property(
+        lambda self: "{SIMULATED_DEVICE_KIND}"
+        if orig_platform.__get__(self) == "cpu"
+        else orig_kind.__get__(self))
+
+
+_sim_tpu_platform()
+'''
