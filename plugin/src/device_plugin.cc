@@ -414,6 +414,8 @@ void DevicePlugin::WatchdogLoop() {
     if (stat(cfg_.endpoint_path().c_str(), &st) != 0) {
       LogLine("socket vanished (kubelet restart?); re-serving");
       rebinds_.fetch_add(1);
+      std::lock_guard<std::mutex> lock(server_mu_);
+      if (stopping_.load()) break;
       server_->Shutdown();
       server_ = std::make_unique<grpc::Server>();
       InstallHandlers();
@@ -431,13 +433,18 @@ void DevicePlugin::WatchdogLoop() {
 
 void DevicePlugin::Stop() {
   if (stopping_.exchange(true)) return;
-  if (server_) server_->Shutdown();
-  if (register_thread_.joinable()) register_thread_.join();
+  {
+    std::lock_guard<std::mutex> lock(server_mu_);
+    if (server_) server_->Shutdown();
+  }
+  // Join the watchdog FIRST: it owns the register_thread_ handoff
+  // during re-binds, so joining it makes register_thread_ ours alone.
   if (watchdog_thread_.joinable()) watchdog_thread_.join();
+  if (register_thread_.joinable()) register_thread_.join();
 }
 
 void DevicePlugin::Wait() {
-  while (!stopping_.load()) {
+  while (!stopping_.load() && !stop_requested_.load()) {
     std::this_thread::sleep_for(std::chrono::milliseconds(200));
   }
 }

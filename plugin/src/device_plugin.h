@@ -71,8 +71,15 @@ class DevicePlugin {
   bool Start();
   void Stop();
 
-  // Blocks until Stop() (or a fatal serving error); runs the
-  // kubelet-restart watchdog meanwhile.
+  // Async-signal-safe stop request (a lock-free atomic store and
+  // nothing else): signal handlers must NOT call Stop() directly —
+  // it joins threads, and free() inside a signal context deadlocks
+  // (caught by the TSAN lifecycle stress test). Wait() returns after
+  // a request; the caller then runs Stop() in a normal context.
+  void RequestStop() { stop_requested_.store(true); }
+
+  // Blocks until RequestStop()/Stop() (or a fatal serving error);
+  // runs the kubelet-restart watchdog meanwhile.
   void Wait();
 
   // Current device IDs (stable, matches SliceTopology.device_ids).
@@ -90,8 +97,15 @@ class DevicePlugin {
   void InstallHandlers();
 
   PluginConfig cfg_;
+  // Guards server_ replacement (watchdog re-bind) and the
+  // register_thread_ handoff against concurrent Stop(): without it,
+  // Stop() can call Shutdown() on a server the watchdog is
+  // simultaneously destroying (use-after-free; flagged by the
+  // round-1 review, provable under the TSAN build).
+  std::mutex server_mu_;
   std::unique_ptr<grpc::Server> server_;
   std::atomic<bool> stopping_{false};
+  std::atomic<bool> stop_requested_{false};
   std::atomic<uint64_t> health_generation_{0};
   // Introspection counters (served by /tpusim.v1.Introspection/State —
   // the observability surface SURVEY.md §5 notes the reference lacks).

@@ -153,6 +153,16 @@ void Server::AcceptLoop() {
 
 void Server::HandleConnection(int fd) {
   auto conn = std::make_shared<http2::Connection>(fd, /*is_server=*/true);
+  {
+    std::lock_guard<std::mutex> lock(conn_mu_);
+    conns_.push_back(conn);
+  }
+  // Shutdown() may have swept conns_ between accept and registration;
+  // don't serve on a server that is already stopping.
+  if (!running_.load()) {
+    conn->Close();
+    return;
+  }
   auto streams = std::make_shared<std::map<uint32_t, IncomingStream>>();
 
   auto dispatch = [this, conn, streams](uint32_t stream_id) {
@@ -188,8 +198,14 @@ void Server::HandleConnection(int fd) {
     auto sit = streaming_.find(in.path);
     if (sit != streaming_.end()) {
       ServerStreamingHandler handler = sit->second;
-      conn->SendHeaders(stream_id, ResponseHeaders(), false);
       std::lock_guard<std::mutex> lock(conn_mu_);
+      // Checked under conn_mu_: once Shutdown() has flipped running_
+      // and swapped conn_threads_ out, a late-dispatched stream must
+      // not emplace a thread nobody will ever join (a joinable
+      // std::thread left in the vector aborts via std::terminate
+      // when the watchdog destroys the old server).
+      if (!running_.load()) return;
+      conn->SendHeaders(stream_id, ResponseHeaders(), false);
       conn_threads_.emplace_back(
           [conn, stream_id, handler, request] {
             StreamImpl stream(conn, stream_id);
@@ -227,20 +243,38 @@ void Server::HandleConnection(int fd) {
   conn->set_callbacks(std::move(cb));
 
   if (conn->Start()) conn->Run();
+  // The callbacks capture `conn` itself (dispatch holds the
+  // shared_ptr) — a self-cycle that would keep the Connection, and
+  // with it the fd, alive forever. Run() has returned, so nothing
+  // reads the callbacks anymore; clearing them breaks the cycle and
+  // lets the destructor close the fd.
+  conn->set_callbacks({});
 }
 
 void Server::Shutdown() {
   if (!running_.exchange(false)) return;
   if (listen_fd_ >= 0) {
+    // shutdown() unblocks a blocked accept(); the fd is closed and
+    // cleared only after the accept thread is joined — writing
+    // listen_fd_ while AcceptLoop still reads it is a data race.
     ::shutdown(listen_fd_, SHUT_RDWR);
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  if (listen_fd_ >= 0) {
     ::close(listen_fd_);
     listen_fd_ = -1;
   }
-  if (accept_thread_.joinable()) accept_thread_.join();
   std::vector<std::thread> threads;
+  std::vector<std::weak_ptr<http2::Connection>> conns;
   {
     std::lock_guard<std::mutex> lock(conn_mu_);
     threads.swap(conn_threads_);
+    conns.swap(conns_);
+  }
+  // Force-close live connections FIRST: read loops unblock, streaming
+  // handlers see Cancelled(), and the joins below actually finish.
+  for (auto& weak : conns) {
+    if (auto conn = weak.lock()) conn->Close();
   }
   for (auto& t : threads) {
     if (t.joinable()) t.join();

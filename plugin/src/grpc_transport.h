@@ -87,6 +87,11 @@ class Server {
   std::thread accept_thread_;
   std::mutex conn_mu_;
   std::vector<std::thread> conn_threads_;
+  // Live connections, so Shutdown() can force-close them: a blocked
+  // read loop or a streaming handler with a connected client would
+  // otherwise keep Shutdown() joined forever (kubelet-restart
+  // re-bind with a live ListAndWatch stream).
+  std::vector<std::weak_ptr<http2::Connection>> conns_;
 };
 
 // ---------------------------------------------------------------------

@@ -43,7 +43,14 @@ Connection::Connection(int fd, bool is_server)
   if (!is_server_) next_client_stream_ = 1;
 }
 
-Connection::~Connection() { Close(); }
+Connection::~Connection() {
+  Close();
+  // The fd is closed only here, once no thread can still be blocked
+  // in read()/write() on it: Close() shuts the socket down (which
+  // unblocks them) but closing the fd concurrently would race with
+  // those calls and could hit a reused descriptor.
+  ::close(fd_);
+}
 
 bool Connection::ReadExact(uint8_t* buf, size_t len) {
   size_t got = 0;
@@ -391,8 +398,9 @@ void Connection::Close() {
     closed_ = true;
   }
   window_cv_.notify_all();
+  // Shutdown (not close): unblocks any thread inside read()/write()
+  // on this socket; the fd itself is released by the destructor.
   ::shutdown(fd_, SHUT_RDWR);
-  ::close(fd_);
 }
 
 bool Connection::closed() const {

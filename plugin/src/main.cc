@@ -19,7 +19,9 @@ namespace {
 tpusim::DevicePlugin* g_plugin = nullptr;
 
 void HandleSignal(int) {
-  if (g_plugin) g_plugin->Stop();
+  // Only an atomic store here: Stop() joins threads (malloc/free),
+  // which is not async-signal-safe. main() runs Stop() after Wait().
+  if (g_plugin) g_plugin->RequestStop();
 }
 
 bool ParseFlag(const char* arg, const char* name, std::string* out) {
@@ -91,5 +93,6 @@ int main(int argc, char** argv) {
 
   if (!plugin.Start()) return 1;
   plugin.Wait();
+  plugin.Stop();
   return 0;
 }

@@ -36,3 +36,77 @@ except ImportError:
     pass
 else:
     jax.config.update("jax_platforms", "cpu")
+
+
+# -- native plugin fixtures (shared by test_plugin_grpc and
+# test_plugin_lifecycle) ----------------------------------------------
+
+import importlib.util  # noqa: E402
+import subprocess  # noqa: E402
+
+import pytest  # noqa: E402
+
+PLUGIN_DIR = REPO_ROOT / "plugin"
+
+
+def _cmake_build(build_dir, *extra_defines):
+    subprocess.run(
+        ["cmake", "-S", str(PLUGIN_DIR), "-B", str(build_dir),
+         "-G", "Ninja", *extra_defines],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["ninja", "-C", str(build_dir), "tpu-device-plugin"],
+        check=True, capture_output=True,
+    )
+
+
+@pytest.fixture(scope="session")
+def plugin_binary():
+    """Release build of the native plugin (built on demand)."""
+    binary = PLUGIN_DIR / "build" / "tpu-device-plugin"
+    if not binary.exists():
+        _cmake_build(PLUGIN_DIR / "build", "-DCMAKE_BUILD_TYPE=Release")
+    return binary
+
+
+@pytest.fixture(scope="session")
+def tsan_plugin_binary():
+    """Thread-sanitized build (plugin/build-tsan); skips when the
+    toolchain has no TSAN runtime."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        probe = pathlib.Path(tmp) / "t.cc"
+        probe.write_text("int main(){return 0;}\n")
+        ok = subprocess.run(
+            ["g++", "-fsanitize=thread", str(probe), "-o",
+             str(pathlib.Path(tmp) / "t")],
+            capture_output=True,
+        ).returncode == 0
+    if not ok:
+        pytest.skip("toolchain lacks -fsanitize=thread")
+    binary = PLUGIN_DIR / "build-tsan" / "tpu-device-plugin"
+    if not binary.exists():
+        _cmake_build(PLUGIN_DIR / "build-tsan",
+                     "-DTPU_SIM_SANITIZER=thread")
+    return binary
+
+
+@pytest.fixture(scope="session")
+def pb(tmp_path_factory):
+    """protoc-generated message classes for deviceplugin.proto."""
+    out = tmp_path_factory.mktemp("pb")
+    subprocess.run(
+        ["protoc", f"--proto_path={PLUGIN_DIR / 'proto'}",
+         f"--python_out={out}",
+         str(PLUGIN_DIR / "proto" / "deviceplugin.proto")],
+        check=True, capture_output=True,
+    )
+    spec = importlib.util.spec_from_file_location(
+        "deviceplugin_pb2", out / "deviceplugin_pb2.py"
+    )
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["deviceplugin_pb2"] = module
+    spec.loader.exec_module(module)
+    return module

@@ -15,57 +15,22 @@ Python message classes are generated on the fly with protoc
 no generated service stubs are required.
 """
 
-import importlib.util
 import os
 import pathlib
 import queue
 import signal
 import subprocess
-import sys
 import time
 
 import pytest
 
 grpc = pytest.importorskip("grpc")
 
+# plugin_binary / tsan_plugin_binary / pb fixtures live in conftest.py
+# (shared with test_plugin_lifecycle.py).
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 PLUGIN_DIR = REPO / "plugin"
-BUILD_DIR = PLUGIN_DIR / "build"
-BINARY = BUILD_DIR / "tpu-device-plugin"
-
-
-@pytest.fixture(scope="session")
-def plugin_binary():
-    """Build the plugin via CMake if it isn't built yet."""
-    if not BINARY.exists():
-        subprocess.run(
-            ["cmake", "-S", str(PLUGIN_DIR), "-B", str(BUILD_DIR),
-             "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
-            check=True, capture_output=True,
-        )
-        subprocess.run(
-            ["ninja", "-C", str(BUILD_DIR)], check=True,
-            capture_output=True,
-        )
-    return BINARY
-
-
-@pytest.fixture(scope="session")
-def pb(tmp_path_factory):
-    """protoc-generated message classes for deviceplugin.proto."""
-    out = tmp_path_factory.mktemp("pb")
-    subprocess.run(
-        ["protoc", f"--proto_path={PLUGIN_DIR / 'proto'}",
-         f"--python_out={out}", str(PLUGIN_DIR / "proto" / "deviceplugin.proto")],
-        check=True, capture_output=True,
-    )
-    spec = importlib.util.spec_from_file_location(
-        "deviceplugin_pb2", out / "deviceplugin_pb2.py"
-    )
-    module = importlib.util.module_from_spec(spec)
-    sys.modules["deviceplugin_pb2"] = module
-    spec.loader.exec_module(module)
-    return module
 
 
 class FakeKubelet:

@@ -1,0 +1,236 @@
+"""Fake-kubelet e2e harness: the full device-plugin lifecycle.
+
+This is the closest possible stand-in for the never-run-here
+tpu-ci.yaml path (the reference's pass/fail gate is a real kubelet
+admitting a pod, rocm-ci.yaml:35): a grpcio fake kubelet and client
+walk the native plugin through every lifecycle transition IN ONE
+CONTINUOUS SESSION — register, advertise, allocate, kubelet restart,
+re-bind + re-register, chaos health drop, heal — exactly the sequence
+a real kubelet + the chaos subcommand would drive.
+
+The same walk runs against the thread-sanitized build
+(plugin/build-tsan), and a restart stress test hammers the watchdog's
+server re-bind under TSAN — the round-1 review's highest-risk
+untested surface (watchdog recreating the server while streams run).
+"""
+
+import os
+import pathlib
+import signal
+import subprocess
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from test_plugin_grpc import (  # noqa: E402
+    FakeKubelet,
+    call_unary,
+    make_channel,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class PluginSession:
+    """A running plugin + fake kubelet with helpers for the walk."""
+
+    def __init__(self, binary, tmp_path, pb):
+        self.pb = pb
+        self.sock_dir = tmp_path / "dp"
+        self.sock_dir.mkdir()
+        self.socket = self.sock_dir / "tpu-sim.sock"
+        self.unhealthy = tmp_path / "unhealthy.txt"
+        self.kubelet = FakeKubelet(self.sock_dir / "kubelet.sock", pb)
+        env = {
+            **os.environ,
+            "TPU_SIM_ACCELERATOR_TYPE": "v5litepod-16",
+            "TPU_SIM_CHIPS_PER_HOST_BOUNDS": "2,4,1",
+            "TPU_SIM_HOST_BOUNDS": "2,1,1",
+            "TPU_SIM_HOSTNAMES": "h0,h1",
+            # surface races immediately and fail the run on any report
+            "TSAN_OPTIONS": "halt_on_error=1 exitcode=66",
+        }
+        self.proc = subprocess.Popen(
+            [str(binary), f"--socket-dir={self.sock_dir}",
+             "--chips=8", "--worker-id=1",
+             f"--unhealthy-file={self.unhealthy}"],
+            env=env, stderr=subprocess.PIPE, text=True,
+        )
+        self.wait_socket()
+
+    def wait_socket(self, timeout=15):
+        deadline = time.time() + timeout
+        while not self.socket.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert self.socket.exists(), "plugin socket never appeared"
+
+    def open_stream(self, channel):
+        return channel.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=self.pb.Empty.SerializeToString,
+            response_deserializer=(
+                self.pb.ListAndWatchResponse.FromString),
+        )(self.pb.Empty(), timeout=60)
+
+    def stop(self, expect_clean=True):
+        self.proc.send_signal(signal.SIGTERM)
+        try:
+            _, stderr = self.proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            _, stderr = self.proc.communicate()
+            if expect_clean:
+                raise AssertionError(
+                    "plugin did not exit on SIGTERM:\n" + stderr[-2000:])
+        self.kubelet.stop()
+        assert "ThreadSanitizer" not in stderr, stderr[-4000:]
+        if expect_clean:
+            assert self.proc.returncode == 0, (
+                self.proc.returncode, stderr[-2000:])
+        return stderr
+
+
+@pytest.fixture(params=["release", "tsan"])
+def session(request, tmp_path, pb, plugin_binary):
+    if request.param == "tsan":
+        binary = request.getfixturevalue("tsan_plugin_binary")
+    else:
+        binary = plugin_binary
+    s = PluginSession(binary, tmp_path, pb)
+    yield s
+    if s.proc.poll() is None:
+        s.stop(expect_clean=False)
+
+
+def test_full_lifecycle(session):
+    """The six-transition walk from VERDICT.md next-round #3."""
+    pb = session.pb
+
+    # 1. register: kubelet sees the plugin's identity
+    req = session.kubelet.requests.get(timeout=15)
+    assert req.resource_name == "google.com/tpu"
+    assert req.endpoint == "tpu-sim.sock"
+
+    # 2. advertise: first ListAndWatch frame carries 8 healthy chips
+    channel = make_channel(session.socket)
+    stream = session.open_stream(channel)
+    first = next(stream)
+    assert len(first.devices) == 8
+    assert all(d.health == "Healthy" for d in first.devices)
+    ids = [d.ID for d in first.devices]
+
+    # 3. allocate 3 chips: env + device nodes injected
+    areq = pb.AllocateRequest()
+    areq.container_requests.add().devicesIDs.extend(ids[:3])
+    resp = call_unary(channel, pb, "Allocate", areq,
+                      pb.AllocateRequest, pb.AllocateResponse)
+    cresp = resp.container_responses[0]
+    env = dict(cresp.envs)
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2"
+    assert len(cresp.devices) == 3
+
+    # 4. kubelet restart: the device-plugin dir is wiped
+    os.unlink(session.socket)
+
+    # 5. plugin re-binds and re-registers on its own
+    req2 = session.kubelet.requests.get(timeout=20)
+    assert req2.resource_name == "google.com/tpu"
+    session.wait_socket()
+    # the old stream belonged to the shut-down server; it must end,
+    # not hang (cancellation status or clean end are both fine)
+    with pytest.raises((StopIteration, grpc.RpcError)):
+        while True:
+            next(stream)
+    channel.close()
+
+    channel = make_channel(session.socket)
+    stream = session.open_stream(channel)
+    assert len(next(stream).devices) == 8
+
+    # 6. chaos: failing one chip drops advertised health to 7
+    session.unhealthy.write_text(ids[3] + "\n")
+    update = next(stream)
+    health = {d.ID: d.health for d in update.devices}
+    assert health[ids[3]] == "Unhealthy"
+    assert sum(1 for h in health.values() if h == "Healthy") == 7
+
+    # ... and healing restores all 8
+    session.unhealthy.write_text("")
+    update = next(stream)
+    assert all(d.health == "Healthy" for d in update.devices)
+
+    # introspection agrees with the story the walk just told
+    import json as jsonlib
+
+    state = jsonlib.loads(channel.unary_unary(
+        "/tpusim.v1.Introspection/State",
+        request_serializer=lambda x: x,
+        response_deserializer=bytes,
+    )(b"", timeout=10))
+    assert state["kubelet_registrations"] >= 2
+    assert state["socket_rebinds"] >= 1
+    assert state["allocations"] == 1
+    assert state["allocated_chips"] == 3
+
+    stream.cancel()
+    channel.close()
+    session.stop()
+
+
+def test_restart_stress_under_tsan(tmp_path, pb, tsan_plugin_binary):
+    """Hammer the watchdog: repeated kubelet restarts with live
+    ListAndWatch streams and allocations, under ThreadSanitizer.
+    Any data race in the server re-bind path aborts the plugin
+    (TSAN halt_on_error) and fails the run."""
+    session = PluginSession(tsan_plugin_binary, tmp_path, pb)
+    try:
+        session.kubelet.requests.get(timeout=15)
+        for round_idx in range(5):
+            channel = make_channel(session.socket)
+            stream = session.open_stream(channel)
+            assert len(next(stream).devices) == 8
+            areq = pb.AllocateRequest()
+            areq.container_requests.add().devicesIDs.extend(
+                [f"tpu-1-{8 + round_idx}"])
+            call_unary(channel, pb, "Allocate", areq,
+                       pb.AllocateRequest, pb.AllocateResponse)
+            # kill the socket while the stream is live
+            os.unlink(session.socket)
+            session.kubelet.requests.get(timeout=20)
+            session.wait_socket()
+            channel.close()
+    finally:
+        # stop() is the authoritative check: clean exit + no
+        # ThreadSanitizer report in stderr
+        session.stop()
+
+def test_no_fd_leak_across_connections(tmp_path, pb, plugin_binary):
+    """Server connections must release their fds when the client goes
+    away (regression: the Connection callbacks self-cycle kept every
+    accepted connection — and its fd — alive forever)."""
+    session = PluginSession(plugin_binary, tmp_path, pb)
+    try:
+        def fd_count():
+            return len(os.listdir(f"/proc/{session.proc.pid}/fd"))
+
+        def one_round():
+            channel = make_channel(session.socket)
+            call_unary(channel, pb, "GetDevicePluginOptions",
+                       pb.Empty(), pb.Empty, pb.DevicePluginOptions)
+            channel.close()
+
+        for _ in range(3):
+            one_round()  # warm: lazy allocations, logging, etc.
+        time.sleep(0.5)
+        base = fd_count()
+        for _ in range(20):
+            one_round()
+        deadline = time.time() + 10
+        while fd_count() > base + 3 and time.time() < deadline:
+            time.sleep(0.25)
+        assert fd_count() <= base + 3, (base, fd_count())
+    finally:
+        session.stop()
