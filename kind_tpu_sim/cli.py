@@ -151,6 +151,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--accelerator", default="tpu-v4-podslice",
         choices=sorted(topo.ACCELERATORS),
     )
+    smoke.add_argument(
+        "--ring-tokens", type=int, default=0,
+        help=(
+            "also run the long-context ring-attention smoke over the "
+            "whole slice at this many tokens (e.g. 32768)"
+        ),
+    )
     smoke.add_argument("--json", action="store_true", dest="as_json")
 
     man = sub.add_parser(
@@ -190,19 +197,35 @@ def build_parser() -> argparse.ArgumentParser:
 def run_slice_smoke(args: argparse.Namespace) -> int:
     from kind_tpu_sim.parallel import multihost
 
+    if args.ring_tokens:
+        # Fail fast: a non-divisible token count would crash every
+        # worker only after the whole slice has rendezvoused.
+        chips = topo.make_slice(
+            accelerator=args.accelerator,
+            topology=args.topology).num_chips
+        if args.ring_tokens % chips:
+            raise ValueError(
+                f"--ring-tokens={args.ring_tokens} must be divisible "
+                f"by the slice's {chips} chips")
     reports = multihost.launch_local_slice(
-        topology=args.topology, accelerator=args.accelerator)
+        topology=args.topology, accelerator=args.accelerator,
+        ring_tokens=args.ring_tokens)
     ok = all(r["ok"] for r in reports)
     if args.as_json:
         print(json.dumps({"ok": ok, "workers": reports}))
     else:
         for rank, rep in enumerate(reports):
+            ring = ""
+            if "ring_tokens" in rep:
+                ring = (f", ring {rep['ring_tokens']} tokens in "
+                        f"{rep['ring_seconds']}s "
+                        f"{'OK' if rep['ring_ok'] else 'FAILED'}")
             print(
                 f"worker {rank}: {rep['local_devices']} local / "
                 f"{rep['global_devices']} global devices, "
                 f"psum {rep['psum_total']} "
                 f"(want {rep['psum_expected']}) "
-                f"{'OK' if rep['ok'] else 'FAILED'}"
+                f"{'OK' if rep['ok'] else 'FAILED'}{ring}"
             )
         print("SLICE SMOKE " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1

@@ -40,6 +40,7 @@ class ModelConfig:
     n_kv_heads: Optional[int] = None  # grouped-query attention; None = MHA
     flash: bool = False           # Pallas flash attention (long-context)
     int8_kv: bool = False         # int8 KV cache (serving; halves KV HBM)
+    seq_parallel: bool = False    # ring attention over the 'seq' mesh axis
 
     @property
     def head_dim(self) -> int:
@@ -187,7 +188,7 @@ def _attention(q, k, v, causal=True):
     return out.reshape(b, t, h, head_dim)
 
 
-def _block_core(x, bparams, cfg: ModelConfig, positions):
+def _block_core(x, bparams, cfg: ModelConfig, positions, mesh=None):
     """Block body, also exposing the rotated k/v so the decode prefill
     (models/decode.py) can fill its cache without duplicating this.
     Returns (x_out, aux_loss, k, v)."""
@@ -207,7 +208,17 @@ def _block_core(x, bparams, cfg: ModelConfig, positions):
     v = v.reshape(b, t, cfg.kv_heads, cfg.head_dim)
     q = _rotary(q, positions)
     k = _rotary(k, positions)
-    if cfg.flash:
+    if _use_ring(cfg, mesh):
+        # Sequence-parallel long context: q/k/v stay sharded over the
+        # 'seq' mesh axis; K/V blocks rotate around the ring with
+        # ppermute while an online softmax accumulates — attention
+        # over sequences no single chip could hold
+        # (parallel/ring_attention.py).
+        from kind_tpu_sim.parallel.ring_attention import ring_attention
+
+        attn = ring_attention(q, k, v, mesh, axis_name="seq",
+                              causal=True)
+    elif cfg.flash:
         # Fused online-softmax attention (ops/pallas_kernels): no
         # (t, t) score matrix in HBM. Pays off from ~2k tokens; the
         # XLA path below is faster at short sequence on dispatch-
@@ -232,17 +243,27 @@ def _block_core(x, bparams, cfg: ModelConfig, positions):
             jnp.float32(0), k, v)
 
 
-def _block(x, bparams, cfg: ModelConfig, positions):
-    x, aux, _, _ = _block_core(x, bparams, cfg, positions)
+def _use_ring(cfg: ModelConfig, mesh) -> bool:
+    """Ring attention applies when asked for AND the mesh has a real
+    'seq' axis to ride (size 1 degenerates to plain attention)."""
+    return (cfg.seq_parallel and mesh is not None
+            and "seq" in mesh.axis_names
+            and mesh.shape["seq"] > 1)
+
+
+def _block(x, bparams, cfg: ModelConfig, positions, mesh=None):
+    x, aux, _, _ = _block_core(x, bparams, cfg, positions, mesh)
     return x, aux
 
 
 def forward(params: Params, tokens, cfg: ModelConfig,
-            return_aux: bool = False):
+            return_aux: bool = False, mesh=None):
     """tokens (batch, seq) int32 -> logits (batch, seq, vocab) fp32.
 
     With ``return_aux`` also returns the summed MoE load-balancing
-    loss (zero for dense configs).
+    loss (zero for dense configs). ``mesh`` is only consulted for
+    ``cfg.seq_parallel`` (ring attention needs the concrete mesh for
+    its shard_map; every other sharding is GSPMD-derived).
     """
     import jax
     import jax.numpy as jnp
@@ -255,12 +276,13 @@ def forward(params: Params, tokens, cfg: ModelConfig,
     x = embed_lookup(params["embed"], tokens, dtype)
     block = _block
     if cfg.remat:
+        # cfg and mesh are static (hashable config / Mesh object)
         block = jax.checkpoint(
-            _block, static_argnums=(2,), prevent_cse=False
+            _block, static_argnums=(2, 4), prevent_cse=False
         )
     aux_total = jnp.float32(0)
     for bparams in params["blocks"]:
-        x, aux = block(x, bparams, cfg, positions)
+        x, aux = block(x, bparams, cfg, positions, mesh)
         aux_total = aux_total + aux
     x = _rms_norm(x, params["final_norm"])
     # fp32 params keep the historical fp32 readout numerics; a bf16
@@ -272,12 +294,25 @@ def forward(params: Params, tokens, cfg: ModelConfig,
     return logits
 
 
-def loss_fn(params: Params, tokens, cfg: ModelConfig):
+def loss_fn(params: Params, tokens, cfg: ModelConfig, mesh=None):
     """Next-token cross-entropy (+ MoE aux loss when configured)."""
     import jax
     import jax.numpy as jnp
 
-    logits, aux = forward(params, tokens[:, :-1], cfg, return_aux=True)
+    if _use_ring(cfg, mesh):
+        # Ring attention needs the sequence divisible by the 'seq'
+        # axis; run the forward on the full (divisible) length and
+        # drop the final logit instead of shortening the input —
+        # identical logits under causal masking. Caveat for MoE
+        # configs: the aux load-balancing loss then includes the
+        # final position's routing stats (the dense branch excludes
+        # it), a deliberate seq-parallel difference.
+        logits, aux = forward(params, tokens, cfg, return_aux=True,
+                              mesh=mesh)
+        logits = logits[:, :-1]
+    else:
+        logits, aux = forward(params, tokens[:, :-1], cfg,
+                              return_aux=True, mesh=mesh)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     picked = jnp.take_along_axis(
@@ -399,7 +434,7 @@ def make_train_step(cfg: ModelConfig, mesh=None, learning_rate=1e-2,
 
     def step(state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], tokens, cfg)
+            state["params"], tokens, cfg, mesh)
         if tx:
             updates, new_opt = tx.update(
                 grads, state["opt"], state["params"])

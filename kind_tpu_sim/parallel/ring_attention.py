@@ -19,27 +19,42 @@ import functools
 NEG_INF = -1e30
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
-    """Per-shard body. q/k/v: (batch, t_local, heads, head_dim)."""
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
+                          ring: int):
+    """Per-shard body. q: (batch, t_local, heads, head_dim); k/v may
+    carry fewer (grouped-query) heads — kv_heads must divide heads,
+    and head index h maps to kv group h // (heads // kv_heads),
+    matching the flagship transformer's reshape convention.
+
+    ``ring`` (the axis size) is passed statically so the fori_loop has
+    concrete bounds and lowers to a scan — which is what makes the
+    whole ring reverse-differentiable for seq-parallel *training*.
+    """
     import jax
     import jax.numpy as jnp
 
     batch, t_local, heads, head_dim = q.shape
+    kv_heads = k.shape[2]
+    assert heads % kv_heads == 0, (heads, kv_heads)
+    group = heads // kv_heads
     idx = jax.lax.axis_index(axis_name)
-    ring = jax.lax.psum(1, axis_name)
     scale = head_dim ** -0.5
+
+    # Grouped view: head axis (heads) -> (kv_heads, group), so the
+    # score einsums contract against the shared kv head.
+    qg = q.reshape(batch, t_local, kv_heads, group, head_dim)
 
     q_pos = idx * t_local + jnp.arange(t_local)
 
-    # The accumulators are born as shard-local constants, so mark them
-    # device-varying over the ring axis up front: the loop carry must
-    # keep a consistent varying manifest across iterations.
-    pvary = functools.partial(jax.lax.pcast, axis_name=axis_name,
-                              to="varying")
-    acc0 = pvary(jnp.zeros((batch, t_local, heads, head_dim),
-                           jnp.float32))
-    m0 = pvary(jnp.full((batch, heads, t_local), NEG_INF, jnp.float32))
-    l0 = pvary(jnp.zeros((batch, heads, t_local), jnp.float32))
+    # The accumulators must carry the same device-varying manifest as
+    # the loop products (which inherit q's — 'seq' alone on a 1-D
+    # mesh, plus 'data'/'model' when those axes shard batch/heads).
+    # Deriving them FROM q keeps the manifests matched for any spec
+    # combination instead of hand-listing axis names.
+    zero_bht = q[..., 0].transpose(0, 2, 1).astype(jnp.float32) * 0
+    acc0 = q.astype(jnp.float32) * 0
+    m0 = zero_bht + NEG_INF
+    l0 = zero_bht
 
     def body(step, carry):
         k_cur, v_cur, m, l, acc = carry
@@ -47,9 +62,9 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
         k_pos = src * t_local + jnp.arange(t_local)
 
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, k_cur,
+            "bqhgd,bkhd->bhgqk", qg, k_cur,
             preferred_element_type=jnp.float32,
-        ) * scale
+        ).reshape(batch, heads, t_local, t_local) * scale
         if causal:
             mask = q_pos[:, None] >= k_pos[None, :]
             scores = jnp.where(mask[None, None], scores, NEG_INF)
@@ -60,9 +75,11 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
         p = jnp.exp(scores - new_m[..., None])
         l_new = l * correction + jnp.sum(p, axis=-1)
         pv = jnp.einsum(
-            "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32),
+            "bhgqk,bkhd->bqhgd",
+            p.reshape(batch, kv_heads, group, t_local, t_local),
+            v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
-        )
+        ).reshape(batch, t_local, heads, head_dim)
         acc_new = acc * jnp.transpose(
             correction, (0, 2, 1))[..., None] + pv
 
@@ -80,18 +97,26 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool):
 
 
 @functools.lru_cache(maxsize=32)
-def _build_ring_attention(mesh, axis_name: str, causal: bool):
-    """One jitted callable per (mesh, axis, causal) — rebuilt wrappers
-    would miss the jit cache and recompile on every call."""
+def _build_ring_attention(mesh, axis_name: str, causal: bool,
+                          batch_axis, q_head_axis, kv_head_axis):
+    """One jitted callable per (mesh, axis, causal, specs) — rebuilt
+    wrappers would miss the jit cache and recompile on every call."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, axis_name, None, None)
+    q_spec = P(batch_axis, axis_name, q_head_axis, None)
+    kv_spec = P(batch_axis, axis_name, kv_head_axis, None)
     fn = functools.partial(
-        _ring_attention_local, axis_name=axis_name, causal=causal)
+        _ring_attention_local, axis_name=axis_name, causal=causal,
+        ring=int(mesh.shape[axis_name]))
     sharded = jax.shard_map(
-        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        fn, mesh=mesh, in_specs=(q_spec, kv_spec, kv_spec),
+        out_specs=q_spec)
     return jax.jit(sharded)
+
+
+def _shardable(dim: int, mesh, axis) -> bool:
+    return axis is not None and dim % int(mesh.shape[axis]) == 0
 
 
 def ring_attention(q, k, v, mesh, axis_name: str = "chip",
@@ -100,8 +125,31 @@ def ring_attention(q, k, v, mesh, axis_name: str = "chip",
 
     Inputs are global arrays (batch, seq, heads, head_dim); seq must
     divide evenly over the mesh axis. Output matches full attention.
+
+    When the mesh also carries 'data'/'model' axes (the flagship
+    training mesh), batch rides 'data' and heads ride 'model' inside
+    the shard_map too — otherwise every data-by-model group would
+    all-gather and redundantly compute full-batch all-heads attention.
+    GQA: the kv head dim only shards over 'model' when it divides
+    (q heads and kv heads shard independently; the per-shard group
+    mapping is preserved because both are sharded contiguously).
     """
-    return _build_ring_attention(mesh, axis_name, causal)(q, k, v)
+    names = mesh.axis_names
+    batch_axis = "data" if ("data" in names and names != (axis_name,)
+                            and _shardable(q.shape[0], mesh, "data")
+                            ) else None
+    model = "model" if "model" in names else None
+    q_head_axis = model if _shardable(q.shape[2], mesh, model) else None
+    # kv heads shard only when they divide AND q heads shard the same
+    # way — otherwise the grouped q-to-kv head mapping inside one
+    # shard would be wrong.
+    kv_head_axis = (model if q_head_axis is not None
+                    and _shardable(k.shape[2], mesh, model) else None)
+    if kv_head_axis is None:
+        q_head_axis = None
+    return _build_ring_attention(
+        mesh, axis_name, causal, batch_axis, q_head_axis,
+        kv_head_axis)(q, k, v)
 
 
 def reference_attention(q, k, v, causal: bool = True):

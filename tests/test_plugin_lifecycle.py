@@ -222,15 +222,30 @@ def test_no_fd_leak_across_connections(tmp_path, pb, plugin_binary):
                        pb.Empty(), pb.Empty, pb.DevicePluginOptions)
             channel.close()
 
+        def settled_count(timeout=10.0):
+            """fd count once it stops changing (conn threads exit
+            asynchronously after the client closes)."""
+            deadline = time.time() + timeout
+            last = fd_count()
+            stable_since = time.time()
+            while time.time() < deadline:
+                time.sleep(0.25)
+                cur = fd_count()
+                if cur != last:
+                    last = cur
+                    stable_since = time.time()
+                elif time.time() - stable_since >= 1.0:
+                    break
+            return last
+
         for _ in range(3):
             one_round()  # warm: lazy allocations, logging, etc.
-        time.sleep(0.5)
-        base = fd_count()
+        base = settled_count()
         for _ in range(20):
             one_round()
-        deadline = time.time() + 10
-        while fd_count() > base + 3 and time.time() < deadline:
-            time.sleep(0.25)
-        assert fd_count() <= base + 3, (base, fd_count())
+        after = settled_count(timeout=20.0)
+        # A real leak is +1 fd per round (+20 here); the margin only
+        # absorbs scheduling noise in the async conn-thread teardown.
+        assert after <= base + 8, (base, after)
     finally:
         session.stop()

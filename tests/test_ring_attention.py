@@ -90,3 +90,106 @@ def test_multihost_identity_parsing():
         {"TPU_WORKER_ID": "5", "TPU_WORKER_HOSTNAMES": "h0,h1"}) is None
     assert multihost.identity_from_env(
         {"TPU_WORKER_ID": "x", "TPU_WORKER_HOSTNAMES": "h0"}) is None
+
+
+def test_ring_attention_gqa_matches_reference(ring_mesh):
+    """Grouped-query: 4 q heads sharing 2 kv heads."""
+    import jax
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (2, 64, 4, 16))
+    k = jax.random.normal(ks[1], (2, 64, 2, 16))
+    v = jax.random.normal(ks[2], (2, 64, 2, 16))
+    out = ra.ring_attention(q, k, v, ring_mesh, axis_name="chip")
+    ref = ra.reference_attention(q, k, v)
+    np.testing.assert_allclose(np.array(out), np.array(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_differentiable(ring_mesh):
+    """Seq-parallel TRAINING needs grads through the ring (ppermute +
+    scan); compare against grads of the dense reference."""
+    import jax
+
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (1, 32, 2, 8))
+    k = jax.random.normal(ks[1], (1, 32, 2, 8))
+    v = jax.random.normal(ks[2], (1, 32, 2, 8))
+
+    def ring_loss(q, k, v):
+        return (ra.ring_attention(q, k, v, ring_mesh,
+                                  axis_name="chip") ** 2).sum()
+
+    def ref_loss(q, k, v):
+        return (ra.reference_attention(q, k, v)
+                .astype("float32") ** 2).sum()
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.array(gr), np.array(gf),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_seq_parallel_flagship_forward_matches_dense():
+    """ModelConfig(seq_parallel=True) + a mesh with a 'seq' axis must
+    reproduce the dense forward exactly (fp32 tolerances)."""
+    import jax
+
+    from kind_tpu_sim.models import transformer as tf
+    from kind_tpu_sim.parallel import mesh as mesh_lib
+
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=4,
+                         n_layers=2, d_ff=64, max_seq=32,
+                         n_kv_heads=2, dtype="float32")
+    sp_cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=4,
+                            n_layers=2, d_ff=64, max_seq=32,
+                            n_kv_heads=2, dtype="float32",
+                            seq_parallel=True)
+    mesh = mesh_lib.training_mesh(2, 1, 4)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, 2, 32)
+    dense = np.array(tf.forward(params, tokens, cfg))
+    ring = np.array(tf.forward(params, tokens, sp_cfg, mesh=mesh))
+    np.testing.assert_allclose(ring, dense, atol=2e-4, rtol=2e-4)
+
+
+def test_seq_parallel_train_step():
+    """Full sharded train step with ring attention: loss finite and
+    close to the dense-config loss on the same data."""
+    import jax
+
+    from kind_tpu_sim.models import transformer as tf
+    from kind_tpu_sim.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.training_mesh(2, 2, 2)
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=4,
+                         n_layers=2, d_ff=64, max_seq=16,
+                         seq_parallel=True)
+    step, init_state = tf.make_train_step(cfg, mesh=mesh)
+    state = init_state(jax.random.PRNGKey(0))
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, 4, 16)
+    state, loss = step(state, tokens)
+    assert np.isfinite(float(loss))
+
+    dense_cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=4,
+                               n_layers=2, d_ff=64, max_seq=16)
+    dstep, dinit = tf.make_train_step(dense_cfg, mesh=mesh)
+    dstate = dinit(jax.random.PRNGKey(0))
+    _, dloss = dstep(dstate, tokens)
+    # seq-parallel loss computes over the same positions; bf16 ring
+    # reductions differ slightly from the dense fused path
+    assert abs(float(loss) - float(dloss)) < 0.05, (loss, dloss)
+
+
+def test_ring_long_context_smoke_analytic():
+    """The analytic long-context smoke (k=0 -> out[i] = i/2) on the
+    in-process 8-device mesh; the full 32k 2-host version runs via
+    `kind-tpu-sim slice-smoke --ring-tokens=32768`."""
+    from kind_tpu_sim.parallel import multihost
+
+    report = multihost.ring_long_context_smoke(total_tokens=4096,
+                                               head_dim=16)
+    assert report["ring_ok"], report
+    assert report["ring_devices"] == 8
+    assert report["ring_max_rel_err"] < 1e-5
