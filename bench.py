@@ -331,14 +331,7 @@ def model_throughput() -> dict | None:
                 long_tokens = tf.sample_batch(
                     jax.random.PRNGKey(2), long_cfg, 2, 4096)
 
-                def fwd_time(use_flash):
-                    run_cfg = dataclasses.replace(long_cfg,
-                                                  flash=use_flash)
-                    # forward (not loss_fn): loss_fn's next-token
-                    # shift would leave 4095 tokens, which no
-                    # 16-aligned flash block divides.
-                    f = jax.jit(
-                        lambda p, t: tf.forward(p, t, run_cfg).sum())
+                def best_time(f):
                     jax.block_until_ready(f(params, long_tokens))
                     best = None
                     for _ in range(3):
@@ -347,6 +340,15 @@ def model_throughput() -> dict | None:
                         dt = time.monotonic() - t0
                         best = dt if best is None else min(best, dt)
                     return best
+
+                def fwd_time(use_flash):
+                    run_cfg = dataclasses.replace(long_cfg,
+                                                  flash=use_flash)
+                    # forward (not loss_fn): loss_fn's next-token
+                    # shift would leave 4095 tokens, which no
+                    # 16-aligned flash block divides.
+                    return best_time(jax.jit(
+                        lambda p, t: tf.forward(p, t, run_cfg).sum()))
 
                 try:
                     result["fwd_4k_tokens_per_s"] = round(
@@ -358,6 +360,29 @@ def model_throughput() -> dict | None:
                         2 * 4096 / fwd_time(True))
                 except Exception as exc:  # pragma: no cover
                     result["fwd_4k_flash_error"] = str(exc)[:100]
+
+                # Long-context TRAINING: fwd+bwd at 4k, flash (fused
+                # Pallas backward, no (t,t) matrix) vs the XLA path.
+                # Independent trys: the XLA backward materializes the
+                # score matrices and is the path that can OOM — its
+                # failure must not discard the flash number.
+                def fwdbwd_time(use_flash):
+                    run_cfg = dataclasses.replace(long_cfg,
+                                                  flash=use_flash)
+                    return best_time(jax.jit(jax.grad(
+                        lambda p, t: tf.forward(p, t, run_cfg)
+                        .astype(jax.numpy.float32).sum())))
+
+                try:
+                    result["fwdbwd_4k_tokens_per_s"] = round(
+                        2 * 4096 / fwdbwd_time(False))
+                except Exception as exc:  # pragma: no cover
+                    result["fwdbwd_4k_error"] = str(exc)[:100]
+                try:
+                    result["fwdbwd_4k_flash_tokens_per_s"] = round(
+                        2 * 4096 / fwdbwd_time(True))
+                except Exception as exc:  # pragma: no cover
+                    result["fwdbwd_4k_flash_error"] = str(exc)[:100]
             except Exception as exc:  # pragma: no cover
                 result["fwd_4k_error"] = str(exc)[:100]
 

@@ -147,6 +147,38 @@ def _fit_block(size: int, requested: int, align: int) -> int:
     return blk
 
 
+def _fit_blocks(t: int, s: int, block_q: int, block_kv: int, dtype,
+                run_interpreted: bool):
+    """Single source of truth for block fitting: forward and backward
+    MUST agree on effective blocks or their masks drift."""
+    import jax.numpy as jnp
+
+    align = 1 if run_interpreted else (
+        16 if dtype == jnp.bfloat16 else 8)
+    return _fit_block(t, block_q, align), _fit_block(s, block_kv, align)
+
+
+def _masked_scores(q_blk, k_blk, scale, causal, first_row, first_col,
+                   block_q, block_kv):
+    """Scaled (and causally masked) score tile — shared by the
+    forward and both backward kernels so the masking can never
+    diverge between passes."""
+    import jax
+    import jax.numpy as jnp
+
+    scores = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        rows = first_row + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = first_col + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        scores = jnp.where(cols <= rows, scores, -1e30)
+    return scores
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = 512, block_kv: int = 1024,
                     interpret: Optional[bool] = None):
@@ -161,37 +193,38 @@ def flash_attention(q, k, v, causal: bool = True,
     their compute (their DMAs still run — acceptable at these sizes).
     Matches transformer._attention numerics to bf16 tolerance.
 
-    Differentiable: the backward pass recomputes through the XLA
-    reference attention from the saved (q, k, v) — mathematically the
-    same function, so gradients are correct to fp tolerance, at the
-    cost of materializing the score matrix in the backward (flash-
-    style fused backward is future work).
+    Differentiable with a FUSED flash backward: the forward also
+    saves the per-row logsumexp, and the backward recomputes score
+    blocks tile-by-tile in VMEM (two Pallas kernels: dq accumulated
+    over kv blocks; per-q-head dk/dv accumulated over q blocks and
+    group-summed for GQA) — no (t, s) matrix in HBM in either
+    direction, so flash=True keeps its memory promise for
+    long-context training too.
     """
     import jax
 
     @jax.custom_vjp
     def fa(q, k, v):
+        # primal-only path: no lse output, no extra HBM write
         return _flash_impl(q, k, v, causal, block_q, block_kv,
-                           interpret)
+                           interpret, needs_lse=False)
 
     def fwd(q, k, v):
-        return fa(q, k, v), (q, k, v)
+        out, lse = _flash_impl(q, k, v, causal, block_q, block_kv,
+                               interpret, needs_lse=True)
+        return out, (q, k, v, out, lse)
 
     def bwd(res, g):
-        from kind_tpu_sim.models.transformer import _attention
-
-        q, k, v = res
-        _, vjp = jax.vjp(
-            lambda q, k, v: _attention(q, k, v, causal=causal),
-            q, k, v)
-        return vjp(g)
+        q, k, v, out, lse = res
+        return _flash_bwd(q, k, v, out, lse, g, causal, block_q,
+                          block_kv, interpret)
 
     fa.defvjp(fwd, bwd)
     return fa(q, k, v)
 
 
 def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
-                interpret: Optional[bool]):
+                interpret: Optional[bool], needs_lse: bool = False):
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
@@ -206,10 +239,8 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
     # Mosaic tiles the sublane dim: fp32 wants multiples of 8, bf16 of
     # 16 (pallas_guide "Tiling Constraints"). Interpret mode has no
     # such constraint.
-    align = 1 if run_interpreted else (
-        16 if q.dtype == jnp.bfloat16 else 8)
-    block_q = _fit_block(t, block_q, align)
-    block_kv = _fit_block(s, block_kv, align)
+    block_q, block_kv = _fit_blocks(t, s, block_q, block_kv, q.dtype,
+                                    run_interpreted)
     scale = d ** -0.5
 
     # Mosaic tiles the LAST TWO dims of a block (sublane x lane), so
@@ -219,7 +250,11 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
     k = k.transpose(0, 2, 1, 3)    # (b, kv, s, d)
     v = v.transpose(0, 2, 1, 3)
 
-    def kernel(q_ref, k_ref, v_ref, out_ref, m_ref, l_ref, acc_ref):
+    def kernel(q_ref, k_ref, v_ref, out_ref, *rest):
+        if needs_lse:
+            lse_ref, m_ref, l_ref, acc_ref = rest
+        else:
+            m_ref, l_ref, acc_ref = rest
         qi = pl.program_id(2)
         kj = pl.program_id(3)
 
@@ -237,17 +272,9 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
 
         @pl.when(live)
         def _step():
-            scores = jax.lax.dot_general(
-                q_ref[0, 0], k_ref[0, 0],
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale                                  # (bq, bkv)
-            if causal:
-                rows = first_row + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_kv), 0)
-                cols = first_col + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_kv), 1)
-                scores = jnp.where(cols <= rows, scores, -1e30)
+            scores = _masked_scores(q_ref[0, 0], k_ref[0, 0], scale,
+                                    causal, first_row, first_col,
+                                    block_q, block_kv)  # (bq, bkv)
 
             m_prev = m_ref[:, 0:1]                     # (bq, 1)
             m_new = jnp.maximum(
@@ -268,8 +295,22 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
         def _finalize():
             out_ref[0, 0] = (
                 acc_ref[:] / l_ref[:, 0:1]).astype(out_ref.dtype)
+            if needs_lse:
+                # logsumexp of the scaled scores, saved for the fused
+                # backward (lanes replicated; col 0 authoritative)
+                lse_ref[0, 0] = m_ref[:] + jnp.log(l_ref[:])
 
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, 1, block_q, d),
+                              lambda bi, hi, qi, kj: (bi, hi, qi, 0))]
+    out_shape = [jax.ShapeDtypeStruct((b, h, t, d), q.dtype)]
+    if needs_lse:
+        out_specs.append(
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, t, 128), jnp.float32))
+
+    results = pl.pallas_call(
         kernel,
         grid=(b, h, t // block_q, s // block_kv),
         in_specs=[
@@ -280,17 +321,209 @@ def _flash_impl(q, k, v, causal: bool, block_q: int, block_kv: int,
             pl.BlockSpec((1, 1, block_kv, d),
                          lambda bi, hi, qi, kj: (bi, hi // group, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, 128), jnp.float32),   # running max
             pltpu.VMEM((block_q, 128), jnp.float32),   # denominator
             pltpu.VMEM((block_q, d), jnp.float32),     # accumulator
         ],
-        interpret=_interpret(interpret),
+        interpret=run_interpreted,
     )(q, k, v)
-    return out.transpose(0, 2, 1, 3)                   # (b, t, h, d)
+    out = results[0].transpose(0, 2, 1, 3)             # (b, t, h, d)
+    if needs_lse:
+        return out, results[1]  # lse stays head-major (b, h, t, 128)
+    return out
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: int,
+               block_kv: int, interpret: Optional[bool]):
+    """Fused flash backward: dq/dk/dv without a (t, s) matrix in HBM.
+
+    Standard flash-bwd recurrence over tiles, with the forward's
+    logsumexp: P = exp(S - lse); dV += P^T dO; dS = P*(dO V^T - D);
+    dQ += dS K * scale; dK += dS^T Q * scale, where
+    D = rowsum(dO * O). Two kernels because the two accumulations
+    run over different grid axes: dq over kv blocks (innermost),
+    dk/dv over q blocks (innermost), the latter per q-head and then
+    group-summed (GQA).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    kv = k.shape[2]
+    group = h // kv
+
+    run_interpreted = _interpret(interpret)
+    block_q, block_kv = _fit_blocks(t, s, block_q, block_kv, q.dtype,
+                                    run_interpreted)
+    scale = d ** -0.5
+
+    qh = q.transpose(0, 2, 1, 3)        # (b, h, t, d)
+    kh = k.transpose(0, 2, 1, 3)        # (b, kv, s, d)
+    vh = v.transpose(0, 2, 1, 3)
+    oh = out.transpose(0, 2, 1, 3)      # (b, h, t, d)
+    gh = g.transpose(0, 2, 1, 3)
+    # D = rowsum(dO * O): elementwise, fine in XLA; lanes replicated
+    # to match the lse layout.
+    dsum = jnp.broadcast_to(
+        jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32),
+                axis=-1, keepdims=True), (b, h, t, 128))
+
+    n_i = t // block_q
+    n_j = s // block_kv
+
+    def dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dsum_ref,
+                  dq_ref, acc_ref):
+        qi = pl.program_id(2)
+        kj = pl.program_id(3)
+
+        @pl.when(kj == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        first_row = qi * block_q
+        first_col = kj * block_kv
+        live = (not causal) or (first_col <= first_row + block_q - 1)
+
+        @pl.when(live)
+        def _step():
+            scores = _masked_scores(q_ref[0, 0], k_ref[0, 0], scale,
+                                    causal, first_row, first_col,
+                                    block_q, block_kv)
+            p = jnp.exp(scores - lse_ref[0, 0][:, 0:1])
+            dp = jax.lax.dot_general(
+                g_ref[0, 0].astype(jnp.float32),
+                v_ref[0, 0].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - dsum_ref[0, 0][:, 0:1])
+            acc_ref[:] += jax.lax.dot_general(
+                ds, k_ref[0, 0].astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+
+        @pl.when(kj == pl.num_programs(3) - 1)
+        def _finalize():
+            dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+    dqh = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, n_i, n_j),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, qi, kj: (bi, hi // group, kj, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, qi, kj: (bi, hi // group, kj, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=run_interpreted,
+    )(qh, kh, vh, gh, lse, dsum)
+
+    def dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dsum_ref,
+                   dk_ref, dv_ref, dk_acc, dv_acc):
+        kj = pl.program_id(2)
+        qi = pl.program_id(3)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        first_row = qi * block_q
+        first_col = kj * block_kv
+        live = (not causal) or (first_col <= first_row + block_q - 1)
+
+        @pl.when(live)
+        def _step():
+            scores = _masked_scores(q_ref[0, 0], k_ref[0, 0], scale,
+                                    causal, first_row, first_col,
+                                    block_q, block_kv)
+            p = jnp.exp(scores - lse_ref[0, 0][:, 0:1])   # (bq, bkv)
+            gf = g_ref[0, 0].astype(jnp.float32)
+            dv_acc[:] += jax.lax.dot_general(
+                p, gf, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                              # (bkv, d)
+            dp = jax.lax.dot_general(
+                gf, v_ref[0, 0].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - dsum_ref[0, 0][:, 0:1])
+            dk_acc[:] += jax.lax.dot_general(
+                ds, q_ref[0, 0].astype(jnp.float32),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                      # (bkv, d)
+
+        @pl.when(qi == pl.num_programs(3) - 1)
+        def _finalize():
+            # fp32 out: the GQA group-sum happens outside the kernel,
+            # and summing in the param dtype would drop the fp32
+            # accumulation this module promises for bf16 inputs
+            dk_ref[0, 0] = dk_acc[:]
+            dv_ref[0, 0] = dv_acc[:]
+
+    dkh, dvh = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, n_j, n_i),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, kj, qi: (bi, hi // group, kj, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, kj, qi: (bi, hi // group, kj, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, block_kv, d),
+                         lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        interpret=run_interpreted,
+    )(qh, kh, vh, gh, lse, dsum)
+
+    dq = dqh.transpose(0, 2, 1, 3)
+    # GQA: per-q-head dk/dv sum (in fp32) over the group sharing each
+    # kv head; cast to the param dtype only after the sum
+    dk = dkh.reshape(b, kv, group, s, d).sum(axis=2).transpose(
+        0, 2, 1, 3).astype(k.dtype)
+    dv = dvh.reshape(b, kv, group, s, d).sum(axis=2).transpose(
+        0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
 
 
 def toolchain_smoke() -> dict:

@@ -69,7 +69,10 @@ bool Connection::ReadExact(uint8_t* buf, size_t len) {
 bool Connection::WriteAllLocked(const uint8_t* buf, size_t len) {
   size_t sent = 0;
   while (sent < len) {
-    ssize_t n = ::write(fd_, buf + sent, len - sent);
+    // MSG_NOSIGNAL: a peer that closed mid-write (client cancels a
+    // call and tears the channel down) must surface as EPIPE, not a
+    // process-killing SIGPIPE.
+    ssize_t n = ::send(fd_, buf + sent, len - sent, MSG_NOSIGNAL);
     if (n < 0) {
       if (errno == EINTR) continue;
       return false;

@@ -116,13 +116,23 @@ def make_channel(sock):
 
 
 def call_unary(channel, pb, method, request, request_cls, response_cls,
-               timeout=5):
+               timeout=20):
     stub = channel.unary_unary(
         f"/v1beta1.DevicePlugin/{method}",
         request_serializer=request_cls.SerializeToString,
         response_deserializer=response_cls.FromString,
     )
-    return stub(request, timeout=timeout)
+    try:
+        return stub(request, timeout=timeout)
+    except grpc.RpcError as exc:
+        # One retry for transient transport errors (grpcio under a
+        # loaded host occasionally drops the first attempt); a real
+        # protocol bug fails both attempts identically.
+        if exc.code() in (grpc.StatusCode.UNAVAILABLE,
+                          grpc.StatusCode.DEADLINE_EXCEEDED):
+            time.sleep(0.5)
+            return stub(request, timeout=timeout)
+        raise
 
 
 def test_register_called_with_plugin_identity(plugin_env, pb):
