@@ -483,39 +483,50 @@ def model_throughput() -> dict | None:
 
             # Int8 serving snapshot: int8 weights AND int8 KV cache
             # (decode is pure HBM bandwidth; both halvings are real
-            # byte reductions). Own try: an int8-only failure must
-            # not be attributed to the (already-recorded) bf16
-            # numbers.
+            # byte reductions). Two variants, distinct keys:
+            # decode_int8_* = native W8A8 (int8 x int8 -> int32 MXU
+            # contractions, no VPU dequant — the shipping config);
+            # decode_int8_dequant_* = the cast-at-the-matmul path,
+            # kept measured so the native delta stays reproducible.
+            # Own try: an int8-only failure must not be attributed to
+            # the (already-recorded) bf16 numbers.
             try:
                 import dataclasses as _dc
 
                 from kind_tpu_sim.models import quant
 
-                cfg_q = _dc.replace(cfg, int8_kv=True)
-                qparams = quant.quantize_params(params, cfg_q)
-                pre_q = jax.jit(
-                    lambda p, t: decode.prefill(p, cfg_q, t, total))
+                def int8_decode_tps(native: bool):
+                    cfg_q = _dc.replace(cfg, int8_kv=True,
+                                        int8_native=native)
+                    qparams = quant.quantize_params(params, cfg_q)
+                    pre_q = jax.jit(
+                        lambda p, t: decode.prefill(p, cfg_q, t,
+                                                    total))
 
-                def _dec_q(p, logits, cache):
-                    first = jax.numpy.argmax(logits, -1).astype(
-                        prompt.dtype)
-                    return decode.generate_from_cache(
-                        p, cfg_q, first, cache, prompt.shape[1],
-                        new_tokens)
+                    def _dec_q(p, logits, cache):
+                        first = jax.numpy.argmax(logits, -1).astype(
+                            prompt.dtype)
+                        return decode.generate_from_cache(
+                            p, cfg_q, first, cache, prompt.shape[1],
+                            new_tokens)
 
-                dec_q = jax.jit(_dec_q)
-                logits_q, cache_q = jax.block_until_ready(
-                    pre_q(qparams, prompt))
-                np.asarray(dec_q(qparams, logits_q, cache_q))  # warm
+                    dec_q = jax.jit(_dec_q)
+                    logits_q, cache_q = jax.block_until_ready(
+                        pre_q(qparams, prompt))
+                    np.asarray(dec_q(qparams, logits_q, cache_q))
 
-                def run_decode_q():
-                    state["out_q"] = np.asarray(
-                        dec_q(qparams, logits_q, cache_q))
+                    def run_decode_q():
+                        state["out_q"] = np.asarray(
+                            dec_q(qparams, logits_q, cache_q))
 
-                raw_q = med(run_decode_q, 3)
-                dt_q = raw_q - null_dt
-                if dt_q > 0.3 * raw_q:
-                    q_tps = batch * new_tokens / dt_q
+                    raw_q = med(run_decode_q, 3)
+                    dt_q = raw_q - null_dt
+                    if dt_q <= 0.3 * raw_q:
+                        return None
+                    return batch * new_tokens / dt_q
+
+                q_tps = int8_decode_tps(native=True)
+                if q_tps is not None:
                     result["decode_int8_tokens_per_s"] = round(q_tps)
                     if spec is not None:
                         roof_q = F.decode_roofline(
@@ -524,6 +535,16 @@ def model_throughput() -> dict | None:
                         result["decode_int8_gbps"] = \
                             roof_q["achieved_gbps"]
                         result["decode_int8_roofline"] = roof_q
+                dq_tps = int8_decode_tps(native=False)
+                if dq_tps is not None:
+                    result["decode_int8_dequant_tokens_per_s"] = \
+                        round(dq_tps)
+                    if spec is not None:
+                        result["decode_int8_dequant_gbps"] = \
+                            F.decode_roofline(
+                                cfg, batch, total, dq_tps, spec,
+                                weight_bytes=1, kv_bytes=1,
+                            )["achieved_gbps"]
             except Exception as exc:  # pragma: no cover
                 result["decode_int8_error"] = str(exc)[:100]
         except Exception as exc:  # pragma: no cover - best effort

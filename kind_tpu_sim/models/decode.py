@@ -122,24 +122,33 @@ def _store(cache_arr, update, start_idx):
         cache_arr, update.astype(cache_arr.dtype), (0, start_idx, 0, 0))
 
 
-def _cache_scores(qg, cache_k, scale):
+def _cache_scores(qg, cache_k, scale, native=False):
     """Attention scores of qg (b, kv, group, hd) against a cache
     tensor (b, s, kv, hd), plain or int8. Returns fp32 (b, kv, g, s).
 
-    Int8 path: only the int8 bytes cross the HBM bus; the per-row
-    fp32 scale multiplies the (much smaller) score matrix after the
-    MXU contraction.
+    Int8 dequant path: only the int8 bytes cross the HBM bus; the
+    per-row fp32 scale multiplies the (much smaller) score matrix
+    after the MXU contraction. Int8 native path (W8A8): the query is
+    row-quantized too and the contraction runs int8 x int8 -> int32,
+    skipping the VPU cast of the cache bytes.
     """
     import jax.numpy as jnp
 
-    from kind_tpu_sim.models.quant import QuantArray
+    from kind_tpu_sim.models.quant import QuantArray, quant_rows
 
     if isinstance(cache_k, QuantArray):
+        row = jnp.transpose(cache_k.scale[..., 0], (0, 2, 1))
+        if native:
+            qq, qs = quant_rows(qg)
+            acc = jnp.einsum(
+                "bkgd,bskd->bkgs", qq, cache_k.q,
+                preferred_element_type=jnp.int32)
+            return (acc.astype(jnp.float32) * (qs * scale)
+                    * row[:, :, None, :])
         sc = jnp.einsum(
             "bkgd,bskd->bkgs", qg, cache_k.q.astype(qg.dtype),
             preferred_element_type=jnp.float32,
         ) * scale
-        row = jnp.transpose(cache_k.scale[..., 0], (0, 2, 1))
         return sc * row[:, :, None, :]
     return jnp.einsum(
         "bkgd,bskd->bkgs", qg, cache_k,
@@ -147,19 +156,28 @@ def _cache_scores(qg, cache_k, scale):
     ) * scale
 
 
-def _cache_values(probs, cache_v, dtype):
+def _cache_values(probs, cache_v, dtype, native=False):
     """probs (b, kv, g, s) fp32 x cache values (b, s, kv, hd) ->
     (b, kv, g, hd). For an int8 cache the per-row value scale folds
     into probs before the contraction (scale is constant along hd),
-    so the cache is read as raw int8."""
+    so the cache is read as raw int8. The native path additionally
+    row-quantizes the folded probs (one scale per (b, kv, g) row) so
+    the contraction runs int8 x int8 -> int32 on the MXU — probs live
+    in [0, 1], so the row scale is ~max_prob/127 and the quantization
+    error is bounded by half that per position."""
     import jax.numpy as jnp
 
-    from kind_tpu_sim.models.quant import QuantArray
+    from kind_tpu_sim.models.quant import QuantArray, quant_rows
 
     if isinstance(cache_v, QuantArray):
         row = jnp.transpose(cache_v.scale[..., 0], (0, 2, 1))
-        p = (probs * row[:, :, None, :]).astype(dtype)
-        return jnp.einsum("bkgs,bskd->bkgd", p,
+        p = probs * row[:, :, None, :]
+        if native:
+            pq, ps = quant_rows(p)
+            acc = jnp.einsum("bkgs,bskd->bkgd", pq, cache_v.q,
+                             preferred_element_type=jnp.int32)
+            return (acc.astype(jnp.float32) * ps).astype(dtype)
+        return jnp.einsum("bkgs,bskd->bkgd", p.astype(dtype),
                           cache_v.q.astype(dtype))
     return jnp.einsum("bkgs,bskd->bkgd", probs.astype(dtype), cache_v)
 
@@ -174,7 +192,7 @@ def _attend_token(x, bparams, cfg: ModelConfig, positions):
 
     b, _ = x.shape
     h = _rms_norm(x, bparams["attn_norm"])
-    qkv = linear(h, bparams["wqkv"])
+    qkv = linear(h, bparams["wqkv"], native=cfg.int8_native)
     q_dim = cfg.n_heads * cfg.head_dim
     kv_dim = cfg.kv_heads * cfg.head_dim
     q, k, v = jnp.split(qkv, [q_dim, q_dim + kv_dim], axis=-1)
@@ -194,7 +212,7 @@ def _finish_block(x, attn, bparams, cfg: ModelConfig):
 
     from kind_tpu_sim.models.quant import linear
 
-    x = x + linear(attn, bparams["wo"])
+    x = x + linear(attn, bparams["wo"], native=cfg.int8_native)
     h = _rms_norm(x, bparams["mlp_norm"])
     if "moe" in bparams:
         from kind_tpu_sim.models.moe import MoeConfig, moe_mlp
@@ -202,8 +220,10 @@ def _finish_block(x, attn, bparams, cfg: ModelConfig):
         out, _ = moe_mlp(h[:, None, :], bparams["moe"],
                          MoeConfig(n_experts=cfg.n_experts))
         return x + out[:, 0, :]
-    return x + linear(jax.nn.gelu(linear(h, bparams["w_up"])),
-                      bparams["w_down"])
+    return x + linear(
+        jax.nn.gelu(linear(h, bparams["w_up"],
+                           native=cfg.int8_native)),
+        bparams["w_down"], native=cfg.int8_native)
 
 
 def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
@@ -225,14 +245,16 @@ def _block_decode(x, bparams, cfg: ModelConfig, layer_cache, pos):
     scale = cfg.head_dim ** -0.5
 
     max_len = layer_cache["k"].shape[1]
-    sc_past = _cache_scores(qg, layer_cache["k"], scale)
+    sc_past = _cache_scores(qg, layer_cache["k"], scale,
+                            native=cfg.int8_native)
     valid = jnp.arange(max_len) < pos
     sc_past = jnp.where(valid[None, None, None, :], sc_past, -1e30)
     scores = jnp.concatenate([sc_past, _cache_scores(qg, k, scale)],
                              -1)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = (
-        _cache_values(probs[..., :max_len], layer_cache["v"], dtype)
+        _cache_values(probs[..., :max_len], layer_cache["v"], dtype,
+                      native=cfg.int8_native)
         + _cache_values(probs[..., max_len:], v, dtype)
     ).reshape(b, cfg.d_model)
 
@@ -272,7 +294,7 @@ def prefill(params: Params, cfg: ModelConfig, prompt, max_len: int):
                                     positions)
         new_cache.append(updated)
     last = _rms_norm(x[:, -1, :], params["final_norm"])
-    logits = _readout(last, params["embed"])
+    logits = _readout(last, params["embed"], cfg.int8_native)
     return logits, new_cache
 
 
@@ -289,7 +311,7 @@ def decode_step(params: Params, cfg: ModelConfig, token, cache, pos):
         x, updated = _block_decode(x, bparams, cfg, layer_cache, pos)
         new_cache.append(updated)
     x = _rms_norm(x, params["final_norm"])
-    logits = _readout(x, params["embed"])
+    logits = _readout(x, params["embed"], cfg.int8_native)
     return logits, new_cache
 
 
@@ -316,7 +338,8 @@ def _block_decode_chunk(x, bparams, cfg: ModelConfig, big, small,
 
     s_big = big["k"].shape[1]
     c_len = small["k"].shape[1]
-    sc_big = _cache_scores(qg, big["k"], scale)
+    sc_big = _cache_scores(qg, big["k"], scale,
+                           native=cfg.int8_native)
     sc_big = jnp.where(
         (jnp.arange(s_big) < base)[None, None, None, :], sc_big, -1e30)
     sc_sm = _cache_scores(qg, small["k"], scale)
@@ -326,7 +349,8 @@ def _block_decode_chunk(x, bparams, cfg: ModelConfig, big, small,
         [sc_big, sc_sm, _cache_scores(qg, k, scale)], -1)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = (
-        _cache_values(probs[..., :s_big], big["v"], dtype)
+        _cache_values(probs[..., :s_big], big["v"], dtype,
+                      native=cfg.int8_native)
         + _cache_values(probs[..., s_big:s_big + c_len], small["v"],
                         dtype)
         + _cache_values(probs[..., s_big + c_len:], v, dtype)
@@ -371,7 +395,7 @@ def _run_chunk(params, cfg: ModelConfig, token, cache, base,
                 x, bparams, cfg, big_lc, small_lc, base, i)
             new_small.append(small_lc)
         x = _rms_norm(x, params["final_norm"])
-        logits = _readout(x, params["embed"])
+        logits = _readout(x, params["embed"], cfg.int8_native)
         nxt = select_fn(logits, step0 + i, token.dtype)
         return (nxt, new_small), nxt
 
@@ -510,7 +534,7 @@ def sample_generate(params: Params, cfg: ModelConfig, prompt,
 
 
 def greedy_generate(params: Params, cfg: ModelConfig, prompt,
-                    num_new: int):
+                    num_new: int, chunk: int = 64):
     """prompt (b, t_p) int32 -> (b, t_p + num_new) greedy continuation.
 
     Batched prefill over the prompt (one forward pass filling the
@@ -524,7 +548,7 @@ def greedy_generate(params: Params, cfg: ModelConfig, prompt,
     logits, cache = prefill(params, cfg, prompt, t_p + num_new)
     first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
     generated = generate_from_cache(params, cfg, first, cache,
-                                    t_p, num_new)
+                                    t_p, num_new, chunk=chunk)
     return jnp.concatenate([prompt, generated], axis=1)
 
 

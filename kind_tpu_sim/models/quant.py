@@ -8,12 +8,20 @@ not enough: at the bench shape the step traffic is ~243 MB of weights
 plus ~101 MB of KV, so int8 weights alone cap the speedup at ~1.55x.
 Halving BOTH (int8 weights here, int8 KV cache via
 ``ModelConfig(int8_kv=True)`` + models/decode.py) cuts the step bytes
-1.96x; measured v5e decode gets 1.62x of it (int8 runs at ~82% of the
-HBM roof vs bf16's ~100% — the residual is VPU dequant work on 175 MB
-of int8 per step, the price of keeping activations bf16). The int8
-tensors are read from HBM and dequantized in VMEM right at the matmul,
-so the saving is real, not cosmetic; see
-models/flops.py:decode_bytes_per_step for the accounting bench.py
+1.96x.
+
+How the halved bytes are cashed in depends on the matmul style:
+
+* dequant (default): int8 crosses the HBM bus and is cast to bf16 in
+  VMEM right at the matmul. Real savings, but the VPU cast of 175 MB
+  per step caps decode at ~84% of the roof — measured 1.65x bf16.
+* native W8A8 (``ModelConfig(int8_native=True)``): activations are
+  dynamically row-quantized (`quant_rows`) and the contractions run
+  int8 x int8 -> int32 on the MXU, so the weight/KV bytes are never
+  cast at all. Profiled on v5e the dominant dequant fusion drops
+  ~2.1x and decode reaches ~91% of the byte roofline — ~1.8x bf16.
+
+See models/flops.py:decode_bytes_per_step for the accounting bench.py
 reports against.
 
 Representation: `QuantArray(q=int8, scale=f32)` — a NamedTuple, hence
@@ -70,13 +78,34 @@ def dequantize(qa: QuantArray, dtype=None):
     return (qa.q.astype(jnp.float32) * qa.scale).astype(dtype)
 
 
-def linear(x, w, dtype=None):
+def quant_rows(x):
+    """Dynamic symmetric int8 over the LAST axis (one scale per row).
+
+    The activation half of the W8A8 path: quantizing the (tiny)
+    activation lets the matmul run int8 x int8 -> int32 on the MXU
+    natively, so the (huge) int8 weight is never cast to bf16 — the
+    VPU dequant pass that caps the dequant-style int8 decode at ~84%
+    of the HBM roof disappears entirely. Same recipe as `quantize`
+    (one definition of the int8 rounding), returned unpacked.
+    """
+    qa = quantize(x, axis=-1)
+    return qa.q, qa.scale
+
+
+def linear(x, w, dtype=None, native=False):
     """x @ w for a plain array or QuantArray weight.
 
-    Int8 path: the weight is cast AFTER the HBM read (inside the fused
-    matmul), so only q's bytes cross the HBM bus; the per-channel
-    scale multiplies the (much smaller) output.
+    Int8 dequant path (default): the weight is cast AFTER the HBM read
+    (inside the fused matmul), so only q's bytes cross the HBM bus;
+    the per-channel scale multiplies the (much smaller) output.
+
+    Int8 native path (``native=True``, i.e. W8A8): the activation is
+    dynamically quantized per row (`quant_rows`) and the contraction
+    runs int8 x int8 -> int32 on the MXU, skipping the VPU cast of the
+    weight bytes altogether; int32 accumulation is exact, the combined
+    row/channel scales apply to the small output.
     """
+    import jax
     import jax.numpy as jnp
 
     if isinstance(w, QuantArray):
@@ -84,6 +113,13 @@ def linear(x, w, dtype=None):
             raise ValueError(
                 "linear() needs a weight quantized along axis 0 "
                 f"(scale shape (1, out)); got scale {w.scale.shape}")
+        if native:
+            xq, xs = quant_rows(x)
+            acc = jax.lax.dot_general(
+                xq, w.q, (((xq.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            return (acc.astype(jnp.float32) * xs * w.scale[0]).astype(
+                x.dtype)
         out = jnp.einsum(
             "...d,df->...f", x, w.q.astype(x.dtype),
             preferred_element_type=jnp.float32,
@@ -101,15 +137,24 @@ def embed_lookup(embed, tokens, dtype):
     return embed[tokens].astype(dtype)
 
 
-def readout(x, embed):
+def readout(x, embed, native=False):
     """Weight-tied logits against a plain or quantized embedding.
 
     Must stay in lockstep with transformer._readout (the cache-vs-
-    forward argmax contract): fp32 accumulation, logits f32.
+    forward argmax contract): fp32 accumulation, logits f32. The
+    ``native`` switch mirrors `linear`: int8 x int8 -> int32 MXU
+    contraction against the (largest single) int8 weight.
     """
     import jax.numpy as jnp
 
     if isinstance(embed, QuantArray):
+        if native:
+            xq, xs = quant_rows(x)
+            acc = jnp.einsum(
+                "...d,vd->...v", xq, embed.q,
+                preferred_element_type=jnp.int32)
+            return (acc.astype(jnp.float32) * xs
+                    * embed.scale[:, 0]).astype(jnp.float32)
         logits = jnp.einsum(
             "...d,vd->...v", x, embed.q.astype(x.dtype),
             preferred_element_type=jnp.float32,

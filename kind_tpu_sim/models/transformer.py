@@ -40,6 +40,7 @@ class ModelConfig:
     n_kv_heads: Optional[int] = None  # grouped-query attention; None = MHA
     flash: bool = False           # Pallas flash attention (long-context)
     int8_kv: bool = False         # int8 KV cache (serving; halves KV HBM)
+    int8_native: bool = False     # W8A8: int8 MXU dots (no VPU dequant)
     seq_parallel: bool = False    # ring attention over the 'seq' mesh axis
 
     @property
@@ -123,7 +124,7 @@ def init_params(key, cfg: ModelConfig) -> Params:
 # forward
 
 
-def _readout(x, embed):
+def _readout(x, embed, native=False):
     """Weight-tied logits with fp32 accumulation (plain or int8-
     quantized embedding). The single definition shared by forward,
     prefill and decode_step — the cached-decode-vs-full-forward argmax
@@ -131,7 +132,7 @@ def _readout(x, embed):
     them."""
     from kind_tpu_sim.models.quant import readout
 
-    return readout(x, embed)
+    return readout(x, embed, native=native)
 
 
 def _rms_norm(x, weight, eps=1e-6):
@@ -199,7 +200,7 @@ def _block_core(x, bparams, cfg: ModelConfig, positions, mesh=None):
 
     b, t, _ = x.shape
     h = _rms_norm(x, bparams["attn_norm"])
-    qkv = linear(h, bparams["wqkv"])
+    qkv = linear(h, bparams["wqkv"], native=cfg.int8_native)
     q_dim = cfg.n_heads * cfg.head_dim
     kv_dim = cfg.kv_heads * cfg.head_dim
     q, k, v = jnp.split(qkv, [q_dim, q_dim + kv_dim], axis=-1)
@@ -229,7 +230,7 @@ def _block_core(x, bparams, cfg: ModelConfig, positions, mesh=None):
     else:
         attn = _attention(q, k, v)
     attn = attn.reshape(b, t, cfg.d_model)
-    x = x + linear(attn, bparams["wo"])
+    x = x + linear(attn, bparams["wo"], native=cfg.int8_native)
 
     h = _rms_norm(x, bparams["mlp_norm"])
     if "moe" in bparams:
@@ -238,8 +239,9 @@ def _block_core(x, bparams, cfg: ModelConfig, positions, mesh=None):
         out, aux = moe_mlp(h, bparams["moe"],
                            MoeConfig(n_experts=cfg.n_experts))
         return x + out, aux, k, v
-    act = jax.nn.gelu(linear(h, bparams["w_up"]))
-    return (x + linear(act, bparams["w_down"]),
+    act = jax.nn.gelu(
+        linear(h, bparams["w_up"], native=cfg.int8_native))
+    return (x + linear(act, bparams["w_down"], native=cfg.int8_native),
             jnp.float32(0), k, v)
 
 
@@ -288,7 +290,7 @@ def forward(params: Params, tokens, cfg: ModelConfig,
     # fp32 params keep the historical fp32 readout numerics; a bf16
     # serving snapshot (models/decode.py serving_params) halves the
     # HBM read of the largest weight and runs the MXU at full rate.
-    logits = _readout(x, params["embed"])
+    logits = _readout(x, params["embed"], cfg.int8_native)
     if return_aux:
         return logits, aux_total
     return logits
