@@ -325,14 +325,19 @@ def _block_decode_chunk(x, bparams, cfg: ModelConfig, big, small,
     the bf16 chunk buffer holding this chunk's tokens (positions
     base..base+i-1); the in-flight token attends directly. Exact
     causal math: the three score groups partition positions <= pos.
+
+    ``base`` is a scalar for the single-sequence engine, or a (b,)
+    vector of per-slot occupancies for the continuous-batching grid
+    (models/serving.py) — each slot then attends over its own
+    [0, base[b]) prefix of the big cache.
     """
     import jax
     import jax.numpy as jnp
 
     b, _ = x.shape
     dtype = jnp.dtype(cfg.dtype)
-    pos = base + i
-    positions = jnp.full((b, 1), pos)
+    base = jnp.broadcast_to(base, (b,))
+    positions = (base + i)[:, None]
     qg, k, v = _attend_token(x, bparams, cfg, positions)
     scale = cfg.head_dim ** -0.5
 
@@ -340,8 +345,8 @@ def _block_decode_chunk(x, bparams, cfg: ModelConfig, big, small,
     c_len = small["k"].shape[1]
     sc_big = _cache_scores(qg, big["k"], scale,
                            native=cfg.int8_native)
-    sc_big = jnp.where(
-        (jnp.arange(s_big) < base)[None, None, None, :], sc_big, -1e30)
+    big_mask = jnp.arange(s_big)[None, :] < base[:, None]
+    sc_big = jnp.where(big_mask[:, None, None, :], sc_big, -1e30)
     sc_sm = _cache_scores(qg, small["k"], scale)
     sc_sm = jnp.where(
         (jnp.arange(c_len) < i)[None, None, None, :], sc_sm, -1e30)

@@ -1,0 +1,414 @@
+"""Continuous-batching serving engine (the vLLM-analog layer).
+
+The reference ships vLLM as a pod (`pods/vllm-cpu-pod.yaml`,
+/root/reference/pods/vllm-cpu-pod.yaml:16-20) — an inference server
+whose core trick is continuous batching: sequences of different
+lengths share one decode batch, finished sequences free their slot
+immediately, and new requests join at the next scheduling boundary
+instead of waiting for the whole batch to drain. This module is that
+engine rebuilt TPU-first on top of models/decode.py's chunked cache:
+
+* **Static shapes.** The batch is a fixed grid of ``max_slots`` slots
+  over a preallocated (slots, max_len) KV cache; jit traces once.
+  Ragged sequence state lives in device vectors (``lengths``,
+  ``last_token``, ``active``) — never in Python control flow.
+* **Ragged chunked decode.** The single-sequence engine keeps the big
+  cache loop-invariant per chunk (decode.py's HBM-roofline trick).
+  Here the chunk base is a per-slot VECTOR: each slot attends over
+  [0, lengths[b]) of the big cache, its own chunk-buffer prefix, and
+  its in-flight k/v — three exactly-partitioned score groups, per
+  slot. The once-per-chunk merge scatters each slot's chunk rows at
+  its own offset (vmapped dynamic_update_slice).
+* **Admission at chunk boundaries.** Free slots are refilled from the
+  queue between chunks: one bucketed prefill (padded to the next
+  power of two so jit compiles O(log max_len) variants, not one per
+  prompt length) writes the prompt's k/v straight into the slot row.
+* **Donated buffers.** The cache is donated through both the prefill
+  and the chunk step, so XLA updates it in place across dispatches
+  instead of copying 100+ MB per call.
+
+Correctness contract: with a bf16 cache, a sequence decoded through a
+busy multi-tenant grid emits EXACTLY the tokens the single-sequence
+``decode.greedy_generate`` emits — slots are independent rows of
+every contraction (tests/test_serving.py proves prompt-length mixes,
+mid-flight admission, and eviction ordering).
+
+Reference behavior being stood in for: vllm serve --max-model-len /
+--max-num-seqs knobs (pods/vllm-cpu-pod.yaml:16-20).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from kind_tpu_sim.models.decode import (
+    _block_decode_chunk,
+    init_cache,
+)
+from kind_tpu_sim.models.transformer import (
+    ModelConfig,
+    Params,
+    _block_core,
+    _readout,
+    _rms_norm,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Engine knobs (the vLLM --max-num-seqs / --max-model-len analog)."""
+
+    max_slots: int = 4        # concurrent sequences (the decode batch)
+    max_len: int = 128        # per-slot KV capacity (prompt + generated)
+    chunk: int = 16           # decode tokens per dispatch between
+    #                           scheduling boundaries (admission /
+    #                           completion checks happen every chunk)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request; ``max_new`` includes the first sampled
+    token. ``eos_id`` stops generation early when emitted."""
+
+    request_id: str
+    prompt: List[int]
+    max_new: int
+    eos_id: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Completion:
+    request_id: str
+    prompt: List[int]
+    tokens: List[int]          # generated tokens (eos included if hit)
+    finish_reason: str         # "stop" (eos) or "length"
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    """Next power of two >= n (>= lo): bounds prefill recompiles."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------
+# jitted kernels (pure functions of device state)
+
+
+def _prefill_into_slot(params, cfg: ModelConfig, cache, tokens,
+                       true_len, slot):
+    """Run the prompt (1, L_pad) through the forward, writing k/v for
+    positions < true_len into row ``slot`` of the donated cache.
+    Returns (cache, first greedy token (scalar)).
+
+    Padding discipline: positions >= true_len still flow through the
+    matmuls (static shapes) but their k/v are masked to zero before
+    the write and their scores never matter later because every decode
+    step masks the big cache at ``arange(max_len) < lengths[slot]``.
+    The returned token is read from the TRUE last position, with
+    causal attention, so padding cannot leak into it.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import QuantArray, embed_lookup, quantize
+
+    _, t_p = tokens.shape
+    dtype = jnp.dtype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(t_p), (1, t_p))
+    x = embed_lookup(params["embed"], tokens, dtype)
+    keep = (jnp.arange(t_p) < true_len)[None, :, None, None]
+
+    new_cache = []
+    for bparams, layer_cache in zip(params["blocks"], cache):
+        x, _, k, v = _block_core(x, bparams, cfg, positions)
+
+        def write(arr, upd):
+            upd = jnp.where(keep, upd, 0)[:, :arr.shape[1]]
+            pad = arr.shape[1] - upd.shape[1]
+            upd = jnp.pad(upd, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            if isinstance(arr, QuantArray):
+                qa = quantize(upd, axis=3)
+                return QuantArray(
+                    q=jax.lax.dynamic_update_slice(
+                        arr.q, qa.q.astype(arr.q.dtype),
+                        (slot, 0, 0, 0)),
+                    scale=jax.lax.dynamic_update_slice(
+                        arr.scale, qa.scale, (slot, 0, 0, 0)),
+                )
+            return jax.lax.dynamic_update_slice(
+                arr, upd.astype(arr.dtype), (slot, 0, 0, 0))
+
+        new_cache.append({"k": write(layer_cache["k"], k),
+                          "v": write(layer_cache["v"], v)})
+
+    last = jnp.take_along_axis(
+        x, (true_len - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
+    h = _rms_norm(last[:, 0, :], params["final_norm"])
+    logits = _readout(h, params["embed"], cfg.int8_native)
+    return new_cache, jnp.argmax(logits[0], -1).astype(jnp.int32)
+
+
+def _merge_row(arr_row, upd_row, start):
+    """Write upd_row (chunk, kv, hd) into arr_row (max_len, kv, hd) at
+    ``start`` — vmapped over slots so each row lands at its own
+    offset."""
+    import jax
+
+    return jax.lax.dynamic_update_slice(arr_row, upd_row, (start, 0, 0))
+
+
+def _scatter_chunk(cache_arr, small_arr, starts, active, cfg):
+    """Merge each slot's chunk-buffer rows into the big cache at that
+    slot's offset. Slots that must not be written — inactive ones,
+    and slots whose window would run past max_len — re-write their
+    existing bytes instead (a dynamic_update_slice must write
+    something; reading the current window back makes it a no-op).
+
+    The overflow case is reachable by an active slot on its final
+    round (lengths > max_len - chunk with the last emissions still
+    owed); suppressing the write is safe because the scheduler
+    retires such a slot this same round — submit() guarantees
+    prompt + max_new <= max_len, so positions past the budget are
+    never attended. Gating (rather than clamping) the write keeps
+    that safety structural: a surviving slot would keep a consistent
+    cache instead of a silently misaligned one."""
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import QuantArray, quantize
+
+    chunk = small_arr.shape[1]
+    fits = starts + chunk <= cache_arr.shape[1]
+    active = active & fits
+    starts = jnp.clip(starts, 0, cache_arr.shape[1] - chunk)
+
+    if isinstance(cache_arr, QuantArray):
+        qa = quantize(small_arr, axis=3)
+        cur_q = jax.vmap(
+            lambda row, s: jax.lax.dynamic_slice(
+                row, (s, 0, 0), (chunk,) + row.shape[1:])
+        )(cache_arr.q, starts)
+        cur_s = jax.vmap(
+            lambda row, s: jax.lax.dynamic_slice(
+                row, (s, 0, 0), (chunk,) + row.shape[1:])
+        )(cache_arr.scale, starts)
+        sel = active[:, None, None, None]
+        q_upd = jnp.where(sel, qa.q.astype(cache_arr.q.dtype), cur_q)
+        s_upd = jnp.where(sel, qa.scale, cur_s)
+        return QuantArray(
+            q=jax.vmap(_merge_row)(cache_arr.q, q_upd, starts),
+            scale=jax.vmap(_merge_row)(cache_arr.scale, s_upd, starts),
+        )
+    cur = jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice(
+            row, (s, 0, 0), (chunk,) + row.shape[1:])
+    )(cache_arr, starts)
+    upd = jnp.where(active[:, None, None, None],
+                    small_arr.astype(cache_arr.dtype), cur)
+    return jax.vmap(_merge_row)(cache_arr, upd, starts)
+
+
+def _decode_chunk(params, cfg: ModelConfig, cache, lengths, last_token,
+                  active, chunk: int):
+    """One scheduling quantum: ``chunk`` greedy tokens for every slot
+    (inactive slots compute too — lockstep SPMD — but their cache
+    write-back is suppressed and their emissions ignored by the host).
+    Returns (cache, lengths, last_token, emitted (slots, chunk))."""
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import embed_lookup
+
+    b = last_token.shape[0]
+    dtype = jnp.dtype(cfg.dtype)
+    small0 = [
+        {
+            "k": jnp.zeros((b, chunk, cfg.kv_heads, cfg.head_dim),
+                           dtype),
+            "v": jnp.zeros((b, chunk, cfg.kv_heads, cfg.head_dim),
+                           dtype),
+        }
+        for _ in range(cfg.n_layers)
+    ]
+
+    def step(carry, i):
+        token, small = carry
+        x = embed_lookup(params["embed"], token, dtype)
+        new_small = []
+        for bparams, big_lc, small_lc in zip(params["blocks"], cache,
+                                             small):
+            # decode's chunk block with a per-slot base vector: each
+            # slot attends over its own [0, lengths[b]) prefix.
+            x, small_lc = _block_decode_chunk(
+                x, bparams, cfg, big_lc, small_lc, lengths, i)
+            new_small.append(small_lc)
+        x = _rms_norm(x, params["final_norm"])
+        logits = _readout(x, params["embed"], cfg.int8_native)
+        nxt = jnp.argmax(logits, -1).astype(token.dtype)
+        nxt = jnp.where(active, nxt, token)  # inactive slots hold
+        return (nxt, new_small), nxt
+
+    (token, small), emitted = jax.lax.scan(
+        step, (last_token, small0), jnp.arange(chunk))
+    new_cache = [
+        {
+            "k": _scatter_chunk(big_lc["k"], small_lc["k"], lengths,
+                                active, cfg),
+            "v": _scatter_chunk(big_lc["v"], small_lc["v"], lengths,
+                                active, cfg),
+        }
+        for big_lc, small_lc in zip(cache, small)
+    ]
+    lengths = jnp.where(active, lengths + chunk, lengths)
+    return new_cache, lengths, token, emitted.swapaxes(0, 1)
+
+
+# ---------------------------------------------------------------------
+# host-side engine
+
+
+class ServingEngine:
+    """Continuous-batching scheduler around the jitted kernels.
+
+    Host state is the queue + per-slot bookkeeping; device state is
+    the cache grid and the (lengths, last_token, active) vectors.
+    ``run()`` drains the queue; ``submit``/``step_round``/``poll``
+    expose the incremental surface the tests drive mid-flight.
+    """
+
+    def __init__(self, params: Params, cfg: ModelConfig,
+                 serving: ServingConfig = ServingConfig()):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        self.params = params
+        self.cfg = cfg
+        self.serving = serving
+        n = serving.max_slots
+        self.cache = init_cache(cfg, n, serving.max_len)
+        self.lengths = jnp.zeros((n,), jnp.int32)
+        self.last_token = jnp.zeros((n,), jnp.int32)
+        self.active = jnp.zeros((n,), bool)
+
+        self.queue: List[Request] = []
+        self.slot_req: List[Optional[Request]] = [None] * n
+        self.slot_emitted: List[List[int]] = [[] for _ in range(n)]
+        self.finished: List[Completion] = []
+
+        # cache is donated: XLA updates the 100+ MB grid in place.
+        self._prefill = jax.jit(
+            functools.partial(_prefill_into_slot, params, cfg),
+            donate_argnums=(0,))
+        self._chunk = jax.jit(
+            functools.partial(_decode_chunk, params, cfg,
+                              chunk=serving.chunk),
+            donate_argnums=(0,))
+
+    # -- public surface ------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        need = len(request.prompt) + request.max_new
+        if need > self.serving.max_len:
+            raise ValueError(
+                f"request {request.request_id} needs {need} positions; "
+                f"slot capacity is {self.serving.max_len}")
+        if request.max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        self.queue.append(request)
+
+    def step_round(self) -> None:
+        """One scheduling quantum: admit into free slots, then decode
+        one chunk for the whole grid, then retire finished slots."""
+        self._admit()
+        if not any(r is not None for r in self.slot_req):
+            return
+        (self.cache, self.lengths, self.last_token,
+         emitted) = self._chunk(self.cache, self.lengths,
+                                self.last_token, self.active)
+        self._retire(emitted)
+
+    def poll(self) -> List[Completion]:
+        out, self.finished = self.finished, []
+        return out
+
+    def run(self) -> List[Completion]:
+        """Drain queue + grid to completion; returns all completions
+        in finish order."""
+        done: List[Completion] = []
+        while (self.queue or
+               any(r is not None for r in self.slot_req)):
+            self.step_round()
+            done.extend(self.poll())
+        return done
+
+    # -- internals -----------------------------------------------------
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        for slot in range(self.serving.max_slots):
+            if self.slot_req[slot] is not None or not self.queue:
+                continue
+            req = self.queue.pop(0)
+            t_p = len(req.prompt)
+            pad = _bucket(t_p)
+            tokens = np.zeros((1, pad), np.int32)
+            tokens[0, :t_p] = req.prompt
+            self.cache, first = self._prefill(
+                self.cache, jnp.asarray(tokens),
+                jnp.int32(t_p), slot)
+            first = int(first)
+            self.slot_req[slot] = req
+            self.slot_emitted[slot] = [first]
+            self.lengths = self.lengths.at[slot].set(t_p)
+            self.last_token = self.last_token.at[slot].set(first)
+            active = first != req.eos_id and req.max_new > 1
+            self.active = self.active.at[slot].set(active)
+            if not active:
+                self._finish(slot)
+
+    def _retire(self, emitted) -> None:
+        import numpy as np
+
+        emitted = np.asarray(emitted)
+        for slot, req in enumerate(self.slot_req):
+            if req is None or not bool(self.active[slot]):
+                continue
+            have = self.slot_emitted[slot]
+            budget = req.max_new - len(have)
+            new = emitted[slot, :budget].tolist()
+            if req.eos_id is not None and req.eos_id in new:
+                new = new[:new.index(req.eos_id) + 1]
+            have.extend(new)
+            if (len(have) >= req.max_new or
+                    (req.eos_id is not None and
+                     have[-1] == req.eos_id)):
+                self._finish(slot)
+
+    def _finish(self, slot: int) -> None:
+        req = self.slot_req[slot]
+        toks = self.slot_emitted[slot]
+        reason = ("stop" if req.eos_id is not None and toks and
+                  toks[-1] == req.eos_id else "length")
+        self.finished.append(Completion(
+            request_id=req.request_id, prompt=list(req.prompt),
+            tokens=list(toks), finish_reason=reason))
+        self.slot_req[slot] = None
+        self.slot_emitted[slot] = []
+        self.active = self.active.at[slot].set(False)
+
+    def report(self) -> Dict[str, Any]:
+        """Pod/bench-friendly state snapshot."""
+        return {
+            "slots": self.serving.max_slots,
+            "active": int(sum(1 for r in self.slot_req
+                              if r is not None)),
+            "queued": len(self.queue),
+            "finished": len(self.finished),
+        }
