@@ -77,6 +77,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="TPU slice topology, e.g. 4x4 (v5e) or 2x2x4 (v4)",
     )
     create.add_argument(
+        "--num-slices", type=int, default=1,
+        help=(
+            "simulate a TPU multislice job: N identical ICI slices "
+            "joined over DCN (one set of kind workers per slice; "
+            "pods get MEGASCALE_* env from the device plugin)"
+        ),
+    )
+    create.add_argument(
         "--capacity-mode", choices=["plugin", "patch"], default="plugin",
         help=(
             "plugin: durable capacity from the device plugin (default); "
@@ -277,6 +285,7 @@ def config_from_args(args: argparse.Namespace) -> SimConfig:
             vendor=args.vendor,
             accelerator=args.accelerator,
             tpu_topology=args.topology,
+            num_slices=args.num_slices,
             capacity_mode=args.capacity_mode,
             gpu_workers=args.gpu_workers,
             gpus_per_node=args.gpus_per_node,
@@ -339,10 +348,14 @@ class Simulator:
                 self.plugin.deploy(cfg.vendor, image)
         if cfg.vendor == "tpu":
             s = cfg.slice
+            prefix = (f"{cfg.num_slices} x " if cfg.num_slices > 1
+                      else "")
             log.info(
-                "simulated %s slice ready: topology %s, %d workers x %d "
-                "google.com/tpu", s.accelerator_type,
-                topo.format_topology(s.dims), s.num_hosts, s.chips_per_host,
+                "simulated %s%s slice%s ready: topology %s, %d workers"
+                " x %d google.com/tpu", prefix, s.accelerator_type,
+                "s" if cfg.num_slices > 1 else "",
+                topo.format_topology(s.dims), cfg.workers,
+                s.chips_per_host,
             )
         print(f"Simulated {cfg.vendor} kind cluster is ready "
               f"('{cfg.cluster_name}')")

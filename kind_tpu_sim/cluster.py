@@ -117,13 +117,18 @@ class ClusterManager:
 
     def _prepare_tpu_workers(self, workers: List[str]) -> None:
         s = self.cfg.slice
-        if len(workers) != s.num_hosts:
+        ms = self.cfg.multislice
+        if len(workers) != ms.num_hosts:
             raise RuntimeError(
-                f"cluster has {len(workers)} workers but slice "
-                f"{s.accelerator_type} needs {s.num_hosts}"
+                f"cluster has {len(workers)} workers but "
+                f"{ms.num_slices}x {s.accelerator_type} needs "
+                f"{ms.num_hosts}"
             )
-        for worker_id, node in enumerate(workers):
-            for key, value in s.node_labels(worker_id).items():
+        for global_id, node in enumerate(workers):
+            # Row-major: slice 0's hosts first, then slice 1's, ...
+            slice_id, worker_id = divmod(global_id, s.num_hosts)
+            for key, value in ms.node_labels(slice_id,
+                                             worker_id).items():
                 self._label(node, key, value)
             self._label(node, "node-role.kubernetes.io/worker", "")
             kubectl(

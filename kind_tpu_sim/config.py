@@ -26,6 +26,7 @@ class SimConfig:
     vendor: str = "tpu"
     accelerator: str = topo.DEFAULT_ACCELERATOR
     tpu_topology: str = topo.DEFAULT_TOPOLOGY
+    num_slices: int = 1          # >1: TPU multislice (DCN tier)
     gpus_per_node: int = 2       # rocm/nvidia parity (kind-gpu-sim.sh:113,116)
     gpu_workers: int = 2         # worker count for rocm/nvidia clusters
 
@@ -53,6 +54,8 @@ class SimConfig:
             raise ValueError(f"bad registry port {self.registry_port}")
         if self.gpus_per_node < 1 or self.gpu_workers < 1:
             raise ValueError("gpus_per_node and gpu_workers must be >= 1")
+        if self.num_slices < 1:
+            raise ValueError("num_slices must be >= 1")
 
     @property
     def slice(self) -> topo.SliceTopology:
@@ -60,8 +63,15 @@ class SimConfig:
         return topo.make_slice(self.accelerator, self.tpu_topology)
 
     @property
+    def multislice(self) -> topo.MultiSlice:
+        """All slices of the simulated job (num_slices may be 1)."""
+        return topo.MultiSlice(slice_topo=self.slice,
+                               num_slices=self.num_slices)
+
+    @property
     def workers(self) -> int:
-        """kind worker-node count: one per TPU host, or gpu_workers."""
+        """kind worker-node count: one per TPU host across every
+        slice, or gpu_workers."""
         if self.vendor == "tpu":
-            return self.slice.num_hosts
+            return self.num_slices * self.slice.num_hosts
         return self.gpu_workers

@@ -163,6 +163,25 @@ def tpu_plugin_daemonset(cfg: SimConfig, image: str) -> str:
             "valueFrom": {"fieldRef": {"fieldPath": "spec.nodeName"}},
         },
     ]
+    if cfg.num_slices > 1:
+        # Multislice: the plugin decomposes the node's global worker
+        # index into (slice, local worker) and injects the MEGASCALE_*
+        # cross-slice contract at Allocate. Hostnames become the full
+        # cross-slice list; the plugin narrows to this slice's window.
+        ms = cfg.multislice
+        all_hosts = ms.hostnames()
+        env = [e for e in env if e.get("name") != "TPU_SIM_HOSTNAMES"]
+        env.extend([
+            {"name": "TPU_SIM_HOSTNAMES",
+             "value": ",".join(all_hosts)},
+            {"name": "TPU_SIM_NUM_SLICES",
+             "value": str(ms.num_slices)},
+            {"name": "TPU_SIM_HOSTS_PER_SLICE",
+             "value": str(s.num_hosts)},
+            {"name": "TPU_SIM_MEGASCALE_COORDINATOR",
+             "value": ms.megascale_env(0)[
+                 "MEGASCALE_COORDINATOR_ADDRESS"]},
+        ])
     doc = {
         "apiVersion": "apps/v1",
         "kind": "DaemonSet",
@@ -282,7 +301,7 @@ def gpu_plugin_daemonset(cfg: SimConfig, vendor: str, image: str) -> str:
 
 
 def jax_multihost_manifest(cfg: SimConfig) -> str:
-    """Multi-host JAX Service + StatefulSet derived from the slice topology.
+    """Multi-host JAX Services + StatefulSets from the slice topology.
 
     The reference has no analog (it hardcodes everything); round 1 shipped
     a static 2x8 ``pods/jax-multihost.yaml``.  This generator derives
@@ -291,7 +310,30 @@ def jax_multihost_manifest(cfg: SimConfig) -> str:
     produce a working manifest without hand edits.  Hostnames follow
     :func:`kind_tpu_sim.topology.default_hostnames` (StatefulSet ordinal
     DNS under the headless ``tpu-sim`` service).
+
+    Multislice (``cfg.num_slices > 1``): one Service + StatefulSet PER
+    SLICE, each pinned to its slice's nodes via the slice-id label —
+    every slice is its own jax.distributed world whose hostnames match
+    :meth:`kind_tpu_sim.topology.MultiSlice.hostnames` (what the device
+    plugin windows per slice at Allocate). Cross-slice identity arrives
+    in the pods as the MEGASCALE_* env.
     """
+    if cfg.num_slices > 1:
+        docs = []
+        for sid in range(cfg.num_slices):
+            docs.append(_jax_world_manifest(
+                cfg, name=f"jax-tpu-s{sid}",
+                service=f"tpu-sim-s{sid}",
+                extra_selector={topo.LABEL_SLICE_ID: str(sid)},
+                slice_note=f"{sid}/{cfg.num_slices}"))
+        return "\n".join(docs)
+    return _jax_world_manifest(cfg, name="jax-tpu", service="tpu-sim",
+                               extra_selector={}, slice_note=None)
+
+
+def _jax_world_manifest(cfg: SimConfig, name: str, service: str,
+                        extra_selector: Dict[str, str],
+                        slice_note) -> str:
     from kind_tpu_sim.tpu_platform import (
         POD_JAX_REQUIREMENT,
         POD_SNIPPET,
@@ -300,7 +342,7 @@ def jax_multihost_manifest(cfg: SimConfig) -> str:
     s = cfg.slice
     replicas = s.num_hosts
     chips = s.chips_per_host
-    coordinator = topo.default_hostnames(replicas)[0]
+    coordinator = f"{name}-0.{service}.default.svc.cluster.local"
     payload = f"""\
 pip install --quiet {POD_JAX_REQUIREMENT}
 export XLA_FLAGS="--xla_force_host_platform_device_count={chips}"
@@ -341,41 +383,42 @@ print("GLOBAL PSUM OK:", float(result[0]),
 PYEOF
 sleep 3600
 """
-    service = {
+    service_doc = {
         "apiVersion": "v1",
         "kind": "Service",
-        "metadata": {"name": "tpu-sim"},
+        "metadata": {"name": service},
         "spec": {
             "clusterIP": "None",
-            "selector": {"app": "jax-tpu"},
+            "selector": {"app": name},
             "ports": [{"name": "coordinator", "port": 8476}],
         },
     }
     statefulset = {
         "apiVersion": "apps/v1",
         "kind": "StatefulSet",
-        "metadata": {"name": "jax-tpu"},
+        "metadata": {"name": name},
         "spec": {
-            "serviceName": "tpu-sim",
+            "serviceName": service,
             "replicas": replicas,
             "podManagementPolicy": "Parallel",
-            "selector": {"matchLabels": {"app": "jax-tpu"}},
+            "selector": {"matchLabels": {"app": name}},
             "template": {
-                "metadata": {"labels": {"app": "jax-tpu"}},
+                "metadata": {"labels": {"app": name}},
                 "spec": {
                     "affinity": {
                         "podAntiAffinity": {
                             "requiredDuringSchedulingIgnoredDuringExecution": [
                                 {
                                     "labelSelector": {
-                                        "matchLabels": {"app": "jax-tpu"}
+                                        "matchLabels": {"app": name}
                                     },
                                     "topologyKey": "kubernetes.io/hostname",
                                 }
                             ]
                         }
                     },
-                    "nodeSelector": _node_selector("tpu"),
+                    "nodeSelector": {**_node_selector("tpu"),
+                                     **extra_selector},
                     "tolerations": _taint_toleration("tpu"),
                     "containers": [
                         {
@@ -402,17 +445,19 @@ sleep 3600
             },
         },
     }
+    what = (f"slice {slice_note}" if slice_note
+            else "the whole simulated slice")
     header = (
-        "# Multi-host JAX over the whole simulated slice — the DCN tier.\n"
+        f"# Multi-host JAX over {what} — the DCN tier.\n"
         "# GENERATED by kind_tpu_sim.manifests.jax_multihost_manifest for\n"
         f"# {s.accelerator_type} topology {topo.format_topology(s.dims)} "
         f"({replicas} hosts x {chips} chips).\n"
         "# Regenerate: kind-tpu-sim manifests jax-multihost "
         f"--accelerator={s.spec.gke_type} "
         f"--topology={topo.format_topology(s.dims)}\n"
-        "# CI greps for \"GLOBAL PSUM OK\" on jax-tpu-0.\n"
+        f"# CI greps for \"GLOBAL PSUM OK\" on {name}-0.\n"
     )
-    return header + to_yaml(service) + "---\n" + to_yaml(statefulset)
+    return header + to_yaml(service_doc) + "---\n" + to_yaml(statefulset)
 
 
 def plugin_app_label(vendor: str) -> str:

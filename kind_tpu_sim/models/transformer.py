@@ -360,14 +360,23 @@ def param_specs(cfg: ModelConfig, mesh=None):
 
 
 def batch_spec(mesh=None):
-    """Tokens (batch, seq): batch over 'data', seq over 'seq' if present."""
+    """Tokens (batch, seq): batch over 'data' — jointly over
+    ('dcn', 'data') on a multislice mesh, so each ICI slice holds a
+    data shard and only the gradient psum crosses DCN — seq over
+    'seq' if present."""
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:
         return P(None, None)
     names = mesh.axis_names
+    if "dcn" in names and "data" in names:
+        batch_axes = ("dcn", "data")
+    elif "data" in names:
+        batch_axes = "data"
+    else:
+        batch_axes = None
     return P(
-        "data" if "data" in names else None,
+        batch_axes,
         "seq" if "seq" in names else None,
     )
 

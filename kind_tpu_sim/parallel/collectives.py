@@ -125,6 +125,50 @@ def all_gather_smoke(mesh=None) -> Dict[str, object]:
     }
 
 
+def hierarchical_psum_smoke(mesh) -> Dict[str, object]:
+    """Two-tier reduction over a multislice mesh: reduce within each
+    ICI slice first, then across slices over 'dcn' — the traffic
+    pattern of multislice data parallelism (per-slice gradient
+    reduce-scatter on ICI, cross-slice psum on DCN).
+
+    Verifies both tiers separately: after the ICI-only psum every
+    device in a slice holds that slice's subtotal (slices differ);
+    after the DCN psum every device holds the global total.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if "dcn" not in mesh.axis_names:
+        raise ValueError(f"mesh has no 'dcn' axis: {mesh.axis_names}")
+    ici_axes = tuple(a for a in mesh.axis_names if a != "dcn")
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=P(*mesh.axis_names),
+        out_specs=(P("dcn"), P()),
+    )
+    def two_tier(x):
+        ici = jax.lax.psum(x, ici_axes)       # within-slice (ICI)
+        return (ici, jax.lax.psum(ici, "dcn"))  # cross-slice (DCN)
+
+    shape = mesh.devices.shape
+    x = jnp.arange(1.0, mesh.devices.size + 1.0).reshape(shape)
+    ici_tot, global_tot = two_tier(x)
+    per_slice = np.array(x).reshape(shape[0], -1).sum(axis=1)
+    ici_arr = np.array(ici_tot).reshape(-1)
+    ok_ici = np.allclose(ici_arr, per_slice)
+    ok_global = np.allclose(np.array(global_tot), per_slice.sum())
+    return {
+        "collective": "hierarchical_psum",
+        "slices": shape[0],
+        "ici_subtotals": ici_arr.tolist(),
+        "global": float(np.array(global_tot).reshape(-1)[0]),
+        "ok": bool(ok_ici and ok_global),
+    }
+
+
 def run_all(mesh=None) -> Dict[str, object]:
     """The full fabric smoke suite; `ok` only if every collective is."""
     results = {

@@ -80,6 +80,44 @@ def training_mesh(
     return Mesh(arr.reshape(data, model), axis_names=("data", "model"))
 
 
+def multislice_mesh(
+    num_slices: int,
+    data: int,
+    model: int,
+    seq: int = 1,
+    devices: Optional[Sequence] = None,
+):
+    """Hierarchical ('dcn', 'data', 'model'[, 'seq']) mesh for TPU
+    multislice: the outermost 'dcn' axis partitions the device list
+    into ``num_slices`` contiguous ICI domains (on real hardware the
+    grouping comes from each device's slice_index; the simulator's
+    virtual devices are grouped by position, matching how the fake
+    slices are laid out).
+
+    The layout recipe (scaling-book): collectives over 'dcn' are the
+    slow tier, so only gradient/data traffic should ride it — shard
+    batch over ('dcn', 'data'), keep 'model'/'seq' inside a slice.
+    Params never mention 'dcn', so GSPMD replicates them per slice and
+    inserts the cross-slice gradient psum automatically.
+    """
+    from jax.sharding import Mesh
+
+    per_slice = data * model * seq
+    want = num_slices * per_slice
+    if devices is None:
+        devices = _devices(want)
+    if len(devices) != want:
+        raise ValueError(
+            f"multislice {num_slices}x({data}x{model}x{seq}) needs "
+            f"{want} devices, got {len(devices)}")
+    arr = np.array(devices)
+    if seq > 1:
+        return Mesh(arr.reshape(num_slices, data, model, seq),
+                    axis_names=("dcn", "data", "model", "seq"))
+    return Mesh(arr.reshape(num_slices, data, model),
+                axis_names=("dcn", "data", "model"))
+
+
 def auto_training_mesh(n_devices: Optional[int] = None,
                        with_seq: bool = False):
     """Split available devices into a near-square (data, model) mesh."""

@@ -31,6 +31,7 @@ LABEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 LABEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
 LABEL_WORKER_ID = "kind-tpu-sim.dev/worker-id"
 LABEL_HOST_COORD = "kind-tpu-sim.dev/host-coord"
+LABEL_SLICE_ID = "kind-tpu-sim.dev/slice-id"  # multislice (DCN) tier
 LABEL_HARDWARE_TYPE = "hardware-type"  # selector key kept from the reference
 
 # Taint applied to simulated TPU nodes (GKE uses google.com/tpu=present).
@@ -275,3 +276,114 @@ def make_slice(
             f"known: {sorted(ACCELERATORS)}"
         ) from exc
     return SliceTopology(spec=spec, dims=parse_topology(topology))
+
+
+# ---------------------------------------------------------------------
+# multislice (DCN tier)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiSlice:
+    """N identical ICI slices joined over DCN (TPU multislice).
+
+    The real system: each slice is its own ICI domain; traffic between
+    slices rides the data-center network, coordinated by libtpu's
+    "megascale" layer, which workers discover through MEGASCALE_* env
+    vars. The simulator mirrors exactly that split: per-slice worker
+    identity stays `SliceTopology.worker_env` (the ICI contract), this
+    class adds the cross-slice contract (env + labels), and
+    :func:`kind_tpu_sim.parallel.mesh.multislice_mesh` exposes the
+    hierarchy to JAX as an outermost 'dcn' mesh axis so sharding
+    annotations decide what rides DCN (data parallelism) and what
+    stays ICI-local (model/seq axes).
+    """
+
+    slice_topo: SliceTopology
+    num_slices: int
+
+    def __post_init__(self) -> None:
+        if self.num_slices < 1:
+            raise ValueError("num_slices must be >= 1")
+
+    @property
+    def num_chips(self) -> int:
+        return self.num_slices * self.slice_topo.num_chips
+
+    @property
+    def num_hosts(self) -> int:
+        return self.num_slices * self.slice_topo.num_hosts
+
+    def _check_slice(self, slice_id: int) -> None:
+        if not 0 <= slice_id < self.num_slices:
+            raise ValueError(
+                f"slice_id {slice_id} out of range for "
+                f"{self.num_slices}-slice job")
+
+    def node_labels(self, slice_id: int, worker_id: int) -> Dict[str, str]:
+        """Per-node labels: the slice's own labels plus the slice id,
+        so scheduling can pin a replica group to one ICI domain."""
+        self._check_slice(slice_id)
+        labels = dict(self.slice_topo.node_labels(worker_id))
+        labels[LABEL_SLICE_ID] = str(slice_id)
+        return labels
+
+    def hostnames(self) -> List[str]:
+        """Canonical pod DNS names across every slice, slice-major —
+        THE global list the device plugin receives whole and windows
+        per slice. Single-slice jobs keep the historical names
+        (`default_hostnames`); multislice jobs get one StatefulSet +
+        headless Service per slice (manifests.jax_multihost_manifest),
+        hence per-slice DNS."""
+        if self.num_slices == 1:
+            return default_hostnames(self.slice_topo.num_hosts)
+        return [
+            f"jax-tpu-s{s}-{i}.tpu-sim-s{s}.default.svc.cluster.local"
+            for s in range(self.num_slices)
+            for i in range(self.slice_topo.num_hosts)
+        ]
+
+    def slice_hostnames(self, slice_id: int) -> List[str]:
+        """One slice's window of :meth:`hostnames` — each slice is its
+        own jax.distributed world."""
+        self._check_slice(slice_id)
+        h = self.slice_topo.num_hosts
+        return self.hostnames()[slice_id * h:(slice_id + 1) * h]
+
+    def megascale_env(
+        self, slice_id: int,
+        coordinator: str | None = None,
+    ) -> Dict[str, str]:
+        """libtpu's cross-slice discovery contract (the DCN analog of
+        ``worker_env``): which slice this worker belongs to, how many
+        slices exist, and where slice 0's coordinator lives."""
+        self._check_slice(slice_id)
+        if coordinator is None:
+            coordinator = self.hostnames()[0] + ":8476"
+        return {
+            "MEGASCALE_COORDINATOR_ADDRESS": coordinator,
+            "MEGASCALE_NUM_SLICES": str(self.num_slices),
+            "MEGASCALE_SLICE_ID": str(slice_id),
+        }
+
+    def worker_env(
+        self, slice_id: int, worker_id: int,
+        hostnames: List[str] | None = None,
+    ) -> Dict[str, str]:
+        """Full env for one worker: ICI identity (with THIS slice's
+        hostname window — each slice is its own jax.distributed
+        world) + DCN identity. Matches what the device plugin's
+        AllocateEnv computes from the global list."""
+        if hostnames is None:
+            hostnames = self.slice_hostnames(slice_id)
+        env = self.slice_topo.worker_env(worker_id, hostnames)
+        env.update(self.megascale_env(slice_id))
+        return env
+
+
+def make_multislice(
+    num_slices: int,
+    accelerator: str = DEFAULT_ACCELERATOR,
+    topology: str = DEFAULT_TOPOLOGY,
+) -> MultiSlice:
+    return MultiSlice(slice_topo=make_slice(accelerator, topology),
+                      num_slices=num_slices)

@@ -80,24 +80,59 @@ PluginConfig PluginConfig::FromEnv() {
   cfg.host_bounds = GetEnv("TPU_SIM_HOST_BOUNDS");
   cfg.hostnames = GetEnv("TPU_SIM_HOSTNAMES");
   cfg.unhealthy_file = GetEnv("TPU_SIM_UNHEALTHY_FILE");
+  cfg.num_slices = atoi(GetEnv("TPU_SIM_NUM_SLICES", "1").c_str());
+  if (cfg.num_slices < 1) cfg.num_slices = 1;
+  cfg.hosts_per_slice =
+      atoi(GetEnv("TPU_SIM_HOSTS_PER_SLICE", "0").c_str());
+  if (cfg.hosts_per_slice < 0) cfg.hosts_per_slice = 0;
+  cfg.megascale_coordinator = GetEnv("TPU_SIM_MEGASCALE_COORDINATOR");
+  cfg.ApplyDerivedDefaults();
+  return cfg;
+}
 
+void PluginConfig::ApplyDerivedDefaults() {
   // Single-host defaults matching kind_tpu_sim.topology for a
   // standalone plugin (v5e host shapes).
-  if (cfg.chips_per_host_bounds.empty()) {
-    switch (cfg.chips) {
-      case 1: cfg.chips_per_host_bounds = "1,1,1"; break;
-      case 4: cfg.chips_per_host_bounds = "2,2,1"; break;
-      case 8: cfg.chips_per_host_bounds = "2,4,1"; break;
+  if (chips_per_host_bounds.empty()) {
+    switch (chips) {
+      case 1: chips_per_host_bounds = "1,1,1"; break;
+      case 4: chips_per_host_bounds = "2,2,1"; break;
+      case 8: chips_per_host_bounds = "2,4,1"; break;
       default:
-        cfg.chips_per_host_bounds = std::to_string(cfg.chips) + ",1,1";
+        chips_per_host_bounds = std::to_string(chips) + ",1,1";
     }
   }
-  if (cfg.host_bounds.empty()) cfg.host_bounds = "1,1,1";
-  if (cfg.accelerator_type.empty()) {
-    cfg.accelerator_type = "v5litepod-" + std::to_string(cfg.chips);
+  if (host_bounds.empty()) host_bounds = "1,1,1";
+  if (accelerator_type.empty()) {
+    accelerator_type = "v5litepod-" + std::to_string(chips);
   }
-  if (cfg.hostnames.empty()) cfg.hostnames = "localhost";
-  return cfg;
+  if (hostnames.empty()) hostnames = "localhost";
+}
+
+std::string PluginConfig::Validate() const {
+  if (num_slices <= 1) return "";
+  if (hosts_per_slice < 1) {
+    return "TPU_SIM_NUM_SLICES > 1 requires TPU_SIM_HOSTS_PER_SLICE";
+  }
+  if (worker_id < 0 || worker_id >= num_slices * hosts_per_slice) {
+    return "worker_id " + std::to_string(worker_id) +
+           " out of range for " + std::to_string(num_slices) + "x" +
+           std::to_string(hosts_per_slice) + " hosts";
+  }
+  int names = hostnames.empty() ? 0 : 1;
+  for (char c : hostnames) {
+    if (c == ',') ++names;
+  }
+  if (names != num_slices * hosts_per_slice) {
+    return "TPU_SIM_HOSTNAMES lists " + std::to_string(names) +
+           " names; multislice needs num_slices * hosts_per_slice = " +
+           std::to_string(num_slices * hosts_per_slice);
+  }
+  if (megascale_coordinator.empty()) {
+    return "TPU_SIM_NUM_SLICES > 1 requires "
+           "TPU_SIM_MEGASCALE_COORDINATOR";
+  }
+  return "";
 }
 
 DevicePlugin::DevicePlugin(PluginConfig cfg) : cfg_(std::move(cfg)) {}
@@ -138,16 +173,47 @@ std::vector<std::pair<std::string, std::string>> DevicePlugin::AllocateEnv(
         std::to_string(LocalChipIndex(id, cfg_.worker_id, cfg_.chips));
     id_list += id;
   }
-  return {
+  // Multislice: decompose the node's global worker index into
+  // (slice, local worker) and narrow the hostname list to this
+  // slice's window — each slice is its own jax.distributed world,
+  // joined across slices by the MEGASCALE layer. Validate() (run at
+  // startup) guarantees worker_id and the hostname count fit the
+  // slice grid, so the decomposition is total here.
+  int local_worker = cfg_.worker_id;
+  std::string hostnames = cfg_.hostnames;
+  bool multislice = cfg_.num_slices > 1 && cfg_.hosts_per_slice > 0;
+  int slice_id = 0;
+  if (multislice) {
+    slice_id = cfg_.worker_id / cfg_.hosts_per_slice;
+    local_worker = cfg_.worker_id - slice_id * cfg_.hosts_per_slice;
+    std::vector<std::string> all;
+    std::istringstream is(cfg_.hostnames);
+    std::string name;
+    while (std::getline(is, name, ',')) all.push_back(name);
+    hostnames.clear();
+    for (int i = 0; i < cfg_.hosts_per_slice; ++i) {
+      if (i) hostnames += ",";
+      hostnames += all[slice_id * cfg_.hosts_per_slice + i];
+    }
+  }
+  std::vector<std::pair<std::string, std::string>> env = {
       {"TPU_ACCELERATOR_TYPE", cfg_.accelerator_type},
       {"TPU_CHIPS_PER_HOST_BOUNDS", cfg_.chips_per_host_bounds},
       {"TPU_HOST_BOUNDS", cfg_.host_bounds},
-      {"TPU_WORKER_ID", std::to_string(cfg_.worker_id)},
-      {"TPU_WORKER_HOSTNAMES", cfg_.hostnames},
+      {"TPU_WORKER_ID", std::to_string(local_worker)},
+      {"TPU_WORKER_HOSTNAMES", hostnames},
       {"TPU_SKIP_MDS_QUERY", "true"},
       {"TPU_VISIBLE_CHIPS", visible},
       {"TPU_SIM_DEVICE_IDS", id_list},
   };
+  if (multislice) {
+    env.emplace_back("MEGASCALE_NUM_SLICES",
+                     std::to_string(cfg_.num_slices));
+    env.emplace_back("MEGASCALE_SLICE_ID", std::to_string(slice_id));
+    env.emplace_back("MEGASCALE_COORDINATOR_ADDRESS",
+                     cfg_.megascale_coordinator);
+  }
+  return env;
 }
 
 void DevicePlugin::InstallHandlers() {

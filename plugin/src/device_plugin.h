@@ -37,11 +37,31 @@ struct PluginConfig {
   std::string host_bounds;             // e.g. "2,1,1"
   std::string hostnames;               // comma-separated worker DNS names
 
+  // Multislice (DCN tier): with num_slices > 1 the node's global
+  // worker index decomposes as slice_id * hosts_per_slice + local
+  // worker id; Allocate then injects the slice-local TPU_WORKER_ID,
+  // the per-slice window of `hostnames`, and libtpu's MEGASCALE_*
+  // cross-slice discovery contract (kind_tpu_sim.topology.MultiSlice
+  // is the Python source of truth for these values).
+  int num_slices = 1;
+  int hosts_per_slice = 0;             // 0 = single-slice (all hosts)
+  std::string megascale_coordinator;   // host:port of slice 0's coord
+
   // Fault injection: file listing unhealthy device IDs (one per line),
   // polled by ListAndWatch. Absent/empty file = all healthy.
   std::string unhealthy_file;
 
   bool register_with_kubelet = true;
+
+  // Fill chip-count-derived fields (accelerator_type,
+  // chips_per_host_bounds, host_bounds, hostnames) that are still
+  // empty — called by FromEnv, and again by main() after flag
+  // parsing clears fields whose env-time derivation went stale.
+  void ApplyDerivedDefaults();
+
+  // Cross-field consistency (multislice knobs vs worker_id vs
+  // hostname count). Empty string = valid; else the error to print.
+  std::string Validate() const;
 
   std::string endpoint_path() const {
     return socket_dir + "/" + socket_name;

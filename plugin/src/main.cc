@@ -61,6 +61,17 @@ int main(int argc, char** argv) {
         fprintf(stderr, "--chips must be >= 1\n");
         return 2;
       }
+      // Defaults derived from the env-time chip count go stale when
+      // the flag changes it; clear non-explicit fields so
+      // ApplyDerivedDefaults (the single derivation site) refills
+      // them from the new count.
+      if (getenv("TPU_SIM_ACCELERATOR_TYPE") == nullptr) {
+        cfg.accelerator_type.clear();
+      }
+      if (getenv("TPU_SIM_CHIPS_PER_HOST_BOUNDS") == nullptr) {
+        cfg.chips_per_host_bounds.clear();
+      }
+      cfg.ApplyDerivedDefaults();
     } else if (ParseFlag(arg, "worker-id", &value)) {
       cfg.worker_id = atoi(value.c_str());
     } else if (strcmp(arg, "--no-register") == 0) {
@@ -75,6 +86,12 @@ int main(int argc, char** argv) {
       Usage();
       return 2;
     }
+  }
+
+  std::string err = cfg.Validate();
+  if (!err.empty()) {
+    fprintf(stderr, "invalid configuration: %s\n", err.c_str());
+    return 2;
   }
 
   tpusim::DevicePlugin plugin(cfg);

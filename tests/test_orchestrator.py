@@ -87,6 +87,32 @@ def test_create_tpu_plugin_mode_command_stream(tmp_path, monkeypatch):
     assert sum(1 for c in cmds if "mkdir -p /etc/containerd/certs.d" in c) == 3
 
 
+def test_create_tpu_multislice_command_stream(tmp_path, monkeypatch):
+    """--num-slices=2 of a 2x4 slice: 2 kind workers per slice, each
+    labeled with its slice id and per-slice worker id, and the plugin
+    DaemonSet carrying the MEGASCALE wiring."""
+    sim = make_sim(tmp_path, monkeypatch, vendor="tpu",
+                   tpu_topology="2x4", num_slices=2)
+    assert sim.cfg.workers == 2  # 2x4 = one host per slice, 2 slices
+    sim.create()
+    cmds = sim.executor.commands()
+
+    assert any("kind-tpu-sim.dev/slice-id=0" in c and "worker " in c
+               for c in cmds)
+    assert any("kind-tpu-sim.dev/slice-id=1" in c and "worker2" in c
+               for c in cmds)
+    # per-slice worker id restarts at 0 on the second slice's node
+    assert any("kind-tpu-sim.dev/worker-id=0" in c and "worker2" in c
+               for c in cmds)
+
+    applies = sim.executor.find("kubectl apply -f -")
+    ds = next(stdin for _, stdin in applies
+              if stdin and "tpu-sim-device-plugin" in stdin)
+    assert "TPU_SIM_NUM_SLICES" in ds
+    assert "TPU_SIM_HOSTS_PER_SLICE" in ds
+    assert "TPU_SIM_MEGASCALE_COORDINATOR" in ds
+
+
 def test_create_tpu_patch_mode_skip_plugin(tmp_path, monkeypatch):
     sim = make_sim(
         tmp_path, monkeypatch, vendor="tpu", capacity_mode="patch"

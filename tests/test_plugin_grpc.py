@@ -283,6 +283,85 @@ def test_large_metadata_exercises_continuation(plugin_env, pb):
     channel.close()
 
 
+def test_allocate_multislice_megascale_env(tmp_path, plugin_binary, pb):
+    """With the multislice knobs set, Allocate injects the per-slice
+    worker identity (slice-local TPU_WORKER_ID, this slice's hostname
+    window) plus libtpu's MEGASCALE_* cross-slice contract."""
+    sock_dir = tmp_path / "dp"
+    sock_dir.mkdir()
+    proc = subprocess.Popen(
+        [str(plugin_binary), f"--socket-dir={sock_dir}",
+         "--chips=4", "--worker-id=3", "--no-register"],
+        env={**os.environ,
+             "TPU_SIM_ACCELERATOR_TYPE": "v5litepod-8",
+             "TPU_SIM_CHIPS_PER_HOST_BOUNDS": "2,2,1",
+             "TPU_SIM_HOST_BOUNDS": "2,1,1",
+             "TPU_SIM_HOSTNAMES": "h0,h1,h2,h3",
+             "TPU_SIM_NUM_SLICES": "2",
+             "TPU_SIM_HOSTS_PER_SLICE": "2",
+             "TPU_SIM_MEGASCALE_COORDINATOR": "h0:8476"},
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        sock = sock_dir / "tpu-sim.sock"
+        deadline = time.time() + 10
+        while not sock.exists() and time.time() < deadline:
+            time.sleep(0.05)
+        assert sock.exists()
+        channel = make_channel(sock)
+        req = pb.AllocateRequest()
+        req.container_requests.add().devicesIDs.extend(
+            ["tpu-3-12", "tpu-3-13"])
+        resp = call_unary(channel, pb, "Allocate", req,
+                          pb.AllocateRequest, pb.AllocateResponse)
+        env = dict(resp.container_responses[0].envs)
+        # global worker 3 = slice 1, local worker 1
+        assert env["TPU_WORKER_ID"] == "1"
+        assert env["TPU_WORKER_HOSTNAMES"] == "h2,h3"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "h0:8476"
+        assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+        channel.close()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_multislice_config_validated(tmp_path, plugin_binary):
+    """Inconsistent multislice knobs are rejected at startup instead
+    of emitting a self-contradictory Allocate env."""
+    bad_envs = [
+        # hostname count != num_slices * hosts_per_slice
+        {"TPU_SIM_NUM_SLICES": "2", "TPU_SIM_HOSTS_PER_SLICE": "2",
+         "TPU_SIM_HOSTNAMES": "h0,h1,h2",
+         "TPU_SIM_MEGASCALE_COORDINATOR": "h0:8476"},
+        # multislice without hosts_per_slice
+        {"TPU_SIM_NUM_SLICES": "2",
+         "TPU_SIM_HOSTNAMES": "h0,h1,h2,h3",
+         "TPU_SIM_MEGASCALE_COORDINATOR": "h0:8476"},
+        # worker beyond the slice grid
+        {"TPU_SIM_NUM_SLICES": "2", "TPU_SIM_HOSTS_PER_SLICE": "1",
+         "TPU_SIM_HOSTNAMES": "h0,h1",
+         "TPU_SIM_MEGASCALE_COORDINATOR": "h0:8476",
+         "NODE_NAME": "kind-tpu-sim-worker5"},
+        # missing coordinator
+        {"TPU_SIM_NUM_SLICES": "2", "TPU_SIM_HOSTS_PER_SLICE": "2",
+         "TPU_SIM_HOSTNAMES": "h0,h1,h2,h3"},
+    ]
+    for bad in bad_envs:
+        proc = subprocess.run(
+            [str(plugin_binary), f"--socket-dir={tmp_path}",
+             "--chips=4", "--no-register", "--print-env"],
+            env={**os.environ, **bad}, capture_output=True, text=True,
+        )
+        assert proc.returncode == 2, (bad, proc.stdout)
+        assert "invalid configuration" in proc.stderr, bad
+
+
 def test_allocate_multiple_containers(plugin_env, pb):
     channel = make_channel(plugin_env["socket"])
     req = pb.AllocateRequest()
