@@ -41,6 +41,18 @@ std::vector<std::string> SplitLines(const std::string& text) {
   return out;
 }
 
+// Comma-split, exactly as AllocateEnv consumes hostname lists; the
+// single definition keeps Validate()'s count and AllocateEnv's
+// indexing in agreement (std::getline drops a trailing empty
+// segment, so "h0,h1," is 2 names, not 3).
+std::vector<std::string> SplitCsv(const std::string& text) {
+  std::vector<std::string> out;
+  std::istringstream is(text);
+  std::string item;
+  while (std::getline(is, item, ',')) out.push_back(item);
+  return out;
+}
+
 // Local chip index within this host, parsed from "tpu-<w>-<global>".
 int LocalChipIndex(const std::string& device_id, int worker_id, int chips) {
   auto pos = device_id.rfind('-');
@@ -119,10 +131,7 @@ std::string PluginConfig::Validate() const {
            " out of range for " + std::to_string(num_slices) + "x" +
            std::to_string(hosts_per_slice) + " hosts";
   }
-  int names = hostnames.empty() ? 0 : 1;
-  for (char c : hostnames) {
-    if (c == ',') ++names;
-  }
+  int names = static_cast<int>(SplitCsv(hostnames).size());
   if (names != num_slices * hosts_per_slice) {
     return "TPU_SIM_HOSTNAMES lists " + std::to_string(names) +
            " names; multislice needs num_slices * hosts_per_slice = " +
@@ -186,14 +195,17 @@ std::vector<std::pair<std::string, std::string>> DevicePlugin::AllocateEnv(
   if (multislice) {
     slice_id = cfg_.worker_id / cfg_.hosts_per_slice;
     local_worker = cfg_.worker_id - slice_id * cfg_.hosts_per_slice;
-    std::vector<std::string> all;
-    std::istringstream is(cfg_.hostnames);
-    std::string name;
-    while (std::getline(is, name, ',')) all.push_back(name);
-    hostnames.clear();
-    for (int i = 0; i < cfg_.hosts_per_slice; ++i) {
-      if (i) hostnames += ",";
-      hostnames += all[slice_id * cfg_.hosts_per_slice + i];
+    std::vector<std::string> all = SplitCsv(cfg_.hostnames);
+    // Unreachable through main() (Validate() rejects mismatched
+    // lists at startup); guards embedders constructing PluginConfig
+    // directly from out-of-bounds indexing.
+    if (static_cast<int>(all.size()) >=
+        (slice_id + 1) * cfg_.hosts_per_slice) {
+      hostnames.clear();
+      for (int i = 0; i < cfg_.hosts_per_slice; ++i) {
+        if (i) hostnames += ",";
+        hostnames += all[slice_id * cfg_.hosts_per_slice + i];
+      }
     }
   }
   std::vector<std::pair<std::string, std::string>> env = {
