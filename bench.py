@@ -631,6 +631,7 @@ def model_throughput() -> dict | None:
 
                 eng._chunk = count(orig_chunk)
                 eng._prefill = count(orig_pre)
+                eng._first = count(eng._first)  # per-admission sample
                 for r in reqs:
                     eng.submit(r)
                 t0 = time.monotonic()

@@ -166,6 +166,15 @@ def build_parser() -> argparse.ArgumentParser:
             "whole slice at this many tokens (e.g. 32768)"
         ),
     )
+    smoke.add_argument(
+        "--serving", action="store_true",
+        help=(
+            "also run the serving-layer smoke: continuous-batching "
+            "engine contract (mixed greedy+sampled grid vs the "
+            "single-sequence decoder) and speculative decoding's "
+            "greedy-exactness"
+        ),
+    )
     smoke.add_argument("--json", action="store_true", dest="as_json")
 
     man = sub.add_parser(
@@ -219,8 +228,19 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
         topology=args.topology, accelerator=args.accelerator,
         ring_tokens=args.ring_tokens)
     ok = all(r["ok"] for r in reports)
+    serving_rep = spec_rep = None
+    if args.serving:
+        from kind_tpu_sim.models import serving, speculative
+
+        serving_rep = serving.serving_report()
+        spec_rep = speculative.speculative_report()
+        ok = ok and serving_rep["ok"] and spec_rep["ok"]
     if args.as_json:
-        print(json.dumps({"ok": ok, "workers": reports}))
+        out = {"ok": ok, "workers": reports}
+        if serving_rep is not None:
+            out["serving"] = serving_rep
+            out["speculative"] = spec_rep
+        print(json.dumps(out))
     else:
         for rank, rep in enumerate(reports):
             ring = ""
@@ -235,6 +255,12 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
                 f"(want {rep['psum_expected']}) "
                 f"{'OK' if rep['ok'] else 'FAILED'}{ring}"
             )
+        if serving_rep is not None:
+            print(f"serving: {serving_rep['requests']} requests over "
+                  f"{serving_rep['slots']} slots, greedy-exact "
+                  f"{'OK' if serving_rep['greedy_exact'] else 'FAILED'}")
+            print(f"speculative: greedy-exact "
+                  f"{'OK' if spec_rep['greedy_exact'] else 'FAILED'}")
         print("SLICE SMOKE " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
 

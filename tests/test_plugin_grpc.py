@@ -72,11 +72,20 @@ class FakeKubelet:
 
 @pytest.fixture
 def plugin_env(tmp_path, plugin_binary, pb):
-    """A running plugin + fake kubelet in a temp device-plugin dir."""
+    """A running plugin + fake kubelet in a temp device-plugin dir.
+
+    Plugin stderr goes to a file (never a PIPE: an undrained pipe
+    could block the plugin's logging under pathological volume) and
+    is attached to RPC failures by `call_unary` for diagnosis.
+    """
     sock_dir = tmp_path / "dp"
     sock_dir.mkdir()
     unhealthy = tmp_path / "unhealthy.txt"
+    stderr_path = tmp_path / "plugin-stderr.log"
     kubelet = FakeKubelet(sock_dir / "kubelet.sock", pb)
+    stderr_fh = open(stderr_path, "w", encoding="utf-8")
+    global _LAST_STDERR
+    _LAST_STDERR = stderr_path
     proc = subprocess.Popen(
         [str(plugin_binary),
          f"--socket-dir={sock_dir}",
@@ -87,8 +96,9 @@ def plugin_env(tmp_path, plugin_binary, pb):
              "TPU_SIM_CHIPS_PER_HOST_BOUNDS": "2,4,1",
              "TPU_SIM_HOST_BOUNDS": "2,1,1",
              "TPU_SIM_HOSTNAMES": "h0,h1"},
-        stderr=subprocess.PIPE, text=True,
+        stderr=stderr_fh, text=True,
     )
+    stderr_fh.close()  # child holds the fd
     sock = sock_dir / "tpu-sim.sock"
     deadline = time.time() + 10
     while not sock.exists() and time.time() < deadline:
@@ -103,6 +113,7 @@ def plugin_env(tmp_path, plugin_binary, pb):
             "unhealthy": unhealthy,
         }
     finally:
+        _LAST_STDERR = None
         proc.send_signal(signal.SIGTERM)
         try:
             proc.wait(timeout=5)
@@ -115,6 +126,19 @@ def make_channel(sock):
     return grpc.insecure_channel(f"unix://{sock}")
 
 
+_LAST_STDERR = None  # most recent plugin_env's stderr file
+
+
+def _plugin_stderr_tail() -> str:
+    if _LAST_STDERR is None:
+        return "<no plugin stderr captured>"
+    try:
+        return _LAST_STDERR.read_text(encoding="utf-8",
+                                      errors="replace")[-2000:]
+    except OSError as exc:
+        return f"<stderr unreadable: {exc}>"
+
+
 def call_unary(channel, pb, method, request, request_cls, response_cls,
                timeout=20):
     stub = channel.unary_unary(
@@ -123,16 +147,25 @@ def call_unary(channel, pb, method, request, request_cls, response_cls,
         response_deserializer=response_cls.FromString,
     )
     try:
-        return stub(request, timeout=timeout)
-    except grpc.RpcError as exc:
-        # One retry for transient transport errors (grpcio under a
-        # loaded host occasionally drops the first attempt); a real
-        # protocol bug fails both attempts identically.
-        if exc.code() in (grpc.StatusCode.UNAVAILABLE,
-                          grpc.StatusCode.DEADLINE_EXCEEDED):
-            time.sleep(0.5)
+        try:
             return stub(request, timeout=timeout)
-        raise
+        except grpc.RpcError as exc:
+            # One retry for transient transport errors (grpcio under a
+            # loaded host occasionally drops the first attempt); a real
+            # protocol bug fails both attempts identically.
+            if exc.code() in (grpc.StatusCode.UNAVAILABLE,
+                              grpc.StatusCode.DEADLINE_EXCEEDED):
+                time.sleep(0.5)
+                return stub(request, timeout=timeout)
+            raise
+    except grpc.RpcError as exc:
+        # Self-diagnosing failure: the bare _InactiveRpcError line
+        # hides the status code and the plugin's own view of events.
+        raise AssertionError(
+            f"{method} failed: code={exc.code()} "
+            f"details={exc.details()!r}\n"
+            f"--- plugin stderr tail ---\n{_plugin_stderr_tail()}"
+        ) from exc
 
 
 def test_register_called_with_plugin_identity(plugin_env, pb):

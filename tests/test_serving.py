@@ -26,13 +26,23 @@ def params(cfg):
     return tf.init_params(jax.random.PRNGKey(0), cfg)
 
 
+_ORACLE_JITS = {}
+
+
 def oracle(params, cfg, prompt, max_new, chunk):
     """Single-sequence reference: greedy_generate at the SAME chunk
     size (chunk boundaries change fp32 summation order; matching them
-    keeps the comparison exact, not just argmax-close)."""
-    out = decode.greedy_generate(
-        params, cfg, np.asarray([prompt], np.int32), max_new,
-        chunk=chunk)
+    keeps the comparison exact, not just argmax-close). Jitted and
+    cached per shape: the eager path re-traces its scans on every
+    call, which dominated this file's runtime."""
+    key = (id(params), cfg, len(prompt), max_new, chunk)
+    if key not in _ORACLE_JITS:
+        import jax
+
+        _ORACLE_JITS[key] = jax.jit(
+            lambda p, t: decode.greedy_generate(p, cfg, t, max_new,
+                                                chunk=chunk))
+    out = _ORACLE_JITS[key](params, np.asarray([prompt], np.int32))
     return np.asarray(out)[0, len(prompt):].tolist()
 
 
@@ -72,7 +82,7 @@ def test_continuous_admission_mid_flight(cfg, params):
     """More requests than slots: later requests are admitted into
     slots freed by earlier completions, mid-decode, and still match
     their solo runs."""
-    sc = serving.ServingConfig(max_slots=2, max_len=96, chunk=8)
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
     eng = serving.ServingEngine(params, cfg, sc)
     reqs = [(make_prompt(10 + i, 4 + 3 * i, cfg.vocab_size),
              4 + 5 * (i % 3)) for i in range(5)]
@@ -95,7 +105,7 @@ def test_eos_stops_early(cfg, params):
     at that token's FIRST occurrence with finish_reason=stop. (The
     untrained model often repeats itself, so the cut index is the
     first occurrence of the chosen token, wherever that is.)"""
-    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=4)
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
     prompt = make_prompt(3, 6, cfg.vocab_size)
     solo = oracle(params, cfg, prompt, 12, sc.chunk)
     # Prefer a token whose first occurrence is mid-stream; degenerate
@@ -145,6 +155,78 @@ def test_int8_serving_grid(cfg, params):
         solo = oracle(qp, cfg_q, p, 9, sc.chunk)
         agree = sum(a == b for a, b in zip(got, solo))
         assert agree >= 7, (got, solo)
+
+
+def test_sampled_requests_reproducible_and_slot_independent(cfg, params):
+    """Per-request sampling (vLLM SamplingParams analog): a sampled
+    request's tokens depend only on (request, seed) — not on which
+    slot it lands in, what else shares the grid, or admission order —
+    because the PRNG folds the request key by generation index."""
+    samp = decode.SamplingConfig(temperature=1.5, top_k=0, top_p=1.0)
+    prompt = make_prompt(30, 6, cfg.vocab_size)
+
+    def run_with(extra_first: bool):
+        sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+        eng = serving.ServingEngine(params, cfg, sc)
+        if extra_first:
+            # a greedy co-tenant admitted FIRST, shifting the sampled
+            # request to a different slot
+            eng.submit(serving.Request(
+                "greedy", make_prompt(31, 9, cfg.vocab_size), 12))
+        eng.submit(serving.Request("sampled", prompt, 10,
+                                   sampling=samp, seed=123))
+        return {c.request_id: c for c in eng.run()}
+
+    alone = run_with(False)["sampled"].tokens
+    crowded = run_with(True)["sampled"].tokens
+    assert alone == crowded
+    assert len(alone) == 10
+
+    # different seed -> different continuation (high temperature over
+    # the full vocab; collision across 10 draws is ~impossible)
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    eng.submit(serving.Request("sampled", prompt, 10,
+                               sampling=samp, seed=7))
+    other = eng.run()[0].tokens
+    assert other != alone
+
+
+def test_sampled_and_greedy_share_grid(cfg, params):
+    """Greedy rows must stay EXACTLY greedy while a high-temperature
+    neighbor samples in the same chunk dispatches."""
+    samp = decode.SamplingConfig(temperature=2.0, top_k=0, top_p=1.0)
+    g_prompt = make_prompt(40, 7, cfg.vocab_size)
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    eng.submit(serving.Request("greedy", g_prompt, 11))
+    eng.submit(serving.Request("hot", make_prompt(41, 5,
+                                                  cfg.vocab_size),
+                               11, sampling=samp, seed=3))
+    by_id = {c.request_id: c for c in eng.run()}
+    assert by_id["greedy"].tokens == oracle(params, cfg, g_prompt, 11,
+                                            sc.chunk)
+    assert len(by_id["hot"].tokens) == 11
+
+
+def test_sampling_filters_respected(cfg, params):
+    """top_k=1 degenerates to greedy regardless of temperature — the
+    per-row filter math is live."""
+    samp = decode.SamplingConfig(temperature=5.0, top_k=1, top_p=1.0)
+    prompt = make_prompt(50, 6, cfg.vocab_size)
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    eng.submit(serving.Request("k1", prompt, 9, sampling=samp,
+                               seed=11))
+    out = eng.run()[0].tokens
+    assert out == oracle(params, cfg, prompt, 9, sc.chunk)
+
+
+def test_serving_report_smoke():
+    rep = serving.serving_report()
+    assert rep["ok"], rep
+    assert rep["greedy_exact"] and rep["all_finished"]
+    assert rep["requests"] == 2 * rep["slots"]
 
 
 def test_report_shape(cfg, params):
