@@ -72,6 +72,9 @@ class ServingConfig:
     chunk: int = 16           # decode tokens per dispatch between
     #                           scheduling boundaries (admission /
     #                           completion checks happen every chunk)
+    prefix_cache_entries: int = 0   # >0: LRU prompt-prefix KV cache
+    #                                 (the vLLM automatic-prefix-
+    #                                 caching analog; see PrefixCache)
 
 
 @dataclasses.dataclass
@@ -91,6 +94,8 @@ class Request:
     eos_id: Optional[int] = None
     sampling: Optional[SamplingConfig] = None
     seed: int = 0
+    cache_prefix: bool = False   # store this prompt's KV for reuse
+    #                              by later prefix-sharing requests
 
 
 @dataclasses.dataclass
@@ -341,6 +346,182 @@ def _decode_chunk(params, cache, lengths, last_token, active,
     return new_cache, lengths, token, emitted.swapaxes(0, 1)
 
 
+def _suffix_into_slot(params, cache, tokens, true_len, base, slot, *,
+                      cfg: ModelConfig):
+    """Continue a slot whose first ``base`` positions already hold
+    cached prefix k/v: run the suffix window (1, w_pad) through the
+    model attending to that prefix (speculative's window block — the
+    suffix IS a verify-style window at offset ``base``), write the
+    suffix k/v at ``base``, and return the fp32 logits at the TRUE
+    last suffix position. The prefix-cache admission path's second
+    half; `_prefill_into_slot` is the base == 0 special case (cheaper:
+    no cache attention)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import (
+        QuantArray,
+        embed_lookup,
+        quantize,
+    )
+    from kind_tpu_sim.models.speculative import _window_block
+
+    _, w = tokens.shape
+    dtype = jnp.dtype(cfg.dtype)
+    x = embed_lookup(params["embed"], tokens, dtype)
+    keep = (jnp.arange(w) < true_len)[None, :, None, None]
+    base_vec = jnp.asarray([base]) if jnp.ndim(base) == 0 else base
+
+    def slot_row(arr):
+        return jax.lax.dynamic_slice(
+            arr, (slot,) + (0,) * (arr.ndim - 1),
+            (1,) + arr.shape[1:])
+
+    new_cache = []
+    for bparams, layer_cache in zip(params["blocks"], cache):
+        if isinstance(layer_cache["k"], QuantArray):
+            row = {
+                "k": QuantArray(q=slot_row(layer_cache["k"].q),
+                                scale=slot_row(layer_cache["k"].scale)),
+                "v": QuantArray(q=slot_row(layer_cache["v"].q),
+                                scale=slot_row(layer_cache["v"].scale)),
+            }
+        else:
+            row = {"k": slot_row(layer_cache["k"]),
+                   "v": slot_row(layer_cache["v"])}
+        x, kk, vv = _window_block(x, bparams, cfg, row, base_vec)
+
+        def write(arr, upd):
+            upd = jnp.where(keep, upd, 0)
+            if isinstance(arr, QuantArray):
+                qa = quantize(upd, axis=3)
+                return QuantArray(
+                    q=jax.lax.dynamic_update_slice(
+                        arr.q, qa.q.astype(arr.q.dtype),
+                        (slot, base, 0, 0)),
+                    scale=jax.lax.dynamic_update_slice(
+                        arr.scale, qa.scale, (slot, base, 0, 0)),
+                )
+            return jax.lax.dynamic_update_slice(
+                arr, upd.astype(arr.dtype), (slot, base, 0, 0))
+
+        new_cache.append({"k": write(layer_cache["k"], kk),
+                          "v": write(layer_cache["v"], vv)})
+    x = _rms_norm(x, params["final_norm"])
+    last = jnp.take_along_axis(
+        x, (true_len - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
+    logits = _readout(last[:, 0, :], params["embed"], cfg.int8_native)
+    return new_cache, logits[0].astype(jnp.float32)
+
+
+def _read_slot_rows(cache, slot, length: int):
+    """Copy the first ``length`` cache rows of ``slot`` out of the
+    grid (one pytree per layer) — the store half of prefix caching."""
+    import jax
+
+    from kind_tpu_sim.models.quant import QuantArray
+
+    def rows(arr):
+        return jax.lax.dynamic_slice(
+            arr, (slot, 0) + (0,) * (arr.ndim - 2),
+            (1, length) + arr.shape[2:])
+
+    out = []
+    for layer_cache in cache:
+        if isinstance(layer_cache["k"], QuantArray):
+            out.append({
+                "k": QuantArray(q=rows(layer_cache["k"].q),
+                                scale=rows(layer_cache["k"].scale)),
+                "v": QuantArray(q=rows(layer_cache["v"].q),
+                                scale=rows(layer_cache["v"].scale)),
+            })
+        else:
+            out.append({"k": rows(layer_cache["k"]),
+                        "v": rows(layer_cache["v"])})
+    return out
+
+
+def _write_slot_rows(cache, entry_kv, slot):
+    """Copy a stored prefix entry's rows into ``slot`` at position 0
+    (device-to-device; the restore half of prefix caching)."""
+    import jax
+
+    from kind_tpu_sim.models.quant import QuantArray
+
+    def put(arr, rows):
+        return jax.lax.dynamic_update_slice(
+            arr, rows, (slot, 0) + (0,) * (arr.ndim - 2))
+
+    new_cache = []
+    for layer_cache, entry in zip(cache, entry_kv):
+        if isinstance(layer_cache["k"], QuantArray):
+            new_cache.append({
+                "k": QuantArray(
+                    q=put(layer_cache["k"].q, entry["k"].q),
+                    scale=put(layer_cache["k"].scale,
+                              entry["k"].scale)),
+                "v": QuantArray(
+                    q=put(layer_cache["v"].q, entry["v"].q),
+                    scale=put(layer_cache["v"].scale,
+                              entry["v"].scale)),
+            })
+        else:
+            new_cache.append({"k": put(layer_cache["k"], entry["k"]),
+                              "v": put(layer_cache["v"], entry["v"])})
+    return new_cache
+
+
+class PrefixCache:
+    """Host-side LRU of prompt -> device KV rows (the vLLM automatic-
+    prefix-caching analog, exact-prefix tier).
+
+    Entries are keyed by the stored token tuple, padded on device to
+    the next power-of-two length (one copy-kernel trace per bucket,
+    not per prompt length). ``lookup`` returns the LONGEST stored
+    entry that strictly prefixes the query — admission then copies
+    its rows device-to-device and runs only the suffix through the
+    model. Correctness is positional: prefix k/v were computed at
+    positions 0..p-1, exactly where they land in the new slot.
+    """
+
+    def __init__(self, capacity: int):
+        import collections
+
+        self.capacity = capacity
+        self.entries = collections.OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, prompt: List[int]):
+        """Longest stored strict prefix of ``prompt`` (LRU-refreshed);
+        None on miss."""
+        best = None
+        for key in self.entries:
+            if (len(key) < len(prompt) and best is not None
+                    and len(key) <= len(best)):
+                continue
+            if len(key) < len(prompt) and tuple(
+                    prompt[:len(key)]) == key:
+                best = key
+        if best is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self.entries.move_to_end(best)
+        return self.entries[best]
+
+    def store(self, prompt: List[int], entry) -> None:
+        key = tuple(prompt)
+        self.entries[key] = entry
+        self.entries.move_to_end(key)
+        while len(self.entries) > self.capacity:
+            self.entries.popitem(last=False)
+
+    def report(self) -> Dict[str, Any]:
+        return {"entries": len(self.entries), "hits": self.hits,
+                "misses": self.misses}
+
+
 # ---------------------------------------------------------------------
 # jit wrapper caches: one per (cfg[, chunk]) across ALL engines —
 # params stay a traced argument, so constructing a new ServingEngine
@@ -372,11 +553,37 @@ def _jitted_first():
     return jax.jit(_sample_rows)
 
 
+def _jitted_suffix(cfg: ModelConfig):
+    import functools
+
+    import jax
+
+    return jax.jit(functools.partial(_suffix_into_slot, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+def _jitted_read(length: int):
+    import functools
+
+    import jax
+
+    return jax.jit(functools.partial(_read_slot_rows, length=length))
+
+
+def _jitted_write():
+    import jax
+
+    return jax.jit(_write_slot_rows, donate_argnums=(0,))
+
+
 import functools as _functools
 
 _jitted_prefill = _functools.lru_cache(maxsize=32)(_jitted_prefill)
 _jitted_chunk = _functools.lru_cache(maxsize=32)(_jitted_chunk)
 _jitted_first = _functools.lru_cache(maxsize=1)(_jitted_first)
+_jitted_suffix = _functools.lru_cache(maxsize=32)(_jitted_suffix)
+_jitted_read = _functools.lru_cache(maxsize=32)(_jitted_read)
+_jitted_write = _functools.lru_cache(maxsize=1)(_jitted_write)
 
 
 # ---------------------------------------------------------------------
@@ -429,6 +636,10 @@ class ServingEngine:
         self._chunk = functools.partial(
             _jitted_chunk(cfg, serving.chunk), params)
         self._first = _jitted_first()
+        self._suffix = functools.partial(_jitted_suffix(cfg), params)
+        self.prefix_cache = (
+            PrefixCache(serving.prefix_cache_entries)
+            if serving.prefix_cache_entries > 0 else None)
 
     # -- public surface ------------------------------------------------
 
@@ -483,12 +694,51 @@ class ServingEngine:
                 continue
             req = self.queue.pop(0)
             t_p = len(req.prompt)
-            pad = _bucket(t_p)
-            tokens = np.zeros((1, pad), np.int32)
-            tokens[0, :t_p] = req.prompt
-            self.cache, logits = self._prefill(
-                self.cache, jnp.asarray(tokens),
-                jnp.int32(t_p), slot)
+            hit = None
+            if self.prefix_cache is not None:
+                hit = self.prefix_cache.lookup(req.prompt)
+                if hit is not None and (
+                        # stored on a roomier grid; rows can't fit
+                        hit["pad"] > self.serving.max_len
+                        # suffix window (bucket-padded) would run past
+                        # max_len: dynamic_update_slice CLAMPS the
+                        # start index, which would silently shift the
+                        # write over the restored prefix — fall back
+                        # to the cold path instead
+                        or hit["len"] + _bucket(
+                            len(req.prompt) - hit["len"])
+                        > self.serving.max_len):
+                    hit = None
+            if hit is not None:
+                # prefix-cache admission: device-copy the stored
+                # rows, run ONLY the suffix through the model
+                p = hit["len"]
+                self.cache = _jitted_write()(self.cache, hit["kv"],
+                                             slot)
+                suffix = req.prompt[p:]
+                w_pad = _bucket(len(suffix))
+                tokens = np.zeros((1, w_pad), np.int32)
+                tokens[0, :len(suffix)] = suffix
+                self.cache, logits = self._suffix(
+                    self.cache, jnp.asarray(tokens),
+                    jnp.int32(len(suffix)), jnp.int32(p), slot)
+            else:
+                pad = _bucket(t_p)
+                tokens = np.zeros((1, pad), np.int32)
+                tokens[0, :t_p] = req.prompt
+                self.cache, logits = self._prefill(
+                    self.cache, jnp.asarray(tokens),
+                    jnp.int32(t_p), slot)
+            if (req.cache_prefix and self.prefix_cache is not None):
+                # store AFTER the slot holds the full prompt's k/v
+                # (either admission path), padded to a bucket so the
+                # readback kernel traces per bucket, not per length
+                bucket = min(_bucket(t_p), self.serving.max_len)
+                self.prefix_cache.store(req.prompt, {
+                    "kv": _jitted_read(bucket)(self.cache, slot),
+                    "len": t_p,
+                    "pad": bucket,
+                })
 
             samp = req.sampling or SamplingConfig(temperature=0.0)
             self.temp = self.temp.at[slot].set(samp.temperature)
@@ -545,16 +795,25 @@ class ServingEngine:
         self.slot_req[slot] = None
         self.slot_emitted[slot] = []
         self.active = self.active.at[slot].set(False)
+        # Reset the slot's sampling params: a stale temp > 0 on an
+        # idle slot would keep jnp.any(temp > 0) true and defeat the
+        # all-greedy lax.cond fast path for every later chunk.
+        self.temp = self.temp.at[slot].set(0.0)
+        self.top_k = self.top_k.at[slot].set(0)
+        self.top_p = self.top_p.at[slot].set(1.0)
 
     def report(self) -> Dict[str, Any]:
         """Pod/bench-friendly state snapshot."""
-        return {
+        out = {
             "slots": self.serving.max_slots,
             "active": int(sum(1 for r in self.slot_req
                               if r is not None)),
             "queued": len(self.queue),
             "finished": len(self.finished),
         }
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.report()
+        return out
 
 
 def serving_report(cfg: ModelConfig = None,

@@ -222,6 +222,89 @@ def test_sampling_filters_respected(cfg, params):
     assert out == oracle(params, cfg, prompt, 9, sc.chunk)
 
 
+def test_prefix_cache_hit_matches_cold_path(cfg, params):
+    """A request admitted through a prefix-cache hit (device-copied
+    prefix rows + suffix-only window forward) emits exactly what the
+    cold full-prefill path emits."""
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                               prefix_cache_entries=4)
+    system = make_prompt(60, 12, cfg.vocab_size)   # shared "system prompt"
+    user_a = make_prompt(61, 4, cfg.vocab_size)
+    user_b = make_prompt(62, 5, cfg.vocab_size)
+
+    eng = serving.ServingEngine(params, cfg, sc)
+    eng.submit(serving.Request("warm", system, 6, cache_prefix=True))
+    eng.submit(serving.Request("a", system + user_a, 8))
+    eng.submit(serving.Request("b", system + user_b, 8))
+    by_id = {c.request_id: c for c in eng.run()}
+    stats = eng.prefix_cache.report()
+    assert stats["entries"] == 1
+    assert stats["hits"] == 2, stats  # both follow-ups reused it
+
+    # cold engine (no prefix cache): identical outputs
+    cold = serving.ServingEngine(
+        params, cfg, serving.ServingConfig(max_slots=2, max_len=64,
+                                           chunk=8))
+    cold.submit(serving.Request("a", system + user_a, 8))
+    cold.submit(serving.Request("b", system + user_b, 8))
+    cold_by = {c.request_id: c for c in cold.run()}
+    assert by_id["a"].tokens == cold_by["a"].tokens
+    assert by_id["b"].tokens == cold_by["b"].tokens
+
+
+def test_prefix_cache_lru_eviction_and_miss_accounting(cfg, params):
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                               prefix_cache_entries=2)
+    eng = serving.ServingEngine(params, cfg, sc)
+    prompts = [make_prompt(70 + i, 8 + i, cfg.vocab_size)
+               for i in range(3)]
+    for i, p in enumerate(prompts):
+        eng.submit(serving.Request(f"s{i}", p, 4, cache_prefix=True))
+    eng.run()
+    stats = eng.prefix_cache.report()
+    assert stats["entries"] == 2  # capacity 2: oldest evicted
+    assert tuple(prompts[0]) not in eng.prefix_cache.entries
+    # unrelated prompt: miss counted, output unaffected
+    q = make_prompt(99, 7, cfg.vocab_size)
+    eng.submit(serving.Request("q", q, 6))
+    by_id = {c.request_id: c for c in eng.run()}
+    assert by_id["q"].tokens == oracle(params, cfg, q, 6, sc.chunk)
+    assert eng.prefix_cache.report()["misses"] >= 1
+
+
+def test_prefix_cache_overflowing_suffix_falls_back_cold(cfg, params):
+    """When the bucket-padded suffix window would run past max_len
+    (dynamic_update_slice would CLAMP the start and overwrite the
+    restored prefix), admission must fall back to the cold path and
+    still emit the correct tokens."""
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                               prefix_cache_entries=4)
+    eng = serving.ServingEngine(params, cfg, sc)
+    system = make_prompt(90, 12, cfg.vocab_size)
+    eng.submit(serving.Request("warm", system, 4, cache_prefix=True))
+    eng.run()
+    # suffix of 45 -> bucket 64; 12 + 64 > 64 -> must NOT take the hit
+    long_prompt = system + make_prompt(91, 45, cfg.vocab_size)
+    eng.submit(serving.Request("long", long_prompt, 6))
+    done = {c.request_id: c for c in eng.run()}
+    assert done["long"].tokens == oracle(params, cfg, long_prompt, 6,
+                                         sc.chunk)
+
+
+def test_prefix_cache_longest_prefix_wins(cfg, params):
+    """With nested stored prefixes, admission reuses the LONGEST."""
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                               prefix_cache_entries=4)
+    eng = serving.ServingEngine(params, cfg, sc)
+    short = make_prompt(80, 6, cfg.vocab_size)
+    longer = short + make_prompt(81, 6, cfg.vocab_size)
+    eng.submit(serving.Request("s", short, 4, cache_prefix=True))
+    eng.submit(serving.Request("l", longer, 4, cache_prefix=True))
+    eng.run()
+    hit = eng.prefix_cache.lookup(longer + [1, 2])
+    assert hit is not None and hit["len"] == len(longer)
+
+
 def test_serving_report_smoke():
     rep = serving.serving_report()
     assert rep["ok"], rep
