@@ -492,17 +492,33 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
 
-    def lookup(self, prompt: List[int]):
-        """Longest stored strict prefix of ``prompt`` (LRU-refreshed);
-        None on miss."""
+    def lookup(self, prompt: List[int],
+               max_len: Optional[int] = None):
+        """Longest USABLE stored strict prefix of ``prompt``
+        (LRU-refreshed); None on miss.
+
+        With ``max_len``, entries whose restore would not fit the
+        slot are skipped — both the stored rows (entry pad) and the
+        bucket-padded suffix window must stay within ``max_len``
+        (dynamic_update_slice clamps out-of-bounds starts, which
+        would silently shift the suffix write over the restored
+        prefix). Infeasible entries don't count as hits, don't get
+        LRU-refreshed, and a shorter stored prefix that DOES fit is
+        used instead."""
         best = None
-        for key in self.entries:
-            if (len(key) < len(prompt) and best is not None
-                    and len(key) <= len(best)):
+        for key, entry in self.entries.items():
+            if best is not None and len(key) <= len(best):
                 continue
-            if len(key) < len(prompt) and tuple(
-                    prompt[:len(key)]) == key:
-                best = key
+            if not (len(key) < len(prompt)
+                    and tuple(prompt[:len(key)]) == key):
+                continue
+            if max_len is not None and (
+                    entry["pad"] > max_len
+                    or entry["len"] + _bucket(len(prompt)
+                                              - entry["len"])
+                    > max_len):
+                continue
+            best = key
         if best is None:
             self.misses += 1
             return None
@@ -696,19 +712,11 @@ class ServingEngine:
             t_p = len(req.prompt)
             hit = None
             if self.prefix_cache is not None:
-                hit = self.prefix_cache.lookup(req.prompt)
-                if hit is not None and (
-                        # stored on a roomier grid; rows can't fit
-                        hit["pad"] > self.serving.max_len
-                        # suffix window (bucket-padded) would run past
-                        # max_len: dynamic_update_slice CLAMPS the
-                        # start index, which would silently shift the
-                        # write over the restored prefix — fall back
-                        # to the cold path instead
-                        or hit["len"] + _bucket(
-                            len(req.prompt) - hit["len"])
-                        > self.serving.max_len):
-                    hit = None
+                # feasibility lives in lookup(): infeasible entries
+                # aren't counted as hits and a shorter stored prefix
+                # that fits is preferred
+                hit = self.prefix_cache.lookup(
+                    req.prompt, max_len=self.serving.max_len)
             if hit is not None:
                 # prefix-cache admission: device-copy the stored
                 # rows, run ONLY the suffix through the model

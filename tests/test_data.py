@@ -1,0 +1,99 @@
+"""Input pipeline: packing, prefetch, sharded placement, training."""
+
+import numpy as np
+import pytest
+
+from kind_tpu_sim import data
+from kind_tpu_sim.models import transformer as tf
+
+jax = pytest.importorskip("jax")
+
+
+def test_pack_exact_windows_no_padding_waste():
+    docs = iter([[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11, 12]])
+    batches = data.pack(docs, batch=2, seq=3, eos_id=0)
+    first = next(batches)
+    assert first.shape == (2, 3) and first.dtype == np.int32
+    # concatenation with eos separators, sliced exactly
+    np.testing.assert_array_equal(first, [[1, 2, 3], [0, 4, 5]])
+    second = next(batches)
+    np.testing.assert_array_equal(second, [[0, 6, 7], [8, 9, 10]])
+
+
+def test_pack_stream_is_deterministic():
+    a = data.pack(data.synthetic_documents(7, 64), 2, 16)
+    b = data.pack(data.synthetic_documents(7, 64), 2, 16)
+    for _ in range(3):
+        np.testing.assert_array_equal(next(a), next(b))
+
+
+def test_pack_finite_stream_ends_cleanly():
+    out = list(data.pack(iter([[1, 2, 3], [4, 5]]), 1, 4))
+    assert len(out) == 1  # partial tail window dropped
+    np.testing.assert_array_equal(out[0], [[1, 2, 3, 0]])
+
+
+def test_prefetcher_context_manager_closes():
+    with data.Prefetcher(iter(range(1000)), depth=1) as pf:
+        assert next(pf) == 0
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_order_and_termination():
+    src = iter(range(10))
+    pf = data.Prefetcher(src, depth=3)
+    assert list(pf) == list(range(10))
+
+
+def test_prefetcher_propagates_errors():
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    pf = data.Prefetcher(bad())
+    assert next(pf) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+
+
+def test_prefetcher_close_unblocks_producer():
+    pf = data.Prefetcher(iter(range(1000)), depth=1)
+    assert next(pf) == 0
+    pf.close()  # must not hang on the producer's blocked put
+
+
+def test_pipeline_places_shards_on_mesh():
+    from kind_tpu_sim.parallel import mesh as mesh_lib
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = mesh_lib.training_mesh(4, 2, devices=devices[:8])
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=16)
+    pipe = data.input_pipeline(cfg, batch=8, mesh=mesh, steps=2)
+    batches = list(pipe)
+    assert len(batches) == 2
+    b0 = batches[0]
+    assert b0.shape == (8, 16)
+    # batch axis sharded over 'data' (4-way): each shard holds 2 rows
+    assert len(b0.sharding.device_set) == 8
+    shard_shapes = {s.data.shape for s in b0.addressable_shards}
+    assert shard_shapes == {(2, 16)}
+
+
+def test_training_through_pipeline_learns():
+    """End-to-end: the train step consumes prefetched packed batches
+    and the loss drops on the structured corpus."""
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=16)
+    step, init = tf.make_train_step(cfg, learning_rate=1e-2)
+    state = init(jax.random.PRNGKey(0))
+    losses = []
+    pipe = data.input_pipeline(cfg, batch=8, steps=30)
+    for tokens in pipe:
+        state, loss = step(state, tokens)
+        losses.append(float(loss))
+    assert len(losses) == 30
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.5, (
+        losses[:5], losses[-5:])

@@ -284,11 +284,16 @@ def test_prefix_cache_overflowing_suffix_falls_back_cold(cfg, params):
     eng.submit(serving.Request("warm", system, 4, cache_prefix=True))
     eng.run()
     # suffix of 45 -> bucket 64; 12 + 64 > 64 -> must NOT take the hit
+    hits_before = eng.prefix_cache.report()["hits"]
     long_prompt = system + make_prompt(91, 45, cfg.vocab_size)
     eng.submit(serving.Request("long", long_prompt, 6))
     done = {c.request_id: c for c in eng.run()}
     assert done["long"].tokens == oracle(params, cfg, long_prompt, 6,
                                          sc.chunk)
+    # the infeasible entry is a MISS, not a hit (accounting honest)
+    stats = eng.prefix_cache.report()
+    assert stats["hits"] == hits_before
+    assert stats["misses"] >= 1
 
 
 def test_prefix_cache_longest_prefix_wins(cfg, params):
