@@ -82,6 +82,21 @@ def test_pipeline_places_shards_on_mesh():
     assert shard_shapes == {(2, 16)}
 
 
+def test_train_smoke_cli(capsys):
+    """The train-smoke subcommand: pipeline -> train step -> report,
+    exit 0 with the loss down."""
+    import json as jsonlib
+
+    from kind_tpu_sim.cli import main
+
+    rc = main(["train-smoke", "--steps", "20", "--json"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    report = jsonlib.loads(out)
+    assert rc == 0 and report["ok"]
+    assert report["steps"] == 20
+    assert report["loss_last5"] < report["loss_first5"]
+
+
 def test_training_through_pipeline_learns():
     """End-to-end: the train step consumes prefetched packed batches
     and the loss drops on the structured corpus."""
