@@ -167,6 +167,14 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
     smoke.add_argument(
+        "--num-slices", type=int, default=1,
+        help=(
+            "launch a simulated MULTISLICE job: one process per host "
+            "per slice, each slice its own jax.distributed world "
+            "with the MEGASCALE_* cross-slice contract"
+        ),
+    )
+    smoke.add_argument(
         "--serving", action="store_true",
         help=(
             "also run the serving-layer smoke: continuous-batching "
@@ -244,9 +252,21 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
             raise ValueError(
                 f"--ring-tokens={args.ring_tokens} must be divisible "
                 f"by the slice's {chips} chips")
-    reports = multihost.launch_local_slice(
-        topology=args.topology, accelerator=args.accelerator,
-        ring_tokens=args.ring_tokens)
+    if args.num_slices > 1:
+        if args.ring_tokens:
+            raise SystemExit(
+                "--ring-tokens is a single-slice smoke; drop it or "
+                "run without --num-slices")
+        per_slice = multihost.launch_local_multislice(
+            num_slices=args.num_slices, topology=args.topology,
+            accelerator=args.accelerator)
+        reports = [dict(rep, slice=sid)
+                   for sid, reps in enumerate(per_slice)
+                   for rep in reps]
+    else:
+        reports = multihost.launch_local_slice(
+            topology=args.topology, accelerator=args.accelerator,
+            ring_tokens=args.ring_tokens)
     ok = all(r["ok"] for r in reports)
     serving_rep = spec_rep = None
     if args.serving:
@@ -264,6 +284,8 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
     else:
         for rank, rep in enumerate(reports):
             ring = ""
+            if "slice" in rep:
+                ring = f" [slice {rep['slice']}]"
             if "ring_tokens" in rep:
                 ring = (f", ring {rep['ring_tokens']} tokens in "
                         f"{rep['ring_seconds']}s "

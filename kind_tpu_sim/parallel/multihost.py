@@ -245,6 +245,11 @@ def _worker_main() -> int:
     initialize_from_env()
     report = global_device_report()
     report.update(slice_smoke())
+    # DCN-tier identity (multislice): echoed so the launcher can
+    # assert the plugin-style env contract reached the worker.
+    for key in ("MEGASCALE_NUM_SLICES", "MEGASCALE_SLICE_ID"):
+        if key in os.environ:
+            report[key.lower()] = os.environ[key]
     ring_tokens = int(os.environ.get("TPU_SIM_RING_TOKENS", "0"))
     if ring_tokens:
         report.update(ring_long_context_smoke(ring_tokens))
@@ -256,7 +261,61 @@ def _worker_main() -> int:
     return 0
 
 
+def _pick_ports(n: int) -> List[int]:
+    """n distinct ephemeral ports: all sockets bound CONCURRENTLY
+    before any closes, so the kernel cannot hand out the same port
+    twice within one call. The bind-then-close TOCTOU race with
+    OTHER processes remains; the launchers retry with fresh ports
+    when a launch dies of a bind failure."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            sock = socket.socket()
+            sock.bind(("127.0.0.1", 0))
+            socks.append(sock)
+        return [sock.getsockname()[1] for sock in socks]
+    finally:
+        for sock in socks:
+            sock.close()
+
+
+def _with_port_retry(thunk, attempts: int):
+    """Run a launch thunk, retrying ONLY the coordinator-port TOCTOU
+    race (bind failure, or the rendezvous timeout a port collision
+    degenerates into); any other failure is deterministic and
+    rerunning just doubles the latency to the real error."""
+    attempts = max(1, attempts)
+    for attempt in range(attempts):
+        try:
+            return thunk()
+        except (RuntimeError, TimeoutError) as exc:
+            msg = str(exc).lower()
+            retryable = (isinstance(exc, TimeoutError)
+                         or any(pat in msg for pat in _BIND_ERRORS))
+            if not retryable or attempt == attempts - 1:
+                raise
+    raise AssertionError("unreachable")
+
+
 def _launch_once(s, timeout: float, ring_tokens: int = 0) -> List[dict]:
+    port = _pick_ports(1)[0]
+    n = s.num_hosts
+    worker_envs = []
+    for worker in range(n):
+        env = dict(s.worker_env(worker, hostnames=["127.0.0.1"] * n))
+        env["TPU_SIM_COORDINATOR_PORT"] = str(port)
+        if ring_tokens:
+            env["TPU_SIM_RING_TOKENS"] = str(ring_tokens)
+        worker_envs.append(env)
+    return _launch_grid(worker_envs, timeout)
+
+
+def _launch_grid(worker_envs: List[dict], timeout: float) -> List[dict]:
+    """Spawn one worker process per env dict (each env carries the
+    full plugin-style identity incl. its rendezvous port), wait for
+    all, and return their JSON reports in spawn order."""
     import json
     import pathlib
     import subprocess
@@ -264,16 +323,7 @@ def _launch_once(s, timeout: float, ring_tokens: int = 0) -> List[dict]:
     import tempfile
     import time
 
-    n = s.num_hosts
-    # Ephemeral-port pick is bind-then-close, so a rare TOCTOU race
-    # with another process exists; launch_local_slice retries with a
-    # fresh port when the launch dies of a bind failure.
-    import socket
-
-    with socket.socket() as sock:
-        sock.bind(("127.0.0.1", 0))
-        port = sock.getsockname()[1]
-
+    n = len(worker_envs)
     repo_root = str(pathlib.Path(__file__).resolve().parents[2])
     with tempfile.TemporaryDirectory() as logdir:
         logs = pathlib.Path(logdir)
@@ -283,11 +333,7 @@ def _launch_once(s, timeout: float, ring_tokens: int = 0) -> List[dict]:
 
             for worker in range(n):
                 env = cpu_subprocess_env()
-                env.update(s.worker_env(worker,
-                                        hostnames=["127.0.0.1"] * n))
-                env["TPU_SIM_COORDINATOR_PORT"] = str(port)
-                if ring_tokens:
-                    env["TPU_SIM_RING_TOKENS"] = str(ring_tokens)
+                env.update(worker_envs[worker])
                 env["JAX_PLATFORMS"] = "cpu"
                 env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
                     "PYTHONPATH", "")
@@ -364,19 +410,70 @@ def launch_local_slice(topology: str = "2x2x2",
     from kind_tpu_sim import topology as topo
 
     s = topo.make_slice(accelerator=accelerator, topology=topology)
-    attempts = max(1, attempts)
-    for attempt in range(attempts):
-        try:
-            return _launch_once(s, timeout, ring_tokens=ring_tokens)
-        except RuntimeError as exc:
-            # Retry only the coordinator-port TOCTOU race; any other
-            # failure is deterministic and rerunning it just doubles
-            # the latency to the real error.
-            msg = str(exc).lower()
-            retryable = any(pat in msg for pat in _BIND_ERRORS)
-            if not retryable or attempt == attempts - 1:
-                raise
-    raise AssertionError("unreachable")
+    return _with_port_retry(
+        lambda: _launch_once(s, timeout, ring_tokens=ring_tokens),
+        attempts)
+
+
+def launch_local_multislice(num_slices: int = 2,
+                            topology: str = "2x4",
+                            accelerator: str = "tpu-v5-lite-podslice",
+                            timeout: float = 300.0,
+                            attempts: int = 2) -> List[List[dict]]:
+    """Stand up a whole simulated MULTISLICE job on this machine —
+    the no-kind proof of the DCN tier.
+
+    One process per host per slice; each slice rendezvouses as its
+    own jax.distributed world on its own loopback port (exactly the
+    per-slice StatefulSet layout `manifests.jax_multihost_manifest`
+    emits for --num-slices clusters), and every worker carries the
+    MEGASCALE_* cross-slice contract the device plugin injects at
+    Allocate. Returns reports grouped per slice; raises if any
+    worker crashes, any slice's world is the wrong size, or a
+    worker's megascale identity doesn't match its slice.
+    """
+    from kind_tpu_sim import topology as topo
+
+    ms = topo.make_multislice(num_slices, accelerator=accelerator,
+                              topology=topology)
+    h = ms.slice_topo.num_hosts
+
+    def build_envs() -> List[dict]:
+        ports = _pick_ports(num_slices)
+        envs = []
+        for sid in range(num_slices):
+            for worker in range(h):
+                env = dict(ms.worker_env(
+                    sid, worker, hostnames=["127.0.0.1"] * h))
+                env["TPU_SIM_COORDINATOR_PORT"] = str(ports[sid])
+                envs.append(env)
+        return envs
+
+    flat = _with_port_retry(
+        lambda: _launch_grid(build_envs(), timeout), attempts)
+    per_slice = [flat[sid * h:(sid + 1) * h]
+                 for sid in range(num_slices)]
+    chips = ms.slice_topo.num_chips
+    for sid, reports in enumerate(per_slice):
+        for rep in reports:
+            if not rep.get("ok"):
+                raise RuntimeError(
+                    f"slice {sid} worker failed: {rep}")
+            if rep.get("global_devices") != chips:
+                raise RuntimeError(
+                    f"slice {sid} world has "
+                    f"{rep.get('global_devices')} devices, "
+                    f"wanted {chips} (slices must stay separate "
+                    f"jax.distributed worlds)")
+            if rep.get("megascale_slice_id") != str(sid):
+                raise RuntimeError(
+                    f"slice {sid} worker carries megascale id "
+                    f"{rep.get('megascale_slice_id')!r}")
+            if rep.get("megascale_num_slices") != str(num_slices):
+                raise RuntimeError(
+                    f"bad MEGASCALE_NUM_SLICES in slice {sid}: "
+                    f"{rep.get('megascale_num_slices')!r}")
+    return per_slice
 
 
 if __name__ == "__main__":

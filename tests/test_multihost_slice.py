@@ -51,6 +51,27 @@ def test_local_slice_single_host():
     assert rep["global_devices"] == rep["local_devices"] == 4
 
 
+def test_local_multislice_isolated_worlds():
+    """Two MULTI-HOST slices launch as SEPARATE jax.distributed
+    worlds — 2 hosts rendezvous per slice on per-slice ports, global
+    devices stay 8 per world (not 16), and every worker carries the
+    megascale identity the device plugin would inject. The no-kind
+    proof of the DCN tier (1-host slices would skip the rendezvous
+    entirely and prove nothing about world separation)."""
+    per_slice = multihost.launch_local_multislice(
+        num_slices=2, topology="2x2x2",
+        accelerator="tpu-v4-podslice")
+    assert len(per_slice) == 2
+    for sid, reports in enumerate(per_slice):
+        assert len(reports) == 2  # 2x2x2 v4 = two hosts per slice
+        for rep in reports:
+            assert rep["ok"], rep
+            assert rep["process_count"] == 2  # a real rendezvous
+            assert rep["global_devices"] == 8
+            assert rep["megascale_slice_id"] == str(sid)
+            assert rep["megascale_num_slices"] == "2"
+
+
 def test_chips_from_env():
     assert multihost._chips_from_env({"TPU_CHIPS_PER_HOST_BOUNDS":
                                       "2,2,1"}) == 4
