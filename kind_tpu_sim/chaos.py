@@ -47,11 +47,18 @@ class ChaosManager:
         return workers[worker]
 
     def fail_devices(self, node: str, device_ids: List[str]) -> None:
-        """Mark devices unhealthy on a node (empty list = all)."""
+        """Mark devices unhealthy on a node (empty list = all).
+
+        Device IDs come from ``MultiSlice.device_ids`` on the node's
+        GLOBAL worker index — the job-level scheme the plugin uses
+        (``DevicePlugin::DeviceIds``), valid on every slice of a
+        ``--num-slices > 1`` cluster (per-slice ``Slice.device_ids``
+        would reject nodes of slice >= 1).
+        """
         if not device_ids:
-            s = self.cfg.slice
             workers = self.cluster.worker_nodes()
-            device_ids = s.device_ids(workers.index(node))
+            device_ids = self.cfg.multislice.device_ids(
+                workers.index(node))
         content = "\n".join(device_ids) + "\n"
         self.rt.run(
             "exec", node, "mkdir", "-p", manifests.SIM_STATE_DIR

@@ -145,6 +145,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--accelerator", default=topo.DEFAULT_ACCELERATOR,
         choices=sorted(topo.ACCELERATORS),
     )
+    chaos.add_argument(
+        "--num-slices", type=int, default=1,
+        help="match the create-time multislice shape so --worker "
+             "range checks cover every slice's nodes",
+    )
 
     smoke = sub.add_parser(
         "slice-smoke",
@@ -447,6 +452,7 @@ def config_from_args(args: argparse.Namespace) -> SimConfig:
         kwargs.update(
             accelerator=args.accelerator,
             tpu_topology=args.topology,
+            num_slices=args.num_slices,
         )
     if getattr(args, "image_name", None):
         kwargs["image_name"] = args.image_name

@@ -98,24 +98,35 @@ class Prefetcher:
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _put(self, item) -> bool:
+        """Stop-aware bounded put; False if close() was called.
+
+        Every producer put goes through here — including the terminal
+        exception/_DONE puts: an unconditionally blocking put there
+        would ignore a close() that arrives while the queue is full,
+        leaving the thread (and its staged device batches) pinned until
+        the consumer happens to drain. A consumer that abandons
+        iteration without ever calling close() still leaks the thread —
+        use the context-manager surface for early exits."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _run(self) -> None:
         try:
             for item in self._source:
                 if self._stop.is_set():
                     return
-                staged = self._place(item)
-                while not self._stop.is_set():
-                    try:
-                        self._queue.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if self._stop.is_set():
+                if not self._put(self._place(item)):
                     return
         except Exception as exc:  # propagate to the consumer
-            self._queue.put(exc)
+            self._put(exc)
             return
-        self._queue.put(self._DONE)
+        self._put(self._DONE)
 
     def __iter__(self):
         return self

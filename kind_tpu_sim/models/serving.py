@@ -84,8 +84,10 @@ class Request:
 
     ``sampling`` is the per-request vLLM-SamplingParams analog
     (decode.SamplingConfig); None or temperature<=0 means greedy.
-    ``seed`` makes the request's sampled tokens reproducible
-    independent of slot placement or co-tenants.
+    An explicit int ``seed`` makes the request's sampled tokens
+    reproducible independent of slot placement or co-tenants; the
+    default None draws fresh entropy at submit (the vLLM behavior —
+    two seedless sampled requests must not emit identical streams).
     """
 
     request_id: str
@@ -93,7 +95,7 @@ class Request:
     max_new: int
     eos_id: Optional[int] = None
     sampling: Optional[SamplingConfig] = None
-    seed: int = 0
+    seed: Optional[int] = None
     cache_prefix: bool = False   # store this prompt's KV for reuse
     #                              by later prefix-sharing requests
 
@@ -489,6 +491,10 @@ class PrefixCache:
 
         self.capacity = capacity
         self.entries = collections.OrderedDict()
+        # stored-prefix length -> entry count: lookup probes one dict
+        # key per DISTINCT length instead of tuple-comparing every
+        # entry (O(lengths × hash) vs O(entries × prompt_len))
+        self._len_count: Dict[int, int] = collections.Counter()
         self.hits = 0
         self.misses = 0
 
@@ -496,6 +502,10 @@ class PrefixCache:
                max_len: Optional[int] = None):
         """Longest USABLE stored strict prefix of ``prompt``
         (LRU-refreshed); None on miss.
+
+        Probes stored lengths longest-first: only one entry can match
+        ``prompt[:L]`` (entries are keyed by exact token tuple), so
+        each length is a single dict hit — no linear scan.
 
         With ``max_len``, entries whose restore would not fit the
         slot are skipped — both the stored rows (entry pad) and the
@@ -505,12 +515,12 @@ class PrefixCache:
         prefix). Infeasible entries don't count as hits, don't get
         LRU-refreshed, and a shorter stored prefix that DOES fit is
         used instead."""
-        best = None
-        for key, entry in self.entries.items():
-            if best is not None and len(key) <= len(best):
+        for length in sorted(self._len_count, reverse=True):
+            if length >= len(prompt):
                 continue
-            if not (len(key) < len(prompt)
-                    and tuple(prompt[:len(key)]) == key):
+            key = tuple(prompt[:length])
+            entry = self.entries.get(key)
+            if entry is None:
                 continue
             if max_len is not None and (
                     entry["pad"] > max_len
@@ -518,20 +528,23 @@ class PrefixCache:
                                               - entry["len"])
                     > max_len):
                 continue
-            best = key
-        if best is None:
-            self.misses += 1
-            return None
-        self.hits += 1
-        self.entries.move_to_end(best)
-        return self.entries[best]
+            self.hits += 1
+            self.entries.move_to_end(key)
+            return entry
+        self.misses += 1
+        return None
 
     def store(self, prompt: List[int], entry) -> None:
         key = tuple(prompt)
+        if key not in self.entries:
+            self._len_count[len(key)] += 1
         self.entries[key] = entry
         self.entries.move_to_end(key)
         while len(self.entries) > self.capacity:
-            self.entries.popitem(last=False)
+            old_key, _ = self.entries.popitem(last=False)
+            self._len_count[len(old_key)] -= 1
+            if not self._len_count[len(old_key)]:
+                del self._len_count[len(old_key)]
 
     def report(self) -> Dict[str, Any]:
         return {"entries": len(self.entries), "hits": self.hits,
@@ -667,6 +680,12 @@ class ServingEngine:
                 f"slot capacity is {self.serving.max_len}")
         if request.max_new < 1:
             raise ValueError("max_new must be >= 1")
+        if request.seed is None:
+            # per-request entropy, resolved at submit so the stored
+            # Request records the seed that actually ran (replayable)
+            import os
+
+            request.seed = int.from_bytes(os.urandom(4), "little")
         self.queue.append(request)
 
     def step_round(self) -> None:

@@ -327,6 +327,25 @@ class MultiSlice:
         labels[LABEL_SLICE_ID] = str(slice_id)
         return labels
 
+    def device_ids(self, global_worker: int) -> List[str]:
+        """Device-plugin IDs for one node by its GLOBAL worker index —
+        the job-level counterpart of ``SliceTopology.device_ids``.
+
+        The plugin derives IDs from NODE_NAME's global index with the
+        same ``worker_id * chips + i`` scheme regardless of slice
+        (DevicePlugin::DeviceIds, plugin/src/device_plugin.cc:151), so
+        this is THE in-Python source of truth for any tooling (chaos,
+        tests) addressing nodes of slice >= 1."""
+        if not 0 <= global_worker < self.num_hosts:
+            raise ValueError(
+                f"global worker {global_worker} out of range for "
+                f"{self.num_hosts}-host job")
+        chips = self.slice_topo.chips_per_host
+        base = global_worker * chips
+        return [
+            f"tpu-{global_worker}-{base + i}" for i in range(chips)
+        ]
+
     def hostnames(self) -> List[str]:
         """Canonical pod DNS names across every slice, slice-major —
         THE global list the device plugin receives whole and windows

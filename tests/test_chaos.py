@@ -33,6 +33,20 @@ def test_chaos_fail_all_devices_on_worker():
     assert ids == [f"tpu-1-{i}" for i in range(8, 16)]
 
 
+def test_chaos_fail_all_devices_multislice():
+    # Worker 3 lives in slice 1 of a 2-slice job (2 hosts/slice).
+    # Device IDs come from the GLOBAL worker index with the plugin's
+    # worker_id*chips scheme (device_plugin.cc DeviceIds), so fail-all
+    # must work on every slice, not just slice 0.
+    sim = make_sim(num_slices=2)
+    sim.chaos("fail", worker=3)
+    writes = sim.executor.find("docker exec -i kind-tpu-sim-worker4")
+    assert len(writes) == 1
+    _, stdin = writes[0]
+    ids = stdin.strip().splitlines()
+    assert ids == [f"tpu-3-{i}" for i in range(24, 32)]
+
+
 def test_chaos_fail_specific_device_and_heal():
     sim = make_sim()
     sim.chaos("fail", worker=0, devices=["tpu-0-3"])
