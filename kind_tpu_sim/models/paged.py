@@ -1,0 +1,269 @@
+"""Paged KV cache for the serving engine (the vLLM PagedAttention
+memory model, rebuilt TPU-first).
+
+The dense slot grid (models/serving.py) preallocates ``max_slots x
+max_len`` KV rows; with realistic prompt/output length variance most
+of that HBM is padding. vLLM's answer is paging: KV lives in a global
+pool of fixed-size blocks, each sequence holds a block list, and HBM
+scales with TOKENS IN FLIGHT, not worst-case length
+(reference workload: /root/reference/pods/vllm-cpu-pod.yaml:16-20 —
+its KV-cache sizing env at :11-15 is exactly this pool's knob).
+
+TPU-first shape discipline — everything static, no per-sequence
+kernels:
+
+* **Block pool.** Per layer, k/v tensors of shape ``(num_blocks,
+  block_size, kv_heads, head_dim)`` (bf16 or int8 QuantArray — the
+  same storage init_cache builds, with num_blocks standing in for
+  batch). Block 0 is a reserved GARBAGE sink: every masked write
+  (inactive slot, padding position) is routed there instead of being
+  predicated out, so scatters stay dense and branch-free.
+* **Block tables.** A ``(max_slots, width)`` int32 table maps each
+  slot's logical block index to a pool block. ``width`` is bucketed to
+  the next power of two of the longest ACTIVE sequence's block count —
+  the gather view (below) then scales with the workload's real length,
+  not the configured maximum, and jit compiles O(log max_blocks)
+  variants.
+* **Gather-per-chunk.** The decode inner scan needs the big cache
+  loop-invariant (decode.py's HBM-roofline trick). Paging composes
+  with it for free: ONCE per chunk, gather the pool through the block
+  table into a dense ``(slots, width*block_size, kv, hd)`` view, run
+  the exact same chunk scan the grid engine uses (serving._chunk_scan),
+  then scatter the chunk's new k/v back into pool blocks. The gather
+  costs ~2 extra pool reads per chunk — amortized 64-fold like the
+  merge, invisible next to the per-step KV re-read decode already pays.
+* **Scatter writes.** Prompt k/v (prefill) and chunk-buffer rows
+  (decode) are written with one flat ``pool.at[block_ids, offsets]``
+  scatter; target indices are computed from the block table, with
+  masked rows aimed at garbage block 0.
+
+Allocation is host-side (a free list of ints) because it happens at
+scheduling boundaries, not inside jit. Blocks are allocated on demand
+as generation crosses block boundaries; pool exhaustion triggers
+RECOMPUTE PREEMPTION (serving.PagedServingEngine): the youngest slot
+is evicted, its blocks freed, and its request requeued at the front.
+Exactness survives because generation is a pure function of (request,
+seed, generation index) — greedy and seeded-sampled streams replay
+identically, so preemption is invisible in the output (the property
+vLLM gets from recompute-mode preemption).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from kind_tpu_sim.models.decode import init_cache
+from kind_tpu_sim.models.transformer import ModelConfig
+
+GARBAGE_BLOCK = 0
+
+
+def init_pools(cfg: ModelConfig, num_blocks: int, block_size: int):
+    """Per-layer block pools; identical storage to a decode cache with
+    num_blocks as the batch axis (QuantArray when cfg.int8_kv)."""
+    return init_cache(cfg, num_blocks, block_size)
+
+
+def _map_kv(arr, fn):
+    """Apply fn to a plain array or to both components of a
+    QuantArray (q and per-row scale share the paging geometry)."""
+    from kind_tpu_sim.models.quant import QuantArray
+
+    if isinstance(arr, QuantArray):
+        return QuantArray(q=fn(arr.q), scale=fn(arr.scale))
+    return fn(arr)
+
+
+def gather_view(pools, tables):
+    """Gather each slot's blocks into a dense (slots, width*B, kv, hd)
+    big-cache view, one pytree per layer — the loop-invariant cache
+    the chunk scan attends over. Garbage/padding table entries gather
+    block 0; the scan masks them via the lengths vector."""
+    slots, width = tables.shape
+
+    def view(arr):
+        g = arr[tables.reshape(-1)]  # (slots*width, B, ...)
+        return g.reshape((slots, width * arr.shape[1])
+                         + arr.shape[2:])
+
+    return [
+        {"k": _map_kv(lc["k"], view), "v": _map_kv(lc["v"], view)}
+        for lc in pools
+    ]
+
+
+def _scatter_flat(pool_arr, blocks, offsets, rows):
+    """pool[blocks[i], offsets[i]] = rows[i] for every flat row i."""
+    return pool_arr.at[blocks, offsets].set(
+        rows.astype(pool_arr.dtype))
+
+
+def scatter_rows(pools, tables, starts, rows_per_layer, active):
+    """Write each slot's chunk-buffer rows (slots, chunk, kv, hd) into
+    its pool blocks at positions starts[b]..starts[b]+chunk-1.
+    Inactive slots write to garbage block 0. Returns new pools."""
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import QuantArray, quantize
+
+    slots, width = tables.shape
+    chunk = rows_per_layer[0]["k"].shape[1]
+    block_size = pools[0]["k"].q.shape[1] if isinstance(
+        pools[0]["k"], QuantArray) else pools[0]["k"].shape[1]
+
+    pos = starts[:, None] + jnp.arange(chunk)[None, :]  # (slots, chunk)
+    logical = pos // block_size
+    offsets = (pos % block_size).reshape(-1)
+    # clip: an overflowing logical index only occurs for slots being
+    # retired this round (same invariant as serving._scatter_chunk);
+    # their writes are routed to garbage anyway.
+    safe_logical = jnp.clip(logical, 0, width - 1)
+    blocks = jnp.take_along_axis(tables, safe_logical, axis=1)
+    valid = active[:, None] & (logical < width)
+    blocks = jnp.where(valid, blocks, GARBAGE_BLOCK).reshape(-1)
+
+    new_pools = []
+    for lc, rows in zip(pools, rows_per_layer):
+        def write(pool_arr, upd):
+            flat = upd.reshape((slots * chunk,) + upd.shape[2:])
+            return _scatter_flat(pool_arr, blocks, offsets, flat)
+
+        if isinstance(lc["k"], QuantArray):
+            qk = quantize(rows["k"], axis=3)
+            qv = quantize(rows["v"], axis=3)
+            new_pools.append({
+                "k": QuantArray(q=write(lc["k"].q, qk.q),
+                                scale=write(lc["k"].scale, qk.scale)),
+                "v": QuantArray(q=write(lc["v"].q, qv.q),
+                                scale=write(lc["v"].scale, qv.scale)),
+            })
+        else:
+            new_pools.append({"k": write(lc["k"], rows["k"]),
+                              "v": write(lc["v"], rows["v"])})
+    return new_pools
+
+
+def paged_prefill(params, pools, tokens, true_len, table_row, *,
+                  cfg: ModelConfig):
+    """Run a prompt (1, t_pad) through the forward, scattering k/v for
+    positions < true_len into the slot's pool blocks (table_row:
+    (width,) int32). Returns (pools, fp32 logits at the true last
+    position) — the paged counterpart of serving._prefill_into_slot.
+    """
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import QuantArray, embed_lookup, quantize
+    from kind_tpu_sim.models.transformer import (
+        _block_core,
+        _readout,
+        _rms_norm,
+    )
+
+    _, t_p = tokens.shape
+    dtype = jnp.dtype(cfg.dtype)
+    block_size = pools[0]["k"].q.shape[1] if isinstance(
+        pools[0]["k"], QuantArray) else pools[0]["k"].shape[1]
+    width = table_row.shape[0]
+
+    positions = jnp.broadcast_to(jnp.arange(t_p), (1, t_p))
+    x = embed_lookup(params["embed"], tokens, dtype)
+
+    pos = jnp.arange(t_p)
+    logical = pos // block_size
+    offsets = pos % block_size
+    safe_logical = jnp.clip(logical, 0, width - 1)
+    blocks = table_row[safe_logical]
+    valid = (pos < true_len) & (logical < width)
+    blocks = jnp.where(valid, blocks, GARBAGE_BLOCK)
+
+    new_pools = []
+    for bparams, lc in zip(params["blocks"], pools):
+        x, _, k, v = _block_core(x, bparams, cfg, positions)
+
+        def write(pool_arr, upd):
+            return _scatter_flat(pool_arr, blocks, offsets, upd[0])
+
+        if isinstance(lc["k"], QuantArray):
+            qk = quantize(k, axis=3)
+            qv = quantize(v, axis=3)
+            new_pools.append({
+                "k": QuantArray(q=write(lc["k"].q, qk.q),
+                                scale=write(lc["k"].scale, qk.scale)),
+                "v": QuantArray(q=write(lc["v"].q, qv.q),
+                                scale=write(lc["v"].scale, qv.scale)),
+            })
+        else:
+            new_pools.append({"k": write(lc["k"], k),
+                              "v": write(lc["v"], v)})
+
+    last = jnp.take_along_axis(
+        x, (true_len - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
+    h = _rms_norm(last[:, 0, :], params["final_norm"])
+    logits = _readout(h, params["embed"], cfg.int8_native)
+    return new_pools, logits[0].astype(jnp.float32)
+
+
+def paged_decode_chunk(params, pools, tables, lengths, last_token,
+                       active, sampling_state, *, cfg: ModelConfig,
+                       chunk: int):
+    """One scheduling quantum over the paged pool: gather the block
+    view once, run the shared chunk scan, scatter the chunk buffer
+    back. Returns (pools, lengths, last_token, emitted)."""
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.serving import _chunk_scan
+
+    view = gather_view(pools, tables)
+    token, small, emitted = _chunk_scan(
+        params, view, lengths, last_token, active, sampling_state,
+        cfg=cfg, chunk=chunk)
+    pools = scatter_rows(pools, tables, lengths, small, active)
+    lengths = jnp.where(active, lengths + chunk, lengths)
+    return pools, lengths, token, emitted
+
+
+# ---------------------------------------------------------------------
+# host-side block allocator
+
+
+class BlockAllocator:
+    """Free-list allocator over pool blocks 1..num_blocks-1 (block 0
+    is the garbage sink and never allocated). Pure host bookkeeping —
+    allocation happens at scheduling boundaries, outside jit."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (one is garbage)")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks, or None (all-or-nothing) if the pool is short."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if not 0 < b < self.num_blocks:
+                raise ValueError(f"bad block id {b}")
+            if b in self._free:
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+
+def blocks_needed(tokens: int, block_size: int) -> int:
+    return -(-tokens // block_size)
+
+
+def width_bucket(n: int, lo: int = 2) -> int:
+    """Next power of two >= n — bounds block-table width recompiles."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b

@@ -75,6 +75,13 @@ class ServingConfig:
     prefix_cache_entries: int = 0   # >0: LRU prompt-prefix KV cache
     #                                 (the vLLM automatic-prefix-
     #                                 caching analog; see PrefixCache)
+    paged_blocks: int = 0     # >0: paged KV (PagedServingEngine) —
+    #                           global pool of this many blocks
+    #                           replaces the (slots, max_len) grid
+    block_size: int = 16      # KV positions per pool block
+    speculative_k: int = 0    # >0: per-slot prompt-lookup drafts of
+    #                           this width, one verify window per
+    #                           round (SpeculativeServingEngine)
 
 
 @dataclasses.dataclass
@@ -272,17 +279,18 @@ def _scatter_chunk(cache_arr, small_arr, starts, active, cfg):
     return jax.vmap(_merge_row)(cache_arr, upd, starts)
 
 
-def _decode_chunk(params, cache, lengths, last_token, active,
-                  sampling_state, *, cfg: ModelConfig, chunk: int):
-    """One scheduling quantum: ``chunk`` tokens for every slot
-    (inactive slots compute too — lockstep SPMD — but their cache
-    write-back is suppressed and their emissions ignored by the host).
-    ``sampling_state`` carries per-slot (temp, top_k, top_p, keys,
-    prompt_len); token selection folds each slot's key by its
-    GENERATION index (position - prompt_len), so a request's sampled
-    tokens are reproducible regardless of slot placement, admission
-    round, or grid co-tenants. Returns (cache, lengths, last_token,
-    emitted (slots, chunk))."""
+def _chunk_scan(params, big_cache, lengths, last_token, active,
+                sampling_state, *, cfg: ModelConfig, chunk: int):
+    """The shared inner scan of one scheduling quantum: ``chunk``
+    tokens for every slot against a loop-invariant big cache
+    (inactive slots compute too — lockstep SPMD — but their emissions
+    are ignored by the host and their write-back suppressed by the
+    caller's merge). ``big_cache`` is per-layer (b, s, kv, hd) —
+    either the dense grid rows or a paged gather view; the merge-back
+    strategy is the caller's (grid scatter vs pool scatter), which is
+    the only difference between the two engines' decode rounds.
+    Returns (next_token, small chunk buffers, emitted (slots, chunk)).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -305,8 +313,8 @@ def _decode_chunk(params, cache, lengths, last_token, active,
         token, small = carry
         x = embed_lookup(params["embed"], token, dtype)
         new_small = []
-        for bparams, big_lc, small_lc in zip(params["blocks"], cache,
-                                             small):
+        for bparams, big_lc, small_lc in zip(params["blocks"],
+                                             big_cache, small):
             # decode's chunk block with a per-slot base vector: each
             # slot attends over its own [0, lengths[b]) prefix.
             x, small_lc = _block_decode_chunk(
@@ -335,6 +343,23 @@ def _decode_chunk(params, cache, lengths, last_token, active,
 
     (token, small), emitted = jax.lax.scan(
         step, (last_token, small0), jnp.arange(chunk))
+    return token, small, emitted.swapaxes(0, 1)
+
+
+def _decode_chunk(params, cache, lengths, last_token, active,
+                  sampling_state, *, cfg: ModelConfig, chunk: int):
+    """One scheduling quantum over the dense slot grid.
+    ``sampling_state`` carries per-slot (temp, top_k, top_p, keys,
+    prompt_len); token selection folds each slot's key by its
+    GENERATION index (position - prompt_len), so a request's sampled
+    tokens are reproducible regardless of slot placement, admission
+    round, or grid co-tenants. Returns (cache, lengths, last_token,
+    emitted (slots, chunk))."""
+    import jax.numpy as jnp
+
+    token, small, emitted = _chunk_scan(
+        params, cache, lengths, last_token, active, sampling_state,
+        cfg=cfg, chunk=chunk)
     new_cache = [
         {
             "k": _scatter_chunk(big_lc["k"], small_lc["k"], lengths,
@@ -345,7 +370,7 @@ def _decode_chunk(params, cache, lengths, last_token, active,
         for big_lc, small_lc in zip(cache, small)
     ]
     lengths = jnp.where(active, lengths + chunk, lengths)
-    return new_cache, lengths, token, emitted.swapaxes(0, 1)
+    return new_cache, lengths, token, emitted
 
 
 def _suffix_into_slot(params, cache, tokens, true_len, base, slot, *,
@@ -639,7 +664,6 @@ class ServingEngine:
         self.cfg = cfg
         self.serving = serving
         n = serving.max_slots
-        self.cache = init_cache(cfg, n, serving.max_len)
         self.lengths = jnp.zeros((n,), jnp.int32)
         self.last_token = jnp.zeros((n,), jnp.int32)
         self.active = jnp.zeros((n,), bool)
@@ -655,17 +679,27 @@ class ServingEngine:
         self.slot_req: List[Optional[Request]] = [None] * n
         self.slot_emitted: List[List[int]] = [[] for _ in range(n)]
         self.finished: List[Completion] = []
+        self._first = _jitted_first()
+        self._init_storage()
 
+    def _init_storage(self) -> None:
+        """Allocate the KV storage and bind the jitted kernels (the
+        dense grid; PagedServingEngine overrides with block pools)."""
+        import functools
+
+        cfg, serving = self.cfg, self.serving
+        self.cache = init_cache(cfg, serving.max_slots,
+                                serving.max_len)
         # cache is donated: XLA updates the 100+ MB grid in place.
         # The jitted kernels are module-cached per (cfg, chunk);
         # binding params here keeps the bench's dispatch-counting
         # wrappers per engine.
         self._prefill = functools.partial(_jitted_prefill(cfg),
-                                          params)
+                                          self.params)
         self._chunk = functools.partial(
-            _jitted_chunk(cfg, serving.chunk), params)
-        self._first = _jitted_first()
-        self._suffix = functools.partial(_jitted_suffix(cfg), params)
+            _jitted_chunk(cfg, serving.chunk), self.params)
+        self._suffix = functools.partial(_jitted_suffix(cfg),
+                                         self.params)
         self.prefix_cache = (
             PrefixCache(serving.prefix_cache_entries)
             if serving.prefix_cache_entries > 0 else None)
@@ -673,11 +707,7 @@ class ServingEngine:
     # -- public surface ------------------------------------------------
 
     def submit(self, request: Request) -> None:
-        need = len(request.prompt) + request.max_new
-        if need > self.serving.max_len:
-            raise ValueError(
-                f"request {request.request_id} needs {need} positions; "
-                f"slot capacity is {self.serving.max_len}")
+        self._capacity_check(request)
         if request.max_new < 1:
             raise ValueError("max_new must be >= 1")
         if request.seed is None:
@@ -696,11 +726,33 @@ class ServingEngine:
             return
         sampling_state = (self.temp, self.top_k, self.top_p,
                           self.keys, self.prompt_len)
+        emitted = self._decode_round(sampling_state)
+        self._retire(emitted)
+
+    # -- engine hooks (overridden by PagedServingEngine) ---------------
+
+    def _capacity_check(self, request: Request) -> None:
+        need = len(request.prompt) + request.max_new
+        if need > self.serving.max_len:
+            raise ValueError(
+                f"request {request.request_id} needs {need} positions; "
+                f"slot capacity is {self.serving.max_len}")
+
+    def _can_admit(self, request: Request) -> bool:
+        """Admission gate beyond a free slot (paged: block budget)."""
+        return True
+
+    def _on_admitted(self, slot: int, request: Request,
+                     first: int) -> None:
+        """Post-admission hook (speculative: seed the draft buffer)."""
+
+    def _decode_round(self, sampling_state):
+        """Run one chunk over the big cache; returns emitted tokens."""
         (self.cache, self.lengths, self.last_token,
          emitted) = self._chunk(self.cache, self.lengths,
                                 self.last_token, self.active,
                                 sampling_state)
-        self._retire(emitted)
+        return emitted
 
     def poll(self) -> List[Completion]:
         out, self.finished = self.finished, []
@@ -718,54 +770,70 @@ class ServingEngine:
 
     # -- internals -----------------------------------------------------
 
-    def _admit(self) -> None:
+    def _prefill_slot(self, slot: int, req: Request):
+        """Write the prompt's k/v into the slot's cache storage and
+        return the fp32 logits at the prompt's last position (the
+        grid implementation; PagedServingEngine overrides with the
+        block-pool scatter path)."""
         import jax.numpy as jnp
         import numpy as np
+
+        t_p = len(req.prompt)
+        hit = None
+        if self.prefix_cache is not None:
+            # feasibility lives in lookup(): infeasible entries
+            # aren't counted as hits and a shorter stored prefix
+            # that fits is preferred
+            hit = self.prefix_cache.lookup(
+                req.prompt, max_len=self.serving.max_len)
+        if hit is not None:
+            # prefix-cache admission: device-copy the stored
+            # rows, run ONLY the suffix through the model
+            p = hit["len"]
+            self.cache = _jitted_write()(self.cache, hit["kv"],
+                                         slot)
+            suffix = req.prompt[p:]
+            w_pad = _bucket(len(suffix))
+            tokens = np.zeros((1, w_pad), np.int32)
+            tokens[0, :len(suffix)] = suffix
+            self.cache, logits = self._suffix(
+                self.cache, jnp.asarray(tokens),
+                jnp.int32(len(suffix)), jnp.int32(p), slot)
+        else:
+            pad = _bucket(t_p)
+            tokens = np.zeros((1, pad), np.int32)
+            tokens[0, :t_p] = req.prompt
+            self.cache, logits = self._prefill(
+                self.cache, jnp.asarray(tokens),
+                jnp.int32(t_p), slot)
+        if (req.cache_prefix and self.prefix_cache is not None):
+            # store AFTER the slot holds the full prompt's k/v
+            # (either admission path), padded to a bucket so the
+            # readback kernel traces per bucket, not per length
+            bucket = min(_bucket(t_p), self.serving.max_len)
+            self.prefix_cache.store(req.prompt, {
+                "kv": _jitted_read(bucket)(self.cache, slot),
+                "len": t_p,
+                "pad": bucket,
+            })
+        return logits
+
+    def _admit(self) -> None:
+        import jax.numpy as jnp
 
         import jax
 
         for slot in range(self.serving.max_slots):
             if self.slot_req[slot] is not None or not self.queue:
                 continue
+            if not self._can_admit(self.queue[0]):
+                # FCFS: a head-of-queue request that can't take this
+                # slot (paged block budget) blocks the round — no
+                # overtaking, so big requests can't be starved.
+                break
             req = self.queue.pop(0)
             t_p = len(req.prompt)
-            hit = None
-            if self.prefix_cache is not None:
-                # feasibility lives in lookup(): infeasible entries
-                # aren't counted as hits and a shorter stored prefix
-                # that fits is preferred
-                hit = self.prefix_cache.lookup(
-                    req.prompt, max_len=self.serving.max_len)
-            if hit is not None:
-                # prefix-cache admission: device-copy the stored
-                # rows, run ONLY the suffix through the model
-                p = hit["len"]
-                self.cache = _jitted_write()(self.cache, hit["kv"],
-                                             slot)
-                suffix = req.prompt[p:]
-                w_pad = _bucket(len(suffix))
-                tokens = np.zeros((1, w_pad), np.int32)
-                tokens[0, :len(suffix)] = suffix
-                self.cache, logits = self._suffix(
-                    self.cache, jnp.asarray(tokens),
-                    jnp.int32(len(suffix)), jnp.int32(p), slot)
-            else:
-                pad = _bucket(t_p)
-                tokens = np.zeros((1, pad), np.int32)
-                tokens[0, :t_p] = req.prompt
-                self.cache, logits = self._prefill(
-                    self.cache, jnp.asarray(tokens),
-                    jnp.int32(t_p), slot)
-            if (req.cache_prefix and self.prefix_cache is not None):
-                # store AFTER the slot holds the full prompt's k/v
-                # (either admission path), padded to a bucket so the
-                # readback kernel traces per bucket, not per length
-                bucket = min(_bucket(t_p), self.serving.max_len)
-                self.prefix_cache.store(req.prompt, {
-                    "kv": _jitted_read(bucket)(self.cache, slot),
-                    "len": t_p,
-                    "pad": bucket,
-                })
+            logits = self._prefill_slot(slot, req)
 
             samp = req.sampling or SamplingConfig(temperature=0.0)
             self.temp = self.temp.at[slot].set(samp.temperature)
@@ -790,6 +858,7 @@ class ServingEngine:
             self.last_token = self.last_token.at[slot].set(first)
             active = first != req.eos_id and req.max_new > 1
             self.active = self.active.at[slot].set(active)
+            self._on_admitted(slot, req, first)
             if not active:
                 self._finish(slot)
 
@@ -840,6 +909,329 @@ class ServingEngine:
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.report()
+        return out
+
+
+def _jitted_paged_prefill(cfg: ModelConfig):
+    import functools
+
+    import jax
+
+    from kind_tpu_sim.models.paged import paged_prefill
+
+    return jax.jit(functools.partial(paged_prefill, cfg=cfg),
+                   donate_argnums=(1,))
+
+
+def _jitted_paged_chunk(cfg: ModelConfig, chunk: int):
+    import functools
+
+    import jax
+
+    from kind_tpu_sim.models.paged import paged_decode_chunk
+
+    return jax.jit(
+        functools.partial(paged_decode_chunk, cfg=cfg, chunk=chunk),
+        donate_argnums=(1,))
+
+
+_jitted_paged_prefill = _functools.lru_cache(maxsize=32)(
+    _jitted_paged_prefill)
+_jitted_paged_chunk = _functools.lru_cache(maxsize=32)(
+    _jitted_paged_chunk)
+
+
+class PagedServingEngine(ServingEngine):
+    """Continuous batching over a paged KV pool (models/paged.py) —
+    the vLLM PagedAttention memory model on TPU static shapes.
+
+    Same scheduler, sampling and exactness contracts as the dense
+    grid; only the KV storage differs: HBM scales with tokens in
+    flight (``paged_blocks * block_size`` positions shared by ALL
+    slots) instead of ``max_slots * max_len`` worst-case rows. Blocks
+    are allocated on demand at chunk boundaries; pool exhaustion
+    preempts the YOUNGEST slot (recompute semantics — the request is
+    requeued at the front and replays its exact stream, since
+    generation is a pure function of request + seed + index).
+    """
+
+    def _init_storage(self) -> None:
+        import functools
+
+        from kind_tpu_sim.models import paged
+
+        cfg, serving = self.cfg, self.serving
+        if serving.paged_blocks < 2:
+            raise ValueError(
+                "PagedServingEngine needs ServingConfig.paged_blocks"
+                " >= 2 (block 0 is the garbage sink)")
+        if serving.prefix_cache_entries > 0:
+            raise ValueError(
+                "prefix caching is not supported with the paged "
+                "engine yet; use the dense grid")
+        self.pools = paged.init_pools(cfg, serving.paged_blocks,
+                                      serving.block_size)
+        self.alloc = paged.BlockAllocator(serving.paged_blocks)
+        self.slot_blocks = [[] for _ in range(serving.max_slots)]
+        self.slot_admit_seq = [0] * serving.max_slots
+        self._admit_counter = 0
+        self.preemptions = 0
+        self.prefix_cache = None
+        self._paged_prefill = functools.partial(
+            _jitted_paged_prefill(cfg), self.params)
+        self._paged_chunk = functools.partial(
+            _jitted_paged_chunk(cfg, serving.chunk), self.params)
+
+    # -- hooks ---------------------------------------------------------
+
+    def _capacity_check(self, request: Request) -> None:
+        cap = (self.serving.paged_blocks - 1) * self.serving.block_size
+        need = len(request.prompt) + request.max_new
+        if need > cap:
+            raise ValueError(
+                f"request {request.request_id} needs {need} positions;"
+                f" pool capacity is {cap}")
+
+    def _can_admit(self, request: Request) -> bool:
+        from kind_tpu_sim.models import paged
+
+        return (paged.blocks_needed(len(request.prompt),
+                                    self.serving.block_size)
+                <= self.alloc.free_blocks)
+
+    def _prefill_slot(self, slot: int, req: Request):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kind_tpu_sim.models import paged
+
+        t_p = len(req.prompt)
+        bsz = self.serving.block_size
+        n = paged.blocks_needed(t_p, bsz)
+        blocks = self.alloc.alloc(n)
+        assert blocks is not None  # _can_admit gated this
+        self.slot_blocks[slot] = blocks
+        self._admit_counter += 1
+        self.slot_admit_seq[slot] = self._admit_counter
+
+        width = paged.width_bucket(n)
+        table_row = np.zeros((width,), np.int32)
+        table_row[:n] = blocks
+        pad = _bucket(t_p)
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :t_p] = req.prompt
+        self.pools, logits = self._paged_prefill(
+            self.pools, jnp.asarray(tokens), jnp.int32(t_p),
+            jnp.asarray(table_row))
+        return logits
+
+    def _preempt_youngest(self) -> bool:
+        """Evict the most recently admitted active slot: free its
+        blocks and requeue its request AT THE FRONT for exact
+        recompute. Returns False if nothing was evictable."""
+        import jax.numpy as jnp  # noqa: F401 (device vectors below)
+
+        candidates = [
+            (self.slot_admit_seq[s], s)
+            for s, r in enumerate(self.slot_req) if r is not None
+        ]
+        if not candidates:
+            return False
+        _, slot = max(candidates)
+        req = self.slot_req[slot]
+        self.alloc.free(self.slot_blocks[slot])
+        self.slot_blocks[slot] = []
+        self.queue.insert(0, req)
+        self.slot_req[slot] = None
+        self.slot_emitted[slot] = []
+        self.active = self.active.at[slot].set(False)
+        self.temp = self.temp.at[slot].set(0.0)
+        self.top_k = self.top_k.at[slot].set(0)
+        self.top_p = self.top_p.at[slot].set(1.0)
+        self.preemptions += 1
+        return True
+
+    def _decode_round(self, sampling_state):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kind_tpu_sim.models import paged
+
+        bsz = self.serving.block_size
+        chunk = self.serving.chunk
+        lengths_host = np.asarray(self.lengths)
+        active_host = np.asarray(self.active)
+
+        # Grow each active slot's block list to cover this chunk's
+        # writes — capped at the request's total need, so budget
+        # overshoot inside the final chunk never allocates blocks
+        # (those writes land in last-block slack or garbage).
+        while True:
+            shortfalls = {}
+            for s, req in enumerate(self.slot_req):
+                if req is None or not active_host[s]:
+                    continue
+                cover = min(int(lengths_host[s]) + chunk,
+                            len(req.prompt) + req.max_new)
+                need = paged.blocks_needed(cover, bsz) \
+                    - len(self.slot_blocks[s])
+                if need > 0:
+                    shortfalls[s] = need
+            if sum(shortfalls.values()) <= self.alloc.free_blocks:
+                break
+            # pool pressure: evict the youngest slot and retry;
+            # _capacity_check guarantees a lone survivor fits.
+            if not self._preempt_youngest():
+                break
+            active_host = np.asarray(self.active)
+        for s, need in shortfalls.items():
+            got = self.alloc.alloc(need)
+            assert got is not None
+            self.slot_blocks[s].extend(got)
+
+        width = paged.width_bucket(
+            max((len(b) for b in self.slot_blocks), default=1) or 1)
+        tables = np.zeros((self.serving.max_slots, width), np.int32)
+        for s, blks in enumerate(self.slot_blocks):
+            tables[s, :len(blks)] = blks
+
+        # preemption may have emptied the grid mid-round
+        if not any(r is not None for r in self.slot_req):
+            import numpy as _np
+
+            return _np.zeros((self.serving.max_slots, chunk),
+                             _np.int32)
+
+        (self.pools, self.lengths, self.last_token,
+         emitted) = self._paged_chunk(
+            self.pools, jnp.asarray(tables), self.lengths,
+            self.last_token, self.active, sampling_state)
+        return emitted
+
+    def _finish(self, slot: int) -> None:
+        super()._finish(slot)
+        self.alloc.free(self.slot_blocks[slot])
+        self.slot_blocks[slot] = []
+
+    def report(self) -> Dict[str, Any]:
+        out = super().report()
+        out["paged"] = {
+            "blocks": self.serving.paged_blocks,
+            "block_size": self.serving.block_size,
+            "blocks_in_use": (self.serving.paged_blocks - 1
+                              - self.alloc.free_blocks),
+            "preemptions": self.preemptions,
+        }
+        return out
+
+
+class SpeculativeServingEngine(ServingEngine):
+    """Continuous batching with speculative decoding per slot (the
+    vLLM speculative+continuous-batching composition).
+
+    Each scheduling quantum runs ONE verify window over the whole
+    grid (models/speculative._grid_verify_step): every active slot
+    drafts ``speculative_k`` tokens by prompt-lookup from its own
+    emitted buffer, the window is verified in a single forward (one
+    weight read for up to k+1 tokens per slot), and each slot keeps
+    its longest model-agreeing prefix — between 1 and k+1 tokens per
+    slot per dispatch, ragged, exactly like the serving grid handles
+    ragged lengths everywhere else. Admission/retirement happen
+    between windows, so the engine composes continuous batching and
+    speculation instead of choosing.
+
+    Greedy-only: acceptance is argmax-checked, so output is EXACTLY
+    the dense grid's / solo decoder's greedy stream
+    (tests/test_serving.py::test_speculative_grid_*); sampled
+    requests are rejected at submit.
+    """
+
+    def _init_storage(self) -> None:
+        import functools
+
+        import jax.numpy as jnp
+
+        from kind_tpu_sim.models.speculative import _jitted_grid_step
+
+        cfg, serving = self.cfg, self.serving
+        k = serving.speculative_k
+        if k < 1:
+            raise ValueError(
+                "SpeculativeServingEngine needs "
+                "ServingConfig.speculative_k >= 1")
+        if serving.prefix_cache_entries > 0:
+            raise ValueError(
+                "prefix caching is not supported with the "
+                "speculative engine yet")
+        n = serving.max_slots
+        # + k + 1 rows: the final verify window writes k/v past the
+        # last budgeted position (stale rows, never attended)
+        self._rows = serving.max_len + k + 1
+        self.cache = init_cache(cfg, n, self._rows)
+        self.out = jnp.zeros((n, self._rows), jnp.int32)
+        self.total = jnp.zeros((n,), jnp.int32)
+        self.verify_steps = 0
+        self._prefill = functools.partial(_jitted_prefill(cfg),
+                                          self.params)
+        self._suffix = functools.partial(_jitted_suffix(cfg),
+                                         self.params)
+        self._spec_step = functools.partial(_jitted_grid_step(cfg, k),
+                                            self.params)
+        self.prefix_cache = None
+
+    def _capacity_check(self, request: Request) -> None:
+        super()._capacity_check(request)
+        samp = request.sampling
+        if samp is not None and samp.temperature > 0.0:
+            raise ValueError(
+                "speculative serving is greedy-exact only; submit "
+                f"request {request.request_id} without sampling")
+
+    def _on_admitted(self, slot: int, request: Request,
+                     first: int) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        t_p = len(request.prompt)
+        row = np.zeros((self._rows,), np.int32)
+        row[:t_p] = request.prompt
+        row[t_p] = first
+        self.out = self.out.at[slot].set(jnp.asarray(row))
+        self.total = self.total.at[slot].set(t_p + 1)
+
+    def step_round(self) -> None:
+        """Admit, run one verify window for the grid, retire."""
+        import numpy as np
+
+        self._admit()
+        if not any(r is not None for r in self.slot_req):
+            return
+        (self.cache, self.out, self.total, emit,
+         m) = self._spec_step(self.cache, self.out, self.total,
+                              self.active)
+        self.verify_steps += 1
+        emit_h = np.asarray(emit)
+        m_h = np.asarray(m)
+        for slot, req in enumerate(self.slot_req):
+            if req is None or not bool(self.active[slot]):
+                continue
+            have = self.slot_emitted[slot]
+            budget = req.max_new - len(have)
+            new = emit_h[slot, :int(m_h[slot]) + 1][:budget].tolist()
+            if req.eos_id is not None and req.eos_id in new:
+                new = new[:new.index(req.eos_id) + 1]
+            have.extend(new)
+            if (len(have) >= req.max_new or
+                    (req.eos_id is not None and have and
+                     have[-1] == req.eos_id)):
+                self._finish(slot)
+
+    def report(self) -> Dict[str, Any]:
+        out = super().report()
+        out["speculative"] = {
+            "draft_k": self.serving.speculative_k,
+            "verify_steps": self.verify_steps,
+        }
         return out
 
 

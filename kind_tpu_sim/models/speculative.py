@@ -282,6 +282,71 @@ def _pad_draft(draft, k: int):
     return jnp.concatenate([draft, draft[:, -1:]], axis=1)
 
 
+def _grid_verify_step(params, cache, out, total, active, *,
+                      cfg: ModelConfig, k: int):
+    """One speculative step over the serving grid: like _verify_step,
+    but with an ``active`` mask (lockstep SPMD — inactive slots
+    compute too, their state is frozen and their cache writes land in
+    rows the next tenant overwrites before reading). Returns
+    (cache, out, total, emit (b, k+1), m) where row b's real new
+    tokens this step are emit[b, :m[b]+1] (accepted drafts + bonus).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import embed_lookup
+
+    b, L = out.shape
+    dtype = jnp.dtype(cfg.dtype)
+    draft = propose_ngram(out, total, k)
+    last = jnp.take_along_axis(out, (total - 1)[:, None], 1)
+    window = jnp.concatenate([last, draft], axis=1)
+    base = total - 1
+
+    x = embed_lookup(params["embed"], window, dtype)
+    new_cache = []
+    for bparams, layer_cache in zip(params["blocks"], cache):
+        x, kk, vv = _window_block(x, bparams, cfg, layer_cache, base)
+        new_cache.append({
+            "k": _write_window(layer_cache["k"], kk, base),
+            "v": _write_window(layer_cache["v"], vv, base),
+        })
+    x = _rms_norm(x, params["final_norm"])
+    logits = _readout(x, params["embed"], cfg.int8_native)
+    preds = jnp.argmax(logits, axis=-1).astype(out.dtype)
+
+    agree = (draft == preds[:, :-1])
+    m = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
+    m = jnp.where(active, m, 0)
+    bonus = jnp.take_along_axis(preds, m[:, None], 1)[:, 0]
+
+    emit_idx = jnp.arange(k + 1)[None, :]
+    emit = jnp.where(
+        emit_idx < m[:, None], _pad_draft(draft, k),
+        jnp.where(emit_idx == m[:, None], bonus[:, None], 0),
+    )
+
+    def put_row(row, u, s):
+        return jax.lax.dynamic_update_slice(row, u, (s,))
+
+    new_out = jax.vmap(put_row)(out, emit.astype(out.dtype),
+                                jnp.clip(total, 0, L - (k + 1)))
+    out = jnp.where(active[:, None], new_out, out)
+    total = jnp.where(active, total + m + 1, total)
+    return new_cache, out, total, emit, m
+
+
+def _jitted_grid_step(cfg: ModelConfig, k: int):
+    import jax
+
+    return jax.jit(
+        functools.partial(_grid_verify_step, cfg=cfg, k=k),
+        donate_argnums=(1,))
+
+
+_jitted_grid_step = functools.lru_cache(maxsize=16)(_jitted_grid_step)
+
+
 def speculative_generate(params: Params, cfg: ModelConfig, prompt,
                          num_new: int, draft_k: int = 4,
                          return_stats: bool = False):

@@ -12,6 +12,10 @@ import pytest
 
 from kind_tpu_sim.models import decode, serving, transformer as tf
 
+# Model-heavy module: every test pays real jit compiles. The fast
+# tier (-m 'not slow') skips it; CI runs tiers as separate steps.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def cfg():
@@ -323,3 +327,85 @@ def test_report_shape(cfg, params):
     rep = eng.report()
     assert rep == {"slots": 2, "active": 0, "queued": 0,
                    "finished": 0}
+
+
+# -- speculative decoding inside the grid -----------------------------
+
+
+def test_speculative_grid_matches_solo(cfg, params):
+    """Grid + speculative == solo greedy decoder, token for token,
+    across mixed prompt lengths and more requests than slots."""
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                               speculative_k=4)
+    eng = serving.SpeculativeServingEngine(params, cfg, sc)
+    reqs = [(make_prompt(40 + i, 4 + 3 * i, cfg.vocab_size), 6 + 2 * i)
+            for i in range(5)]
+    for i, (p, n) in enumerate(reqs):
+        eng.submit(serving.Request(f"r{i}", p, max_new=n))
+    done = {c.request_id: c for c in eng.run()}
+    assert len(done) == len(reqs)
+    for i, (p, n) in enumerate(reqs):
+        solo = decode.greedy_generate(
+            params, cfg, np.asarray([p], np.int32), n, chunk=8)
+        assert done[f"r{i}"].tokens == \
+            np.asarray(solo)[0, len(p):].tolist(), i
+    # speculation actually batched tokens: fewer verify windows than
+    # generated tokens per slot would imply at 1 token/step
+    gen = sum(len(c.tokens) for c in done.values())
+    assert eng.verify_steps < gen
+
+
+def test_speculative_grid_matches_dense_grid(cfg, params):
+    """Same request stream through the dense grid and the speculative
+    grid: identical completions (both are greedy-exact)."""
+    reqs = [(make_prompt(60 + i, 5 + 2 * i, cfg.vocab_size), 8)
+            for i in range(4)]
+
+    def run(engine_cls, **extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                                   **extra)
+        eng = engine_cls(params, cfg, sc)
+        for i, (p, n) in enumerate(reqs):
+            eng.submit(serving.Request(f"x{i}", p, max_new=n))
+        return {c.request_id: (c.tokens, c.finish_reason)
+                for c in eng.run()}
+
+    dense = run(serving.ServingEngine)
+    spec = run(serving.SpeculativeServingEngine, speculative_k=3)
+    assert dense == spec
+
+
+def test_speculative_grid_eos_and_midflight(cfg, params):
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                               speculative_k=4)
+    eng = serving.SpeculativeServingEngine(params, cfg, sc)
+    p0 = make_prompt(70, 6, cfg.vocab_size)
+    p1 = make_prompt(71, 9, cfg.vocab_size)
+    eng.submit(serving.Request("a", p0, max_new=12))
+    eng.step_round()  # a mid-flight: one verify window done
+    eng.submit(serving.Request("b", p1, max_new=6))
+    done = {c.request_id: c for c in eng.run()}
+    for rid, p, n in [("a", p0, 12), ("b", p1, 6)]:
+        solo = decode.greedy_generate(
+            params, cfg, np.asarray([p], np.int32), n, chunk=8)
+        assert done[rid].tokens == \
+            np.asarray(solo)[0, len(p):].tolist(), rid
+    # eos: stop at the value's first occurrence in the solo stream
+    solo = np.asarray(decode.greedy_generate(
+        params, cfg, np.asarray([p0], np.int32), 12, chunk=8)
+    )[0, len(p0):].tolist()
+    eos = solo[4]
+    want = solo[:solo.index(eos) + 1]
+    eng.submit(serving.Request("c", p0, max_new=12, eos_id=eos))
+    (c,) = eng.run()
+    assert c.finish_reason == "stop" and c.tokens == want
+
+
+def test_speculative_grid_rejects_sampling(cfg, params):
+    sc = serving.ServingConfig(max_slots=1, max_len=32,
+                               speculative_k=2)
+    eng = serving.SpeculativeServingEngine(params, cfg, sc)
+    with pytest.raises(ValueError, match="greedy-exact"):
+        eng.submit(serving.Request(
+            "s", [1, 2, 3], 4,
+            sampling=decode.SamplingConfig(temperature=0.8)))
