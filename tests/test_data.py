@@ -82,6 +82,7 @@ def test_pipeline_places_shards_on_mesh():
     assert shard_shapes == {(2, 16)}
 
 
+@pytest.mark.slow
 def test_train_smoke_cli(capsys):
     """The train-smoke subcommand: pipeline -> train step -> report,
     exit 0 with the loss down."""
@@ -97,6 +98,7 @@ def test_train_smoke_cli(capsys):
     assert report["loss_last5"] < report["loss_first5"]
 
 
+@pytest.mark.slow
 def test_training_through_pipeline_learns():
     """End-to-end: the train step consumes prefetched packed batches
     and the loss drops on the structured corpus."""

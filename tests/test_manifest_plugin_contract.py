@@ -1,0 +1,301 @@
+"""Manifest <-> plugin contract: the generated DaemonSet's env drives
+the REAL plugin binary (VERDICT r2 #4).
+
+The fake-kubelet lifecycle tests construct plugin env by hand; a
+DaemonSet edit could therefore silently break Allocate while every
+test stays green. Here the env comes from
+``manifests.tpu_plugin_daemonset`` itself — parsed out of the YAML a
+user would apply, with only the two hostPath mounts remapped to temp
+dirs (the test-harness stand-in for the kubelet socket-dir and
+sim-state volumes) and NODE_NAME bound to a concrete node name (the
+downward-API substitution kubelet performs). The plugin must then
+register, advertise, honor chaos, and return Allocate env matching
+``topology``'s worker_env — the Python source of truth.
+
+Plus: pinned-schema + cross-field validation
+(kind_tpu_sim.manifest_lint) for every manifest the repo generates
+and every static pod under pods/.
+"""
+
+import os
+import pathlib
+import signal
+import subprocess
+import time
+
+import pytest
+import yaml
+
+grpc = pytest.importorskip("grpc")
+
+from test_plugin_grpc import (  # noqa: E402
+    FakeKubelet,
+    call_unary,
+    make_channel,
+)
+
+from kind_tpu_sim import manifest_lint, manifests, topology as topo
+from kind_tpu_sim.config import SimConfig
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def daemonset_env(cfg: SimConfig, *, node_name: str,
+                  socket_dir: pathlib.Path,
+                  state_dir: pathlib.Path) -> dict:
+    """Extract the container env from the generated DaemonSet, with
+    the mount-path remap and NODE_NAME downward-API substitution a
+    kubelet would perform."""
+    doc = yaml.safe_load(manifests.tpu_plugin_daemonset(cfg, "img:x"))
+    (container,) = doc["spec"]["template"]["spec"]["containers"]
+
+    # the two declared hostPath mounts are the only paths the plugin
+    # touches; remap them for the harness exactly as declared
+    mounts = {m["name"]: m["mountPath"]
+              for m in container["volumeMounts"]}
+    remap = {
+        mounts["device-plugin"]: str(socket_dir),
+        mounts["sim-state"]: str(state_dir),
+    }
+
+    env = {}
+    for item in container["env"]:
+        if "valueFrom" in item:
+            field = item["valueFrom"]["fieldRef"]["fieldPath"]
+            assert field == "spec.nodeName", item
+            env[item["name"]] = node_name
+            continue
+        val = item["value"]
+        for path, repl in remap.items():
+            if val.startswith(path):
+                val = repl + val[len(path):]
+        env[item["name"]] = val
+    # socket dir is not env in the manifest (the plugin's default IS
+    # the mount path); the harness passes the remapped dir the same
+    # way the mount would place it
+    env["TPU_SIM_SOCKET_DIR"] = str(socket_dir)
+    return env
+
+
+def run_plugin(binary, env, tmp_path):
+    full_env = {k: v for k, v in os.environ.items()
+                if not k.startswith("TPU_SIM")}
+    full_env.update(env)
+    proc = subprocess.Popen(
+        [str(binary)], env=full_env,
+        stderr=subprocess.PIPE, text=True,
+    )
+    return proc
+
+
+def wait_for(path: pathlib.Path, timeout=15):
+    deadline = time.time() + timeout
+    while not path.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    assert path.exists(), f"{path} never appeared"
+
+
+def stop_plugin(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        _, stderr = proc.communicate(timeout=15)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        _, stderr = proc.communicate()
+    return stderr
+
+
+def test_daemonset_env_drives_real_plugin(tmp_path, pb,
+                                          plugin_binary):
+    """Single-slice 2x4: register -> advertise -> Allocate env equals
+    topology.worker_env -> chaos file flips health."""
+    cfg = SimConfig(vendor="tpu")
+    s = cfg.slice
+    sock_dir = tmp_path / "dp"
+    state_dir = tmp_path / "state"
+    sock_dir.mkdir()
+    state_dir.mkdir()
+    # worker2 -> global worker index 1 (the plugin's NODE_NAME rule)
+    env = daemonset_env(cfg, node_name="kind-tpu-sim-worker2",
+                        socket_dir=sock_dir, state_dir=state_dir)
+
+    kubelet = FakeKubelet(sock_dir / "kubelet.sock", pb)
+    proc = run_plugin(plugin_binary, env, tmp_path)
+    try:
+        # 1. registration carries the manifest's resource name
+        req = kubelet.requests.get(timeout=15)
+        assert req.resource_name == "google.com/tpu"
+
+        sock = sock_dir / "tpu-sim.sock"
+        wait_for(sock)
+        channel = make_channel(sock)
+
+        # 2. advertised devices follow the topology's id scheme
+        stream = channel.unary_stream(
+            "/v1beta1.DevicePlugin/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )(pb.Empty(), timeout=30)
+        first = next(stream)
+        ids = sorted(d.ID for d in first.devices)
+        assert ids == sorted(s.device_ids(1))
+        assert all(d.health == "Healthy" for d in first.devices)
+
+        # 3. Allocate env == topology.worker_env(1): THE contract
+        areq = pb.AllocateRequest()
+        creq = areq.container_requests.add()
+        creq.devicesIDs.extend(s.device_ids(1)[:2])
+        resp = call_unary(channel, pb, "Allocate", areq,
+                          pb.AllocateRequest, pb.AllocateResponse)
+        got = dict(resp.container_responses[0].envs)
+        want = s.worker_env(1)
+        for key, val in want.items():
+            assert got[key] == val, (key, got.get(key), val)
+
+        # 4. chaos channel: the manifest's UNHEALTHY_FILE path (as
+        # remapped through the sim-state mount) drives health
+        unhealthy = state_dir / pathlib.Path(
+            manifests.UNHEALTHY_FILE).name
+        unhealthy.write_text(s.device_ids(1)[0] + "\n")
+        deadline = time.time() + 15
+        saw_unhealthy = False
+        while time.time() < deadline and not saw_unhealthy:
+            frame = next(stream)
+            health = {d.ID: d.health for d in frame.devices}
+            saw_unhealthy = (
+                health.get(s.device_ids(1)[0]) == "Unhealthy")
+        assert saw_unhealthy
+        stream.cancel()
+        channel.close()
+    finally:
+        stderr = stop_plugin(proc)
+        kubelet.stop()
+    assert proc.returncode == 0, stderr[-2000:]
+
+
+def test_daemonset_env_drives_plugin_multislice(tmp_path, pb,
+                                                plugin_binary):
+    """num_slices=2: the SAME DaemonSet env on a slice-1 node
+    (worker4 -> global 3) must produce slice-local identity plus the
+    MEGASCALE_* contract matching topology.MultiSlice."""
+    cfg = SimConfig(vendor="tpu", num_slices=2)
+    ms = cfg.multislice
+    sock_dir = tmp_path / "dp"
+    state_dir = tmp_path / "state"
+    sock_dir.mkdir()
+    state_dir.mkdir()
+    env = daemonset_env(cfg, node_name="kind-tpu-sim-worker4",
+                        socket_dir=sock_dir, state_dir=state_dir)
+
+    kubelet = FakeKubelet(sock_dir / "kubelet.sock", pb)
+    proc = run_plugin(plugin_binary, env, tmp_path)
+    try:
+        kubelet.requests.get(timeout=15)
+        sock = sock_dir / "tpu-sim.sock"
+        wait_for(sock)
+        channel = make_channel(sock)
+        areq = pb.AllocateRequest()
+        creq = areq.container_requests.add()
+        creq.devicesIDs.extend(ms.device_ids(3)[:1])
+        resp = call_unary(channel, pb, "Allocate", areq,
+                          pb.AllocateRequest, pb.AllocateResponse)
+        got = dict(resp.container_responses[0].envs)
+        # global worker 3 = slice 1, local worker 1
+        want = ms.worker_env(1, 1)
+        for key, val in want.items():
+            assert got[key] == val, (key, got.get(key), val)
+        assert got["MEGASCALE_SLICE_ID"] == "1"
+        assert got["MEGASCALE_NUM_SLICES"] == "2"
+        channel.close()
+    finally:
+        stderr = stop_plugin(proc)
+        kubelet.stop()
+    assert proc.returncode == 0, stderr[-2000:]
+
+
+# -- schema + contract validation over everything we emit -------------
+
+
+def _generated_manifests():
+    cfg = SimConfig(vendor="tpu")
+    cfg_ms = SimConfig(vendor="tpu", num_slices=2)
+    out = {
+        "kind_cluster_config": manifests.kind_cluster_config(cfg),
+        "registry_configmap": manifests.registry_configmap(cfg),
+        "tpu_plugin_daemonset": manifests.tpu_plugin_daemonset(
+            cfg, "img:x"),
+        "tpu_plugin_daemonset_ms": manifests.tpu_plugin_daemonset(
+            cfg_ms, "img:x"),
+        "gpu_plugin_daemonset_rocm": manifests.gpu_plugin_daemonset(
+            SimConfig(vendor="rocm"), "rocm", "img:x"),
+        "gpu_plugin_daemonset_nvidia": manifests.gpu_plugin_daemonset(
+            SimConfig(vendor="nvidia"), "nvidia", "img:x"),
+        "jax_multihost": manifests.jax_multihost_manifest(cfg),
+        "jax_multihost_ms": manifests.jax_multihost_manifest(cfg_ms),
+    }
+    return out
+
+
+@pytest.mark.parametrize("name,text", sorted(
+    _generated_manifests().items()))
+def test_generated_manifest_schema(name, text):
+    errs = manifest_lint.validate_yaml(text)
+    assert not errs, f"{name}: " + "; ".join(errs)
+
+
+@pytest.mark.parametrize("pod", sorted(
+    (REPO / "pods").glob("*.yaml"), key=lambda p: p.name))
+def test_static_pod_schema(pod):
+    errs = manifest_lint.validate_yaml(pod.read_text())
+    assert not errs, f"{pod.name}: " + "; ".join(errs)
+
+
+def test_lint_catches_broken_manifests():
+    """The linter actually rejects the failure modes it claims to."""
+    base = yaml.safe_load(manifests.tpu_plugin_daemonset(
+        SimConfig(vendor="tpu"), "img:x"))
+
+    broken = yaml.safe_load(yaml.safe_dump(base))
+    broken["spec"]["template"]["metadata"]["labels"]["app"] = "other"
+    assert any("selector" in e
+               for e in manifest_lint.validate_doc(broken))
+
+    broken = yaml.safe_load(yaml.safe_dump(base))
+    broken["spec"]["template"]["spec"]["volumes"] = []
+    assert any("volumeMount" in e
+               for e in manifest_lint.validate_doc(broken))
+
+    broken = yaml.safe_load(yaml.safe_dump(base))
+    env = broken["spec"]["template"]["spec"]["containers"][0]["env"]
+    env.append(dict(env[0]))
+    assert any("duplicate env" in e
+               for e in manifest_lint.validate_doc(broken))
+
+    assert manifest_lint.validate_doc({"kind": "Widget"})
+
+    pod = yaml.safe_load((REPO / "pods" / "tpu-test-pod.yaml")
+                         .read_text())
+    pod["spec"]["containers"][0]["resources"]["limits"][
+        "google.com/tpu"] = "not-a-number"
+    assert any("bad quantity" in e
+               for e in manifest_lint.validate_doc(pod))
+
+
+def test_topology_env_matches_plugin_defaults():
+    """The DaemonSet env block covers every TPU_SIM_* knob the plugin
+    reads (except harness-only overrides) — an env the manifest stops
+    setting would silently fall back to C++ defaults."""
+    cfg = SimConfig(vendor="tpu", num_slices=2)
+    doc = yaml.safe_load(manifests.tpu_plugin_daemonset(cfg, "i"))
+    (container,) = doc["spec"]["template"]["spec"]["containers"]
+    set_names = {e["name"] for e in container["env"]}
+    src = (REPO / "plugin" / "src" / "device_plugin.cc").read_text()
+    import re
+
+    read_names = set(re.findall(r'GetEnv\("(TPU_SIM_[A-Z_]+)"', src))
+    harness_only = {"TPU_SIM_SOCKET_DIR", "TPU_SIM_SOCKET_NAME"}
+    missing = read_names - set_names - harness_only
+    assert not missing, (
+        f"plugin reads {sorted(missing)} but the DaemonSet never "
+        "sets them")

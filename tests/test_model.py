@@ -56,6 +56,7 @@ def test_training_reduces_loss_single_device(cfg):
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+@pytest.mark.slow
 def test_training_on_dp_tp_mesh_matches_single_device(cfg):
     """The sharded step computes the same losses as unsharded."""
     import jax
@@ -88,6 +89,7 @@ def test_param_specs_cover_params(cfg):
     assert flat_p.num_leaves == flat_s.num_leaves
 
 
+@pytest.mark.slow
 def test_dp_tp_seq_mesh_runs(cfg):
     """3-axis mesh (dp x tp x sp): the full sharding combo compiles
     and executes — the single-process analog of dryrun_multichip."""
@@ -101,6 +103,7 @@ def test_dp_tp_seq_mesh_runs(cfg):
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_remat_matches(cfg):
     import dataclasses
 

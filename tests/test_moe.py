@@ -55,6 +55,7 @@ def test_moe_transformer_trains(moe_cfg):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_moe_sharded_over_model_axis(moe_cfg):
     """EP via the 'model' axis: the expert dim of w_up/w_down shards
     and the step still runs (GSPMD inserts the all_to_alls)."""

@@ -13,6 +13,7 @@ import pytest
 from kind_tpu_sim.parallel import multihost
 
 
+@pytest.mark.slow
 def test_local_slice_v4_two_hosts():
     reports = multihost.launch_local_slice(
         topology="2x2x2", accelerator="tpu-v4-podslice")
@@ -51,6 +52,7 @@ def test_local_slice_single_host():
     assert rep["global_devices"] == rep["local_devices"] == 4
 
 
+@pytest.mark.slow
 def test_local_multislice_isolated_worlds():
     """Two MULTI-HOST slices launch as SEPARATE jax.distributed
     worlds — 2 hosts rendezvous per slice on per-slice ports, global

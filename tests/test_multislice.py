@@ -97,6 +97,7 @@ def test_batch_spec_shards_over_dcn_and_data(devices8):
     assert tf.batch_spec(flat) == P("data", None)
 
 
+@pytest.mark.slow
 def test_multislice_train_step_runs_and_matches_single_device(devices8):
     """The sharded multislice step computes the same loss as the
     unsharded step — GSPMD's DCN/ICI collectives change placement,

@@ -5,6 +5,10 @@ import pytest
 
 from kind_tpu_sim.ops import pallas_kernels as pk
 
+# Model-heavy module: every test pays real jit compiles. The fast
+# tier (-m 'not slow') skips it; CI runs tiers as separate steps.
+pytestmark = pytest.mark.slow
+
 
 def test_matmul_matches_xla():
     import jax

@@ -38,6 +38,7 @@ def test_stack_stage_params_shapes(cfg, params):
         pipeline.stack_stage_params(params, 3)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("stages", [2, 4])
 def test_pipeline_matches_sequential(cfg, params, stages):
     import jax

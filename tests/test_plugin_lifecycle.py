@@ -180,6 +180,7 @@ def test_full_lifecycle(session):
     session.stop()
 
 
+@pytest.mark.slow
 def test_restart_stress_under_tsan(tmp_path, pb, tsan_plugin_binary):
     """Hammer the watchdog: repeated kubelet restarts with live
     ListAndWatch streams and allocations, under ThreadSanitizer.

@@ -69,6 +69,7 @@ def test_quantized_forward_close(cfg, params):
     assert corr > 0.99, corr
 
 
+@pytest.mark.slow
 def test_quantized_decode_self_consistent(cfg, params):
     """The cached decode path and the full forward agree under int8
     weights (both run identical quantized math)."""
@@ -94,6 +95,7 @@ def test_quantized_params_flow_through_jit(cfg, params):
     assert out.shape == (2, 16, cfg.vocab_size)
 
 
+@pytest.mark.slow
 def test_quantized_moe_params(params):
     import jax
 
@@ -165,6 +167,7 @@ def test_native_forward_close(cfg, params):
     assert corr > 0.99, corr
 
 
+@pytest.mark.slow
 def test_native_decode_self_consistent(cfg, params):
     """W8A8 decode matches the W8A8 full forward's argmax for dense
     (bf16) caches: both paths row-quantize the same per-token
@@ -182,6 +185,7 @@ def test_native_decode_self_consistent(cfg, params):
     np.testing.assert_array_equal(np.array(out[:, -1]), expected_last)
 
 
+@pytest.mark.slow
 def test_native_int8_kv_decode_near_argmax(cfg, params):
     """int8_kv is excluded from the exact argmax contract (decode.py
     docstring: chunk-buffer bf16 vs merged int8 can flip near-ties).

@@ -10,6 +10,10 @@ import pytest
 
 from kind_tpu_sim.models import decode, speculative, transformer as tf
 
+# Model-heavy module: every test pays real jit compiles. The fast
+# tier (-m 'not slow') skips it; CI runs tiers as separate steps.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def cfg():

@@ -5,6 +5,8 @@ behavioral test runs in a clean subprocess — the suite's own jax
 must keep seeing honest platforms.
 """
 
+import pytest
+
 import json
 import pathlib
 import subprocess
@@ -27,6 +29,7 @@ def run_probe(code: str) -> dict:
     return json.loads(proc.stdout.splitlines()[-1])
 
 
+@pytest.mark.slow
 def test_activate_reports_tpu_platform():
     report = run_probe(r"""
 import json, os, sys

@@ -8,6 +8,8 @@ wrapper scripts against the fake runtime, asserting the flag actually
 reached the orchestrator (not just that argparse didn't crash).
 """
 
+import pytest
+
 import pathlib
 import subprocess
 
@@ -54,6 +56,7 @@ def test_tpu_wrapper_mixed_placement():
     assert "5002" in proc.stderr
 
 
+@pytest.mark.slow
 def test_help_and_version():
     proc = run_wrapper("kind-gpu-sim.sh", "--help")
     assert "create" in proc.stdout
