@@ -1,0 +1,294 @@
+"""Schema + contract validation for every manifest this repo emits.
+
+The reference validated its DaemonSets by actually applying them
+(kind-gpu-sim.sh:279-283 blocks on rollout); this host has no cluster,
+so validation is split in two and wired into CI (unit-tests.yaml):
+
+1. **Pinned structural schemas** (jsonschema): a deliberately small,
+   in-repo subset of the Kubernetes OpenAPI for the kinds we generate
+   (Pod, DaemonSet, StatefulSet, Service, ConfigMap, kind Cluster).
+   Pinned rather than fetched: zero-network CI, and the subset only
+   asserts fields our tooling actually relies on — a schema bump is a
+   reviewed diff, not a moving target.
+2. **Cross-field contract checks** schemas cannot express: label
+   selectors must match template labels (a mismatched DaemonSet is
+   accepted by the apiserver and then controls nothing), volumeMounts
+   must reference declared volumes, env names must be unique, and
+   resource quantities must parse.
+
+tests/test_manifest_plugin_contract.py closes the remaining gap by
+launching the real plugin binary under the generated DaemonSet's env.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterator, List
+
+_QUANTITY = re.compile(
+    r"^[0-9]+(\.[0-9]+)?(m|k|Ki|Mi|Gi|Ti|M|G|T)?$")
+
+_META = {
+    "type": "object",
+    "required": ["name"],
+    "properties": {
+        "name": {"type": "string", "minLength": 1,
+                 "pattern": r"^[a-z0-9]([-a-z0-9.]*[a-z0-9])?$"},
+        "namespace": {"type": "string", "minLength": 1},
+        "labels": {"type": "object"},
+    },
+}
+
+_CONTAINER = {
+    "type": "object",
+    "required": ["name", "image"],
+    "properties": {
+        "name": {"type": "string", "minLength": 1},
+        "image": {"type": "string", "minLength": 1},
+        "env": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["name"],
+                "properties": {
+                    "name": {"type": "string", "minLength": 1},
+                    "value": {"type": "string"},
+                    "valueFrom": {"type": "object"},
+                },
+            },
+        },
+        "volumeMounts": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["name", "mountPath"],
+            },
+        },
+        "resources": {"type": "object"},
+    },
+}
+
+_POD_SPEC = {
+    "type": "object",
+    "required": ["containers"],
+    "properties": {
+        "containers": {"type": "array", "minItems": 1,
+                       "items": _CONTAINER},
+        "volumes": {
+            "type": "array",
+            "items": {"type": "object", "required": ["name"]},
+        },
+        "tolerations": {"type": "array"},
+        "nodeSelector": {"type": "object"},
+    },
+}
+
+_TEMPLATE = {
+    "type": "object",
+    "required": ["metadata", "spec"],
+    "properties": {
+        "metadata": {"type": "object"},
+        "spec": _POD_SPEC,
+    },
+}
+
+SCHEMAS: Dict[str, Dict[str, Any]] = {
+    "Pod": {
+        "type": "object",
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+        "properties": {
+            "apiVersion": {"const": "v1"},
+            "metadata": _META,
+            "spec": _POD_SPEC,
+        },
+    },
+    "DaemonSet": {
+        "type": "object",
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+        "properties": {
+            "apiVersion": {"const": "apps/v1"},
+            "metadata": _META,
+            "spec": {
+                "type": "object",
+                "required": ["selector", "template"],
+                "properties": {
+                    "selector": {
+                        "type": "object",
+                        "required": ["matchLabels"],
+                    },
+                    "template": _TEMPLATE,
+                },
+            },
+        },
+    },
+    "Deployment": {
+        "type": "object",
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+        "properties": {
+            "apiVersion": {"const": "apps/v1"},
+            "metadata": _META,
+            "spec": {
+                "type": "object",
+                "required": ["selector", "template"],
+                "properties": {
+                    "selector": {
+                        "type": "object",
+                        "required": ["matchLabels"],
+                    },
+                    "template": _TEMPLATE,
+                    "replicas": {"type": "integer", "minimum": 0},
+                },
+            },
+        },
+    },
+    "StatefulSet": {
+        "type": "object",
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+        "properties": {
+            "apiVersion": {"const": "apps/v1"},
+            "metadata": _META,
+            "spec": {
+                "type": "object",
+                "required": ["selector", "template", "serviceName"],
+                "properties": {
+                    "selector": {
+                        "type": "object",
+                        "required": ["matchLabels"],
+                    },
+                    "template": _TEMPLATE,
+                    "replicas": {"type": "integer", "minimum": 0},
+                    "serviceName": {"type": "string"},
+                },
+            },
+        },
+    },
+    "Service": {
+        "type": "object",
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+        "properties": {
+            "apiVersion": {"const": "v1"},
+            "metadata": _META,
+            "spec": {
+                "type": "object",
+                "properties": {
+                    "selector": {"type": "object"},
+                    "ports": {"type": "array"},
+                    "clusterIP": {"type": "string"},
+                },
+            },
+        },
+    },
+    "ConfigMap": {
+        "type": "object",
+        "required": ["apiVersion", "kind", "metadata", "data"],
+        "properties": {
+            "apiVersion": {"const": "v1"},
+            "metadata": _META,
+            "data": {"type": "object"},
+        },
+    },
+    "Cluster": {  # kind.x-k8s.io cluster config
+        "type": "object",
+        "required": ["kind", "apiVersion", "nodes"],
+        "properties": {
+            "apiVersion": {"const": "kind.x-k8s.io/v1alpha4"},
+            "nodes": {
+                "type": "array",
+                "minItems": 1,
+                "items": {
+                    "type": "object",
+                    "required": ["role"],
+                    "properties": {
+                        "role": {"enum": ["control-plane", "worker"]},
+                    },
+                },
+            },
+        },
+    },
+}
+
+
+def _pod_specs(doc: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+    kind = doc.get("kind")
+    if kind == "Pod":
+        yield doc.get("spec", {})
+    elif kind in ("DaemonSet", "StatefulSet", "Deployment"):
+        yield doc.get("spec", {}).get("template", {}).get("spec", {})
+
+
+def _contract_errors(doc: Dict[str, Any]) -> List[str]:
+    """Cross-field rules jsonschema can't express."""
+    errs: List[str] = []
+    kind = doc.get("kind")
+
+    if kind in ("DaemonSet", "StatefulSet", "Deployment"):
+        sel = (doc.get("spec", {}).get("selector", {})
+               .get("matchLabels", {}))
+        labels = (doc.get("spec", {}).get("template", {})
+                  .get("metadata", {}).get("labels", {}))
+        for key, val in sel.items():
+            if labels.get(key) != val:
+                errs.append(
+                    f"selector {key}={val} does not match template "
+                    f"labels {labels} (the controller would select "
+                    "nothing)")
+
+    for spec in _pod_specs(doc):
+        declared = {v.get("name") for v in spec.get("volumes", [])}
+        for c in spec.get("containers", []):
+            names = [e.get("name") for e in c.get("env", [])]
+            dupes = {n for n in names if names.count(n) > 1}
+            if dupes:
+                errs.append(
+                    f"container {c.get('name')}: duplicate env "
+                    f"names {sorted(dupes)}")
+            for e in c.get("env", []):
+                if "value" not in e and "valueFrom" not in e:
+                    errs.append(
+                        f"env {e.get('name')}: needs value or "
+                        "valueFrom")
+            for m in c.get("volumeMounts", []):
+                if m.get("name") not in declared:
+                    errs.append(
+                        f"container {c.get('name')}: volumeMount "
+                        f"{m.get('name')} has no matching volume")
+            res = c.get("resources", {})
+            for section in ("limits", "requests"):
+                for rname, qty in res.get(section, {}).items():
+                    if not _QUANTITY.match(str(qty)):
+                        errs.append(
+                            f"resource {rname}: bad quantity "
+                            f"{qty!r}")
+    return errs
+
+
+def validate_doc(doc: Dict[str, Any]) -> List[str]:
+    """All schema + contract errors for one manifest document
+    (empty list = valid). Unknown kinds fail — every manifest this
+    repo emits must have a pinned schema."""
+    import jsonschema
+
+    kind = doc.get("kind")
+    schema = SCHEMAS.get(kind or "")
+    if schema is None:
+        return [f"no pinned schema for kind {kind!r}"]
+    validator = jsonschema.Draft7Validator(schema)
+    errs = [
+        f"{'/'.join(str(p) for p in e.absolute_path) or '<root>'}: "
+        f"{e.message}"
+        for e in validator.iter_errors(doc)
+    ]
+    return errs + _contract_errors(doc)
+
+
+def validate_yaml(text: str) -> List[str]:
+    """Validate every document in a (possibly multi-doc) YAML string."""
+    import yaml
+
+    errs: List[str] = []
+    for i, doc in enumerate(yaml.safe_load_all(text)):
+        if doc is None:
+            continue
+        for e in validate_doc(doc):
+            errs.append(f"doc[{i}] {doc.get('kind')}: {e}")
+    return errs
