@@ -872,17 +872,20 @@ def model_child_main() -> int:
     return 0
 
 
-def probe_accelerator(attempts: int = 3, timeout_s: float = 60,
+def probe_accelerator(timeouts=(60, 120, 180),
                       spacing_s: float = 15) -> tuple:
-    """Bounded accelerator probe with retries.
+    """Bounded accelerator probe with escalating retries.
 
     Round 2 lost every TPU number to ONE 180s probe timeout against a
-    transiently wedged tunnel (BENCH_r02.json). Three spaced 60s
-    attempts cover the same wall-clock but survive a tunnel that
-    recovers between attempts. Returns (ok, per-attempt errors).
+    transiently wedged tunnel (BENCH_r02.json). Escalating attempts
+    (60s, 120s, 180s, spaced) survive both failure modes: a tunnel
+    that recovers between attempts (any attempt passes) AND a slow-
+    but-healthy backend init (a consistently-90s init fails the 60s
+    attempt but passes the 120s one — a fixed short retry would fail
+    all three). Returns (ok, per-attempt errors).
     """
     errors = []
-    for i in range(attempts):
+    for i, timeout_s in enumerate(timeouts):
         try:
             subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
@@ -894,9 +897,10 @@ def probe_accelerator(attempts: int = 3, timeout_s: float = 60,
             if isinstance(stderr, bytes):
                 stderr = stderr.decode("utf-8", "replace")
             errors.append(
-                f"attempt {i + 1}: {type(exc).__name__} "
+                f"attempt {i + 1} ({timeout_s:.0f}s): "
+                f"{type(exc).__name__} "
                 f"{stderr.strip()[-120:]}".strip())
-            if i + 1 < attempts:
+            if i + 1 < len(timeouts):
                 time.sleep(spacing_s)
     return False, errors
 
@@ -1112,8 +1116,12 @@ def capture_model_section(phases: dict) -> None:
     budget = float(os.environ.get("BENCH_MODEL_BUDGET_S", "1200"))
     with stopwatch("model_total"):
         throughput = model_throughput_via_child(budget)
-    if throughput:
-        phases["model"] = throughput
+    # A child that died/hung before streaming its FIRST section must
+    # still leave an explicit error marker — a silently absent model
+    # key is indistinguishable from "never attempted".
+    phases["model"] = throughput or {
+        "error": ("model child produced no sections within "
+                  f"{budget:.0f}s budget")}
 
 
 def bench_model_only(out_path: str | None) -> int:

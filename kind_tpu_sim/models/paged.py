@@ -98,18 +98,70 @@ def _scatter_flat(pool_arr, blocks, offsets, rows):
         rows.astype(pool_arr.dtype))
 
 
+def _pool_block_size(pools) -> int:
+    from kind_tpu_sim.models.quant import QuantArray
+
+    k = pools[0]["k"]
+    return k.q.shape[1] if isinstance(k, QuantArray) else k.shape[1]
+
+
+def _window_indices(length: int, base, block_size: int, width: int,
+                    true_len, table_row):
+    """Flat (blocks, offsets) for writing ``length`` window positions
+    starting at ``base``: positions past ``true_len`` or past the
+    table's width are routed to the garbage block."""
+    import jax.numpy as jnp
+
+    pos = base + jnp.arange(length)
+    logical = pos // block_size
+    offsets = pos % block_size
+    safe_logical = jnp.clip(logical, 0, width - 1)
+    blocks = table_row[safe_logical]
+    valid = (jnp.arange(length) < true_len) & (logical < width)
+    return jnp.where(valid, blocks, GARBAGE_BLOCK), offsets
+
+
+def _write_layer(lc, kk, vv, write):
+    """One layer's k/v update through ``write(pool_arr, upd)``,
+    row-quantizing when the pool is int8 — THE single copy of the
+    QuantArray-vs-dense write branch (used by prefill, suffix, and
+    the chunk scatter)."""
+    from kind_tpu_sim.models.quant import QuantArray, quantize
+
+    if isinstance(lc["k"], QuantArray):
+        qk = quantize(kk, axis=3)
+        qv = quantize(vv, axis=3)
+        return {
+            "k": QuantArray(q=write(lc["k"].q, qk.q),
+                            scale=write(lc["k"].scale, qk.scale)),
+            "v": QuantArray(q=write(lc["v"].q, qv.q),
+                            scale=write(lc["v"].scale, qv.scale)),
+        }
+    return {"k": write(lc["k"], kk), "v": write(lc["v"], vv)}
+
+
+def _last_logits(x, params, true_len, cfg: ModelConfig):
+    """fp32 logits at the window's TRUE last position (1, w, d) -> (vocab,)."""
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.transformer import _readout, _rms_norm
+
+    last = jnp.take_along_axis(
+        x, (true_len - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
+    h = _rms_norm(last[:, 0, :], params["final_norm"])
+    logits = _readout(h, params["embed"], cfg.int8_native)
+    return logits[0].astype(jnp.float32)
+
+
 def scatter_rows(pools, tables, starts, rows_per_layer, active):
     """Write each slot's chunk-buffer rows (slots, chunk, kv, hd) into
     its pool blocks at positions starts[b]..starts[b]+chunk-1.
     Inactive slots write to garbage block 0. Returns new pools."""
     import jax.numpy as jnp
 
-    from kind_tpu_sim.models.quant import QuantArray, quantize
-
     slots, width = tables.shape
     chunk = rows_per_layer[0]["k"].shape[1]
-    block_size = pools[0]["k"].q.shape[1] if isinstance(
-        pools[0]["k"], QuantArray) else pools[0]["k"].shape[1]
+    block_size = _pool_block_size(pools)
 
     pos = starts[:, None] + jnp.arange(chunk)[None, :]  # (slots, chunk)
     logical = pos // block_size
@@ -122,25 +174,12 @@ def scatter_rows(pools, tables, starts, rows_per_layer, active):
     valid = active[:, None] & (logical < width)
     blocks = jnp.where(valid, blocks, GARBAGE_BLOCK).reshape(-1)
 
-    new_pools = []
-    for lc, rows in zip(pools, rows_per_layer):
-        def write(pool_arr, upd):
-            flat = upd.reshape((slots * chunk,) + upd.shape[2:])
-            return _scatter_flat(pool_arr, blocks, offsets, flat)
+    def write(pool_arr, upd):
+        flat = upd.reshape((slots * chunk,) + upd.shape[2:])
+        return _scatter_flat(pool_arr, blocks, offsets, flat)
 
-        if isinstance(lc["k"], QuantArray):
-            qk = quantize(rows["k"], axis=3)
-            qv = quantize(rows["v"], axis=3)
-            new_pools.append({
-                "k": QuantArray(q=write(lc["k"].q, qk.q),
-                                scale=write(lc["k"].scale, qk.scale)),
-                "v": QuantArray(q=write(lc["v"].q, qv.q),
-                                scale=write(lc["v"].scale, qv.scale)),
-            })
-        else:
-            new_pools.append({"k": write(lc["k"], rows["k"]),
-                              "v": write(lc["v"], rows["v"])})
-    return new_pools
+    return [_write_layer(lc, rows["k"], rows["v"], write)
+            for lc, rows in zip(pools, rows_per_layer)]
 
 
 def paged_prefill(params, pools, tokens, true_len, table_row, *,
@@ -152,55 +191,26 @@ def paged_prefill(params, pools, tokens, true_len, table_row, *,
     """
     import jax.numpy as jnp
 
-    from kind_tpu_sim.models.quant import QuantArray, embed_lookup, quantize
-    from kind_tpu_sim.models.transformer import (
-        _block_core,
-        _readout,
-        _rms_norm,
-    )
+    from kind_tpu_sim.models.quant import embed_lookup
+    from kind_tpu_sim.models.transformer import _block_core
 
     _, t_p = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
-    block_size = pools[0]["k"].q.shape[1] if isinstance(
-        pools[0]["k"], QuantArray) else pools[0]["k"].shape[1]
-    width = table_row.shape[0]
-
     positions = jnp.broadcast_to(jnp.arange(t_p), (1, t_p))
     x = embed_lookup(params["embed"], tokens, dtype)
 
-    pos = jnp.arange(t_p)
-    logical = pos // block_size
-    offsets = pos % block_size
-    safe_logical = jnp.clip(logical, 0, width - 1)
-    blocks = table_row[safe_logical]
-    valid = (pos < true_len) & (logical < width)
-    blocks = jnp.where(valid, blocks, GARBAGE_BLOCK)
+    blocks, offsets = _window_indices(
+        t_p, 0, _pool_block_size(pools), table_row.shape[0],
+        true_len, table_row)
+
+    def write(pool_arr, upd):
+        return _scatter_flat(pool_arr, blocks, offsets, upd[0])
 
     new_pools = []
     for bparams, lc in zip(params["blocks"], pools):
         x, _, k, v = _block_core(x, bparams, cfg, positions)
-
-        def write(pool_arr, upd):
-            return _scatter_flat(pool_arr, blocks, offsets, upd[0])
-
-        if isinstance(lc["k"], QuantArray):
-            qk = quantize(k, axis=3)
-            qv = quantize(v, axis=3)
-            new_pools.append({
-                "k": QuantArray(q=write(lc["k"].q, qk.q),
-                                scale=write(lc["k"].scale, qk.scale)),
-                "v": QuantArray(q=write(lc["v"].q, qv.q),
-                                scale=write(lc["v"].scale, qv.scale)),
-            })
-        else:
-            new_pools.append({"k": write(lc["k"], k),
-                              "v": write(lc["v"], v)})
-
-    last = jnp.take_along_axis(
-        x, (true_len - 1).reshape(1, 1, 1).astype(jnp.int32), axis=1)
-    h = _rms_norm(last[:, 0, :], params["final_norm"])
-    logits = _readout(h, params["embed"], cfg.int8_native)
-    return new_pools, logits[0].astype(jnp.float32)
+        new_pools.append(_write_layer(lc, k, v, write))
+    return new_pools, _last_logits(x, params, true_len, cfg)
 
 
 def paged_decode_chunk(params, pools, tables, lengths, last_token,
@@ -222,39 +232,183 @@ def paged_decode_chunk(params, pools, tables, lengths, last_token,
     return pools, lengths, token, emitted
 
 
+def paged_suffix(params, pools, tokens, true_len, base, table_row, *,
+                 cfg: ModelConfig):
+    """Prefix-cache admission, paged: the slot's table already points
+    at the SHARED prefix blocks (positions < ``base``, a block
+    boundary); run only the prompt suffix (1, w_pad) through the
+    model attending to the gathered prefix view, scatter the suffix
+    k/v into the slot's OWN blocks at ``base``.., and return the fp32
+    logits at the true last suffix position. Shared blocks are never
+    written: the suffix starts exactly at a block boundary, so every
+    write lands in blocks this slot allocated itself.
+    """
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import embed_lookup
+    from kind_tpu_sim.models.speculative import _window_block
+
+    _, w = tokens.shape
+    dtype = jnp.dtype(cfg.dtype)
+    view = gather_view(pools, table_row[None, :])
+    x = embed_lookup(params["embed"], tokens, dtype)
+    base_vec = jnp.reshape(base, (1,))
+
+    blocks, offsets = _window_indices(
+        w, base, _pool_block_size(pools), table_row.shape[0],
+        true_len, table_row)
+
+    def write(pool_arr, upd):
+        return _scatter_flat(pool_arr, blocks, offsets, upd[0])
+
+    new_pools = []
+    for bparams, lc, view_lc in zip(params["blocks"], pools, view):
+        x, kk, vv = _window_block(x, bparams, cfg, view_lc, base_vec)
+        new_pools.append(_write_layer(lc, kk, vv, write))
+    return new_pools, _last_logits(x, params, true_len, cfg)
+
+
+class PagedPrefixCache:
+    """Block-granular prompt-prefix sharing (the vLLM automatic-
+    prefix-caching design, exact-prefix tier): a stored prefix is a
+    list of FULL pool blocks, refcounted by the allocator and keyed
+    by the token tuple those blocks hold. Admission with a hit simply
+    POINTS the new slot's table at the shared blocks — zero copies,
+    zero forward FLOPs for the shared positions — and runs only the
+    block-aligned suffix. Shared blocks are immutable by construction
+    (writes start at the first non-shared block boundary).
+    """
+
+    def __init__(self, capacity: int, alloc: BlockAllocator,
+                 block_size: int):
+        import collections
+
+        self.capacity = capacity
+        self.alloc = alloc
+        self.block_size = block_size
+        self.entries = collections.OrderedDict()
+        self._len_count: dict = collections.Counter()
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, prompt: List[int]):
+        """Longest stored full-block STRICT prefix (so the suffix is
+        never empty: at least the prompt's last token runs through
+        the model to produce logits). LRU-refreshed."""
+        for length in sorted(self._len_count, reverse=True):
+            if length >= len(prompt):
+                continue
+            key = tuple(prompt[:length])
+            entry = self.entries.get(key)
+            if entry is None:
+                continue
+            self.hits += 1
+            self.entries.move_to_end(key)
+            return entry
+        self.misses += 1
+        return None
+
+    def store(self, prompt: List[int], blocks: List[int]) -> None:
+        """Share the slot's full-prefix blocks into the cache. Only
+        whole blocks are cacheable; callers pass the slot's first
+        ``len(prompt) // block_size`` blocks."""
+        n_full = len(prompt) // self.block_size
+        usable = blocks[:n_full]
+        if not usable:
+            return
+        key = tuple(prompt[:n_full * self.block_size])
+        if key in self.entries:
+            self.entries.move_to_end(key)
+            return
+        self.alloc.share(usable)
+        self.entries[key] = {"blocks": list(usable),
+                             "len": n_full * self.block_size}
+        self._len_count[len(key)] += 1
+        while len(self.entries) > self.capacity:
+            self.evict_lru()
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used entry, releasing its block
+        references (blocks with live slot users stay allocated until
+        those slots retire). False when the cache is empty.
+
+        Called on store() overflow AND under allocation pressure
+        (PagedServingEngine): cache-held blocks are the cheapest
+        reclaim — dropping an entry costs a future prefill recompute,
+        while preempting a slot discards work already done. Without
+        this, retired cache entries could pin the whole pool and
+        starve admission forever.
+        """
+        if not self.entries:
+            return False
+        old_key, old = self.entries.popitem(last=False)
+        self.alloc.free(old["blocks"])
+        self._len_count[len(old_key)] -= 1
+        if not self._len_count[len(old_key)]:
+            del self._len_count[len(old_key)]
+        return True
+
+    def report(self) -> dict:
+        return {"entries": len(self.entries), "hits": self.hits,
+                "misses": self.misses}
+
+
 # ---------------------------------------------------------------------
 # host-side block allocator
 
 
 class BlockAllocator:
-    """Free-list allocator over pool blocks 1..num_blocks-1 (block 0
-    is the garbage sink and never allocated). Pure host bookkeeping —
-    allocation happens at scheduling boundaries, outside jit."""
+    """Refcounted free-list allocator over pool blocks 1..num_blocks-1
+    (block 0 is the garbage sink and never allocated). Pure host
+    bookkeeping — allocation happens at scheduling boundaries, outside
+    jit. Refcounts exist for prefix sharing: a cached prefix's blocks
+    are referenced by the cache entry AND every slot using them;
+    ``free`` decrements and only returns a block to the pool at zero.
+    """
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
             raise ValueError("need >= 2 blocks (one is garbage)")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._refs: dict = {}
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n blocks, or None (all-or-nothing) if the pool is short."""
+        """n fresh blocks (ref 1 each), or None (all-or-nothing)."""
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
         return out
 
+    def share(self, blocks: List[int]) -> None:
+        """Add a reference to already-allocated blocks."""
+        for b in blocks:
+            if self._refs.get(b, 0) < 1:
+                raise ValueError(f"share of unallocated block {b}")
+            self._refs[b] += 1
+
     def free(self, blocks: List[int]) -> None:
+        """Drop one reference; blocks return to the pool at ref 0."""
         for b in blocks:
             if not 0 < b < self.num_blocks:
                 raise ValueError(f"bad block id {b}")
-            if b in self._free:
+            refs = self._refs.get(b, 0)
+            if refs < 1:
                 raise ValueError(f"double free of block {b}")
-            self._free.append(b)
+            if refs == 1:
+                del self._refs[b]
+                self._free.append(b)
+            else:
+                self._refs[b] = refs - 1
+
+    def refcount(self, block: int) -> int:
+        return self._refs.get(block, 0)
 
 
 def blocks_needed(tokens: int, block_size: int) -> int:

@@ -935,10 +935,23 @@ def _jitted_paged_chunk(cfg: ModelConfig, chunk: int):
         donate_argnums=(1,))
 
 
+def _jitted_paged_suffix(cfg: ModelConfig):
+    import functools
+
+    import jax
+
+    from kind_tpu_sim.models.paged import paged_suffix
+
+    return jax.jit(functools.partial(paged_suffix, cfg=cfg),
+                   donate_argnums=(1,))
+
+
 _jitted_paged_prefill = _functools.lru_cache(maxsize=32)(
     _jitted_paged_prefill)
 _jitted_paged_chunk = _functools.lru_cache(maxsize=32)(
     _jitted_paged_chunk)
+_jitted_paged_suffix = _functools.lru_cache(maxsize=32)(
+    _jitted_paged_suffix)
 
 
 class PagedServingEngine(ServingEngine):
@@ -965,10 +978,6 @@ class PagedServingEngine(ServingEngine):
             raise ValueError(
                 "PagedServingEngine needs ServingConfig.paged_blocks"
                 " >= 2 (block 0 is the garbage sink)")
-        if serving.prefix_cache_entries > 0:
-            raise ValueError(
-                "prefix caching is not supported with the paged "
-                "engine yet; use the dense grid")
         self.pools = paged.init_pools(cfg, serving.paged_blocks,
                                       serving.block_size)
         self.alloc = paged.BlockAllocator(serving.paged_blocks)
@@ -976,11 +985,20 @@ class PagedServingEngine(ServingEngine):
         self.slot_admit_seq = [0] * serving.max_slots
         self._admit_counter = 0
         self.preemptions = 0
-        self.prefix_cache = None
+        # Block-granular prefix sharing (paged.PagedPrefixCache):
+        # cache entries hold refcounted references to FULL pool
+        # blocks; a hit points the new slot's table at them — no
+        # copy, no recompute of the shared positions.
+        self.prefix_cache = (
+            paged.PagedPrefixCache(serving.prefix_cache_entries,
+                                   self.alloc, serving.block_size)
+            if serving.prefix_cache_entries > 0 else None)
         self._paged_prefill = functools.partial(
             _jitted_paged_prefill(cfg), self.params)
         self._paged_chunk = functools.partial(
             _jitted_paged_chunk(cfg, serving.chunk), self.params)
+        self._paged_suffix = functools.partial(
+            _jitted_paged_suffix(cfg), self.params)
 
     # -- hooks ---------------------------------------------------------
 
@@ -995,9 +1013,17 @@ class PagedServingEngine(ServingEngine):
     def _can_admit(self, request: Request) -> bool:
         from kind_tpu_sim.models import paged
 
-        return (paged.blocks_needed(len(request.prompt),
-                                    self.serving.block_size)
-                <= self.alloc.free_blocks)
+        # Worst-case (cache-miss) requirement; under pressure, evict
+        # prefix-cache entries first — retired entries must never pin
+        # the pool and starve admission (run() would spin forever on
+        # a queue nothing can drain).
+        need = paged.blocks_needed(len(request.prompt),
+                                   self.serving.block_size)
+        while need > self.alloc.free_blocks:
+            if (self.prefix_cache is None
+                    or not self.prefix_cache.evict_lru()):
+                return False
+        return True
 
     def _prefill_slot(self, slot: int, req: Request):
         import jax.numpy as jnp
@@ -1007,22 +1033,54 @@ class PagedServingEngine(ServingEngine):
 
         t_p = len(req.prompt)
         bsz = self.serving.block_size
-        n = paged.blocks_needed(t_p, bsz)
-        blocks = self.alloc.alloc(n)
-        assert blocks is not None  # _can_admit gated this
-        self.slot_blocks[slot] = blocks
         self._admit_counter += 1
         self.slot_admit_seq[slot] = self._admit_counter
 
-        width = paged.width_bucket(n)
-        table_row = np.zeros((width,), np.int32)
-        table_row[:n] = blocks
-        pad = _bucket(t_p)
-        tokens = np.zeros((1, pad), np.int32)
-        tokens[0, :t_p] = req.prompt
-        self.pools, logits = self._paged_prefill(
-            self.pools, jnp.asarray(tokens), jnp.int32(t_p),
-            jnp.asarray(table_row))
+        hit = (self.prefix_cache.lookup(req.prompt)
+               if self.prefix_cache is not None else None)
+        if hit is not None:
+            # zero-copy admission: point the table at the shared
+            # prefix blocks (refcounted), allocate own blocks only
+            # for the suffix, run only the suffix forward
+            base = hit["len"]  # block-aligned by construction
+            own = self.alloc.alloc(
+                paged.blocks_needed(t_p - base, bsz))
+            assert own is not None  # _can_admit covered full t_p
+            self.alloc.share(hit["blocks"])
+            blocks = list(hit["blocks"]) + own
+            self.slot_blocks[slot] = blocks
+
+            suffix = req.prompt[base:]
+            w_pad = _bucket(len(suffix))
+            tokens = np.zeros((1, w_pad), np.int32)
+            tokens[0, :len(suffix)] = suffix
+            width = paged.width_bucket(len(blocks))
+            table_row = np.zeros((width,), np.int32)
+            table_row[:len(blocks)] = blocks
+            self.pools, logits = self._paged_suffix(
+                self.pools, jnp.asarray(tokens),
+                jnp.int32(len(suffix)), jnp.int32(base),
+                jnp.asarray(table_row))
+        else:
+            n = paged.blocks_needed(t_p, bsz)
+            blocks = self.alloc.alloc(n)
+            assert blocks is not None  # _can_admit gated this
+            self.slot_blocks[slot] = blocks
+
+            width = paged.width_bucket(n)
+            table_row = np.zeros((width,), np.int32)
+            table_row[:n] = blocks
+            pad = _bucket(t_p)
+            tokens = np.zeros((1, pad), np.int32)
+            tokens[0, :t_p] = req.prompt
+            self.pools, logits = self._paged_prefill(
+                self.pools, jnp.asarray(tokens), jnp.int32(t_p),
+                jnp.asarray(table_row))
+        if req.cache_prefix and self.prefix_cache is not None:
+            # shares (refcounts) the slot's full-prefix blocks — no
+            # copy; the entry holds them alive past slot retirement
+            self.prefix_cache.store(req.prompt,
+                                    self.slot_blocks[slot])
         return logits
 
     def _preempt_youngest(self) -> bool:
@@ -1079,8 +1137,13 @@ class PagedServingEngine(ServingEngine):
                     shortfalls[s] = need
             if sum(shortfalls.values()) <= self.alloc.free_blocks:
                 break
-            # pool pressure: evict the youngest slot and retry;
-            # _capacity_check guarantees a lone survivor fits.
+            # pool pressure, cheapest reclaim first: cache-held
+            # blocks (costs a future recompute) before preempting a
+            # slot (discards work done). _capacity_check + full
+            # eviction guarantee a lone surviving slot always fits.
+            if (self.prefix_cache is not None
+                    and self.prefix_cache.evict_lru()):
+                continue
             if not self._preempt_youngest():
                 break
             active_host = np.asarray(self.active)

@@ -42,6 +42,42 @@ _ACTIVATED = False
 SIMULATED_DEVICE_KIND = "TPU v5 lite (simulated)"
 
 
+def check_compat() -> list:
+    """Names of jax-internal surfaces this shim needs that are MISSING
+    from the installed jax (empty list = compatible).
+
+    The shim leans on jax 0.9.0 internals; a jax bump that renames
+    any of them must fail LOUDLY here (activate raises with the
+    missing names and the validated pin) instead of silently leaving
+    pods with a cpu identity — the failure mode VERDICT r2 flagged.
+    """
+    missing = []
+    try:
+        import jaxlib._jax as _jax
+    except ImportError:
+        return ["jaxlib._jax (module)"]
+    for attr in ("get_tfrt_cpu_client", "Device"):
+        if not hasattr(_jax, attr):
+            missing.append(f"jaxlib._jax.{attr}")
+    if hasattr(_jax, "Device"):
+        # pre-activation these are nanobind descriptors (not Python
+        # `property`); only their existence is checkable without
+        # mutating the class
+        for prop in ("platform", "device_kind"):
+            if getattr(_jax.Device, prop, None) is None:
+                missing.append(f"jaxlib._jax.Device.{prop}")
+    try:
+        from jax._src import xla_bridge as xb
+    except ImportError:
+        return missing + ["jax._src.xla_bridge (module)"]
+    if not isinstance(getattr(xb, "_backend_factories", None), dict):
+        missing.append("jax._src.xla_bridge._backend_factories (dict)")
+    if not callable(getattr(xb, "register_backend_factory", None)):
+        missing.append(
+            "jax._src.xla_bridge.register_backend_factory")
+    return missing
+
+
 def activate(device_kind: str | None = None) -> None:
     """Make JAX's CPU devices identify as simulated TPU chips.
 
@@ -52,6 +88,15 @@ def activate(device_kind: str | None = None) -> None:
     global _ACTIVATED
     if _ACTIVATED:
         return
+    incompat = check_compat()
+    if incompat:
+        import jax
+
+        raise RuntimeError(
+            "kind-tpu-sim platform shim: installed jax "
+            f"{jax.__version__} no longer exposes "
+            f"{', '.join(incompat)}; the shim is validated against "
+            f"{POD_JAX_REQUIREMENT} (kind_tpu_sim/tpu_platform.py)")
     import jaxlib._jax as _jax
     from jax._src import xla_bridge as xb
 

@@ -173,8 +173,99 @@ def test_block_allocator_invariants():
     assert paged.width_bucket(1) == 2
 
 
-def test_paged_rejects_prefix_cache(params):
-    sc = serving.ServingConfig(max_slots=1, paged_blocks=4,
-                               prefix_cache_entries=2)
-    with pytest.raises(ValueError, match="prefix caching"):
-        serving.PagedServingEngine(params, CFG, sc)
+def test_paged_prefix_sharing_exact_and_refcounted(params):
+    """Block-granular prefix caching: a hit POINTS the new slot at
+    the stored blocks (zero copy), output stays exact, and refcounts
+    keep shared blocks alive exactly as long as someone uses them."""
+    rng = np.random.RandomState(5)
+    shared = rng.randint(0, CFG.vocab_size, size=16).tolist()  # 2 blocks
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                               paged_blocks=16, block_size=8,
+                               prefix_cache_entries=4)
+    eng = serving.PagedServingEngine(params, CFG, sc)
+
+    eng.submit(serving.Request("cold", shared + [1, 2], 6,
+                               cache_prefix=True))
+    done = {c.request_id: c for c in eng.run()}
+    assert done["cold"].tokens == solo_greedy(params, shared + [1, 2],
+                                              6)
+    rep = eng.report()
+    assert rep["prefix_cache"]["entries"] == 1
+    # the cache entry's 2 full blocks survive slot retirement
+    assert rep["paged"]["blocks_in_use"] == 2
+
+    # hit: exact greedy through the shared blocks, no extra residency
+    eng.submit(serving.Request("hot", shared + [5, 6, 7], 6))
+    done = {c.request_id: c for c in eng.run()}
+    assert done["hot"].tokens == solo_greedy(params,
+                                             shared + [5, 6, 7], 6)
+    rep = eng.report()
+    assert rep["prefix_cache"]["hits"] == 1
+    assert rep["paged"]["blocks_in_use"] == 2
+
+    # two CONCURRENT hits share the same physical prefix blocks
+    eng.submit(serving.Request("h1", shared + [9], 4))
+    eng.submit(serving.Request("h2", shared + [11, 12], 4))
+    done = {c.request_id: c for c in eng.run()}
+    assert done["h1"].tokens == solo_greedy(params, shared + [9], 4)
+    assert done["h2"].tokens == solo_greedy(params, shared + [11, 12],
+                                            4)
+    assert eng.report()["paged"]["blocks_in_use"] == 2
+
+
+def test_paged_prefix_cache_eviction_frees_blocks(params):
+    rng = np.random.RandomState(6)
+    sc = serving.ServingConfig(max_slots=1, max_len=48, chunk=8,
+                               paged_blocks=24, block_size=8,
+                               prefix_cache_entries=1)
+    eng = serving.PagedServingEngine(params, CFG, sc)
+    p1 = rng.randint(0, CFG.vocab_size, size=9).tolist()   # 1 block
+    p2 = rng.randint(0, CFG.vocab_size, size=17).tolist()  # 2 blocks
+    eng.submit(serving.Request("a", p1, 4, cache_prefix=True))
+    eng.run()
+    assert eng.report()["paged"]["blocks_in_use"] == 1
+    # capacity 1: storing p2 evicts p1's entry and frees its block
+    eng.submit(serving.Request("b", p2, 4, cache_prefix=True))
+    eng.run()
+    rep = eng.report()
+    assert rep["prefix_cache"]["entries"] == 1
+    assert rep["paged"]["blocks_in_use"] == 2  # p2's two full blocks
+
+
+def test_cache_held_blocks_cannot_starve_admission(params):
+    """Regression: retired prefix-cache entries must be evicted under
+    allocation pressure — otherwise a cache holding most of the pool
+    starves admission and run() spins forever on a drainable queue."""
+    rng = np.random.RandomState(9)
+    # 7 usable blocks x 8 positions; cache capacity lets entries pin
+    # 4 of them after their slots retire
+    sc = serving.ServingConfig(max_slots=1, max_len=48, chunk=8,
+                               paged_blocks=8, block_size=8,
+                               prefix_cache_entries=4)
+    eng = serving.PagedServingEngine(params, CFG, sc)
+    for i in range(2):
+        p = rng.randint(0, CFG.vocab_size, size=16).tolist()
+        eng.submit(serving.Request(f"c{i}", p, 4, cache_prefix=True))
+    eng.run()
+    assert eng.report()["paged"]["blocks_in_use"] == 4  # cache-held
+    # needs 4 blocks; only 3 free -> must evict a cache entry
+    big = rng.randint(0, CFG.vocab_size, size=28).tolist()
+    eng.submit(serving.Request("big", big, 4))
+    done = eng.run()
+    assert len(done) == 1
+    assert done[0].tokens == solo_greedy(params, big, 4)
+
+
+def test_block_allocator_refcounts():
+    alloc = paged.BlockAllocator(6)
+    a = alloc.alloc(2)
+    alloc.share(a)
+    alloc.free(a)                     # drops to 1 ref
+    assert alloc.free_blocks == 3     # still held
+    assert alloc.refcount(a[0]) == 1
+    alloc.free(a)                     # drops to 0 -> pooled
+    assert alloc.free_blocks == 5
+    with pytest.raises(ValueError, match="double free"):
+        alloc.free(a)
+    with pytest.raises(ValueError, match="unallocated"):
+        alloc.share([a[0]])

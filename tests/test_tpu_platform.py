@@ -146,3 +146,22 @@ print(json.dumps({"skip": False, "platform": ds[0].platform}))
         # assert; the cpu-rewrite behavior is covered above
         return
     assert report["platform"] != "cpu"
+
+
+def test_check_compat_clean_on_pinned_jax():
+    """The validated jax pin passes the compat probe; and the probe
+    reports names (not a crash) when a surface disappears."""
+    from kind_tpu_sim import tpu_platform
+
+    assert tpu_platform.check_compat() == []
+
+
+def test_activate_raises_loudly_on_incompatible_jax(monkeypatch):
+    import jaxlib._jax as _jax
+
+    from kind_tpu_sim import tpu_platform
+
+    monkeypatch.setattr(tpu_platform, "_ACTIVATED", False)
+    monkeypatch.delattr(_jax, "get_tfrt_cpu_client")
+    with pytest.raises(RuntimeError, match="get_tfrt_cpu_client"):
+        tpu_platform.activate()
