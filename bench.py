@@ -681,71 +681,84 @@ def model_throughput(emit=None) -> dict | None:
                 result["serving_error"] = str(exc)[:100]
             _note()
 
-            # Paged-KV engine over the same request stream: the
-            # memory model costs ~2 pool passes per chunk (gather
-            # view + scatter-back); this entry is that overhead
-            # measured, next to the pool-vs-grid HBM ratio the
-            # paging buys (docs/SERVING.md "Padding-waste").
-            try:
-                from kind_tpu_sim.models import serving
+            # Paged-KV engine, both attention tiers, over the SAME
+            # request stream. Gather tier: the memory model costs ~2
+            # pool passes per chunk (view + scatter-back) — this
+            # entry is that overhead measured, next to the
+            # pool-vs-grid HBM ratio paging buys (docs/SERVING.md).
+            # Kernel tier: pool blocks read directly through the
+            # table (no gather view) — the gather-vs-kernel delta IS
+            # the per-chunk view cost. Shared setup out of both trys
+            # so a tier failure names its real cause.
+            from kind_tpu_sim.models import serving
 
-                _paged_t0 = time.monotonic()
-                sp = decode.serving_params(params, cfg)
-                # pool sized to the workload (max 256-token prompts +
-                # 192 new, 16 slots' worth) — the point of paging is
-                # NOT provisioning slots x max_len
-                block = 64
-                pool_blocks = 1 + 2 * batch * ((256 + 192) // block + 1)
-                scp = serving.ServingConfig(
+            # pool sized to the workload (max 256-token prompts +
+            # 192 new, 16 slots' worth) — the point of paging is
+            # NOT provisioning slots x max_len
+            block = 64
+            pool_blocks = 1 + 2 * batch * ((256 + 192) // block + 1)
+            lens = [192, 224, 256]
+
+            def run_paged(key: str, **cfg_extra):
+                """One paged-engine measurement over the canonical
+                request stream (identical by construction across
+                tiers: same RandomState(0) draw)."""
+                t_section = time.monotonic()
+                sc_p = serving.ServingConfig(
                     max_slots=batch, max_len=1024, chunk=64,
-                    paged_blocks=pool_blocks, block_size=block)
-                engp = serving.PagedServingEngine(sp, cfg, scp)
+                    paged_blocks=pool_blocks, block_size=block,
+                    **cfg_extra)
+                eng_p = serving.PagedServingEngine(sp, cfg, sc_p)
+                eng_p.submit(serving.Request(
+                    "warm", np.asarray(tokens[0, :256]).tolist(), 2))
+                eng_p.run()  # compile prefill bucket + chunk trace
+                d = {"n": 0}
+                c = make_counter(d)
+                eng_p._paged_chunk = c(eng_p._paged_chunk)
+                eng_p._paged_prefill = c(eng_p._paged_prefill)
+                eng_p._first = c(eng_p._first)
                 rng = np.random.RandomState(0)
-                lens = [192, 224, 256]
-                reqs = []
                 for i in range(2 * batch):
                     p_len = int(rng.choice(lens))
                     max_new = int(rng.choice([64, 128, 192]))
-                    reqs.append(serving.Request(
-                        f"p{i}",
+                    eng_p.submit(serving.Request(
+                        f"{key}{i}",
                         np.asarray(tokens[0, :p_len]).tolist(),
                         max_new))
-                engp.submit(serving.Request(
-                    "warm", np.asarray(tokens[0, :256]).tolist(), 2))
-                engp.run()  # compile prefill bucket + chunk trace
-                disp = {"n": 0}
-                countp = make_counter(disp)
-                engp._paged_chunk = countp(engp._paged_chunk)
-                engp._paged_prefill = countp(engp._paged_prefill)
-                engp._first = countp(engp._first)
-                for r in reqs:
-                    engp.submit(r)
                 t0 = time.monotonic()
-                donep = engp.run()
-                wallp = time.monotonic() - t0
-                genp = sum(len(c.tokens) for c in donep)
-                assert len(donep) == len(reqs)
-                devp = wallp - disp["n"] * null_dt
-                grid_positions = batch * 1024
-                pool_positions = pool_blocks * block
+                done_p = eng_p.run()
+                wall = time.monotonic() - t0
+                gen_p = sum(len(cm.tokens) for cm in done_p)
+                assert len(done_p) == 2 * batch
+                dev = wall - d["n"] * null_dt
                 entry = {
-                    "requests": len(donep),
-                    "generated_tokens": genp,
+                    "requests": len(done_p),
+                    "generated_tokens": gen_p,
                     "pool_blocks": pool_blocks,
                     "block_size": block,
-                    "preemptions": engp.preemptions,
+                    "preemptions": eng_p.preemptions,
                     "kv_positions_vs_grid": round(
-                        pool_positions / grid_positions, 3),
-                    "wall_tokens_per_s": round(genp / wallp),
-                    "dispatches": disp["n"],
+                        pool_blocks * block / (batch * 1024), 3),
+                    "wall_tokens_per_s": round(gen_p / wall),
+                    "dispatches": d["n"],
                 }
-                if devp > 0.2 * wallp:
-                    entry["device_tokens_per_s"] = round(genp / devp)
-                result["serving_paged"] = entry
-                SECTION_S["serving_paged"] = round(
-                    time.monotonic() - _paged_t0, 1)
+                if dev > 0.2 * wall:
+                    entry["device_tokens_per_s"] = round(gen_p / dev)
+                result[key] = entry
+                SECTION_S[key] = round(
+                    time.monotonic() - t_section, 1)
+
+            try:
+                sp = decode.serving_params(params, cfg)
+                run_paged("serving_paged")
             except Exception as exc:  # pragma: no cover
                 result["serving_paged_error"] = str(exc)[:100]
+            _note()
+            try:
+                sp = decode.serving_params(params, cfg)
+                run_paged("serving_paged_kernel", paged_kernel=True)
+            except Exception as exc:  # pragma: no cover
+                result["serving_paged_kernel_error"] = str(exc)[:100]
             _note()
 
             # Speculative decoding composed WITH continuous batching

@@ -353,6 +353,90 @@ class PagedPrefixCache:
                 "misses": self.misses}
 
 
+def _block_decode_kernel(x, bparams, cfg: ModelConfig, pool_lc,
+                         tables, small_lc, lengths, i):
+    """One decode-chunk block with the big-cache attention computed by
+    the Pallas paged kernel (ops.pallas_kernels.paged_attention):
+    pool blocks are read directly through the block table — no
+    gathered view in HBM. The kernel returns softmax partials
+    (acc, m, l) over the paged prefix; the chunk-buffer and in-flight
+    groups are computed dense and merged with the standard flash
+    combine, which is mathematically the same softmax (fp32 partials;
+    bitwise it can differ from the monolithic concatenated softmax —
+    greedy streams still match at tested sizes, the flash-class
+    numerics tier).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.decode import (
+        _attend_token,
+        _cache_scores,
+        _finish_block,
+    )
+    from kind_tpu_sim.ops.pallas_kernels import paged_attention
+
+    b, _ = x.shape
+    dtype = jnp.dtype(cfg.dtype)
+    positions = (lengths + i)[:, None]
+    qg, k1, v1 = _attend_token(x, bparams, cfg, positions)
+    scale = cfg.head_dim ** -0.5
+
+    acc_b, m_b, l_b = paged_attention(
+        qg, pool_lc["k"], pool_lc["v"], tables, lengths)
+
+    c_len = small_lc["k"].shape[1]
+    sc_sm = _cache_scores(qg, small_lc["k"], scale)
+    sc_sm = jnp.where(
+        (jnp.arange(c_len) < i)[None, None, None, :], sc_sm, -1e30)
+    rest = jnp.concatenate([sc_sm, _cache_scores(qg, k1, scale)], -1)
+    v_cat = jnp.concatenate([small_lc["v"], v1], 1)  # (b, c+1, kv, hd)
+
+    # flash combine of the kernel partials with the dense groups;
+    # the in-flight token is always live, so m_tot is finite and the
+    # denominator strictly positive even for an empty paged prefix
+    m_tot = jnp.maximum(m_b, jnp.max(rest, axis=-1))
+    p_rest = jnp.exp(rest - m_tot[..., None])
+    attn_rest = jnp.einsum(
+        "bkgs,bskd->bkgd", p_rest, v_cat.astype(jnp.float32))
+    corr = jnp.exp(m_b - m_tot)
+    l_tot = l_b * corr + jnp.sum(p_rest, axis=-1)
+    attn = ((acc_b * corr[..., None] + attn_rest)
+            / l_tot[..., None]).astype(dtype).reshape(b, cfg.d_model)
+
+    small_lc = {
+        "k": jax.lax.dynamic_update_slice(small_lc["k"], k1,
+                                          (0, i, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(small_lc["v"], v1,
+                                          (0, i, 0, 0)),
+    }
+    return _finish_block(x, attn, bparams, cfg), small_lc
+
+
+def paged_decode_chunk_kernel(params, pools, tables, lengths,
+                              last_token, active, sampling_state, *,
+                              cfg: ModelConfig, chunk: int):
+    """paged_decode_chunk's Pallas tier: same scheduling quantum, but
+    the big-cache attention reads pool blocks directly through the
+    table (no per-chunk gather, no transient view — peak HBM is the
+    pool alone). Requires bf16 pools (the kernel contracts bf16/fp32;
+    int8 pools stay on the gather tier)."""
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.serving import _chunk_scan
+
+    def block_fn(x, bparams, pool_lc, small_lc, i):
+        return _block_decode_kernel(
+            x, bparams, cfg, pool_lc, tables, small_lc, lengths, i)
+
+    token, small, emitted = _chunk_scan(
+        params, pools, lengths, last_token, active, sampling_state,
+        cfg=cfg, chunk=chunk, block_fn=block_fn)
+    pools = scatter_rows(pools, tables, lengths, small, active)
+    lengths = jnp.where(active, lengths + chunk, lengths)
+    return pools, lengths, token, emitted
+
+
 # ---------------------------------------------------------------------
 # host-side block allocator
 

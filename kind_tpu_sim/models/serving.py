@@ -82,6 +82,9 @@ class ServingConfig:
     speculative_k: int = 0    # >0: per-slot prompt-lookup drafts of
     #                           this width, one verify window per
     #                           round (SpeculativeServingEngine)
+    paged_kernel: bool = False  # paged tier only: Pallas paged-
+    #                             attention (direct block reads, no
+    #                             gather view); bf16 pools only
 
 
 @dataclasses.dataclass
@@ -280,7 +283,8 @@ def _scatter_chunk(cache_arr, small_arr, starts, active, cfg):
 
 
 def _chunk_scan(params, big_cache, lengths, last_token, active,
-                sampling_state, *, cfg: ModelConfig, chunk: int):
+                sampling_state, *, cfg: ModelConfig, chunk: int,
+                block_fn=None):
     """The shared inner scan of one scheduling quantum: ``chunk``
     tokens for every slot against a loop-invariant big cache
     (inactive slots compute too — lockstep SPMD — but their emissions
@@ -289,7 +293,10 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
     either the dense grid rows or a paged gather view; the merge-back
     strategy is the caller's (grid scatter vs pool scatter), which is
     the only difference between the two engines' decode rounds.
-    Returns (next_token, small chunk buffers, emitted (slots, chunk)).
+    ``block_fn(x, bparams, big_lc, small_lc, i)`` overrides the
+    per-layer block (paged.py's Pallas-kernel tier passes a closure
+    attending block pools directly). Returns (next_token, small chunk
+    buffers, emitted (slots, chunk)).
     """
     import jax
     import jax.numpy as jnp
@@ -299,6 +306,12 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
     temp, top_k, top_p, keys, prompt_len = sampling_state
     b = last_token.shape[0]
     dtype = jnp.dtype(cfg.dtype)
+    if block_fn is None:
+        # decode's chunk block with a per-slot base vector: each
+        # slot attends over its own [0, lengths[b]) prefix.
+        def block_fn(x, bparams, big_lc, small_lc, i):
+            return _block_decode_chunk(
+                x, bparams, cfg, big_lc, small_lc, lengths, i)
     small0 = [
         {
             "k": jnp.zeros((b, chunk, cfg.kv_heads, cfg.head_dim),
@@ -315,10 +328,7 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
         new_small = []
         for bparams, big_lc, small_lc in zip(params["blocks"],
                                              big_cache, small):
-            # decode's chunk block with a per-slot base vector: each
-            # slot attends over its own [0, lengths[b]) prefix.
-            x, small_lc = _block_decode_chunk(
-                x, bparams, cfg, big_lc, small_lc, lengths, i)
+            x, small_lc = block_fn(x, bparams, big_lc, small_lc, i)
             new_small.append(small_lc)
         x = _rms_norm(x, params["final_norm"])
         logits = _readout(x, params["embed"], cfg.int8_native)
@@ -688,6 +698,13 @@ class ServingEngine:
         import functools
 
         cfg, serving = self.cfg, self.serving
+        if serving.paged_blocks or serving.paged_kernel:
+            # loud, not silent: a paged config on the dense-grid
+            # tiers would otherwise "run" and quietly benchmark the
+            # wrong storage model
+            raise ValueError(
+                f"{type(self).__name__} ignores paged_blocks/"
+                "paged_kernel; construct PagedServingEngine")
         self.cache = init_cache(cfg, serving.max_slots,
                                 serving.max_len)
         # cache is donated: XLA updates the 100+ MB grid in place.
@@ -946,12 +963,27 @@ def _jitted_paged_suffix(cfg: ModelConfig):
                    donate_argnums=(1,))
 
 
+def _jitted_paged_chunk_kernel(cfg: ModelConfig, chunk: int):
+    import functools
+
+    import jax
+
+    from kind_tpu_sim.models.paged import paged_decode_chunk_kernel
+
+    return jax.jit(
+        functools.partial(paged_decode_chunk_kernel, cfg=cfg,
+                          chunk=chunk),
+        donate_argnums=(1,))
+
+
 _jitted_paged_prefill = _functools.lru_cache(maxsize=32)(
     _jitted_paged_prefill)
 _jitted_paged_chunk = _functools.lru_cache(maxsize=32)(
     _jitted_paged_chunk)
 _jitted_paged_suffix = _functools.lru_cache(maxsize=32)(
     _jitted_paged_suffix)
+_jitted_paged_chunk_kernel = _functools.lru_cache(maxsize=32)(
+    _jitted_paged_chunk_kernel)
 
 
 class PagedServingEngine(ServingEngine):
@@ -995,8 +1027,17 @@ class PagedServingEngine(ServingEngine):
             if serving.prefix_cache_entries > 0 else None)
         self._paged_prefill = functools.partial(
             _jitted_paged_prefill(cfg), self.params)
-        self._paged_chunk = functools.partial(
-            _jitted_paged_chunk(cfg, serving.chunk), self.params)
+        if serving.paged_kernel:
+            if cfg.int8_kv:
+                raise ValueError(
+                    "paged_kernel needs bf16 pools; int8_kv uses "
+                    "the gather tier")
+            self._paged_chunk = functools.partial(
+                _jitted_paged_chunk_kernel(cfg, serving.chunk),
+                self.params)
+        else:
+            self._paged_chunk = functools.partial(
+                _jitted_paged_chunk(cfg, serving.chunk), self.params)
         self._paged_suffix = functools.partial(
             _jitted_paged_suffix(cfg), self.params)
 
@@ -1222,6 +1263,11 @@ class SpeculativeServingEngine(ServingEngine):
             raise ValueError(
                 "SpeculativeServingEngine needs "
                 "ServingConfig.speculative_k >= 1")
+        if serving.paged_blocks or serving.paged_kernel:
+            raise ValueError(
+                "SpeculativeServingEngine ignores paged_blocks/"
+                "paged_kernel; speculation over the paged pool is "
+                "not composed yet")
         if serving.prefix_cache_entries > 0:
             raise ValueError(
                 "prefix caching is not supported with the "

@@ -526,6 +526,127 @@ def _flash_bwd(q, k, v, out, lse, g, causal: bool, block_q: int,
     return dq, dk, dv
 
 
+# ---------------------------------------------------------------------
+# paged attention (decode): block-table-indexed KV pool reads
+
+
+def paged_attention(qg, k_pool, v_pool, tables, lengths,
+                    interpret: Optional[bool] = None):
+    """Partial-softmax attention of ONE query token per slot over its
+    paged KV prefix, reading pool blocks DIRECTLY via the block table
+    (scalar-prefetched index maps) — no gathered view ever exists in
+    HBM, which is the kernel's reason to be: the XLA paged path
+    (models/paged.gather_view) materializes a (slots, width*B) copy
+    per chunk, this reads exactly the live blocks.
+
+    qg:      (slots, kv_heads, group, head_dim) query, grouped
+    k_pool:  (num_blocks, block_size, kv_heads, head_dim)
+    v_pool:  same shape as k_pool
+    tables:  (slots, width) int32 — logical block b of slot s lives in
+             pool block tables[s, b]; padding entries point anywhere
+             (they are masked by ``lengths``)
+    lengths: (slots,) int32 — slot s attends positions [0, lengths[s])
+
+    Returns fp32 partials (acc, m, l) with shapes
+    ((slots, kv, g, hd), (slots, kv, g), (slots, kv, g)):
+    acc = sum(exp(s - m) * v), m = running max, l = sum(exp(s - m)).
+    The caller merges them with the chunk-buffer / in-flight score
+    groups via the standard flash combine (models/paged.py), so a
+    slot with lengths == 0 (l = 0, m = -1e30) contributes nothing.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    slots, kv, g, hd = qg.shape
+    nblocks, bsz, kv2, hd2 = k_pool.shape
+    assert (kv, hd) == (kv2, hd2), (qg.shape, k_pool.shape)
+    width = tables.shape[1]
+    scale = hd ** -0.5
+    NEG = -1e30
+
+    def kernel(tab_ref, len_ref, q_ref, k_ref, v_ref,
+               acc_out, m_out, l_out, acc_s, m_s, l_s):
+        s = pl.program_id(0)
+        b = pl.program_id(2)
+
+        @pl.when(b == 0)
+        def _init():
+            acc_s[...] = jnp.zeros_like(acc_s)
+            m_s[...] = jnp.full_like(m_s, NEG)
+            l_s[...] = jnp.zeros_like(l_s)
+
+        q = q_ref[0, 0].astype(jnp.float32)          # (g, hd)
+        kb = k_ref[0, :, 0, :].astype(jnp.float32)   # (B, hd)
+        vb = v_ref[0, :, 0, :].astype(jnp.float32)   # (B, hd)
+        scores = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (g, B)
+        pos = b * bsz + jax.lax.broadcasted_iota(
+            jnp.int32, (g, bsz), 1)
+        mask = pos < len_ref[s]
+        scores = jnp.where(mask, scores, NEG)
+
+        m_prev = m_s[:, :1]                          # (g, 1)
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(scores, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)               # (g, 1)
+        # mask multiplies (not just the NEG bias): with every
+        # position masked, m_new == NEG and exp(NEG - NEG) == 1
+        # would fabricate weight out of nothing
+        p = jnp.exp(scores - m_new) * mask           # (g, B)
+        l_new = l_s[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
+        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+
+        @pl.when(b == width - 1)
+        def _finalize():
+            acc_out[0, 0] = acc_s[...]
+            m_out[0, 0] = m_s[...]                   # lanes replicated
+            l_out[0, 0] = l_s[...]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # tables, lengths
+        grid=(slots, kv, width),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd),
+                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
+            pl.BlockSpec((1, bsz, 1, hd),
+                         lambda s, h, b, tab, ln: (tab[s, b], 0, h, 0)),
+            pl.BlockSpec((1, bsz, 1, hd),
+                         lambda s, h, b, tab, ln: (tab[s, b], 0, h, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, g, hd),
+                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, g, 128),
+                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
+            pl.BlockSpec((1, 1, g, 128),
+                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, hd), jnp.float32),    # accumulator
+            pltpu.VMEM((g, 128), jnp.float32),   # running max
+            pltpu.VMEM((g, 128), jnp.float32),   # denominator
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((slots, kv, g, hd), jnp.float32),
+            jax.ShapeDtypeStruct((slots, kv, g, 128), jnp.float32),
+            jax.ShapeDtypeStruct((slots, kv, g, 128), jnp.float32),
+        ],
+        interpret=_interpret(interpret),
+    )(tables, lengths, qg, k_pool, v_pool)
+    return acc, m[..., 0], l[..., 0]
+
+
 def toolchain_smoke() -> dict:
     """The pallas-pod gate: kernels execute and match XLA numerics."""
     import jax

@@ -232,6 +232,40 @@ def test_paged_prefix_cache_eviction_frees_blocks(params):
     assert rep["paged"]["blocks_in_use"] == 2  # p2's two full blocks
 
 
+def test_paged_kernel_tier_matches_gather_tier(params):
+    """The Pallas paged-attention tier (direct block reads) emits the
+    same greedy streams as the gather tier and the solo decoder —
+    mixed lengths, mid-flight admission, preemption pressure."""
+    ps = prompts(4, seed=11)
+
+    def run(**extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                                   paged_blocks=8, block_size=8,
+                                   **extra)
+        eng = serving.PagedServingEngine(params, CFG, sc)
+        for i, p in enumerate(ps):
+            eng.submit(serving.Request(f"k{i}", p, max_new=8))
+        out = {c.request_id: c.tokens for c in eng.run()}
+        return out, eng
+
+    gather_out, _ = run()
+    kernel_out, eng = run(paged_kernel=True)
+    assert gather_out == kernel_out
+    for i, p in enumerate(ps):
+        assert kernel_out[f"k{i}"] == solo_greedy(params, p, 8), i
+    assert eng.report()["paged"]["blocks_in_use"] == 0
+
+
+def test_paged_kernel_rejects_int8(params):
+    import dataclasses
+
+    sc = serving.ServingConfig(max_slots=1, paged_blocks=4,
+                               paged_kernel=True)
+    with pytest.raises(ValueError, match="bf16 pools"):
+        serving.PagedServingEngine(
+            params, dataclasses.replace(CFG, int8_kv=True), sc)
+
+
 def test_cache_held_blocks_cannot_starve_admission(params):
     """Regression: retired prefix-cache entries must be evicted under
     allocation pressure — otherwise a cache holding most of the pool
