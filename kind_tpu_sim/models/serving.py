@@ -186,19 +186,18 @@ def _prefill_into_slot(params, cache, tokens, true_len, slot, *,
     return new_cache, logits[0].astype(jnp.float32)
 
 
-def _sample_rows(logits, temp, top_k, top_p, keys):
-    """Per-row sampling over fp32 logits (b, vocab): each row has its
-    OWN temperature / top-k / top-p / PRNG key (the vLLM per-request
-    SamplingParams shape). Rows with temp <= 0 are greedy. The
+def _filtered_scaled(logits, temp, top_k, top_p):
+    """Temperature-scaled, top-k/top-p-filtered logits per row
+    (b, vocab) — the shared front half of per-request sampling. The
     filtering math mirrors decode._sample_token exactly, vectorized:
     dynamic per-row k via the sorted kth value, nucleus cutoff from
-    the cumulative mass BEFORE each token."""
+    the cumulative mass BEFORE each token. softmax of the result is
+    THE per-request target distribution (used directly by the
+    rejection-sampling verify in speculative serving)."""
     import jax
     import jax.numpy as jnp
 
-    b, vocab = logits.shape
-    greedy = jnp.argmax(logits, axis=-1)
-
+    _, vocab = logits.shape
     scaled = logits / jnp.maximum(temp, 1e-6)[:, None]
     sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
     k_eff = jnp.where(top_k > 0, top_k, vocab)
@@ -216,8 +215,18 @@ def _sample_rows(logits, temp, top_k, top_p, keys):
     keep = (cum - sorted_probs) < p_eff[:, None]
     cutoff = jnp.min(jnp.where(keep, sorted_probs, 2.0), axis=-1,
                      keepdims=True)
-    scaled = jnp.where(probs < cutoff, -1e30, scaled)
+    return jnp.where(probs < cutoff, -1e30, scaled)
 
+
+def _sample_rows(logits, temp, top_k, top_p, keys):
+    """Per-row sampling over fp32 logits (b, vocab): each row has its
+    OWN temperature / top-k / top-p / PRNG key (the vLLM per-request
+    SamplingParams shape). Rows with temp <= 0 are greedy."""
+    import jax
+    import jax.numpy as jnp
+
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = _filtered_scaled(logits, temp, top_k, top_p)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temp <= 0.0, greedy, sampled)
 
@@ -1244,10 +1253,16 @@ class SpeculativeServingEngine(ServingEngine):
     between windows, so the engine composes continuous batching and
     speculation instead of choosing.
 
-    Greedy-only: acceptance is argmax-checked, so output is EXACTLY
+    Greedy requests are argmax-verified, so their output is EXACTLY
     the dense grid's / solo decoder's greedy stream
-    (tests/test_serving.py::test_speculative_grid_*); sampled
-    requests are rejected at submit.
+    (tests/test_serving.py::test_speculative_grid_*). Sampled
+    requests use modified rejection sampling against the per-request
+    filtered target distribution (speculative._rejection_select, the
+    vLLM scheme for deterministic n-gram proposals): the emitted law
+    at every position is exactly the target distribution — the
+    stream differs from the dense engine's per-seed draw (different
+    mechanism) but is still a pure, replayable function of
+    (request, seed), and greedy/sampled requests mix in one grid.
     """
 
     def _init_storage(self) -> None:
@@ -1288,14 +1303,6 @@ class SpeculativeServingEngine(ServingEngine):
                                             self.params)
         self.prefix_cache = None
 
-    def _capacity_check(self, request: Request) -> None:
-        super()._capacity_check(request)
-        samp = request.sampling
-        if samp is not None and samp.temperature > 0.0:
-            raise ValueError(
-                "speculative serving is greedy-exact only; submit "
-                f"request {request.request_id} without sampling")
-
     def _on_admitted(self, slot: int, request: Request,
                      first: int) -> None:
         import jax.numpy as jnp
@@ -1315,9 +1322,11 @@ class SpeculativeServingEngine(ServingEngine):
         self._admit()
         if not any(r is not None for r in self.slot_req):
             return
+        sampling_state = (self.temp, self.top_k, self.top_p,
+                          self.keys, self.prompt_len)
         (self.cache, self.out, self.total, emit,
          m) = self._spec_step(self.cache, self.out, self.total,
-                              self.active)
+                              self.active, sampling_state)
         self.verify_steps += 1
         emit_h = np.asarray(emit)
         m_h = np.asarray(m)

@@ -282,12 +282,57 @@ def _pad_draft(draft, k: int):
     return jnp.concatenate([draft, draft[:, -1:]], axis=1)
 
 
-def _grid_verify_step(params, cache, out, total, active, *,
-                      cfg: ModelConfig, k: int):
+def _rejection_select(probs, draft, u, pos_keys):
+    """Modified rejection sampling for a DETERMINISTIC proposal (the
+    vLLM scheme for n-gram/prompt-lookup drafts under sampling):
+    accept draft d_j with probability p_j(d_j) (u_j < p); at the
+    first rejection m, emit a token from the RESIDUAL distribution
+    p_m with d_m zeroed, renormalized; with every draft accepted
+    (m == k), emit a plain sample from the (k+1)-th position's
+    distribution. The emitted token's law at every position is
+    exactly p — speculation changes wall-clock, not the distribution
+    (Monte-Carlo-verified by tests/test_serving.py::
+    test_rejection_select_preserves_distribution).
+
+    probs (b, k+1, vocab) per-request-filtered target distributions,
+    draft (b, k), u (b, k+1) uniforms, pos_keys (b, k+1, key) the
+    per-generation-index PRNG keys. Returns (m, bonus).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, k1, vocab = probs.shape
+    k = k1 - 1
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], draft[..., None], -1)[..., 0]
+    accept = u[:, :k] < p_draft
+    m = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                axis=1)
+    probs_m = jnp.take_along_axis(probs, m[:, None, None], 1)[:, 0]
+    draft_m = jnp.take_along_axis(
+        _pad_draft(draft, k), m[:, None], 1)[:, 0]
+    resid = probs_m * (1.0 - jax.nn.one_hot(draft_m, vocab,
+                                            dtype=probs.dtype))
+    resid = jnp.where((m < k)[:, None], resid, probs_m)
+    key_m = jnp.take_along_axis(
+        pos_keys, m[:, None, None], 1)[:, 0]
+    bonus = jax.vmap(
+        lambda kk, r: jax.random.categorical(
+            jax.random.fold_in(kk, 1), jnp.log(r + 1e-30))
+    )(key_m, resid)
+    return m, bonus
+
+
+def _grid_verify_step(params, cache, out, total, active,
+                      sampling_state=None, *, cfg: ModelConfig,
+                      k: int):
     """One speculative step over the serving grid: like _verify_step,
     but with an ``active`` mask (lockstep SPMD — inactive slots
     compute too, their state is frozen and their cache writes land in
-    rows the next tenant overwrites before reading). Returns
+    rows the next tenant overwrites before reading) and, when
+    ``sampling_state`` carries per-slot SamplingParams, rejection-
+    sampled acceptance for temp > 0 slots (greedy argmax acceptance
+    otherwise; the two mix freely in one grid). Returns
     (cache, out, total, emit (b, k+1), m) where row b's real new
     tokens this step are emit[b, :m[b]+1] (accepted drafts + bonus).
     """
@@ -317,9 +362,54 @@ def _grid_verify_step(params, cache, out, total, active, *,
 
     agree = (draft == preds[:, :-1])
     m = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
-    m = jnp.where(active, m, 0)
     bonus = jnp.take_along_axis(preds, m[:, None], 1)[:, 0]
 
+    if sampling_state is not None:
+        from kind_tpu_sim.models.serving import _filtered_scaled
+
+        temp, top_k, top_p, keys, prompt_len = sampling_state
+        vocab = logits.shape[-1]
+
+        def rejection_merge(_):
+            flat = logits.reshape(b * (k + 1), vocab).astype(
+                jnp.float32)
+
+            def tile(v):
+                return jnp.repeat(v, k + 1, axis=0)
+
+            probs = jax.nn.softmax(
+                _filtered_scaled(flat, tile(temp), tile(top_k),
+                                 tile(top_p)),
+                axis=-1).reshape(b, k + 1, vocab)
+            # generation index of window position j: the first
+            # window token continues generation (total -
+            # prompt_len); every sampled decision at index g folds
+            # the request key by g — the same indexing the chunk
+            # engine uses, so a stream is a pure function of
+            # (request, seed) regardless of window boundaries,
+            # placement, or co-tenants.
+            gidx = (total - prompt_len)[:, None] + jnp.arange(k + 1)
+            pos_keys = jax.vmap(
+                lambda key, gs: jax.vmap(
+                    lambda g: jax.random.fold_in(key, g))(gs)
+            )(keys, gidx)
+            u = jax.vmap(jax.vmap(
+                lambda kk: jax.random.uniform(
+                    jax.random.fold_in(kk, 0))))(pos_keys)
+            m_s, bonus_s = _rejection_select(probs, draft, u,
+                                             pos_keys)
+            sampled = temp > 0.0
+            return (jnp.where(sampled, m_s, m),
+                    jnp.where(sampled, bonus_s.astype(bonus.dtype),
+                              bonus))
+
+        # all-greedy grids (the common case) skip the vocab-wide
+        # sort/softmax pipeline at execution time
+        m, bonus = jax.lax.cond(
+            jnp.any(temp > 0.0), rejection_merge,
+            lambda _: (m, bonus), None)
+
+    m = jnp.where(active, m, 0)
     emit_idx = jnp.arange(k + 1)[None, :]
     emit = jnp.where(
         emit_idx < m[:, None], _pad_draft(draft, k),
