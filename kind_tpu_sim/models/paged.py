@@ -437,6 +437,32 @@ def paged_decode_chunk_kernel(params, pools, tables, lengths,
     return pools, lengths, token, emitted
 
 
+def paged_verify_step(params, pools, tables, out, total, active,
+                      sampling_state, *, cfg: ModelConfig, k: int):
+    """One speculative verify window over PAGED storage: gather the
+    block view once per window (amortized over up to k+1 emitted
+    tokens, the same economics as the chunk gather), run the window
+    forward against it, scatter the window's k/v into each slot's
+    own blocks at its base, and run the shared accept/emit
+    (speculative._accept_and_emit — greedy argmax and rejection-
+    sampled acceptance both). Returns (pools, out, total, emit, m).
+    """
+    from kind_tpu_sim.models.speculative import (
+        _accept_and_emit,
+        _window_forward,
+    )
+
+    view = gather_view(pools, tables)
+    draft, base, logits, rows = _window_forward(
+        params, view, out, total, cfg=cfg, k=k)
+    # window k/v land at each slot's own positions base..base+k —
+    # scatter_rows' per-slot starts; inactive slots write garbage
+    pools = scatter_rows(pools, tables, base, rows, active)
+    out, total, emit, m = _accept_and_emit(
+        logits, draft, out, total, active, sampling_state, k=k)
+    return pools, out, total, emit, m
+
+
 # ---------------------------------------------------------------------
 # host-side block allocator
 

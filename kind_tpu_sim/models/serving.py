@@ -995,6 +995,22 @@ _jitted_paged_chunk_kernel = _functools.lru_cache(maxsize=32)(
     _jitted_paged_chunk_kernel)
 
 
+def _jitted_paged_spec(cfg: ModelConfig, k: int):
+    import functools
+
+    import jax
+
+    from kind_tpu_sim.models.paged import paged_verify_step
+
+    return jax.jit(
+        functools.partial(paged_verify_step, cfg=cfg, k=k),
+        donate_argnums=(1,))
+
+
+_jitted_paged_spec = _functools.lru_cache(maxsize=16)(
+    _jitted_paged_spec)
+
+
 class PagedServingEngine(ServingEngine):
     """Continuous batching over a paged KV pool (models/paged.py) —
     the vLLM PagedAttention memory model on TPU static shapes.
@@ -1159,27 +1175,29 @@ class PagedServingEngine(ServingEngine):
         self.preemptions += 1
         return True
 
-    def _decode_round(self, sampling_state):
-        import jax.numpy as jnp
+    def _ensure_blocks(self, extend_by: int, occupancy) -> None:
+        """Grow each active slot's block list to cover its next
+        ``extend_by`` writes past ``occupancy[slot]`` — capped at the
+        request's total need, so budget overshoot inside a final
+        chunk/window never allocates blocks (those writes land in
+        last-block slack or garbage). Under pool pressure, reclaim
+        cheapest-first: prefix-cache entries (cost: a future
+        recompute) before preempting the youngest slot (cost: work
+        already done); _capacity_check + full eviction guarantee a
+        lone surviving slot always fits."""
         import numpy as np
 
         from kind_tpu_sim.models import paged
 
         bsz = self.serving.block_size
-        chunk = self.serving.chunk
-        lengths_host = np.asarray(self.lengths)
+        occ_host = np.asarray(occupancy)
         active_host = np.asarray(self.active)
-
-        # Grow each active slot's block list to cover this chunk's
-        # writes — capped at the request's total need, so budget
-        # overshoot inside the final chunk never allocates blocks
-        # (those writes land in last-block slack or garbage).
         while True:
             shortfalls = {}
             for s, req in enumerate(self.slot_req):
                 if req is None or not active_host[s]:
                     continue
-                cover = min(int(lengths_host[s]) + chunk,
+                cover = min(int(occ_host[s]) + extend_by,
                             len(req.prompt) + req.max_new)
                 need = paged.blocks_needed(cover, bsz) \
                     - len(self.slot_blocks[s])
@@ -1187,10 +1205,6 @@ class PagedServingEngine(ServingEngine):
                     shortfalls[s] = need
             if sum(shortfalls.values()) <= self.alloc.free_blocks:
                 break
-            # pool pressure, cheapest reclaim first: cache-held
-            # blocks (costs a future recompute) before preempting a
-            # slot (discards work done). _capacity_check + full
-            # eviction guarantee a lone surviving slot always fits.
             if (self.prefix_cache is not None
                     and self.prefix_cache.evict_lru()):
                 continue
@@ -1202,18 +1216,32 @@ class PagedServingEngine(ServingEngine):
             assert got is not None
             self.slot_blocks[s].extend(got)
 
+    def _build_tables(self):
+        """Device block table bucketed to the longest slot's block
+        count (pow-2 width bounds retraces)."""
+        import numpy as np
+
+        from kind_tpu_sim.models import paged
+
         width = paged.width_bucket(
             max((len(b) for b in self.slot_blocks), default=1) or 1)
         tables = np.zeros((self.serving.max_slots, width), np.int32)
         for s, blks in enumerate(self.slot_blocks):
             tables[s, :len(blks)] = blks
+        return tables
+
+    def _decode_round(self, sampling_state):
+        import jax.numpy as jnp
+        import numpy as np
+
+        chunk = self.serving.chunk
+        self._ensure_blocks(chunk, self.lengths)
+        tables = self._build_tables()
 
         # preemption may have emptied the grid mid-round
         if not any(r is not None for r in self.slot_req):
-            import numpy as _np
-
-            return _np.zeros((self.serving.max_slots, chunk),
-                             _np.int32)
+            return np.zeros((self.serving.max_slots, chunk),
+                            np.int32)
 
         (self.pools, self.lengths, self.last_token,
          emitted) = self._paged_chunk(
@@ -1317,8 +1345,6 @@ class SpeculativeServingEngine(ServingEngine):
 
     def step_round(self) -> None:
         """Admit, run one verify window for the grid, retire."""
-        import numpy as np
-
         self._admit()
         if not any(r is not None for r in self.slot_req):
             return
@@ -1327,6 +1353,14 @@ class SpeculativeServingEngine(ServingEngine):
         (self.cache, self.out, self.total, emit,
          m) = self._spec_step(self.cache, self.out, self.total,
                               self.active, sampling_state)
+        self._spec_retire(emit, m)
+
+    def _spec_retire(self, emit, m) -> None:
+        """Ragged per-slot retirement after one verify window: each
+        active slot takes its accepted-prefix+bonus tokens (budget-
+        and eos-truncated on host, like the chunk engine's retire)."""
+        import numpy as np
+
         self.verify_steps += 1
         emit_h = np.asarray(emit)
         m_h = np.asarray(m)
@@ -1351,6 +1385,86 @@ class SpeculativeServingEngine(ServingEngine):
             "verify_steps": self.verify_steps,
         }
         return out
+
+
+class PagedSpeculativeServingEngine(PagedServingEngine):
+    """Speculative decoding over PAGED storage — the full vLLM
+    composition: continuous batching + PagedAttention memory +
+    speculative verify windows + rejection-sampled or greedy-exact
+    acceptance, in one engine.
+
+    Each round gathers the block view once per verify window
+    (amortized over up to k+1 emitted tokens — the same economics as
+    the chunk gather), scatters the window's k/v into each slot's own
+    blocks, and shares the accept/emit math with the grid engine
+    (paged.paged_verify_step). Block growth, recompute preemption,
+    pressure eviction and block-granular prefix sharing all carry
+    over from PagedServingEngine unchanged; the draft buffer and
+    ragged retirement carry over from SpeculativeServingEngine's
+    contract (same _seed/_retire recipe).
+    """
+
+    def _init_storage(self) -> None:
+        import functools
+
+        import jax.numpy as jnp
+
+        serving = self.serving
+        k = serving.speculative_k
+        if k < 1:
+            raise ValueError(
+                "PagedSpeculativeServingEngine needs "
+                "ServingConfig.speculative_k >= 1")
+        if serving.paged_kernel:
+            raise ValueError(
+                "paged_kernel applies to the chunked decode path; "
+                "the verify window uses the gather tier")
+        super()._init_storage()
+        n = serving.max_slots
+        cap = (serving.paged_blocks - 1) * serving.block_size
+        # out rows sized so the final window write (total + k + 1)
+        # and the emit dynamic_update_slice stay in bounds
+        self._rows = cap + k + 1
+        self.out = jnp.zeros((n, self._rows), jnp.int32)
+        self.total = jnp.zeros((n,), jnp.int32)
+        self.verify_steps = 0
+        self._spec_step = functools.partial(
+            _jitted_paged_spec(self.cfg, k), self.params)
+
+    # the draft-buffer seeding and ragged retirement are the
+    # speculative engine's, verbatim (no super() inside either, so
+    # borrowing the unbound functions across the class tree is safe)
+    _on_admitted = SpeculativeServingEngine._on_admitted
+    _spec_retire = SpeculativeServingEngine._spec_retire
+
+    def report(self) -> Dict[str, Any]:
+        out = super().report()  # paged stats + prefix cache
+        out["speculative"] = {
+            "draft_k": self.serving.speculative_k,
+            "verify_steps": self.verify_steps,
+        }
+        return out
+
+    def step_round(self) -> None:
+        import jax.numpy as jnp
+
+        self._admit()
+        if not any(r is not None for r in self.slot_req):
+            return
+        # block coverage for this window's writes (base..base+k =
+        # total-1..total-1+k); overshoot past a retiring slot's
+        # budget is garbage-masked by the table width
+        self._ensure_blocks(self.serving.speculative_k, self.total)
+        tables = self._build_tables()
+        if not any(r is not None for r in self.slot_req):
+            return  # preemption emptied the grid
+        sampling_state = (self.temp, self.top_k, self.top_p,
+                          self.keys, self.prompt_len)
+        (self.pools, self.out, self.total, emit,
+         m) = self._spec_step(self.pools, jnp.asarray(tables),
+                              self.out, self.total, self.active,
+                              sampling_state)
+        self._spec_retire(emit, m)
 
 
 def serving_report(cfg: ModelConfig = None,

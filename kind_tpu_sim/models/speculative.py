@@ -336,12 +336,34 @@ def _grid_verify_step(params, cache, out, total, active,
     (cache, out, total, emit (b, k+1), m) where row b's real new
     tokens this step are emit[b, :m[b]+1] (accepted drafts + bonus).
     """
-    import jax
+    draft, base, logits, rows = _window_forward(
+        params, cache, out, total, cfg=cfg, k=k)
+    new_cache = [
+        {
+            "k": _write_window(layer_cache["k"], r["k"], base),
+            "v": _write_window(layer_cache["v"], r["v"], base),
+        }
+        for layer_cache, r in zip(cache, rows)
+    ]
+    out, total, emit, m = _accept_and_emit(
+        logits, draft, out, total, active, sampling_state, k=k)
+    return new_cache, out, total, emit, m
+
+
+def _window_forward(params, cache_like, out, total, *,
+                    cfg: ModelConfig, k: int):
+    """Shared front half of every speculative verify step: propose
+    the draft, build the (last, draft) window, run it through the
+    blocks against any big-cache representation (grid rows or a
+    paged gather view), and read out logits. Returns
+    (draft, base, logits, rows) with rows[layer] = {"k","v"} window
+    k/v — PERSISTENCE is the caller's (grid: per-row window write;
+    paged: block scatter), which is the only storage-specific part.
+    """
     import jax.numpy as jnp
 
     from kind_tpu_sim.models.quant import embed_lookup
 
-    b, L = out.shape
     dtype = jnp.dtype(cfg.dtype)
     draft = propose_ngram(out, total, k)
     last = jnp.take_along_axis(out, (total - 1)[:, None], 1)
@@ -349,15 +371,26 @@ def _grid_verify_step(params, cache, out, total, active,
     base = total - 1
 
     x = embed_lookup(params["embed"], window, dtype)
-    new_cache = []
-    for bparams, layer_cache in zip(params["blocks"], cache):
+    rows = []
+    for bparams, layer_cache in zip(params["blocks"], cache_like):
         x, kk, vv = _window_block(x, bparams, cfg, layer_cache, base)
-        new_cache.append({
-            "k": _write_window(layer_cache["k"], kk, base),
-            "v": _write_window(layer_cache["v"], vv, base),
-        })
+        rows.append({"k": kk, "v": vv})
     x = _rms_norm(x, params["final_norm"])
     logits = _readout(x, params["embed"], cfg.int8_native)
+    return draft, base, logits, rows
+
+
+def _accept_and_emit(logits, draft, out, total, active,
+                     sampling_state, *, k: int):
+    """Shared back half of every speculative verify step (grid and
+    paged storage): greedy argmax acceptance, rejection-sampled
+    acceptance for temp > 0 slots when sampling_state is given, emit
+    window construction, and the out/total update (active-masked).
+    Returns (out, total, emit (b, k+1), m)."""
+    import jax
+    import jax.numpy as jnp
+
+    b, L = out.shape
     preds = jnp.argmax(logits, axis=-1).astype(out.dtype)
 
     agree = (draft == preds[:, :-1])
@@ -423,7 +456,7 @@ def _grid_verify_step(params, cache, out, total, active,
                                 jnp.clip(total, 0, L - (k + 1)))
     out = jnp.where(active[:, None], new_out, out)
     total = jnp.where(active, total + m + 1, total)
-    return new_cache, out, total, emit, m
+    return out, total, emit, m
 
 
 def _jitted_grid_step(cfg: ModelConfig, k: int):

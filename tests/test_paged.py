@@ -290,6 +290,75 @@ def test_cache_held_blocks_cannot_starve_admission(params):
     assert done[0].tokens == solo_greedy(params, big, 4)
 
 
+def test_paged_speculative_matches_solo_and_spec_grid(params):
+    """The full composition — paged storage x speculative verify —
+    emits exactly the solo greedy streams AND exactly what the
+    grid-storage speculative engine emits, with fewer verify windows
+    than tokens."""
+    ps = prompts(4, seed=13)
+
+    def run(engine_cls, **extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=48,
+                                   speculative_k=3, **extra)
+        eng = serving.PagedSpeculativeServingEngine(params, CFG, sc) \
+            if engine_cls == "paged" else \
+            serving.SpeculativeServingEngine(params, CFG, sc)
+        for i, p in enumerate(ps):
+            eng.submit(serving.Request(f"v{i}", p, max_new=9))
+        return {c.request_id: c.tokens for c in eng.run()}, eng
+
+    grid_out, _ = run("grid")
+    paged_out, eng = run("paged", paged_blocks=16, block_size=8)
+    assert grid_out == paged_out
+    gen = sum(len(t) for t in paged_out.values())
+    assert eng.verify_steps < gen
+    for i, p in enumerate(ps):
+        assert paged_out[f"v{i}"] == solo_greedy(params, p, 9), i
+    assert eng.report()["paged"]["blocks_in_use"] == 0
+
+
+def test_paged_speculative_preemption_exact(params):
+    # 5 usable blocks x 8: concurrent slots can't all fit -> the
+    # window-growth path must preempt and replay exactly
+    sc = serving.ServingConfig(max_slots=2, paged_blocks=6,
+                               block_size=8, speculative_k=3)
+    eng = serving.PagedSpeculativeServingEngine(params, CFG, sc)
+    ps = prompts(3, seed=14)
+    for i, p in enumerate(ps):
+        eng.submit(serving.Request(f"w{i}", p, max_new=11))
+    done = {c.request_id: c for c in eng.run()}
+    assert len(done) == 3
+    for i, p in enumerate(ps):
+        assert done[f"w{i}"].tokens == solo_greedy(params, p, 11), i
+    assert eng.report()["paged"]["blocks_in_use"] == 0
+
+
+def test_paged_speculative_sampled_and_prefix_sharing(params):
+    """Sampled requests are seed-reproducible through the paged
+    speculative engine, and block-granular prefix sharing composes
+    (greedy co-tenant stays exact)."""
+    rng = np.random.RandomState(15)
+    shared = rng.randint(0, CFG.vocab_size, size=16).tolist()
+    samp = decode.SamplingConfig(temperature=1.4, top_k=16)
+    sc = serving.ServingConfig(max_slots=2, paged_blocks=16,
+                               block_size=8, speculative_k=3,
+                               prefix_cache_entries=2)
+
+    def run():
+        eng = serving.PagedSpeculativeServingEngine(params, CFG, sc)
+        eng.submit(serving.Request("c", shared + [1, 2], 6,
+                                   cache_prefix=True))
+        eng.submit(serving.Request("s", shared + [3], 8,
+                                   sampling=samp, seed=9))
+        return {c.request_id: c.tokens for c in eng.run()}, eng
+
+    o1, e1 = run()
+    o2, _ = run()
+    assert o1["s"] == o2["s"]
+    assert o1["c"] == solo_greedy(params, shared + [1, 2], 6)
+    assert e1.prefix_cache.hits >= 1
+
+
 def test_block_allocator_refcounts():
     alloc = paged.BlockAllocator(6)
     a = alloc.alloc(2)
