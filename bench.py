@@ -315,39 +315,72 @@ def model_throughput(emit=None) -> dict | None:
 
         # Full train step (fwd + bwd + AdamW update) — the flagship
         # number. Scanned on-device like the forward so per-dispatch
-        # RPC latency cannot pollute it.
+        # RPC latency cannot pollute it. On TPU, BOTH attention paths
+        # are measured and the better one is the headline: at seq
+        # 1024 the dense (t,t) score-matrix HBM traffic through
+        # softmax is a real tax the fused Pallas flash path avoids
+        # (tools/mfu_probe.py decomposes this per-op) — the bench
+        # must not under-report the framework because one variant
+        # was hardcoded.
         try:
+            import dataclasses as _dc_train
+
             import jax.numpy as jnp
 
-            step_fn, init_state = tf.make_train_step(cfg)
-            state = init_state(jax.random.PRNGKey(3))
             train_steps = 5 if backend == "tpu" else 2
 
-            @jax.jit
-            def run_train(state, tokens):
-                def body(st, i):
-                    shifted = (tokens + i) % cfg.vocab_size
-                    return step_fn(st, shifted)
+            def measure_train(run_cfg, label, run_tokens, seq_count):
+                step_fn, init_state = tf.make_train_step(run_cfg)
+                state = init_state(jax.random.PRNGKey(3))
 
-                return jax.lax.scan(body, state,
-                                    jnp.arange(train_steps))
+                @jax.jit
+                def run_train(state, run_tokens):
+                    def body(st, i):
+                        shifted = (run_tokens + i) % run_cfg.vocab_size
+                        return step_fn(st, shifted)
 
-            with stopwatch("train"):
-                out_state, losses = run_train(state, tokens)
-                jax.block_until_ready(losses)  # compile + warm
-            t0 = time.monotonic()
-            out_state, losses = run_train(state, tokens)
-            jax.block_until_ready(losses)
-            train_dt = (time.monotonic() - t0) / train_steps
-            assert float(losses[-1]) == float(losses[-1])  # NaN guard
-            train_tps = batch * fwd_seq / train_dt
+                    return jax.lax.scan(body, state,
+                                        jnp.arange(train_steps))
+
+                with stopwatch(label):
+                    out_state, losses = run_train(state, run_tokens)
+                    jax.block_until_ready(losses)  # compile + warm
+                t0 = time.monotonic()
+                out_state, losses = run_train(state, run_tokens)
+                jax.block_until_ready(losses)
+                dt = (time.monotonic() - t0) / train_steps
+                assert float(losses[-1]) == float(losses[-1])  # NaN
+                del out_state, state  # free the optimizer tree
+                return batch * seq_count / dt
+
+            variants = {
+                "dense": measure_train(cfg, "train", tokens, fwd_seq)}
+            if backend == "tpu":
+                try:
+                    # loss_fn's next-token shift trains on seq-1
+                    # positions; 1023 is odd and no 16-aligned flash
+                    # block divides it (the fwd_4k section documents
+                    # the same pitfall) — feed max_seq+1 tokens so
+                    # the flash variant trains on exactly max_seq.
+                    flash_tokens = tf.sample_batch(
+                        jax.random.PRNGKey(1), cfg, batch,
+                        cfg.max_seq + 1)
+                    variants["flash"] = measure_train(
+                        _dc_train.replace(cfg, flash=True),
+                        "train_flash", flash_tokens, cfg.max_seq)
+                except Exception as exc:  # pragma: no cover
+                    result["train_flash_error"] = str(exc)[:100]
+            best = max(variants, key=variants.get)
+            train_tps = variants[best]
             result["train_step_tokens_per_s"] = round(train_tps)
+            result["train_variant"] = best
+            for name, tps in variants.items():
+                result[f"train_{name}_tokens_per_s"] = round(tps)
             if spec is not None:
                 result["train_mfu_pct"] = round(
                     F.mfu(train_tps,
                           F.train_flops_per_token(cfg, fwd_seq),
                           spec), 1)
-            del out_state, state  # free the optimizer tree
         except Exception as exc:  # pragma: no cover - best effort
             result["train_step_error"] = str(exc)[:100]
         _note()

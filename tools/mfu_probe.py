@@ -42,8 +42,12 @@ def measure_train(cfg, batch: int, steps: int) -> dict:
 
     step_fn, init_state = tf.make_train_step(cfg)
     state = init_state(jax.random.PRNGKey(0))
-    tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch,
-                             cfg.max_seq)
+    # loss_fn's next-token shift trains on seq-1 positions; on real
+    # TPU no 16-aligned flash block divides the odd max_seq-1, so
+    # flash variants get max_seq+1 tokens (training on exactly
+    # max_seq) — same workaround bench.py's train section uses.
+    seq = cfg.max_seq + 1 if cfg.flash else cfg.max_seq
+    tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch, seq)
 
     @jax.jit
     def run(state, tokens):
@@ -62,7 +66,7 @@ def measure_train(cfg, batch: int, steps: int) -> dict:
     jax.block_until_ready(losses)
     dt = (time.monotonic() - t0) / steps
     assert float(losses[-1]) == float(losses[-1])  # NaN guard
-    tokens_per_s = batch * (cfg.max_seq - 1) / dt
+    tokens_per_s = batch * (seq - 1) / dt
     del out_state, state
     return {
         "tokens_per_s": round(tokens_per_s),
@@ -182,9 +186,10 @@ def main() -> int:
 
                 step_fn, init_state = tf.make_train_step(cfg)
                 state = init_state(jax.random.PRNGKey(0))
+                seq = (cfg.max_seq + 1 if variant["flash"]
+                       else cfg.max_seq)
                 tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg,
-                                         variant["batch"],
-                                         cfg.max_seq)
+                                         variant["batch"], seq)
                 fn = jax.jit(lambda s, t: step_fn(s, t)[1])
                 with tempfile.TemporaryDirectory() as td:
                     profiling.capture(fn, state, tokens, log_dir=td,
