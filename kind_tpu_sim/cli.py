@@ -279,7 +279,10 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
 
         serving_rep = serving.serving_report()
         spec_rep = speculative.speculative_report()
-        ok = ok and serving_rep["ok"] and spec_rep["ok"]
+        engines_rep = serving.engines_report()
+        serving_rep["engines"] = engines_rep
+        ok = (ok and serving_rep["ok"] and spec_rep["ok"]
+              and engines_rep["ok"])
     if args.as_json:
         out = {"ok": ok, "workers": reports}
         if serving_rep is not None:
@@ -308,6 +311,10 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
                   f"{'OK' if serving_rep['greedy_exact'] else 'FAILED'}")
             print(f"speculative: greedy-exact "
                   f"{'OK' if spec_rep['greedy_exact'] else 'FAILED'}")
+            eng_rep = serving_rep["engines"]
+            print(f"engine matrix ({', '.join(eng_rep['engines'])}): "
+                  "identical streams "
+                  f"{'OK' if eng_rep['ok'] else 'FAILED'}")
         print("SLICE SMOKE " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
 

@@ -1467,6 +1467,57 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
         self._spec_retire(emit, m)
 
 
+def engines_report(cfg: ModelConfig = None) -> Dict[str, Any]:
+    """One smoke over the WHOLE serving matrix: the same greedy
+    request stream through all four engines — dense grid, paged,
+    speculative grid, paged+speculative — must emit identical
+    streams (and match the solo decoder; serving_report pins that
+    leg). Pod / slice-smoke friendly: the strongest single check
+    that the storage and verify tiers compose without drift."""
+    import jax
+    import numpy as np
+
+    from kind_tpu_sim.models import transformer as tf
+
+    cfg = cfg or tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                                n_layers=2, d_ff=64, max_seq=64)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, size=4 + 3 * i).tolist()
+               for i in range(3)]
+
+    def run(make):
+        eng = make()
+        for i, p in enumerate(prompts):
+            eng.submit(Request(f"e{i}", p, max_new=6))
+        return {c.request_id: tuple(c.tokens) for c in eng.run()}
+
+    outs = {
+        "grid": run(lambda: ServingEngine(
+            params, cfg, ServingConfig(max_slots=2, max_len=48,
+                                       chunk=8))),
+        "paged": run(lambda: PagedServingEngine(
+            params, cfg, ServingConfig(max_slots=2, max_len=48,
+                                       chunk=8, paged_blocks=12,
+                                       block_size=8))),
+        "spec": run(lambda: SpeculativeServingEngine(
+            params, cfg, ServingConfig(max_slots=2, max_len=48,
+                                       speculative_k=3))),
+        "paged_spec": run(lambda: PagedSpeculativeServingEngine(
+            params, cfg, ServingConfig(max_slots=2, max_len=48,
+                                       speculative_k=3,
+                                       paged_blocks=12,
+                                       block_size=8))),
+    }
+    agree = all(o == outs["grid"] for o in outs.values())
+    return {
+        "engines": sorted(outs),
+        "requests": len(prompts),
+        "all_streams_identical": bool(agree),
+        "ok": bool(agree),
+    }
+
+
 def serving_report(cfg: ModelConfig = None,
                    max_slots: int = 2) -> Dict[str, Any]:
     """Smoke + contract check for the continuous-batching engine

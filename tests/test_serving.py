@@ -459,3 +459,10 @@ def test_rejection_select_preserves_distribution():
                         np.asarray(draft[:, 0]), np.asarray(bonus))
     hist = np.bincount(emitted0, minlength=vocab) / n
     np.testing.assert_allclose(hist, p_row, atol=0.012)
+
+
+def test_engines_report_matrix_agrees():
+    rep = serving.engines_report()
+    assert rep["ok"], rep
+    assert rep["all_streams_identical"]
+    assert rep["engines"] == ["grid", "paged", "paged_spec", "spec"]
