@@ -1176,9 +1176,14 @@ def bench_model_only(out_path: str | None) -> int:
     committable artifact (e.g. BENCH_LOCAL_r03.json)."""
     phases: dict = {}
     capture_model_section(phases)
+    ok = isinstance(phases.get("model"), dict) and \
+        "error" not in phases["model"]
     artifact = {
         "metric": "tpu_model_throughput",
         "mode": "model-only",
+        # a reader must not mistake a failed capture for evidence:
+        # the status names the outcome before any key is inspected
+        "status": "ok" if ok else "capture-failed",
         "model": phases.get("model"),
         "section_seconds": dict(SECTION_S),
         "captured_unix": int(time.time()),
@@ -1187,8 +1192,6 @@ def bench_model_only(out_path: str | None) -> int:
     if out_path:
         pathlib.Path(out_path).write_text(line + "\n")
     print(line)
-    ok = isinstance(artifact["model"], dict) and \
-        "error" not in artifact["model"]
     return 0 if ok else 1
 
 

@@ -1,0 +1,165 @@
+"""bench.py capture machinery (host-only, no jax): the probe-retry,
+streaming-child, and partial-capture paths that round 2 lost its TPU
+evidence to. These tests pin the machinery itself so a bench refactor
+can't silently reintroduce the discard-everything failure mode."""
+
+import json
+import subprocess
+import sys
+import types
+
+import pytest
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def clean_section_state():
+    """SECTION_S is bench-module state; isolate every test from it so
+    assertions never pass against a stale value."""
+    bench.SECTION_S.clear()
+    yield
+    bench.SECTION_S.clear()
+
+
+def test_min_of_returns_min_and_samples():
+    calls = iter([3.0, 1.0, 2.0])
+    best, samples = bench.min_of(lambda: next(calls), n=3)
+    assert best == 1.0
+    assert samples == [3.0, 1.0, 2.0]
+
+
+def test_min_of_aborts_on_none():
+    calls = iter([3.0, None, 2.0])
+    best, samples = bench.min_of(lambda: next(calls), n=3)
+    assert best is None
+    assert samples == [3.0]
+
+
+def test_probe_accelerator_recovers_between_attempts(monkeypatch):
+    attempts = {"n": 0}
+
+    def fake_run(argv, **kw):
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise subprocess.TimeoutExpired(argv, kw["timeout"])
+        return types.SimpleNamespace(returncode=0)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    ok, errors = bench.probe_accelerator()
+    assert ok
+    assert len(errors) == 2
+    assert "attempt 1 (60s)" in errors[0]
+    assert "attempt 2 (120s)" in errors[1]
+
+
+def test_probe_accelerator_escalates_then_fails(monkeypatch):
+    seen = []
+
+    def fake_run(argv, **kw):
+        seen.append(kw["timeout"])
+        raise subprocess.TimeoutExpired(argv, kw["timeout"])
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    ok, errors = bench.probe_accelerator()
+    assert not ok
+    assert seen == [60, 120, 180]
+    assert len(errors) == 3
+
+
+def _fake_child(monkeypatch, child_code: str):
+    """Route the model child's Popen at an arbitrary python script."""
+    real_popen = bench.subprocess.Popen
+
+    def popen(argv, **kw):
+        return real_popen([sys.executable, "-c", child_code], **kw)
+
+    monkeypatch.setattr(bench.subprocess, "Popen", popen)
+
+
+def test_streaming_child_keeps_partial_on_hang(monkeypatch):
+    # child streams two sections then hangs: the parent must keep the
+    # LAST streamed snapshot and mark truncation — the r02 failure
+    # mode (one hang discarding every measured number) must not recur
+    _fake_child(monkeypatch, (
+        "import json, sys, time\n"
+        "print(json.dumps({'model_partial': {'fwd_tokens_per_s': 1,"
+        " 'section_seconds': {'fwd': 1.0}}}), flush=True)\n"
+        "print(json.dumps({'model_partial': {'fwd_tokens_per_s': 1,"
+        " 'train_step_tokens_per_s': 2,"
+        " 'section_seconds': {'fwd': 1.0, 'train': 2.0}}}),"
+        " flush=True)\n"
+        "time.sleep(60)\n"
+    ))
+    result = bench.model_throughput_via_child(budget_s=3)
+    assert result["train_step_tokens_per_s"] == 2
+    assert "budget 3s exhausted" in result["truncated"]
+    assert bench.SECTION_S.get("train") == 2.0
+
+
+def test_streaming_child_coalesced_lines_not_lost(monkeypatch):
+    # both lines arrive in ONE pipe write; the raw-fd reader must
+    # process both before the child hangs (a buffered readline would
+    # strand the second line and return the stale first snapshot)
+    _fake_child(monkeypatch, (
+        "import json, sys, time\n"
+        "sys.stdout.write("
+        "json.dumps({'model_partial': {'a': 1}}) + '\\n'"
+        " + json.dumps({'model_partial': {'a': 1, 'b': 2}}) + '\\n')\n"
+        "sys.stdout.flush()\n"
+        "time.sleep(60)\n"
+    ))
+    result = bench.model_throughput_via_child(budget_s=3)
+    assert result.get("b") == 2
+
+
+def test_streaming_child_final_wins(monkeypatch):
+    _fake_child(monkeypatch, (
+        "import json\n"
+        "print(json.dumps({'model_partial': {'a': 1}}), flush=True)\n"
+        "print(json.dumps({'model_final': {'a': 1, 'done': True},"
+        " 'section_seconds': {}}), flush=True)\n"
+    ))
+    result = bench.model_throughput_via_child(budget_s=30)
+    assert result == {"a": 1, "done": True}
+
+
+def test_capture_section_marks_childless_failure(monkeypatch):
+    monkeypatch.setattr(bench, "probe_accelerator",
+                        lambda: (True, []))
+    monkeypatch.setattr(bench, "model_throughput_via_child",
+                        lambda budget: None)
+    phases = {}
+    bench.capture_model_section(phases)
+    assert "error" in phases["model"]
+    assert "no sections" in phases["model"]["error"]
+
+
+def test_capture_section_records_probe_errors(monkeypatch):
+    monkeypatch.setattr(
+        bench, "probe_accelerator",
+        lambda: (False, ["attempt 1 (60s): TimeoutExpired"]))
+    phases = {}
+    bench.capture_model_section(phases)
+    assert phases["model"]["probe_attempts"]
+    assert "unavailable" in phases["model"]["error"]
+
+
+def test_model_only_writes_artifact(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "probe_accelerator",
+                        lambda: (True, []))
+    monkeypatch.setattr(bench, "model_throughput_via_child",
+                        lambda budget: {"fwd_tokens_per_s": 7})
+    out = tmp_path / "artifact.json"
+    rc = bench.bench_model_only(str(out))
+    assert rc == 0
+    data = json.loads(out.read_text())
+    assert data["model"]["fwd_tokens_per_s"] == 7
+    assert data["mode"] == "model-only"
+
+
+def test_out_flag_requires_value(capsys):
+    assert bench.main(["--model-only", "--out"]) == 2
+    assert "--out requires a file path" in capsys.readouterr().err
