@@ -690,6 +690,8 @@ def model_throughput(emit=None) -> dict | None:
                 eng._chunk = count(eng._chunk)
                 eng._prefill = count(eng._prefill)
                 eng._first = count(eng._first)  # per-admission sample
+                eng.reset_latency()  # warm request's TTFT is compile
+                #                      time, not serving latency
                 for r in reqs:
                     eng.submit(r)
                 t0 = time.monotonic()
@@ -707,6 +709,9 @@ def model_throughput(emit=None) -> dict | None:
                 }
                 if device > 0.2 * wall:
                     entry["device_tokens_per_s"] = round(gen / device)
+                lat = eng.report().get("latency")
+                if lat:
+                    entry["latency"] = lat
                 result["serving"] = entry
                 SECTION_S["serving"] = round(
                     time.monotonic() - _serving_t0, 1)

@@ -116,6 +116,11 @@ class Completion:
     prompt: List[int]
     tokens: List[int]          # generated tokens (eos included if hit)
     finish_reason: str         # "stop" (eos) or "length"
+    # host-side request metrics (the vLLM observability analog):
+    # ttft_s = submit -> first token (queue wait + prefill);
+    # e2e_s = submit -> completion. None when timing is disabled.
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -698,6 +703,19 @@ class ServingEngine:
         self.slot_req: List[Optional[Request]] = [None] * n
         self.slot_emitted: List[List[int]] = [[] for _ in range(n)]
         self.finished: List[Completion] = []
+        # host-side per-request wall clocks (submit/admit/finish) —
+        # Completion.ttft_s/e2e_s and report()'s latency aggregates.
+        # Aggregation is bounded: running count/max plus a recent
+        # window for percentiles, so a long-lived engine driven via
+        # submit()/poll() neither grows without bound nor re-sorts
+        # its whole history on every report().
+        import collections as _collections
+
+        self._req_clock: Dict[str, Dict[str, float]] = {}
+        self._lat_window = _collections.deque(maxlen=1024)
+        self._lat_count = 0
+        self._lat_ttft_max = 0.0
+        self._lat_e2e_max = 0.0
         self._first = _jitted_first()
         self._init_storage()
 
@@ -742,6 +760,10 @@ class ServingEngine:
             import os
 
             request.seed = int.from_bytes(os.urandom(4), "little")
+        import time as _time
+
+        self._req_clock[request.request_id] = {
+            "submit": _time.monotonic()}
         self.queue.append(request)
 
     def step_round(self) -> None:
@@ -878,6 +900,13 @@ class ServingEngine:
                 jnp.asarray([samp.top_k], jnp.int32),
                 jnp.asarray([samp.top_p], jnp.float32),
                 jax.random.fold_in(key, 0)[None, :])[0])
+            # TTFT clock: the EARLIEST first-token time survives a
+            # recompute preemption (the user saw that token then)
+            import time as _time
+
+            clock = self._req_clock.get(req.request_id)
+            if clock is not None and "first" not in clock:
+                clock["first"] = _time.monotonic()
             self.slot_req[slot] = req
             self.slot_emitted[slot] = [first]
             self.lengths = self.lengths.at[slot].set(t_p)
@@ -907,13 +936,26 @@ class ServingEngine:
                 self._finish(slot)
 
     def _finish(self, slot: int) -> None:
+        import time as _time
+
         req = self.slot_req[slot]
         toks = self.slot_emitted[slot]
         reason = ("stop" if req.eos_id is not None and toks and
                   toks[-1] == req.eos_id else "length")
+        now = _time.monotonic()
+        clock = self._req_clock.pop(req.request_id, None)
+        ttft = e2e = None
+        if clock is not None and "submit" in clock:
+            ttft = round(clock.get("first", now) - clock["submit"], 6)
+            e2e = round(now - clock["submit"], 6)
+            self._lat_window.append((ttft, e2e))
+            self._lat_count += 1
+            self._lat_ttft_max = max(self._lat_ttft_max, ttft)
+            self._lat_e2e_max = max(self._lat_e2e_max, e2e)
         self.finished.append(Completion(
             request_id=req.request_id, prompt=list(req.prompt),
-            tokens=list(toks), finish_reason=reason))
+            tokens=list(toks), finish_reason=reason,
+            ttft_s=ttft, e2e_s=e2e))
         self.slot_req[slot] = None
         self.slot_emitted[slot] = []
         self.active = self.active.at[slot].set(False)
@@ -935,7 +977,25 @@ class ServingEngine:
         }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.report()
+        if self._lat_count:
+            ttfts = sorted(t for t, _ in self._lat_window)
+            e2es = sorted(e for _, e in self._lat_window)
+            out["latency"] = {
+                "completed": self._lat_count,
+                "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
+                "ttft_max_s": round(self._lat_ttft_max, 4),
+                "e2e_p50_s": round(e2es[len(e2es) // 2], 4),
+                "e2e_max_s": round(self._lat_e2e_max, 4),
+            }
         return out
+
+    def reset_latency(self) -> None:
+        """Discard latency aggregates (e.g. after warm-up requests
+        whose latency is compile time, not serving time)."""
+        self._lat_window.clear()
+        self._lat_count = 0
+        self._lat_ttft_max = 0.0
+        self._lat_e2e_max = 0.0
 
 
 def _jitted_paged_prefill(cfg: ModelConfig):

@@ -466,3 +466,22 @@ def test_engines_report_matrix_agrees():
     assert rep["ok"], rep
     assert rep["all_streams_identical"]
     assert rep["engines"] == ["grid", "paged", "paged_spec", "spec"]
+
+
+def test_request_latency_metrics(cfg, params):
+    """Completions carry host-side TTFT/e2e and report() aggregates
+    them (the vLLM metrics analog) — for every engine via the shared
+    base bookkeeping."""
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    for i in range(3):
+        eng.submit(serving.Request(
+            f"m{i}", make_prompt(70 + i, 5, cfg.vocab_size), 6))
+    done = eng.run()
+    assert len(done) == 3
+    for c in done:
+        assert c.ttft_s is not None and c.e2e_s is not None
+        assert 0 <= c.ttft_s <= c.e2e_s
+    lat = eng.report()["latency"]
+    assert lat["completed"] == 3
+    assert lat["ttft_p50_s"] <= lat["e2e_max_s"]
