@@ -755,6 +755,7 @@ def model_throughput(emit=None) -> dict | None:
                 eng_p._paged_chunk = c(eng_p._paged_chunk)
                 eng_p._paged_prefill = c(eng_p._paged_prefill)
                 eng_p._first = c(eng_p._first)
+                eng_p.reset_latency()  # exclude warm-up compile
                 rng = np.random.RandomState(0)
                 for i in range(2 * batch):
                     p_len = int(rng.choice(lens))
@@ -782,6 +783,9 @@ def model_throughput(emit=None) -> dict | None:
                 }
                 if dev > 0.2 * wall:
                     entry["device_tokens_per_s"] = round(gen_p / dev)
+                lat = eng_p.report().get("latency")
+                if lat:
+                    entry["latency"] = lat
                 result[key] = entry
                 SECTION_S[key] = round(
                     time.monotonic() - t_section, 1)
@@ -830,6 +834,7 @@ def model_throughput(emit=None) -> dict | None:
                 engs._prefill = counts(engs._prefill)
                 engs._first = counts(engs._first)
                 engs.verify_steps = 0  # exclude the warm request
+                engs.reset_latency()
                 for r in reqs:
                     engs.submit(r)
                 t0 = time.monotonic()
@@ -850,6 +855,9 @@ def model_throughput(emit=None) -> dict | None:
                 }
                 if devs > 0.2 * walls:
                     entry["device_tokens_per_s"] = round(gens / devs)
+                lat = engs.report().get("latency")
+                if lat:
+                    entry["latency"] = lat
                 result["serving_speculative"] = entry
                 SECTION_S["serving_speculative"] = round(
                     time.monotonic() - _specs_t0, 1)

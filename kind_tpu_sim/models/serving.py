@@ -116,9 +116,10 @@ class Completion:
     prompt: List[int]
     tokens: List[int]          # generated tokens (eos included if hit)
     finish_reason: str         # "stop" (eos) or "length"
-    # host-side request metrics (the vLLM observability analog):
+    # host-side request metrics (the vLLM observability analog),
+    # set by the engine on every completion:
     # ttft_s = submit -> first token (queue wait + prefill);
-    # e2e_s = submit -> completion. None when timing is disabled.
+    # e2e_s = submit -> completion.
     ttft_s: Optional[float] = None
     e2e_s: Optional[float] = None
 
@@ -760,6 +761,13 @@ class ServingEngine:
             import os
 
             request.seed = int.from_bytes(os.urandom(4), "little")
+        if request.request_id in self._req_clock:
+            # ids were a pure label before latency metrics keyed host
+            # state by them; enforce uniqueness loudly rather than
+            # silently corrupting another request's clock
+            raise ValueError(
+                f"request id {request.request_id!r} is already "
+                "queued or in flight")
         import time as _time
 
         self._req_clock[request.request_id] = {
