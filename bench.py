@@ -804,9 +804,10 @@ def model_throughput(emit=None) -> dict | None:
             _note()
 
             # Speculative decoding composed WITH continuous batching
-            # (SpeculativeServingEngine): one verify window per round
-            # for the whole grid; tokens per verify window is the
-            # batched analog of the solo speculative tokens/step.
+            # (SpeculativeServingEngine): spec_windows verify windows
+            # scanned per dispatch for the whole grid; tokens per
+            # verify window is the batched analog of the solo
+            # speculative tokens/step.
             try:
                 from kind_tpu_sim.models import serving
 

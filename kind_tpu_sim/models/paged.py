@@ -463,6 +463,36 @@ def paged_verify_step(params, pools, tables, out, total, active,
     return pools, out, total, emit, m
 
 
+def paged_verify_scan(params, pools, tables, out, total, active,
+                      sampling_state, *, cfg: ModelConfig, k: int,
+                      windows: int):
+    """``windows`` paged verify windows in ONE dispatch (lax.scan over
+    paged_verify_step) — the paged twin of speculative's
+    _grid_verify_scan, with the same contract: bitwise the
+    W-separate-dispatch path, scheduling granularity coarsened to
+    every W windows, mid-scan-finished slots' surplus discarded by
+    the host's budget/eos truncation. ``tables`` stay static across
+    the scan — the caller pre-grows every slot's block list to cover
+    windows*(k+1) positions (PagedSpeculativeServingEngine.
+    step_round), so in-scan writes never outrun the table; each
+    window re-gathers the view because the pools advanced.
+
+    Returns (pools, out, total, emits (W, b, k+1), ms (W, b)).
+    """
+    import jax
+
+    def body(carry, _):
+        pools, out, total = carry
+        pools, out, total, emit, m = paged_verify_step(
+            params, pools, tables, out, total, active,
+            sampling_state, cfg=cfg, k=k)
+        return (pools, out, total), (emit, m)
+
+    (pools, out, total), (emits, ms) = jax.lax.scan(
+        body, (pools, out, total), None, length=windows)
+    return pools, out, total, emits, ms
+
+
 # ---------------------------------------------------------------------
 # host-side block allocator
 

@@ -80,8 +80,14 @@ class ServingConfig:
     #                           replaces the (slots, max_len) grid
     block_size: int = 16      # KV positions per pool block
     speculative_k: int = 0    # >0: per-slot prompt-lookup drafts of
-    #                           this width, one verify window per
-    #                           round (SpeculativeServingEngine)
+    #                           this width, verified in windows of
+    #                           k+1 tokens (SpeculativeServingEngine)
+    spec_windows: int = 4     # speculative grid engine: verify
+    #                           windows scanned per dispatch
+    #                           (admission/retirement run between
+    #                           dispatches; >1 amortizes per-dispatch
+    #                           host+RTT costs exactly like `chunk`
+    #                           does for the dense grid)
     paged_kernel: bool = False  # paged tier only: Pallas paged-
     #                             attention (direct block reads, no
     #                             gather view); bf16 pools only
@@ -926,11 +932,17 @@ class ServingEngine:
                 self._finish(slot)
 
     def _retire(self, emitted) -> None:
+        import jax
         import numpy as np
 
+        # ONE batched fetch per round, not one per array or slot: on
+        # remote-tunnel platforms each transfer is its own ~50ms RTT
+        # (tools/spec_profile.py measured 8 per-slot active fetches
+        # at ~0.4s/round — half the serving engine's wall time).
+        emitted, active_h = jax.device_get((emitted, self.active))
         emitted = np.asarray(emitted)
         for slot, req in enumerate(self.slot_req):
-            if req is None or not bool(self.active[slot]):
+            if req is None or not bool(active_h[slot]):
                 continue
             have = self.slot_emitted[slot]
             budget = req.max_new - len(have)
@@ -1063,15 +1075,16 @@ _jitted_paged_chunk_kernel = _functools.lru_cache(maxsize=32)(
     _jitted_paged_chunk_kernel)
 
 
-def _jitted_paged_spec(cfg: ModelConfig, k: int):
+def _jitted_paged_spec(cfg: ModelConfig, k: int, windows: int):
     import functools
 
     import jax
 
-    from kind_tpu_sim.models.paged import paged_verify_step
+    from kind_tpu_sim.models.paged import paged_verify_scan
 
     return jax.jit(
-        functools.partial(paged_verify_step, cfg=cfg, k=k),
+        functools.partial(paged_verify_scan, cfg=cfg, k=k,
+                          windows=windows),
         donate_argnums=(1,))
 
 
@@ -1338,16 +1351,19 @@ class SpeculativeServingEngine(ServingEngine):
     """Continuous batching with speculative decoding per slot (the
     vLLM speculative+continuous-batching composition).
 
-    Each scheduling quantum runs ONE verify window over the whole
-    grid (models/speculative._grid_verify_step): every active slot
-    drafts ``speculative_k`` tokens by prompt-lookup from its own
-    emitted buffer, the window is verified in a single forward (one
-    weight read for up to k+1 tokens per slot), and each slot keeps
-    its longest model-agreeing prefix — between 1 and k+1 tokens per
-    slot per dispatch, ragged, exactly like the serving grid handles
-    ragged lengths everywhere else. Admission/retirement happen
-    between windows, so the engine composes continuous batching and
-    speculation instead of choosing.
+    Each scheduling quantum scans ``spec_windows`` verify windows
+    over the whole grid in one dispatch (models/speculative.
+    _grid_verify_scan): every active slot drafts ``speculative_k``
+    tokens by prompt-lookup from its own emitted buffer, each window
+    is verified in a single forward (one weight read for up to k+1
+    tokens per slot), and each slot keeps its longest model-agreeing
+    prefix — between 1 and k+1 tokens per slot per window, ragged,
+    exactly like the serving grid handles ragged lengths everywhere
+    else. Admission/retirement happen between dispatches, so the
+    engine composes continuous batching and speculation instead of
+    choosing; the window scan amortizes per-dispatch host/RTT costs
+    the way ``chunk`` does for the dense grid (docs/SERVING.md
+    "Dispatch economics").
 
     Greedy requests are argmax-verified, so their output is EXACTLY
     the dense grid's / solo decoder's greedy stream
@@ -1366,7 +1382,7 @@ class SpeculativeServingEngine(ServingEngine):
 
         import jax.numpy as jnp
 
-        from kind_tpu_sim.models.speculative import _jitted_grid_step
+        from kind_tpu_sim.models.speculative import _jitted_grid_scan
 
         cfg, serving = self.cfg, self.serving
         k = serving.speculative_k
@@ -1374,6 +1390,8 @@ class SpeculativeServingEngine(ServingEngine):
             raise ValueError(
                 "SpeculativeServingEngine needs "
                 "ServingConfig.speculative_k >= 1")
+        if serving.spec_windows < 1:
+            raise ValueError("spec_windows must be >= 1")
         if serving.paged_blocks or serving.paged_kernel:
             raise ValueError(
                 "SpeculativeServingEngine ignores paged_blocks/"
@@ -1384,9 +1402,11 @@ class SpeculativeServingEngine(ServingEngine):
                 "prefix caching is not supported with the "
                 "speculative engine yet")
         n = serving.max_slots
-        # + k + 1 rows: the final verify window writes k/v past the
-        # last budgeted position (stale rows, never attended)
-        self._rows = serving.max_len + k + 1
+        W = serving.spec_windows
+        # + W*(k+1) rows: each of the W scanned windows can advance a
+        # slot by k+1, and a slot that finishes mid-scan keeps
+        # writing until the scan ends (stale rows, never attended)
+        self._rows = serving.max_len + W * (k + 1)
         self.cache = init_cache(cfg, n, self._rows)
         self.out = jnp.zeros((n, self._rows), jnp.int32)
         self.total = jnp.zeros((n,), jnp.int32)
@@ -1395,8 +1415,8 @@ class SpeculativeServingEngine(ServingEngine):
                                           self.params)
         self._suffix = functools.partial(_jitted_suffix(cfg),
                                          self.params)
-        self._spec_step = functools.partial(_jitted_grid_step(cfg, k),
-                                            self.params)
+        self._spec_step = functools.partial(
+            _jitted_grid_scan(cfg, k, W), self.params)
         self.prefix_cache = None
 
     def _on_admitted(self, slot: int, request: Request,
@@ -1412,39 +1432,62 @@ class SpeculativeServingEngine(ServingEngine):
         self.total = self.total.at[slot].set(t_p + 1)
 
     def step_round(self) -> None:
-        """Admit, run one verify window for the grid, retire."""
+        """Admit, scan spec_windows verify windows for the grid in
+        one dispatch, retire."""
         self._admit()
         if not any(r is not None for r in self.slot_req):
             return
         sampling_state = (self.temp, self.top_k, self.top_p,
                           self.keys, self.prompt_len)
-        (self.cache, self.out, self.total, emit,
-         m) = self._spec_step(self.cache, self.out, self.total,
-                              self.active, sampling_state)
-        self._spec_retire(emit, m)
+        (self.cache, self.out, self.total, emits,
+         ms) = self._spec_step(self.cache, self.out, self.total,
+                               self.active, sampling_state)
+        self._spec_retire(emits, ms)
 
-    def _spec_retire(self, emit, m) -> None:
-        """Ragged per-slot retirement after one verify window: each
-        active slot takes its accepted-prefix+bonus tokens (budget-
-        and eos-truncated on host, like the chunk engine's retire)."""
-        import numpy as np
+    def _spec_retire(self, emits, ms) -> None:
+        """Ragged per-slot retirement after a scanned verify
+        dispatch: each active slot takes its accepted-prefix+bonus
+        tokens per window, budget- and eos-truncated on host like the
+        chunk engine's retire. ``emits``/``ms`` are stacked
+        (W, b, k+1)/(W, b); a slot that finished in window w has its
+        later windows' surplus tokens discarded here (they were junk
+        by construction)."""
+        import jax
 
-        self.verify_steps += 1
-        emit_h = np.asarray(emit)
-        m_h = np.asarray(m)
+        # One batched device_get for everything the host loop needs —
+        # separate np.asarray calls (and per-slot active indexing) are
+        # one tunnel RTT EACH (tools/spec_profile.py).
+        emit_h, m_h, active_h = jax.device_get(
+            (emits, ms, self.active))
+        W = emit_h.shape[0]
+        # verify_steps counts USEFUL windows (those that delivered at
+        # least one token to some slot), not the scan length: junk
+        # windows after every slot finished mid-scan would inflate
+        # the tokens-per-window stat and can exceed the generated
+        # token count on short-request workloads.
+        used = 1 if any(r is not None for r in self.slot_req) else 0
         for slot, req in enumerate(self.slot_req):
-            if req is None or not bool(self.active[slot]):
+            if req is None or not bool(active_h[slot]):
                 continue
             have = self.slot_emitted[slot]
-            budget = req.max_new - len(have)
-            new = emit_h[slot, :int(m_h[slot]) + 1][:budget].tolist()
-            if req.eos_id is not None and req.eos_id in new:
-                new = new[:new.index(req.eos_id) + 1]
-            have.extend(new)
+            for w in range(W):
+                budget = req.max_new - len(have)
+                if budget <= 0:
+                    break
+                new = emit_h[w, slot,
+                             :int(m_h[w, slot]) + 1][:budget].tolist()
+                if req.eos_id is not None and req.eos_id in new:
+                    new = new[:new.index(req.eos_id) + 1]
+                have.extend(new)
+                used = max(used, w + 1)
+                if (req.eos_id is not None and have and
+                        have[-1] == req.eos_id):
+                    break
             if (len(have) >= req.max_new or
                     (req.eos_id is not None and have and
                      have[-1] == req.eos_id)):
                 self._finish(slot)
+        self.verify_steps += used
 
     def report(self) -> Dict[str, Any]:
         out = super().report()
@@ -1487,17 +1530,21 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
             raise ValueError(
                 "paged_kernel applies to the chunked decode path; "
                 "the verify window uses the gather tier")
+        if serving.spec_windows < 1:
+            raise ValueError("spec_windows must be >= 1")
         super()._init_storage()
         n = serving.max_slots
+        W = serving.spec_windows
         cap = (serving.paged_blocks - 1) * serving.block_size
-        # out rows sized so the final window write (total + k + 1)
-        # and the emit dynamic_update_slice stay in bounds
-        self._rows = cap + k + 1
+        # out rows sized so every scanned window's write (up to
+        # total + W*(k+1)) and the emit dynamic_update_slice stay in
+        # bounds (junk region for slots that finish mid-scan)
+        self._rows = cap + W * (k + 1)
         self.out = jnp.zeros((n, self._rows), jnp.int32)
         self.total = jnp.zeros((n,), jnp.int32)
         self.verify_steps = 0
         self._spec_step = functools.partial(
-            _jitted_paged_spec(self.cfg, k), self.params)
+            _jitted_paged_spec(self.cfg, k, W), self.params)
 
     # the draft-buffer seeding and ragged retirement are the
     # speculative engine's, verbatim (no super() inside either, so
@@ -1519,20 +1566,23 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
         self._admit()
         if not any(r is not None for r in self.slot_req):
             return
-        # block coverage for this window's writes (base..base+k =
-        # total-1..total-1+k); overshoot past a retiring slot's
-        # budget is garbage-masked by the table width
-        self._ensure_blocks(self.serving.speculative_k, self.total)
+        # block coverage for the WHOLE scanned dispatch: W windows
+        # advance a slot by up to W*(k+1) positions and the tables
+        # are static across the scan, so every write must have a
+        # block up front; overshoot past a retiring slot's budget is
+        # garbage-masked by the table width
+        k, W = self.serving.speculative_k, self.serving.spec_windows
+        self._ensure_blocks(W * (k + 1), self.total)
         tables = self._build_tables()
         if not any(r is not None for r in self.slot_req):
             return  # preemption emptied the grid
         sampling_state = (self.temp, self.top_k, self.top_p,
                           self.keys, self.prompt_len)
-        (self.pools, self.out, self.total, emit,
-         m) = self._spec_step(self.pools, jnp.asarray(tables),
-                              self.out, self.total, self.active,
-                              sampling_state)
-        self._spec_retire(emit, m)
+        (self.pools, self.out, self.total, emits,
+         ms) = self._spec_step(self.pools, jnp.asarray(tables),
+                               self.out, self.total, self.active,
+                               sampling_state)
+        self._spec_retire(emits, ms)
 
 
 def engines_report(cfg: ModelConfig = None) -> Dict[str, Any]:

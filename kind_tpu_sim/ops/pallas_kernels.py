@@ -566,10 +566,19 @@ def paged_attention(qg, k_pool, v_pool, tables, lengths,
     scale = hd ** -0.5
     NEG = -1e30
 
+    # Grid is (slots, width) — ALL kv heads are processed per block.
+    # TPU block shapes must have their last two dims either tiling-
+    # divisible (8, 128) or equal to the full array dims; the earlier
+    # per-head k/v spec (1, bsz, 1, hd) had (1, hd) as its trailing
+    # dims and the 1 (a slice of the kv axis) is neither, which the
+    # TPU lowering rejects (BENCH_LOCAL_r03 serving_paged_kernel).
+    # With kv folded into the block, every spec's trailing dims are
+    # full array dims, same legality class as ops in flash_attention.
+
     def kernel(tab_ref, len_ref, q_ref, k_ref, v_ref,
                acc_out, m_out, l_out, acc_s, m_s, l_s):
         s = pl.program_id(0)
-        b = pl.program_id(2)
+        b = pl.program_id(1)
 
         @pl.when(b == 0)
         def _init():
@@ -577,61 +586,66 @@ def paged_attention(qg, k_pool, v_pool, tables, lengths,
             m_s[...] = jnp.full_like(m_s, NEG)
             l_s[...] = jnp.zeros_like(l_s)
 
-        q = q_ref[0, 0].astype(jnp.float32)          # (g, hd)
-        kb = k_ref[0, :, 0, :].astype(jnp.float32)   # (B, hd)
-        vb = v_ref[0, :, 0, :].astype(jnp.float32)   # (B, hd)
-        scores = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (g, B)
         pos = b * bsz + jax.lax.broadcasted_iota(
             jnp.int32, (g, bsz), 1)
         mask = pos < len_ref[s]
-        scores = jnp.where(mask, scores, NEG)
+        # Unrolled loop over the (static, small) kv-head axis: Mosaic
+        # only lowers rank-2 matmuls, so each head runs its own 2D
+        # dot pair; the head slices are static ref subviews.
+        for h in range(kv):
+            q = q_ref[0, h].astype(jnp.float32)          # (g, hd)
+            kb = k_ref[0, :, h, :].astype(jnp.float32)   # (B, hd)
+            vb = v_ref[0, :, h, :].astype(jnp.float32)   # (B, hd)
+            scores = jax.lax.dot_general(
+                q, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # (g, B)
+            scores = jnp.where(mask, scores, NEG)
 
-        m_prev = m_s[:, :1]                          # (g, 1)
-        m_new = jnp.maximum(m_prev,
-                            jnp.max(scores, axis=1, keepdims=True))
-        corr = jnp.exp(m_prev - m_new)               # (g, 1)
-        # mask multiplies (not just the NEG bias): with every
-        # position masked, m_new == NEG and exp(NEG - NEG) == 1
-        # would fabricate weight out of nothing
-        p = jnp.exp(scores - m_new) * mask           # (g, B)
-        l_new = l_s[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_s[...] = acc_s[...] * corr + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_s[...] = jnp.broadcast_to(m_new, m_s.shape)
-        l_s[...] = jnp.broadcast_to(l_new, l_s.shape)
+            m_prev = m_s[h, :, :1]                       # (g, 1)
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=1, keepdims=True))
+            corr = jnp.exp(m_prev - m_new)               # (g, 1)
+            # mask multiplies (not just the NEG bias): with every
+            # position masked, m_new == NEG and exp(NEG - NEG) == 1
+            # would fabricate weight out of nothing
+            p = jnp.exp(scores - m_new) * mask           # (g, B)
+            l_new = (l_s[h, :, :1] * corr
+                     + jnp.sum(p, axis=1, keepdims=True))
+            acc_s[h] = acc_s[h] * corr + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_s[h] = jnp.broadcast_to(m_new, (g, 128))
+            l_s[h] = jnp.broadcast_to(l_new, (g, 128))
 
         @pl.when(b == width - 1)
         def _finalize():
-            acc_out[0, 0] = acc_s[...]
-            m_out[0, 0] = m_s[...]                   # lanes replicated
-            l_out[0, 0] = l_s[...]
+            acc_out[0] = acc_s[...]
+            m_out[0] = m_s[...]                      # lanes replicated
+            l_out[0] = l_s[...]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # tables, lengths
-        grid=(slots, kv, width),
+        grid=(slots, width),
         in_specs=[
-            pl.BlockSpec((1, 1, g, hd),
-                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
-            pl.BlockSpec((1, bsz, 1, hd),
-                         lambda s, h, b, tab, ln: (tab[s, b], 0, h, 0)),
-            pl.BlockSpec((1, bsz, 1, hd),
-                         lambda s, h, b, tab, ln: (tab[s, b], 0, h, 0)),
+            pl.BlockSpec((1, kv, g, hd),
+                         lambda s, b, tab, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, bsz, kv, hd),
+                         lambda s, b, tab, ln: (tab[s, b], 0, 0, 0)),
+            pl.BlockSpec((1, bsz, kv, hd),
+                         lambda s, b, tab, ln: (tab[s, b], 0, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, g, hd),
-                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
-            pl.BlockSpec((1, 1, g, 128),
-                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
-            pl.BlockSpec((1, 1, g, 128),
-                         lambda s, h, b, tab, ln: (s, h, 0, 0)),
+            pl.BlockSpec((1, kv, g, hd),
+                         lambda s, b, tab, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, kv, g, 128),
+                         lambda s, b, tab, ln: (s, 0, 0, 0)),
+            pl.BlockSpec((1, kv, g, 128),
+                         lambda s, b, tab, ln: (s, 0, 0, 0)),
         ],
         scratch_shapes=[
-            pltpu.VMEM((g, hd), jnp.float32),    # accumulator
-            pltpu.VMEM((g, 128), jnp.float32),   # running max
-            pltpu.VMEM((g, 128), jnp.float32),   # denominator
+            pltpu.VMEM((kv, g, hd), jnp.float32),    # accumulator
+            pltpu.VMEM((kv, g, 128), jnp.float32),   # running max
+            pltpu.VMEM((kv, g, 128), jnp.float32),   # denominator
         ],
     )
     acc, m, l = pl.pallas_call(

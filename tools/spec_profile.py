@@ -1,0 +1,148 @@
+"""Per-component timing of one SpeculativeServingEngine round on the
+local accelerator — the diagnosis tool for BENCH_LOCAL_r03's
+serving_speculative anomaly (22 wall tok/s vs 467 for the chunked
+grid; ~0.47s per verify dispatch after null_dt correction).
+
+Suspects, each timed separately over N rounds:
+  dispatch       — the jitted _spec_step call (async return)
+  sync           — first device fetch after it (np.asarray(emit)):
+                   absorbs the actual device execution + transfer
+  fetch_m        — second fetch (np.asarray(m))
+  active_bools   — per-slot bool(self.active[slot]) (8 tiny fetches,
+                   the retire loop's pattern)
+  retire_rest    — the pure-host remainder of _spec_retire
+  round_total    — one full step_round() as the engine runs it
+
+Prints one JSON object (ms per round, averaged); --out writes it.
+
+Usage:  python tools/spec_profile.py [--rounds 20] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=20)
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny config (CPU smoke)")
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from kind_tpu_sim.models import decode, serving
+    from kind_tpu_sim.models import transformer as tf
+
+    if args.quick:
+        cfg = tf.ModelConfig(vocab_size=256, d_model=64, n_heads=4,
+                             n_layers=2, d_ff=128, max_seq=64,
+                             n_kv_heads=2)
+        max_len, p_len, max_new = 64, 12, 8
+    else:
+        cfg = tf.bench_config()
+        max_len, p_len, max_new = 1024, 256, 64
+
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    sp = decode.serving_params(params, cfg)
+    sc = serving.ServingConfig(max_slots=8, max_len=max_len,
+                               speculative_k=4)
+    eng = serving.SpeculativeServingEngine(sp, cfg, sc)
+
+    rng = np.random.RandomState(0)
+
+    def mk(i, n_new=max_new):
+        toks = [int(x) for x in rng.randint(1, cfg.vocab_size, p_len)]
+        return serving.Request(f"r{i}", toks, n_new)
+
+    t0 = time.monotonic()
+    eng.submit(mk("warm", 2))
+    eng.run()
+    warm_s = time.monotonic() - t0
+
+    # Fill all 8 slots, then time the parts of a round by hand.
+    for i in range(8):
+        eng.submit(mk(i))
+    t0 = time.monotonic()
+    eng._admit()
+    admit8_s = time.monotonic() - t0
+
+    n = args.rounds
+    T: dict = collections.defaultdict(float)
+    for _ in range(n):
+        sampling_state = (eng.temp, eng.top_k, eng.top_p, eng.keys,
+                          eng.prompt_len)
+        t0 = time.monotonic()
+        (eng.cache, eng.out, eng.total, emit,
+         m) = eng._spec_step(eng.cache, eng.out, eng.total,
+                             eng.active, sampling_state)
+        T["dispatch"] += time.monotonic() - t0
+        t0 = time.monotonic()
+        emit_h = np.asarray(emit)
+        T["sync"] += time.monotonic() - t0
+        t0 = time.monotonic()
+        m_h = np.asarray(m)
+        T["fetch_m"] += time.monotonic() - t0
+        t0 = time.monotonic()
+        acts = [bool(eng.active[s]) for s in range(8)]
+        T["active_bools"] += time.monotonic() - t0
+        if emit_h.ndim == 2:  # single-window engines
+            emit_h, m_h = emit_h[None], m_h[None]
+        t0 = time.monotonic()
+        for slot, req in enumerate(eng.slot_req):
+            if req is None or not acts[slot]:
+                continue
+            have = eng.slot_emitted[slot]
+            for w in range(emit_h.shape[0]):
+                budget = req.max_new - len(have)
+                if budget <= 0:
+                    break
+                new = emit_h[w, slot,
+                             :int(m_h[w, slot]) + 1][:budget].tolist()
+                have.extend(new)
+        T["retire_rest"] += time.monotonic() - t0
+        eng.verify_steps += emit_h.shape[0]
+
+    # Fresh engine for the black-box round_total (the loop above
+    # consumed budgets without _finish-ing, so this engine's state is
+    # no longer representative).
+    eng2 = serving.SpeculativeServingEngine(sp, cfg, sc)
+    eng2.submit(mk("warm2", 2))
+    eng2.run()
+    for i in range(8):
+        eng2.submit(mk(f"b{i}"))
+    eng2._admit()
+    t0 = time.monotonic()
+    for _ in range(n):
+        eng2.step_round()
+    round_total = time.monotonic() - t0
+
+    report = {
+        "backend": jax.default_backend(),
+        "rounds": n,
+        "warm_s": round(warm_s, 1),
+        "admit8_s": round(admit8_s, 2),
+        "ms_per_round": {k: round(v / n * 1e3, 1)
+                         for k, v in sorted(T.items())},
+        "round_total_ms": round(round_total / n * 1e3, 1),
+    }
+    line = json.dumps(report)
+    print(line)
+    if args.out:
+        pathlib.Path(args.out).write_text(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
