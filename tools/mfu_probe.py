@@ -49,25 +49,29 @@ def measure_train(cfg, batch: int, steps: int) -> dict:
     seq = cfg.max_seq + 1 if cfg.flash else cfg.max_seq
     tokens = tf.sample_batch(jax.random.PRNGKey(1), cfg, batch, seq)
 
+    # The timed region ends on a SCALAR host readback (float(...)),
+    # not block_until_ready: on remote-tunnel platforms (axon)
+    # block_until_ready has been observed returning before the device
+    # work finished, yielding impossible rates (45M tok/s dense);
+    # bench.py's float() readback pattern measures correctly there.
     @jax.jit
     def run(state, tokens):
         def body(st, i):
             shifted = (tokens + i) % cfg.vocab_size
             return step_fn(st, shifted)
 
-        return jax.lax.scan(body, state, jnp.arange(steps))
+        _, losses = jax.lax.scan(body, state, jnp.arange(steps))
+        return losses.sum()
 
     t0 = time.monotonic()
-    out_state, losses = run(state, tokens)
-    jax.block_until_ready(losses)
+    total = float(run(state, tokens))
     compile_s = time.monotonic() - t0
     t0 = time.monotonic()
-    out_state, losses = run(state, tokens)
-    jax.block_until_ready(losses)
+    total = float(run(state, tokens))
     dt = (time.monotonic() - t0) / steps
-    assert float(losses[-1]) == float(losses[-1])  # NaN guard
+    assert total == total  # NaN guard
     tokens_per_s = batch * (seq - 1) / dt
-    del out_state, state
+    del state
     return {
         "tokens_per_s": round(tokens_per_s),
         "step_ms": round(dt * 1e3, 2),
@@ -96,8 +100,10 @@ def attribute(top_ops) -> dict:
     total = 0.0
     for op in top_ops:
         name = op["name"].lower()
-        if name.startswith("mfu-"):
-            continue  # the region annotation spans everything
+        if name.startswith(("mfu-", "jit_")):
+            # region annotations / the outer jitted-program span
+            # cover everything; counting them drowns the real ops
+            continue
         us = op["total_us"]
         total += us
         for fam, pats in OP_FAMILIES:
@@ -145,6 +151,8 @@ def main() -> int:
         spec = (F.chip_spec(jax.devices()[0].device_kind)
                 if backend == "tpu" else None)
 
+    import gc
+
     results = []
     for flash, batch in matrix:
         cfg = dataclasses.replace(base, flash=flash)
@@ -155,6 +163,12 @@ def main() -> int:
             results.append({"config": label,
                             "error": str(exc)[:200]})
             continue
+        finally:
+            # Each variant's executable + its donated/live buffers
+            # must be gone before the next one sizes its own working
+            # set — batch 32 OOMed with batches 8/16's state resident.
+            gc.collect()
+            jax.clear_caches()
         entry = {"config": label, "flash": flash, "batch": batch,
                  **m}
         if spec is not None:
@@ -181,6 +195,8 @@ def main() -> int:
         # per-op attribution for best and worst: what the win IS
         for tag, variant in (("best", best), ("worst", worst)):
             cfg = dataclasses.replace(base, flash=variant["flash"])
+            gc.collect()
+            jax.clear_caches()
             try:
                 import jax.numpy as jnp
 
