@@ -808,22 +808,26 @@ def model_throughput(emit=None) -> dict | None:
             # scanned per dispatch for the whole grid; tokens per
             # verify window is the batched analog of the solo
             # speculative tokens/step.
-            try:
-                from kind_tpu_sim.models import serving
+            from kind_tpu_sim.models import serving
 
+            def run_spec(key: str, engine_cls, **cfg_extra):
+                """One speculative-engine measurement over the
+                canonical request stream (same RandomState(0) draw as
+                the paged/grid entries)."""
                 _specs_t0 = time.monotonic()
-                sp = decode.serving_params(params, cfg)
+                sp_l = decode.serving_params(params, cfg)
                 scs = serving.ServingConfig(
-                    max_slots=batch, max_len=1024, speculative_k=4)
-                engs = serving.SpeculativeServingEngine(sp, cfg, scs)
+                    max_slots=batch, max_len=1024, speculative_k=4,
+                    **cfg_extra)
+                engs = engine_cls(sp_l, cfg, scs)
                 rng = np.random.RandomState(0)
-                lens = [192, 224, 256]
+                lens_s = [192, 224, 256]
                 reqs = []
                 for i in range(2 * batch):
-                    p_len = int(rng.choice(lens))
+                    p_len = int(rng.choice(lens_s))
                     max_new = int(rng.choice([64, 128, 192]))
                     reqs.append(serving.Request(
-                        f"sv{i}",
+                        f"{key}{i}",
                         np.asarray(tokens[0, :p_len]).tolist(),
                         max_new))
                 engs.submit(serving.Request(
@@ -832,7 +836,12 @@ def model_throughput(emit=None) -> dict | None:
                 disp = {"n": 0}
                 counts = make_counter(disp)
                 engs._spec_step = counts(engs._spec_step)
-                engs._prefill = counts(engs._prefill)
+                # grid engine dispatches _prefill; the paged
+                # composition dispatches _paged_prefill instead
+                for attr in ("_prefill", "_paged_prefill"):
+                    if hasattr(engs, attr):
+                        setattr(engs, attr,
+                                counts(getattr(engs, attr)))
                 engs._first = counts(engs._first)
                 engs.verify_steps = 0  # exclude the warm request
                 engs.reset_latency()
@@ -848,6 +857,7 @@ def model_throughput(emit=None) -> dict | None:
                     "requests": len(dones),
                     "generated_tokens": gens,
                     "draft_k": 4,
+                    "spec_windows": scs.spec_windows,
                     "verify_steps": engs.verify_steps,
                     "tokens_per_window": round(
                         gens / max(engs.verify_steps, 1), 2),
@@ -859,11 +869,26 @@ def model_throughput(emit=None) -> dict | None:
                 lat = engs.report().get("latency")
                 if lat:
                     entry["latency"] = lat
-                result["serving_speculative"] = entry
-                SECTION_S["serving_speculative"] = round(
+                result[key] = entry
+                SECTION_S[key] = round(
                     time.monotonic() - _specs_t0, 1)
+
+            try:
+                run_spec("serving_speculative",
+                         serving.SpeculativeServingEngine)
             except Exception as exc:  # pragma: no cover
                 result["serving_speculative_error"] = str(exc)[:100]
+            _note()
+            # The FULL vLLM composition: continuous batching + paged
+            # KV + speculative windows in one engine; the delta vs
+            # serving_speculative is paging's gather/scatter cost
+            # under a verify-window workload.
+            try:
+                run_spec("serving_paged_spec",
+                         serving.PagedSpeculativeServingEngine,
+                         paged_blocks=pool_blocks, block_size=block)
+            except Exception as exc:  # pragma: no cover
+                result["serving_paged_spec_error"] = str(exc)[:100]
             _note()
 
         # Speculative decoding (prompt-lookup drafts + exact greedy

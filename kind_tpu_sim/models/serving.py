@@ -1375,14 +1375,31 @@ class SpeculativeServingEngine(ServingEngine):
     stream differs from the dense engine's per-seed draw (different
     mechanism) but is still a pure, replayable function of
     (request, seed), and greedy/sampled requests mix in one grid.
+
+    ``draft=(draft_params, draft_cfg)`` switches the proposer from
+    prompt-lookup to a DRAFT MODEL (the vLLM draft-model mode): the
+    small model runs k greedy steps per window over its own per-slot
+    cache grid, the target verifies as usual. Same exactness
+    contracts — the argmax draft is deterministic given state, so
+    both the greedy and the rejection-sampling acceptance paths
+    apply unchanged.
     """
+
+    def __init__(self, params: Params, cfg: ModelConfig,
+                 serving: ServingConfig = ServingConfig(),
+                 draft=None):
+        self._draft = draft
+        super().__init__(params, cfg, serving)
 
     def _init_storage(self) -> None:
         import functools
 
         import jax.numpy as jnp
 
-        from kind_tpu_sim.models.speculative import _jitted_grid_scan
+        from kind_tpu_sim.models.speculative import (
+            _jitted_grid_draft_scan,
+            _jitted_grid_scan,
+        )
 
         cfg, serving = self.cfg, self.serving
         k = serving.speculative_k
@@ -1415,9 +1432,38 @@ class SpeculativeServingEngine(ServingEngine):
                                           self.params)
         self._suffix = functools.partial(_jitted_suffix(cfg),
                                          self.params)
-        self._spec_step = functools.partial(
-            _jitted_grid_scan(cfg, k, W), self.params)
+        if self._draft is None:
+            self._spec_step = functools.partial(
+                _jitted_grid_scan(cfg, k, W), self.params)
+        else:
+            dparams, dcfg = self._draft
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target "
+                    f"vocab {cfg.vocab_size}")
+            self.draft_cache = init_cache(dcfg, n, self._rows)
+            self._draft_prefill = functools.partial(
+                _jitted_prefill(dcfg), dparams)
+            self._spec_step = functools.partial(
+                _jitted_grid_draft_scan(cfg, dcfg, k, W),
+                self.params, dparams)
         self.prefix_cache = None
+
+    def _prefill_slot(self, slot: int, req: Request):
+        logits = super()._prefill_slot(slot, req)
+        if self._draft is not None:
+            # the draft model's own prompt k/v, same padded bucket
+            import jax.numpy as jnp
+            import numpy as np
+
+            t_p = len(req.prompt)
+            pad = _bucket(t_p)
+            tokens = np.zeros((1, pad), np.int32)
+            tokens[0, :t_p] = req.prompt
+            self.draft_cache, _ = self._draft_prefill(
+                self.draft_cache, jnp.asarray(tokens),
+                jnp.int32(t_p), slot)
+        return logits
 
     def _on_admitted(self, slot: int, request: Request,
                      first: int) -> None:
@@ -1439,9 +1485,15 @@ class SpeculativeServingEngine(ServingEngine):
             return
         sampling_state = (self.temp, self.top_k, self.top_p,
                           self.keys, self.prompt_len)
-        (self.cache, self.out, self.total, emits,
-         ms) = self._spec_step(self.cache, self.out, self.total,
-                               self.active, sampling_state)
+        if self._draft is None:
+            (self.cache, self.out, self.total, emits,
+             ms) = self._spec_step(self.cache, self.out, self.total,
+                                   self.active, sampling_state)
+        else:
+            (self.cache, self.draft_cache, self.out, self.total,
+             emits, ms) = self._spec_step(
+                self.cache, self.draft_cache, self.out, self.total,
+                self.active, sampling_state)
         self._spec_retire(emits, ms)
 
     def _spec_retire(self, emits, ms) -> None:
@@ -1494,6 +1546,8 @@ class SpeculativeServingEngine(ServingEngine):
         out["speculative"] = {
             "draft_k": self.serving.speculative_k,
             "verify_steps": self.verify_steps,
+            "proposer": ("draft-model" if self._draft is not None
+                         else "prompt-lookup"),
         }
         return out
 

@@ -232,7 +232,7 @@ def _verify_step(params, cache, out, total, *, cfg: ModelConfig,
 
     from kind_tpu_sim.models.quant import embed_lookup
 
-    b, L = out.shape
+    b, _ = out.shape
     dtype = jnp.dtype(cfg.dtype)
     draft = propose_ngram(out, total, k)                       # (b, k)
     last = jnp.take_along_axis(out, (total - 1)[:, None], 1)   # (b, 1)
@@ -249,29 +249,11 @@ def _verify_step(params, cache, out, total, *, cfg: ModelConfig,
         })
     x = _rms_norm(x, params["final_norm"])
     logits = _readout(x, params["embed"], cfg.int8_native)
-    preds = jnp.argmax(logits, axis=-1).astype(out.dtype)  # (b, k+1)
-
-    # accept draft[i] while it equals the model's own next-token
-    # argmax at that point; m = accepted count in [0, k]
-    agree = (draft == preds[:, :-1])
-    m = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1), axis=1)
-    bonus = jnp.take_along_axis(preds, m[:, None], 1)[:, 0]   # (b,)
-
-    # emit window: the m accepted drafts, then the bonus token, then
-    # filler (beyond each row's `total`, masked by every later read
-    # and overwritten by the next step's window write)
-    emit_idx = jnp.arange(k + 1)[None, :]
-    emit = jnp.where(
-        emit_idx < m[:, None], _pad_draft(draft, k),
-        jnp.where(emit_idx == m[:, None], bonus[:, None], 0),
-    )
-
-    def put_row(row, u, s):
-        return jax.lax.dynamic_update_slice(row, u, (s,))
-
-    out = jax.vmap(put_row)(out, emit.astype(out.dtype),
-                            jnp.clip(total, 0, L - (k + 1)))
-    total = total + m + 1
+    # shared greedy acceptance/emission (all rows active, no
+    # sampling state) — ONE copy of the accept math for every
+    # speculative path
+    out, total, _, m = _accept_and_emit(
+        logits, draft, out, total, jnp.ones((b,), bool), None, k=k)
     return new_cache, out, total, m
 
 
@@ -325,7 +307,7 @@ def _rejection_select(probs, draft, u, pos_keys):
 
 def _grid_verify_step(params, cache, out, total, active,
                       sampling_state=None, *, cfg: ModelConfig,
-                      k: int):
+                      k: int, draft=None):
     """One speculative step over the serving grid: like _verify_step,
     but with an ``active`` mask (lockstep SPMD — inactive slots
     compute too, their state is frozen and their cache writes land in
@@ -337,7 +319,7 @@ def _grid_verify_step(params, cache, out, total, active,
     tokens this step are emit[b, :m[b]+1] (accepted drafts + bonus).
     """
     draft, base, logits, rows = _window_forward(
-        params, cache, out, total, cfg=cfg, k=k)
+        params, cache, out, total, cfg=cfg, k=k, draft=draft)
     new_cache = [
         {
             "k": _write_window(layer_cache["k"], r["k"], base),
@@ -351,21 +333,24 @@ def _grid_verify_step(params, cache, out, total, active,
 
 
 def _window_forward(params, cache_like, out, total, *,
-                    cfg: ModelConfig, k: int):
+                    cfg: ModelConfig, k: int, draft=None):
     """Shared front half of every speculative verify step: propose
-    the draft, build the (last, draft) window, run it through the
-    blocks against any big-cache representation (grid rows or a
-    paged gather view), and read out logits. Returns
-    (draft, base, logits, rows) with rows[layer] = {"k","v"} window
-    k/v — PERSISTENCE is the caller's (grid: per-row window write;
-    paged: block scatter), which is the only storage-specific part.
+    the draft (prompt-lookup by default; ``draft`` (b, k) overrides
+    with an externally proposed window, e.g. a draft model's), build
+    the (last, draft) window, run it through the blocks against any
+    big-cache representation (grid rows or a paged gather view), and
+    read out logits. Returns (draft, base, logits, rows) with
+    rows[layer] = {"k","v"} window k/v — PERSISTENCE is the caller's
+    (grid: per-row window write; paged: block scatter), which is the
+    only storage-specific part.
     """
     import jax.numpy as jnp
 
     from kind_tpu_sim.models.quant import embed_lookup
 
     dtype = jnp.dtype(cfg.dtype)
-    draft = propose_ngram(out, total, k)
+    if draft is None:
+        draft = propose_ngram(out, total, k)
     last = jnp.take_along_axis(out, (total - 1)[:, None], 1)
     window = jnp.concatenate([last, draft], axis=1)
     base = total - 1
@@ -506,6 +491,53 @@ def _jitted_grid_scan(cfg: ModelConfig, k: int, windows: int):
 _jitted_grid_scan = functools.lru_cache(maxsize=16)(_jitted_grid_scan)
 
 
+def _grid_draft_verify_scan(params, draft_params, cache, draft_cache,
+                            out, total, active, sampling_state=None,
+                            *, cfg: ModelConfig, dcfg: ModelConfig,
+                            k: int, windows: int):
+    """_grid_verify_scan with the n-gram proposer swapped for a DRAFT
+    MODEL (the vLLM draft-model + continuous-batching composition):
+    each scanned window first runs k greedy steps of the small model
+    over its own per-slot cache grid (_draft_propose — same per-row
+    base vector, same stale-row discipline), then the target verifies
+    the proposed window exactly as in the n-gram path. Acceptance is
+    unchanged (greedy argmax / deterministic-proposal rejection
+    sampling — the argmax draft IS deterministic given state), so the
+    exactness contracts carry over verbatim.
+
+    Returns (cache, draft_cache, out, total, emits (W, b, k+1),
+    ms (W, b)).
+    """
+    import jax
+
+    def body(carry, _):
+        cache, draft_cache, out, total = carry
+        draft, draft_cache = _draft_propose(
+            draft_params, draft_cache, out, total, dcfg=dcfg, k=k)
+        cache, out, total, emit, m = _grid_verify_step(
+            params, cache, out, total, active, sampling_state,
+            cfg=cfg, k=k, draft=draft)
+        return (cache, draft_cache, out, total), (emit, m)
+
+    (cache, draft_cache, out, total), (emits, ms) = jax.lax.scan(
+        body, (cache, draft_cache, out, total), None, length=windows)
+    return cache, draft_cache, out, total, emits, ms
+
+
+def _jitted_grid_draft_scan(cfg: ModelConfig, dcfg: ModelConfig,
+                            k: int, windows: int):
+    import jax
+
+    return jax.jit(
+        functools.partial(_grid_draft_verify_scan, cfg=cfg,
+                          dcfg=dcfg, k=k, windows=windows),
+        donate_argnums=(2, 3))
+
+
+_jitted_grid_draft_scan = functools.lru_cache(maxsize=16)(
+    _jitted_grid_draft_scan)
+
+
 def speculative_generate(params: Params, cfg: ModelConfig, prompt,
                          num_new: int, draft_k: int = 4,
                          return_stats: bool = False):
@@ -539,6 +571,158 @@ def speculative_generate(params: Params, cfg: ModelConfig, prompt,
     steps = 0
     for _ in range(num_new - 1):
         cache, out, total, _ = step(params, cache, out, total)
+        steps += 1
+        if int(np.min(np.asarray(total))) >= t_p + num_new:
+            break
+    result = out[:, :t_p + num_new]
+    if return_stats:
+        return result, {"steps": steps}
+    return result
+
+
+def _draft_propose(draft_params, draft_cache, out, total, *,
+                   dcfg: ModelConfig, k: int):
+    """Autoregressive k-token proposal from a DRAFT MODEL (the vLLM
+    draft-model speculation mode, next to prompt-lookup): k greedy
+    single-token steps of the small model over its own KV cache,
+    inside one trace (lax.scan). Step i consumes token t_i (t_0 is
+    the row's last emitted token) at per-row position base+i, writes
+    its k/v, and proposes t_{i+1}.
+
+    The scan runs k+1 steps: steps 0..k-1 produce the k proposals;
+    step k consumes the FINAL proposal d_k purely for its k/v write
+    (its own proposal is discarded). Without it, a fully accepted
+    window (m == k) leaves row base+k — accepted token d_k's
+    position — permanently zero in the draft cache (the next round
+    starts writing at base+k+1), and every later draft step would
+    attend a spurious zero row.
+
+    Cache invariant after the scan (same stale-row discipline as the
+    target's window write): rows base..base+k hold k/v of (last,
+    d_1..d_k); for acceptance count m the rows past base+m are stale
+    and are overwritten by the next round's scan, whose base' =
+    base + m + 1 starts at the first stale row. The bonus token's
+    k/v is never in the draft cache — the next round's first step
+    consumes it and writes it then.
+
+    Returns (draft (b, k) int32, new draft_cache).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kind_tpu_sim.models.quant import embed_lookup
+
+    dtype = jnp.dtype(dcfg.dtype)
+    base0 = total - 1
+    last = jnp.take_along_axis(out, base0[:, None], 1)[:, 0]
+
+    def step(carry, i):
+        cache, tok = carry
+        x = embed_lookup(draft_params["embed"], tok[:, None], dtype)
+        new_cache = []
+        for bparams, lc in zip(draft_params["blocks"], cache):
+            x, kk, vv = _window_block(x, bparams, dcfg, lc,
+                                      base0 + i)
+            new_cache.append({
+                "k": _write_window(lc["k"], kk, base0 + i),
+                "v": _write_window(lc["v"], vv, base0 + i),
+            })
+        h = _rms_norm(x[:, 0, :], draft_params["final_norm"])
+        logits = _readout(h, draft_params["embed"],
+                          dcfg.int8_native)
+        nxt = jnp.argmax(logits, axis=-1).astype(tok.dtype)
+        return (new_cache, nxt), nxt
+
+    (draft_cache, _), drafts = jax.lax.scan(
+        step, (draft_cache, last), jnp.arange(k + 1))
+    return drafts[:k].T, draft_cache
+
+
+def _draft_verify_step(params, draft_params, cache, draft_cache,
+                       out, total, *, cfg: ModelConfig,
+                       dcfg: ModelConfig, k: int):
+    """One draft-model speculative step: small model proposes k
+    tokens (k cheap serial steps — its weight bytes, not the
+    target's), target verifies the whole window in ONE forward (one
+    big-weight read for up to k+1 tokens), longest model-agreeing
+    prefix kept. Exactly _verify_step with the n-gram proposer
+    swapped for the draft model; emission math is shared."""
+    import jax.numpy as jnp
+
+    draft, draft_cache = _draft_propose(
+        draft_params, draft_cache, out, total, dcfg=dcfg, k=k)
+    _, base, logits, rows = _window_forward(
+        params, cache, out, total, cfg=cfg, k=k, draft=draft)
+    new_cache = [
+        {
+            "k": _write_window(lc["k"], r["k"], base),
+            "v": _write_window(lc["v"], r["v"], base),
+        }
+        for lc, r in zip(cache, rows)
+    ]
+    b, _ = out.shape
+    out, total, _, m = _accept_and_emit(
+        logits, draft, out, total, jnp.ones((b,), bool), None, k=k)
+    return new_cache, draft_cache, out, total, m
+
+
+def _jitted_draft_step(cfg: ModelConfig, dcfg: ModelConfig, k: int):
+    import jax
+
+    return jax.jit(
+        functools.partial(_draft_verify_step, cfg=cfg, dcfg=dcfg,
+                          k=k),
+        donate_argnums=(2, 3))
+
+
+_jitted_draft_step = functools.lru_cache(maxsize=16)(
+    _jitted_draft_step)
+
+
+def draft_model_generate(params: Params, cfg: ModelConfig,
+                         draft_params: Params, dcfg: ModelConfig,
+                         prompt, num_new: int, draft_k: int = 4,
+                         return_stats: bool = False):
+    """Draft-MODEL speculative decoding (the vLLM draft-model mode):
+    prompt (b, t_p) int32 -> (b, t_p + num_new), greedy-exact vs the
+    TARGET's own greedy stream no matter how bad the draft model is
+    (acceptance checks the target's argmax; a wrong draft costs only
+    wasted window positions). ``dcfg`` must share the target's
+    vocab; everything else (depth, width, dtype) is free — the draft
+    run costs k reads of the SMALL model's weights per window vs one
+    of the target's.
+
+    Both models' prompt prefills batch over the full prompt; both
+    caches are donated through the host loop.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    if dcfg.vocab_size != cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab {dcfg.vocab_size} != target vocab "
+            f"{cfg.vocab_size}")
+    b, t_p = prompt.shape
+    if num_new <= 0:
+        return (prompt, {"steps": 0}) if return_stats else prompt
+    L = t_p + num_new + draft_k + 1
+    logits, cache = _jitted_prefill(cfg, L)(params, prompt)
+    # the draft's prefill writes its OWN cache for positions
+    # < t_p; its first proposal step then consumes the first
+    # emitted token at base = t_p
+    _, draft_cache = _jitted_prefill(dcfg, L)(draft_params, prompt)
+    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+    out = jnp.zeros((b, L), prompt.dtype)
+    out = out.at[:, :t_p].set(prompt)
+    out = out.at[:, t_p].set(first)
+    total = jnp.full((b,), t_p + 1, jnp.int32)
+
+    step = _jitted_draft_step(cfg, dcfg, draft_k)
+    steps = 0
+    for _ in range(num_new - 1):
+        cache, draft_cache, out, total, _ = step(
+            params, draft_params, cache, draft_cache, out, total)
         steps += 1
         if int(np.min(np.asarray(total))) >= t_p + num_new:
             break

@@ -107,3 +107,89 @@ def test_int8_native_speculative_runs(cfg, params):
 def test_report(cfg):
     rep = speculative.speculative_report(cfg)
     assert rep["ok"] and rep["greedy_exact"]
+
+
+@pytest.fixture(scope="module")
+def draft_cfg(cfg):
+    # Smaller in every dimension EXCEPT vocab (must match)
+    return tf.ModelConfig(vocab_size=cfg.vocab_size, d_model=16,
+                          n_heads=2, n_layers=1, d_ff=32, max_seq=128)
+
+
+def test_draft_model_greedy_exact(cfg, params, draft_cfg):
+    """A randomly initialized (useless) draft model still yields the
+    target's exact greedy stream — acceptance is checked against the
+    target's own argmax, the draft only modulates speed."""
+    import jax
+
+    draft_params = tf.init_params(jax.random.PRNGKey(9), draft_cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(5), cfg, 3, 17)
+    spec = np.asarray(speculative.draft_model_generate(
+        params, cfg, draft_params, draft_cfg, prompt, 24, draft_k=3))
+    ref = np.asarray(decode.greedy_generate(params, cfg, prompt, 24))
+    np.testing.assert_array_equal(spec, ref)
+
+
+def test_draft_model_self_draft_full_acceptance(cfg, params):
+    """With the TARGET as its own draft the proposals are the
+    target's argmax stream, so every window accepts fully: k+1
+    tokens per verify step (the acceptance plumbing's upper bound)."""
+    import jax
+
+    k, num_new = 3, 21
+    prompt = tf.sample_batch(jax.random.PRNGKey(6), cfg, 2, 9)
+    out, stats = speculative.draft_model_generate(
+        params, cfg, params, cfg, prompt, num_new, draft_k=k,
+        return_stats=True)
+    ref = np.asarray(decode.greedy_generate(params, cfg, prompt,
+                                            num_new))
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    # num_new - 1 tokens emitted by verify steps, k+1 per step
+    expected = -(-(num_new - 1) // (k + 1))  # ceil
+    assert stats["steps"] == expected, stats
+
+
+def test_draft_cache_has_no_holes_after_full_acceptance(cfg, params):
+    """Regression: with the target drafting for itself every window
+    fully accepts, and the draft cache must hold REAL k/v at every
+    position < total-1 — the original k-step proposal scan never
+    wrote the final accepted draft token's row, leaving a permanent
+    zero row at each full-acceptance boundary that skewed all later
+    proposals (output exactness masked it; acceptance rate paid)."""
+    import jax
+    import jax.numpy as jnp
+
+    k, t_p, rounds = 3, 9, 3
+    prompt = tf.sample_batch(jax.random.PRNGKey(6), cfg, 2, t_p)
+    L = t_p + rounds * (k + 1) + k + 2
+    logits, cache = speculative._jitted_prefill(cfg, L)(params,
+                                                        prompt)
+    _, draft_cache = speculative._jitted_prefill(cfg, L)(params,
+                                                         prompt)
+    first = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+    out = jnp.zeros((2, L), prompt.dtype)
+    out = out.at[:, :t_p].set(prompt)
+    out = out.at[:, t_p].set(first)
+    total = jnp.full((2,), t_p + 1, jnp.int32)
+    step = speculative._jitted_draft_step(cfg, cfg, k)
+    for _ in range(rounds):
+        cache, draft_cache, out, total, m = step(
+            params, params, cache, draft_cache, out, total)
+        assert (np.asarray(m) == k).all()  # self-draft: full accept
+    # every row holding an accepted token's k/v must be nonzero
+    k_rows = np.asarray(draft_cache[0]["k"], np.float32)
+    for row_i, t in enumerate(np.asarray(total)):
+        norms = np.abs(k_rows[row_i, : t - 1]).sum(axis=(1, 2))
+        assert (norms > 0).all(), (row_i, np.where(norms == 0))
+
+
+def test_draft_model_vocab_mismatch_raises(cfg, params):
+    import jax
+
+    bad_cfg = tf.ModelConfig(vocab_size=32, d_model=16, n_heads=2,
+                             n_layers=1, d_ff=32, max_seq=128)
+    bad_params = tf.init_params(jax.random.PRNGKey(1), bad_cfg)
+    prompt = tf.sample_batch(jax.random.PRNGKey(2), cfg, 1, 5)
+    with pytest.raises(ValueError, match="vocab"):
+        speculative.draft_model_generate(
+            params, cfg, bad_params, bad_cfg, prompt, 4)
