@@ -672,6 +672,62 @@ _jitted_write = _functools.lru_cache(maxsize=1)(_jitted_write)
 
 
 # ---------------------------------------------------------------------
+# tensor-parallel placement (mesh serving)
+
+
+def _mesh_axis(mesh, name: str) -> int:
+    """Size of a mesh axis, 1 when absent (param_specs' tolerance)."""
+    return (dict(zip(mesh.axis_names, mesh.devices.shape))
+            .get(name, 1))
+
+
+def _check_mesh_divisibility(cfg: ModelConfig, slots: int,
+                             mesh) -> None:
+    data = _mesh_axis(mesh, "data")
+    model = _mesh_axis(mesh, "model")
+    if slots % data != 0:
+        raise ValueError(
+            f"max_slots {slots} not divisible by mesh data axis "
+            f"{data}")
+    if cfg.kv_heads % model != 0:
+        raise ValueError(
+            f"kv_heads {cfg.kv_heads} not divisible by mesh model "
+            f"axis {model}")
+
+
+def _shard_params(params: Params, cfg: ModelConfig, mesh) -> Params:
+    import jax
+    from jax.sharding import NamedSharding
+
+    from kind_tpu_sim.models.transformer import param_specs
+
+    specs = param_specs(cfg, mesh)
+    return jax.tree_util.tree_map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params, specs,
+        is_leaf=lambda x: not isinstance(x, (dict, list)),
+    )
+
+
+def _shard_cache(cache, mesh):
+    """Slot-grid KV storage placed (slots='data', kv_heads='model'):
+    each layer's k/v is (slots, rows, kv, hd); QuantArray components
+    share the geometry (scale is (slots, rows, kv, 1))."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data" if "data" in mesh.axis_names
+                               else None, None,
+                               "model" if "model" in mesh.axis_names
+                               else None, None))
+    # device_put applies one sharding to every pytree leaf, so a
+    # QuantArray's q and scale (same (slots, rows, kv, ...) geometry)
+    # place together without special-casing
+    return [{"k": jax.device_put(lc["k"], sh),
+             "v": jax.device_put(lc["v"], sh)} for lc in cache]
+
+
+# ---------------------------------------------------------------------
 # host-side engine
 
 
@@ -685,16 +741,34 @@ class ServingEngine:
     """
 
     def __init__(self, params: Params, cfg: ModelConfig,
-                 serving: ServingConfig = ServingConfig()):
+                 serving: ServingConfig = ServingConfig(),
+                 mesh=None):
         import functools
 
         import jax
         import jax.numpy as jnp
 
+        self.mesh = mesh
+        n = serving.max_slots
+        if mesh is not None:
+            # All mesh rejections fire BEFORE the weight transfer:
+            # on a real multi-host mesh _shard_params moves the full
+            # model, which an invalid config must not pay for.
+            if serving.paged_blocks or serving.paged_kernel:
+                raise ValueError(
+                    "paged engines do not support mesh serving yet; "
+                    "use the dense-grid engines")
+            _check_mesh_divisibility(cfg, n, mesh)
+            # Tensor-parallel serving: commit the params with the
+            # Megatron 'model'-axis shardings (transformer.
+            # param_specs) and the slot grid over 'data'; the jitted
+            # kernels are UNCHANGED — GSPMD propagates the argument
+            # shardings and inserts the collectives, exactly like
+            # the tp-decode dryrun path (__graft_entry__).
+            params = _shard_params(params, cfg, mesh)
         self.params = params
         self.cfg = cfg
         self.serving = serving
-        n = serving.max_slots
         self.lengths = jnp.zeros((n,), jnp.int32)
         self.last_token = jnp.zeros((n,), jnp.int32)
         self.active = jnp.zeros((n,), bool)
@@ -741,6 +815,8 @@ class ServingEngine:
                 "paged_kernel; construct PagedServingEngine")
         self.cache = init_cache(cfg, serving.max_slots,
                                 serving.max_len)
+        if self.mesh is not None:
+            self.cache = _shard_cache(self.cache, self.mesh)
         # cache is donated: XLA updates the 100+ MB grid in place.
         # The jitted kernels are module-cached per (cfg, chunk);
         # binding params here keeps the bench's dispatch-counting
@@ -1112,6 +1188,14 @@ class PagedServingEngine(ServingEngine):
         from kind_tpu_sim.models import paged
 
         cfg, serving = self.cfg, self.serving
+        if self.mesh is not None:
+            # loud, not silent: the block pool is global across
+            # slots, so 'data'-sharding the slot axis doesn't apply;
+            # pool sharding over 'model' plus table-driven gathers
+            # is future work
+            raise ValueError(
+                f"{type(self).__name__} does not support mesh "
+                "serving yet; use the dense-grid engines")
         if serving.paged_blocks < 2:
             raise ValueError(
                 "PagedServingEngine needs ServingConfig.paged_blocks"
@@ -1387,9 +1471,9 @@ class SpeculativeServingEngine(ServingEngine):
 
     def __init__(self, params: Params, cfg: ModelConfig,
                  serving: ServingConfig = ServingConfig(),
-                 draft=None):
+                 draft=None, mesh=None):
         self._draft = draft
-        super().__init__(params, cfg, serving)
+        super().__init__(params, cfg, serving, mesh)
 
     def _init_storage(self) -> None:
         import functools
@@ -1425,6 +1509,8 @@ class SpeculativeServingEngine(ServingEngine):
         # writing until the scan ends (stale rows, never attended)
         self._rows = serving.max_len + W * (k + 1)
         self.cache = init_cache(cfg, n, self._rows)
+        if self.mesh is not None:
+            self.cache = _shard_cache(self.cache, self.mesh)
         self.out = jnp.zeros((n, self._rows), jnp.int32)
         self.total = jnp.zeros((n,), jnp.int32)
         self.verify_steps = 0
@@ -1441,7 +1527,16 @@ class SpeculativeServingEngine(ServingEngine):
                 raise ValueError(
                     f"draft vocab {dcfg.vocab_size} != target "
                     f"vocab {cfg.vocab_size}")
+            if self.mesh is not None:
+                # only the kv_heads % model half is new here (the
+                # base __init__ already validated slots % data);
+                # validate before the draft grid is allocated
+                _check_mesh_divisibility(dcfg, n, self.mesh)
+                dparams = _shard_params(dparams, dcfg, self.mesh)
             self.draft_cache = init_cache(dcfg, n, self._rows)
+            if self.mesh is not None:
+                self.draft_cache = _shard_cache(self.draft_cache,
+                                                self.mesh)
             self._draft_prefill = functools.partial(
                 _jitted_prefill(dcfg), dparams)
             self._spec_step = functools.partial(
