@@ -481,11 +481,18 @@ def generate_from_cache(params: Params, cfg: ModelConfig, first_token,
 @_dataclasses.dataclass(frozen=True)
 class SamplingConfig:
     """vLLM-style sampling knobs. temperature<=0 means greedy; top_k=0
-    means full vocab; top_p=1.0 disables nucleus filtering."""
+    means full vocab; top_p=1.0 disables nucleus filtering; min_p=0
+    disables the min-p filter (keep tokens with prob >= min_p *
+    max_prob, applied after temperature like vLLM);
+    repetition_penalty=1.0 disables the HF/vLLM-style penalty
+    (logits of tokens already in the prompt or output are divided by
+    the penalty when positive, multiplied when negative)."""
 
     temperature: float = 1.0
     top_k: int = 0
     top_p: float = 1.0
+    min_p: float = 0.0
+    repetition_penalty: float = 1.0
 
 
 def _sample_token(logits, sampling: SamplingConfig, key, dtype):
@@ -509,6 +516,11 @@ def _sample_token(logits, sampling: SamplingConfig, key, dtype):
         cutoff = jnp.min(
             jnp.where(keep, sorted_probs, 2.0), axis=-1, keepdims=True)
         logits = jnp.where(probs < cutoff, -1e30, logits)
+    if sampling.min_p > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        floor = sampling.min_p * jnp.max(probs, axis=-1,
+                                         keepdims=True)
+        logits = jnp.where(probs < floor, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(dtype)
 
 
@@ -523,6 +535,12 @@ def sample_generate(params: Params, cfg: ModelConfig, prompt,
     import jax.numpy as jnp
 
     b, t_p = prompt.shape
+    if sampling.repetition_penalty != 1.0:
+        # loud, not silent: the solo path keeps no presence state;
+        # the serving engines implement the penalty
+        raise ValueError(
+            "repetition_penalty is only supported by the serving "
+            "engines (models/serving.py), not sample_generate")
     if num_new <= 0:
         return prompt
     logits, cache = prefill(params, cfg, prompt, t_p + num_new)

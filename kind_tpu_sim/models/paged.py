@@ -214,22 +214,22 @@ def paged_prefill(params, pools, tokens, true_len, table_row, *,
 
 
 def paged_decode_chunk(params, pools, tables, lengths, last_token,
-                       active, sampling_state, *, cfg: ModelConfig,
-                       chunk: int):
+                       active, sampling_state, presence, *,
+                       cfg: ModelConfig, chunk: int):
     """One scheduling quantum over the paged pool: gather the block
     view once, run the shared chunk scan, scatter the chunk buffer
-    back. Returns (pools, lengths, last_token, emitted)."""
+    back. Returns (pools, lengths, last_token, emitted, presence)."""
     import jax.numpy as jnp
 
     from kind_tpu_sim.models.serving import _chunk_scan
 
     view = gather_view(pools, tables)
-    token, small, emitted = _chunk_scan(
+    token, small, emitted, presence = _chunk_scan(
         params, view, lengths, last_token, active, sampling_state,
-        cfg=cfg, chunk=chunk)
+        presence, cfg=cfg, chunk=chunk)
     pools = scatter_rows(pools, tables, lengths, small, active)
     lengths = jnp.where(active, lengths + chunk, lengths)
-    return pools, lengths, token, emitted
+    return pools, lengths, token, emitted, presence
 
 
 def paged_suffix(params, pools, tokens, true_len, base, table_row, *,
@@ -414,8 +414,9 @@ def _block_decode_kernel(x, bparams, cfg: ModelConfig, pool_lc,
 
 
 def paged_decode_chunk_kernel(params, pools, tables, lengths,
-                              last_token, active, sampling_state, *,
-                              cfg: ModelConfig, chunk: int):
+                              last_token, active, sampling_state,
+                              presence, *, cfg: ModelConfig,
+                              chunk: int):
     """paged_decode_chunk's Pallas tier: same scheduling quantum, but
     the big-cache attention reads pool blocks directly through the
     table (no per-chunk gather, no transient view — peak HBM is the
@@ -429,12 +430,12 @@ def paged_decode_chunk_kernel(params, pools, tables, lengths,
         return _block_decode_kernel(
             x, bparams, cfg, pool_lc, tables, small_lc, lengths, i)
 
-    token, small, emitted = _chunk_scan(
+    token, small, emitted, presence = _chunk_scan(
         params, pools, lengths, last_token, active, sampling_state,
-        cfg=cfg, chunk=chunk, block_fn=block_fn)
+        presence, cfg=cfg, chunk=chunk, block_fn=block_fn)
     pools = scatter_rows(pools, tables, lengths, small, active)
     lengths = jnp.where(active, lengths + chunk, lengths)
-    return pools, lengths, token, emitted
+    return pools, lengths, token, emitted, presence
 
 
 def paged_verify_step(params, pools, tables, out, total, active,

@@ -198,14 +198,31 @@ def _prefill_into_slot(params, cache, tokens, true_len, slot, *,
     return new_cache, logits[0].astype(jnp.float32)
 
 
-def _filtered_scaled(logits, temp, top_k, top_p):
-    """Temperature-scaled, top-k/top-p-filtered logits per row
+def _apply_rep_penalty(logits, rep_pen, presence):
+    """HF/vLLM-style repetition penalty per row: logits of tokens
+    already seen (prompt or output — ``presence`` (b, vocab) bool)
+    are divided by the penalty when positive, multiplied when
+    negative. rep_pen == 1.0 is the identity. Applied BEFORE
+    temperature/filters (the vLLM processor order), and to greedy
+    rows too (penalized argmax — the vLLM behavior)."""
+    import jax.numpy as jnp
+
+    pen = rep_pen[:, None]
+    penalized = jnp.where(logits > 0, logits / pen, logits * pen)
+    return jnp.where(presence & (pen != 1.0), penalized, logits)
+
+
+def _filtered_scaled(logits, temp, top_k, top_p, min_p=None):
+    """Temperature-scaled, top-k/top-p/min-p-filtered logits per row
     (b, vocab) — the shared front half of per-request sampling. The
     filtering math mirrors decode._sample_token exactly, vectorized:
     dynamic per-row k via the sorted kth value, nucleus cutoff from
-    the cumulative mass BEFORE each token. softmax of the result is
-    THE per-request target distribution (used directly by the
-    rejection-sampling verify in speculative serving)."""
+    the cumulative mass BEFORE each token, min-p floor relative to
+    the max prob. softmax of the result is THE per-request target
+    distribution (used directly by the rejection-sampling verify in
+    speculative serving). Repetition penalty is NOT applied here —
+    callers apply _apply_rep_penalty first (the distribution fed to
+    rejection sampling must already be the penalized one)."""
     import jax
     import jax.numpy as jnp
 
@@ -227,18 +244,30 @@ def _filtered_scaled(logits, temp, top_k, top_p):
     keep = (cum - sorted_probs) < p_eff[:, None]
     cutoff = jnp.min(jnp.where(keep, sorted_probs, 2.0), axis=-1,
                      keepdims=True)
-    return jnp.where(probs < cutoff, -1e30, scaled)
+    scaled = jnp.where(probs < cutoff, -1e30, scaled)
+
+    if min_p is not None:
+        probs = jax.nn.softmax(scaled, axis=-1)
+        floor = min_p[:, None] * jnp.max(probs, axis=-1,
+                                         keepdims=True)
+        scaled = jnp.where(
+            (min_p[:, None] > 0.0) & (probs < floor), -1e30, scaled)
+    return scaled
 
 
-def _sample_rows(logits, temp, top_k, top_p, keys):
+def _sample_rows(logits, temp, top_k, top_p, min_p, rep_pen,
+                 presence, keys):
     """Per-row sampling over fp32 logits (b, vocab): each row has its
-    OWN temperature / top-k / top-p / PRNG key (the vLLM per-request
-    SamplingParams shape). Rows with temp <= 0 are greedy."""
+    OWN temperature / top-k / top-p / min-p / repetition penalty /
+    PRNG key (the vLLM per-request SamplingParams shape). Rows with
+    temp <= 0 are greedy — argmax of the PENALIZED logits (penalty
+    affects greedy like vLLM; the monotone filters don't)."""
     import jax
     import jax.numpy as jnp
 
+    logits = _apply_rep_penalty(logits, rep_pen, presence)
     greedy = jnp.argmax(logits, axis=-1)
-    scaled = _filtered_scaled(logits, temp, top_k, top_p)
+    scaled = _filtered_scaled(logits, temp, top_k, top_p, min_p)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled)
     return jnp.where(temp <= 0.0, greedy, sampled)
 
@@ -304,8 +333,8 @@ def _scatter_chunk(cache_arr, small_arr, starts, active, cfg):
 
 
 def _chunk_scan(params, big_cache, lengths, last_token, active,
-                sampling_state, *, cfg: ModelConfig, chunk: int,
-                block_fn=None):
+                sampling_state, presence, *, cfg: ModelConfig,
+                chunk: int, block_fn=None):
     """The shared inner scan of one scheduling quantum: ``chunk``
     tokens for every slot against a loop-invariant big cache
     (inactive slots compute too — lockstep SPMD — but their emissions
@@ -316,15 +345,19 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
     the only difference between the two engines' decode rounds.
     ``block_fn(x, bparams, big_lc, small_lc, i)`` overrides the
     per-layer block (paged.py's Pallas-kernel tier passes a closure
-    attending block pools directly). Returns (next_token, small chunk
-    buffers, emitted (slots, chunk)).
+    attending block pools directly). ``presence`` (b, vocab) bool is
+    each row's seen-token set (prompt + output, the repetition-
+    penalty state), updated in-scan as tokens emit. Returns
+    (next_token, small chunk buffers, emitted (slots, chunk),
+    updated presence).
     """
     import jax
     import jax.numpy as jnp
 
     from kind_tpu_sim.models.quant import embed_lookup
 
-    temp, top_k, top_p, keys, prompt_len = sampling_state
+    (temp, top_k, top_p, min_p, rep_pen, keys,
+     prompt_len) = sampling_state
     b = last_token.shape[0]
     dtype = jnp.dtype(cfg.dtype)
     if block_fn is None:
@@ -344,7 +377,7 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
     ]
 
     def step(carry, i):
-        token, small = carry
+        token, small, seen = carry
         x = embed_lookup(params["embed"], token, dtype)
         new_small = []
         for bparams, big_lc, small_lc in zip(params["blocks"],
@@ -360,37 +393,46 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
         # admission from the prefill logits.
         gen_idx = lengths + i + 1 - prompt_len
         step_keys = jax.vmap(jax.random.fold_in)(keys, gen_idx)
-        # all-greedy grids (the common serving case) skip the
-        # sampling pipeline's sorts/softmax/categorical entirely —
-        # lax.cond runs one branch at execution time
+        # all-default grids (greedy, no penalty/min-p — the common
+        # serving case) skip the sampling pipeline's sorts/softmax/
+        # categorical entirely — lax.cond runs one branch at
+        # execution time
+        # (min_p is absent from the predicate on purpose: it only
+        # affects sampled rows, which the temp term already covers —
+        # a greedy grid with min_p set must keep the fast path)
         nxt = jax.lax.cond(
-            jnp.any(temp > 0.0),
-            lambda lg: _sample_rows(lg, temp, top_k, top_p,
-                                    step_keys),
+            jnp.any(temp > 0.0) | jnp.any(rep_pen != 1.0),
+            lambda lg: _sample_rows(lg, temp, top_k, top_p, min_p,
+                                    rep_pen, seen, step_keys),
             lambda lg: jnp.argmax(lg, axis=-1),
             logits.astype(jnp.float32)).astype(token.dtype)
         nxt = jnp.where(active, nxt, token)  # inactive slots hold
-        return (nxt, new_small), nxt
+        # the emitted token joins its row's presence set (masked:
+        # an inactive slot's held token must not re-mark itself)
+        seen = seen.at[jnp.arange(b), nxt].set(
+            seen[jnp.arange(b), nxt] | active)
+        return (nxt, new_small, seen), nxt
 
-    (token, small), emitted = jax.lax.scan(
-        step, (last_token, small0), jnp.arange(chunk))
-    return token, small, emitted.swapaxes(0, 1)
+    (token, small, presence), emitted = jax.lax.scan(
+        step, (last_token, small0, presence), jnp.arange(chunk))
+    return token, small, emitted.swapaxes(0, 1), presence
 
 
 def _decode_chunk(params, cache, lengths, last_token, active,
-                  sampling_state, *, cfg: ModelConfig, chunk: int):
+                  sampling_state, presence, *, cfg: ModelConfig,
+                  chunk: int):
     """One scheduling quantum over the dense slot grid.
-    ``sampling_state`` carries per-slot (temp, top_k, top_p, keys,
-    prompt_len); token selection folds each slot's key by its
-    GENERATION index (position - prompt_len), so a request's sampled
-    tokens are reproducible regardless of slot placement, admission
-    round, or grid co-tenants. Returns (cache, lengths, last_token,
-    emitted (slots, chunk))."""
+    ``sampling_state`` carries per-slot (temp, top_k, top_p, min_p,
+    rep_pen, keys, prompt_len); token selection folds each slot's
+    key by its GENERATION index (position - prompt_len), so a
+    request's sampled tokens are reproducible regardless of slot
+    placement, admission round, or grid co-tenants. Returns (cache,
+    lengths, last_token, emitted (slots, chunk), presence)."""
     import jax.numpy as jnp
 
-    token, small, emitted = _chunk_scan(
+    token, small, emitted, presence = _chunk_scan(
         params, cache, lengths, last_token, active, sampling_state,
-        cfg=cfg, chunk=chunk)
+        presence, cfg=cfg, chunk=chunk)
     new_cache = [
         {
             "k": _scatter_chunk(big_lc["k"], small_lc["k"], lengths,
@@ -401,7 +443,7 @@ def _decode_chunk(params, cache, lengths, last_token, active,
         for big_lc, small_lc in zip(cache, small)
     ]
     lengths = jnp.where(active, lengths + chunk, lengths)
-    return new_cache, lengths, token, emitted
+    return new_cache, lengths, token, emitted, presence
 
 
 def _suffix_into_slot(params, cache, tokens, true_len, base, slot, *,
@@ -773,10 +815,16 @@ class ServingEngine:
         self.last_token = jnp.zeros((n,), jnp.int32)
         self.active = jnp.zeros((n,), bool)
         # per-slot sampling params (vLLM SamplingParams analog);
-        # temp 0 = greedy, top_k 0 = full vocab, top_p 1 = no nucleus
+        # temp 0 = greedy, top_k 0 = full vocab, top_p 1 = no
+        # nucleus, min_p 0 = no floor, rep_pen 1 = no penalty
         self.temp = jnp.zeros((n,), jnp.float32)
         self.top_k = jnp.zeros((n,), jnp.int32)
         self.top_p = jnp.ones((n,), jnp.float32)
+        self.min_p = jnp.zeros((n,), jnp.float32)
+        self.rep_pen = jnp.ones((n,), jnp.float32)
+        # per-slot seen-token sets (prompt + output): the repetition
+        # penalty's state, updated in-scan as tokens emit
+        self.presence = jnp.zeros((n, cfg.vocab_size), bool)
         self.keys = jax.vmap(jax.random.PRNGKey)(jnp.zeros((n,), jnp.uint32))
         self.prompt_len = jnp.zeros((n,), jnp.int32)
 
@@ -835,6 +883,11 @@ class ServingEngine:
 
     def submit(self, request: Request) -> None:
         self._capacity_check(request)
+        if request.sampling is not None:
+            # at submit, not admission: a mid-run() rejection would
+            # abandon co-tenants' drains, waste the prefill, and
+            # leak the request's clock entry
+            self._check_sampling(request.sampling)
         if request.max_new < 1:
             raise ValueError("max_new must be >= 1")
         if request.seed is None:
@@ -862,10 +915,14 @@ class ServingEngine:
         self._admit()
         if not any(r is not None for r in self.slot_req):
             return
-        sampling_state = (self.temp, self.top_k, self.top_p,
-                          self.keys, self.prompt_len)
-        emitted = self._decode_round(sampling_state)
+        emitted = self._decode_round(self._sampling_state())
         self._retire(emitted)
+
+    def _sampling_state(self):
+        """The per-slot sampling-parameter tuple every decode/verify
+        kernel consumes (presence is separate: mutable storage)."""
+        return (self.temp, self.top_k, self.top_p, self.min_p,
+                self.rep_pen, self.keys, self.prompt_len)
 
     # -- engine hooks (overridden by PagedServingEngine) ---------------
 
@@ -880,16 +937,21 @@ class ServingEngine:
         """Admission gate beyond a free slot (paged: block budget)."""
         return True
 
+    def _check_sampling(self, samp: SamplingConfig) -> None:
+        """Per-engine sampling-feature gate (speculative engines
+        reject repetition_penalty — the verify window's acceptance
+        math has no in-window presence state yet)."""
+
     def _on_admitted(self, slot: int, request: Request,
                      first: int) -> None:
         """Post-admission hook (speculative: seed the draft buffer)."""
 
     def _decode_round(self, sampling_state):
         """Run one chunk over the big cache; returns emitted tokens."""
-        (self.cache, self.lengths, self.last_token,
-         emitted) = self._chunk(self.cache, self.lengths,
-                                self.last_token, self.active,
-                                sampling_state)
+        (self.cache, self.lengths, self.last_token, emitted,
+         self.presence) = self._chunk(self.cache, self.lengths,
+                                      self.last_token, self.active,
+                                      sampling_state, self.presence)
         return emitted
 
     def poll(self) -> List[Completion]:
@@ -977,6 +1039,18 @@ class ServingEngine:
             self.temp = self.temp.at[slot].set(samp.temperature)
             self.top_k = self.top_k.at[slot].set(samp.top_k)
             self.top_p = self.top_p.at[slot].set(samp.top_p)
+            self.min_p = self.min_p.at[slot].set(samp.min_p)
+            self.rep_pen = self.rep_pen.at[slot].set(
+                samp.repetition_penalty)
+            # the slot's seen-token set starts as the PROMPT's tokens
+            # (vLLM counts prompt + output for repetition_penalty);
+            # built host-side — one small transfer per admission
+            import numpy as _np
+
+            seen_row = _np.zeros((self.cfg.vocab_size,), bool)
+            seen_row[_np.asarray(req.prompt, _np.int64)] = True
+            self.presence = self.presence.at[slot].set(
+                jnp.asarray(seen_row))
             key = jax.random.PRNGKey(req.seed)
             self.keys = self.keys.at[slot].set(key)
             self.prompt_len = self.prompt_len.at[slot].set(t_p)
@@ -989,7 +1063,12 @@ class ServingEngine:
                 jnp.asarray([samp.temperature], jnp.float32),
                 jnp.asarray([samp.top_k], jnp.int32),
                 jnp.asarray([samp.top_p], jnp.float32),
+                jnp.asarray([samp.min_p], jnp.float32),
+                jnp.asarray([samp.repetition_penalty], jnp.float32),
+                jnp.asarray(seen_row)[None, :],
                 jax.random.fold_in(key, 0)[None, :])[0])
+            # the first token joins the seen set too
+            self.presence = self.presence.at[slot, first].set(True)
             # TTFT clock: the EARLIEST first-token time survives a
             # recompute preemption (the user saw that token then)
             import time as _time
@@ -1055,12 +1134,15 @@ class ServingEngine:
         self.slot_req[slot] = None
         self.slot_emitted[slot] = []
         self.active = self.active.at[slot].set(False)
-        # Reset the slot's sampling params: a stale temp > 0 on an
-        # idle slot would keep jnp.any(temp > 0) true and defeat the
-        # all-greedy lax.cond fast path for every later chunk.
+        # Reset the slot's sampling params: a stale temp > 0 (or
+        # penalty/min-p) on an idle slot would keep the all-default
+        # lax.cond fast path off for every later chunk.
         self.temp = self.temp.at[slot].set(0.0)
         self.top_k = self.top_k.at[slot].set(0)
         self.top_p = self.top_p.at[slot].set(1.0)
+        self.min_p = self.min_p.at[slot].set(0.0)
+        self.rep_pen = self.rep_pen.at[slot].set(1.0)
+        self.presence = self.presence.at[slot].set(False)
 
     def report(self) -> Dict[str, Any]:
         """Pod/bench-friendly state snapshot."""
@@ -1337,6 +1419,9 @@ class PagedServingEngine(ServingEngine):
         self.temp = self.temp.at[slot].set(0.0)
         self.top_k = self.top_k.at[slot].set(0)
         self.top_p = self.top_p.at[slot].set(1.0)
+        self.min_p = self.min_p.at[slot].set(0.0)
+        self.rep_pen = self.rep_pen.at[slot].set(1.0)
+        self.presence = self.presence.at[slot].set(False)
         self.preemptions += 1
         return True
 
@@ -1408,10 +1493,11 @@ class PagedServingEngine(ServingEngine):
             return np.zeros((self.serving.max_slots, chunk),
                             np.int32)
 
-        (self.pools, self.lengths, self.last_token,
-         emitted) = self._paged_chunk(
+        (self.pools, self.lengths, self.last_token, emitted,
+         self.presence) = self._paged_chunk(
             self.pools, jnp.asarray(tables), self.lengths,
-            self.last_token, self.active, sampling_state)
+            self.last_token, self.active, sampling_state,
+            self.presence)
         return emitted
 
     def _finish(self, slot: int) -> None:
@@ -1560,6 +1646,14 @@ class SpeculativeServingEngine(ServingEngine):
                 jnp.int32(t_p), slot)
         return logits
 
+    def _check_sampling(self, samp: SamplingConfig) -> None:
+        if samp.repetition_penalty != 1.0:
+            raise ValueError(
+                "repetition_penalty is not supported by the "
+                "speculative engines yet (the verify window's "
+                "acceptance math has no in-window presence state); "
+                "use the chunked engines")
+
     def _on_admitted(self, slot: int, request: Request,
                      first: int) -> None:
         import jax.numpy as jnp
@@ -1578,8 +1672,7 @@ class SpeculativeServingEngine(ServingEngine):
         self._admit()
         if not any(r is not None for r in self.slot_req):
             return
-        sampling_state = (self.temp, self.top_k, self.top_p,
-                          self.keys, self.prompt_len)
+        sampling_state = self._sampling_state()
         if self._draft is None:
             (self.cache, self.out, self.total, emits,
              ms) = self._spec_step(self.cache, self.out, self.total,
@@ -1700,6 +1793,7 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
     # borrowing the unbound functions across the class tree is safe)
     _on_admitted = SpeculativeServingEngine._on_admitted
     _spec_retire = SpeculativeServingEngine._spec_retire
+    _check_sampling = SpeculativeServingEngine._check_sampling
 
     def report(self) -> Dict[str, Any]:
         out = super().report()  # paged stats + prefix cache
@@ -1725,8 +1819,7 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
         tables = self._build_tables()
         if not any(r is not None for r in self.slot_req):
             return  # preemption emptied the grid
-        sampling_state = (self.temp, self.top_k, self.top_p,
-                          self.keys, self.prompt_len)
+        sampling_state = self._sampling_state()
         (self.pools, self.out, self.total, emits,
          ms) = self._spec_step(self.pools, jnp.asarray(tables),
                                self.out, self.total, self.active,

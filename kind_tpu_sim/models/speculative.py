@@ -385,7 +385,8 @@ def _accept_and_emit(logits, draft, out, total, active,
     if sampling_state is not None:
         from kind_tpu_sim.models.serving import _filtered_scaled
 
-        temp, top_k, top_p, keys, prompt_len = sampling_state
+        (temp, top_k, top_p, min_p, _rep_pen, keys,
+         prompt_len) = sampling_state
         vocab = logits.shape[-1]
 
         def rejection_merge(_):
@@ -395,9 +396,11 @@ def _accept_and_emit(logits, draft, out, total, active,
             def tile(v):
                 return jnp.repeat(v, k + 1, axis=0)
 
+            # rep_pen is validated == 1.0 at admission (the engines'
+            # _check_sampling); min_p composes — it is stateless
             probs = jax.nn.softmax(
                 _filtered_scaled(flat, tile(temp), tile(top_k),
-                                 tile(top_p)),
+                                 tile(top_p), tile(min_p)),
                 axis=-1).reshape(b, k + 1, vocab)
             # generation index of window position j: the first
             # window token continues generation (total -

@@ -116,7 +116,7 @@ def plugin_env(tmp_path, plugin_binary, pb):
         _LAST_STDERR = None
         proc.send_signal(signal.SIGTERM)
         try:
-            proc.wait(timeout=5)
+            proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
             proc.kill()
         kubelet.stop()
@@ -140,7 +140,7 @@ def _plugin_stderr_tail() -> str:
 
 
 def call_unary(channel, pb, method, request, request_cls, response_cls,
-               timeout=20):
+               timeout=60):
     stub = channel.unary_unary(
         f"/v1beta1.DevicePlugin/{method}",
         request_serializer=request_cls.SerializeToString,
@@ -169,7 +169,7 @@ def call_unary(channel, pb, method, request, request_cls, response_cls,
 
 
 def test_register_called_with_plugin_identity(plugin_env, pb):
-    req = plugin_env["kubelet"].requests.get(timeout=10)
+    req = plugin_env["kubelet"].requests.get(timeout=60)
     assert req.version == "v1beta1"
     assert req.endpoint == "tpu-sim.sock"
     assert req.resource_name == "google.com/tpu"
@@ -187,7 +187,7 @@ def test_options_and_listandwatch(plugin_env, pb):
         "/v1beta1.DevicePlugin/ListAndWatch",
         request_serializer=pb.Empty.SerializeToString,
         response_deserializer=pb.ListAndWatchResponse.FromString,
-    )(pb.Empty(), timeout=10)
+    )(pb.Empty(), timeout=60)
     first = next(stream)
     assert len(first.devices) == 8
     ids = sorted(d.ID for d in first.devices)
@@ -271,7 +271,7 @@ def test_unknown_method_unimplemented(plugin_env, pb):
         response_deserializer=pb.Empty.FromString,
     )
     with pytest.raises(grpc.RpcError) as err:
-        stub(pb.Empty(), timeout=5)
+        stub(pb.Empty(), timeout=60)
     assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
     channel.close()
 
@@ -287,7 +287,7 @@ def test_concurrent_clients_and_streams(plugin_env, pb):
             "/v1beta1.DevicePlugin/ListAndWatch",
             request_serializer=pb.Empty.SerializeToString,
             response_deserializer=pb.ListAndWatchResponse.FromString,
-        )(pb.Empty(), timeout=15)
+        )(pb.Empty(), timeout=60)
         first = next(stream)
         assert len(first.devices) == 8
         # unary call on the same channel while the stream is open
@@ -314,7 +314,7 @@ def test_large_metadata_exercises_continuation(plugin_env, pb):
         response_deserializer=pb.DevicePluginOptions.FromString,
     )
     big = "x" * 20000
-    options = stub(pb.Empty(), timeout=10,
+    options = stub(pb.Empty(), timeout=60,
                    metadata=(("big-bin-header", big),))
     assert options.get_preferred_allocation_available
     channel.close()
@@ -363,7 +363,7 @@ def test_allocate_multislice_megascale_env(tmp_path, plugin_binary, pb):
     finally:
         proc.send_signal(signal.SIGTERM)
         try:
-            proc.wait(timeout=5)
+            proc.wait(timeout=60)
         except subprocess.TimeoutExpired:
             proc.kill()
 
@@ -428,10 +428,10 @@ def test_prestart_container_noop(plugin_env, pb):
 
 def test_reregisters_after_kubelet_restart(plugin_env, pb):
     # First registration.
-    plugin_env["kubelet"].requests.get(timeout=10)
+    plugin_env["kubelet"].requests.get(timeout=60)
     # Simulate kubelet restart: the device-plugin dir is wiped.
     os.unlink(plugin_env["socket"])
-    req = plugin_env["kubelet"].requests.get(timeout=15)
+    req = plugin_env["kubelet"].requests.get(timeout=60)
     assert req.resource_name == "google.com/tpu"
     # Plugin socket is back and serving.
     deadline = time.time() + 10
@@ -458,7 +458,7 @@ def test_introspection_state(plugin_env, pb):
             request_serializer=lambda x: x,
             response_deserializer=bytes,
         )
-        return jsonlib.loads(stub(b"", timeout=10))
+        return jsonlib.loads(stub(b"", timeout=60))
 
     before = state()
     assert before["resource"] == "google.com/tpu"

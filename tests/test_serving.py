@@ -375,6 +375,124 @@ def test_speculative_grid_matches_dense_grid(cfg, params):
     assert dense == spec
 
 
+def test_min_p_filter_math():
+    """_filtered_scaled's min-p leg vs a direct NumPy reference:
+    tokens with prob < min_p * max_prob are masked, rows with
+    min_p == 0 untouched."""
+    import jax.numpy as jnp
+
+    logits = np.log(np.asarray([
+        [0.5, 0.3, 0.15, 0.05],
+        [0.5, 0.3, 0.15, 0.05],
+    ], np.float32))
+    out = np.asarray(serving._filtered_scaled(
+        jnp.asarray(logits),
+        jnp.asarray([1.0, 1.0], jnp.float32),
+        jnp.asarray([0, 0], jnp.int32),
+        jnp.asarray([1.0, 1.0], jnp.float32),
+        jnp.asarray([0.4, 0.0], jnp.float32)))
+    # row 0: floor = 0.4 * 0.5 = 0.2 -> keep {0.5, 0.3}, mask rest
+    assert np.isfinite(out[0, :2]).all()
+    assert (out[0, 2:] < -1e29).all()
+    # row 1: min_p 0 keeps everything
+    assert np.isfinite(out[1]).all()
+
+
+def test_repetition_penalty_matches_reference(cfg, params):
+    """Greedy + repetition_penalty through the serving grid equals a
+    host-side reference loop applying the HF/vLLM penalty rule to
+    the raw decode-step logits (prompt + output presence)."""
+    import jax
+    import jax.numpy as jnp
+
+    pen = 1.8
+    prompt = make_prompt(33, 7, cfg.vocab_size)
+    n_new = 8
+
+    # reference: explicit decode steps, penalty on host
+    L = len(prompt) + n_new
+    logits, cache = decode.prefill(
+        params, cfg, jnp.asarray([prompt], jnp.int32), L)
+    seen = set(prompt)
+    toks = []
+    cur = None
+    lg = np.asarray(logits[0], np.float32)
+    for i in range(n_new):
+        pl = lg.copy()
+        for t in seen:
+            pl[t] = pl[t] / pen if pl[t] > 0 else pl[t] * pen
+        cur = int(pl.argmax())
+        toks.append(cur)
+        seen.add(cur)
+        if i + 1 < n_new:
+            logits, cache = decode.decode_step(
+                params, cfg, jnp.asarray([cur], jnp.int32), cache,
+                len(prompt) + i)
+            lg = np.asarray(logits[0], np.float32)
+
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    eng.submit(serving.Request(
+        "p", prompt, max_new=n_new,
+        sampling=decode.SamplingConfig(temperature=0.0,
+                                       repetition_penalty=pen)))
+    done = eng.run()
+    assert done[0].tokens == toks
+
+
+def test_penalized_request_stream_is_pure(cfg, params):
+    """A sampled request with min_p + penalty emits the same tokens
+    regardless of slot placement and co-tenants (purity holds for
+    the extended sampling surface)."""
+    samp = decode.SamplingConfig(temperature=1.1, min_p=0.05,
+                                 repetition_penalty=1.3)
+    target = serving.Request("t", make_prompt(44, 6, cfg.vocab_size),
+                             max_new=7, sampling=samp, seed=123)
+
+    def stream(extra_first):
+        sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8)
+        eng = serving.ServingEngine(params, cfg, sc)
+        if extra_first:
+            eng.submit(serving.Request(
+                "co", make_prompt(45, 9, cfg.vocab_size), max_new=11))
+        import dataclasses as _dc
+
+        eng.submit(_dc.replace(target))
+        return {c.request_id: c.tokens for c in eng.run()}["t"]
+
+    assert stream(False) == stream(True)
+
+
+def test_spec_engines_reject_repetition_penalty(cfg, params):
+    """Rejected at submit — not mid-run(), which would abandon
+    co-tenant drains and leak the request's clock entry."""
+    sc = serving.ServingConfig(max_slots=2, max_len=48,
+                               speculative_k=3)
+    eng = serving.SpeculativeServingEngine(params, cfg, sc)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        eng.submit(serving.Request(
+            "r", make_prompt(46, 5, cfg.vocab_size), max_new=4,
+            sampling=decode.SamplingConfig(temperature=1.0,
+                                           repetition_penalty=1.5)))
+    # the engine is untouched: the same id resubmits cleanly
+    eng.submit(serving.Request(
+        "r", make_prompt(46, 5, cfg.vocab_size), max_new=4))
+    done = eng.run()
+    assert len(done) == 1 and len(done[0].tokens) == 4
+
+
+def test_solo_sample_generate_rejects_penalty(cfg, params):
+    import jax
+
+    prompt = make_prompt(47, 5, cfg.vocab_size)
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        decode.sample_generate(
+            params, cfg, np.asarray([prompt], np.int32), 4,
+            jax.random.PRNGKey(0),
+            decode.SamplingConfig(temperature=1.0,
+                                  repetition_penalty=1.5))
+
+
 def test_mesh_serving_matches_unsharded(cfg, params):
     """Tensor-parallel serving: the SAME engine over a (data, model)
     mesh — Megatron-sharded params, slot grid over 'data', kv heads

@@ -81,8 +81,7 @@ def main() -> int:
     n = args.rounds
     T: dict = collections.defaultdict(float)
     for _ in range(n):
-        sampling_state = (eng.temp, eng.top_k, eng.top_p, eng.keys,
-                          eng.prompt_len)
+        sampling_state = eng._sampling_state()
         t0 = time.monotonic()
         (eng.cache, eng.out, eng.total, emit,
          m) = eng._spec_step(eng.cache, eng.out, eng.total,
