@@ -91,6 +91,13 @@ class ServingConfig:
     paged_kernel: bool = False  # paged tier only: Pallas paged-
     #                             attention (direct block reads, no
     #                             gather view); bf16 pools only
+    prefill_chunk: int = 0    # >0: chunked prefill (the vLLM TTFT/
+    #                           ITL smoother) — prompts enter the
+    #                           grid in windows of this many tokens,
+    #                           one window per scheduling round per
+    #                           pending slot, interleaved with the
+    #                           grid's decode chunks instead of
+    #                           stalling them for a whole prompt
 
 
 @dataclasses.dataclass
@@ -136,6 +143,18 @@ def _bucket(n: int, lo: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def _padded_window(toks):
+    """(1, bucket(len)) int32 zero-padded token window — THE one
+    copy of the pad discipline every prefill/suffix dispatch uses
+    (whole-prompt, prefix-cache suffix, chunked-prefill windows)."""
+    import numpy as np
+
+    w = len(toks)
+    arr = np.zeros((1, _bucket(w)), np.int32)
+    arr[0, :w] = toks
+    return arr
 
 
 # ---------------------------------------------------------------------
@@ -831,6 +850,9 @@ class ServingEngine:
         self.queue: List[Request] = []
         self.slot_req: List[Optional[Request]] = [None] * n
         self.slot_emitted: List[List[int]] = [[] for _ in range(n)]
+        # chunked prefill: slot -> {"req", "done"} for claimed slots
+        # whose prompts are still streaming in
+        self._pending: Dict[int, Dict[str, Any]] = {}
         self.finished: List[Completion] = []
         # host-side per-request wall clocks (submit/admit/finish) —
         # Completion.ttft_s/e2e_s and report()'s latency aggregates.
@@ -875,6 +897,12 @@ class ServingEngine:
             _jitted_chunk(cfg, serving.chunk), self.params)
         self._suffix = functools.partial(_jitted_suffix(cfg),
                                          self.params)
+        if (serving.prefill_chunk > 0
+                and serving.prefix_cache_entries > 0):
+            raise ValueError(
+                "chunked prefill does not compose with the prefix "
+                "cache yet (store/lookup assume whole-prompt "
+                "admission); pick one")
         self.prefix_cache = (
             PrefixCache(serving.prefix_cache_entries)
             if serving.prefix_cache_entries > 0 else None)
@@ -910,9 +938,12 @@ class ServingEngine:
         self.queue.append(request)
 
     def step_round(self) -> None:
-        """One scheduling quantum: admit into free slots, then decode
-        one chunk for the whole grid, then retire finished slots."""
+        """One scheduling quantum: admit into free slots, advance
+        pending chunked prefills by one window each, then decode one
+        chunk for the whole grid, then retire finished slots."""
         self._admit()
+        if self._pending:
+            self._advance_prefills()
         if not any(r is not None for r in self.slot_req):
             return
         emitted = self._decode_round(self._sampling_state())
@@ -942,6 +973,11 @@ class ServingEngine:
         reject repetition_penalty — the verify window's acceptance
         math has no in-window presence state yet)."""
 
+    def _prefill_extras(self, slot: int, request: Request) -> None:
+        """Post-target-prefill hook, run by _activate on BOTH the
+        whole-prompt and chunked-prefill admission paths (the
+        draft-model engine prefills its draft cache here)."""
+
     def _on_admitted(self, slot: int, request: Request,
                      first: int) -> None:
         """Post-admission hook (speculative: seed the draft buffer)."""
@@ -962,7 +998,7 @@ class ServingEngine:
         """Drain queue + grid to completion; returns all completions
         in finish order."""
         done: List[Completion] = []
-        while (self.queue or
+        while (self.queue or self._pending or
                any(r is not None for r in self.slot_req)):
             self.step_round()
             done.extend(self.poll())
@@ -976,7 +1012,6 @@ class ServingEngine:
         grid implementation; PagedServingEngine overrides with the
         block-pool scatter path)."""
         import jax.numpy as jnp
-        import numpy as np
 
         t_p = len(req.prompt)
         hit = None
@@ -993,18 +1028,12 @@ class ServingEngine:
             self.cache = _jitted_write()(self.cache, hit["kv"],
                                          slot)
             suffix = req.prompt[p:]
-            w_pad = _bucket(len(suffix))
-            tokens = np.zeros((1, w_pad), np.int32)
-            tokens[0, :len(suffix)] = suffix
             self.cache, logits = self._suffix(
-                self.cache, jnp.asarray(tokens),
+                self.cache, jnp.asarray(_padded_window(suffix)),
                 jnp.int32(len(suffix)), jnp.int32(p), slot)
         else:
-            pad = _bucket(t_p)
-            tokens = np.zeros((1, pad), np.int32)
-            tokens[0, :t_p] = req.prompt
             self.cache, logits = self._prefill(
-                self.cache, jnp.asarray(tokens),
+                self.cache, jnp.asarray(_padded_window(req.prompt)),
                 jnp.int32(t_p), slot)
         if (req.cache_prefix and self.prefix_cache is not None):
             # store AFTER the slot holds the full prompt's k/v
@@ -1019,12 +1048,9 @@ class ServingEngine:
         return logits
 
     def _admit(self) -> None:
-        import jax.numpy as jnp
-
-        import jax
-
         for slot in range(self.serving.max_slots):
-            if self.slot_req[slot] is not None or not self.queue:
+            if (self.slot_req[slot] is not None
+                    or slot in self._pending or not self.queue):
                 continue
             if not self._can_admit(self.queue[0]):
                 # FCFS: a head-of-queue request that can't take this
@@ -1032,59 +1058,107 @@ class ServingEngine:
                 # overtaking, so big requests can't be starved.
                 break
             req = self.queue.pop(0)
-            t_p = len(req.prompt)
+            if self.serving.prefill_chunk > 0:
+                # chunked prefill: the slot is claimed but inactive;
+                # _advance_prefills feeds one prompt window per
+                # round until the prompt is in, then activates
+                self._pending[slot] = {"req": req, "done": 0}
+                continue
             logits = self._prefill_slot(slot, req)
+            self._activate(slot, req, logits)
 
-            samp = req.sampling or SamplingConfig(temperature=0.0)
-            self.temp = self.temp.at[slot].set(samp.temperature)
-            self.top_k = self.top_k.at[slot].set(samp.top_k)
-            self.top_p = self.top_p.at[slot].set(samp.top_p)
-            self.min_p = self.min_p.at[slot].set(samp.min_p)
-            self.rep_pen = self.rep_pen.at[slot].set(
-                samp.repetition_penalty)
-            # the slot's seen-token set starts as the PROMPT's tokens
-            # (vLLM counts prompt + output for repetition_penalty);
-            # built host-side — one small transfer per admission
-            import numpy as _np
+    def _advance_prefills(self) -> None:
+        """One prompt window per pending slot per scheduling round
+        (the vLLM chunked-prefill scheduler shape): long prompts
+        enter the grid in prefill_chunk-token windows interleaved
+        with the grid's decode chunks, bounding how long any one
+        admission can stall co-tenants' inter-token latency."""
+        import jax.numpy as jnp
 
-            seen_row = _np.zeros((self.cfg.vocab_size,), bool)
-            seen_row[_np.asarray(req.prompt, _np.int64)] = True
-            self.presence = self.presence.at[slot].set(
-                jnp.asarray(seen_row))
-            key = jax.random.PRNGKey(req.seed)
-            self.keys = self.keys.at[slot].set(key)
-            self.prompt_len = self.prompt_len.at[slot].set(t_p)
+        P = self.serving.prefill_chunk
+        for slot in sorted(self._pending):
+            st = self._pending[slot]
+            req, done = st["req"], st["done"]
+            t_p = len(req.prompt)
+            w = min(P, t_p - done)
+            window = jnp.asarray(
+                _padded_window(req.prompt[done:done + w]))
+            if done == 0:
+                # first window: plain prefill write at base 0 (the
+                # cheap no-cache-attention path)
+                self.cache, logits = self._prefill(
+                    self.cache, window, jnp.int32(w), slot)
+            else:
+                # later windows: the suffix kernel — a verify-style
+                # window attending the slot's [0, done) prefix
+                self.cache, logits = self._suffix(
+                    self.cache, window, jnp.int32(w),
+                    jnp.int32(done), slot)
+            st["done"] = done + w
+            if st["done"] >= t_p:
+                del self._pending[slot]
+                self._activate(slot, req, logits)
 
-            # generation 0 comes from the prefill logits, with the
-            # request key folded at index 0 (same recipe the chunk
-            # step uses for every later index)
-            first = int(self._first(
-                logits[None, :],
-                jnp.asarray([samp.temperature], jnp.float32),
-                jnp.asarray([samp.top_k], jnp.int32),
-                jnp.asarray([samp.top_p], jnp.float32),
-                jnp.asarray([samp.min_p], jnp.float32),
-                jnp.asarray([samp.repetition_penalty], jnp.float32),
-                jnp.asarray(seen_row)[None, :],
-                jax.random.fold_in(key, 0)[None, :])[0])
-            # the first token joins the seen set too
-            self.presence = self.presence.at[slot, first].set(True)
-            # TTFT clock: the EARLIEST first-token time survives a
-            # recompute preemption (the user saw that token then)
-            import time as _time
+    def _activate(self, slot: int, req: Request, logits) -> None:
+        """Post-prefill admission: sampling vectors, presence, first
+        token, clocks, slot bookkeeping (shared by the whole-prompt
+        and chunked-prefill paths)."""
+        import jax.numpy as jnp
 
-            clock = self._req_clock.get(req.request_id)
-            if clock is not None and "first" not in clock:
-                clock["first"] = _time.monotonic()
-            self.slot_req[slot] = req
-            self.slot_emitted[slot] = [first]
-            self.lengths = self.lengths.at[slot].set(t_p)
-            self.last_token = self.last_token.at[slot].set(first)
-            active = first != req.eos_id and req.max_new > 1
-            self.active = self.active.at[slot].set(active)
-            self._on_admitted(slot, req, first)
-            if not active:
-                self._finish(slot)
+        import jax
+
+        t_p = len(req.prompt)
+        self._prefill_extras(slot, req)
+        samp = req.sampling or SamplingConfig(temperature=0.0)
+        self.temp = self.temp.at[slot].set(samp.temperature)
+        self.top_k = self.top_k.at[slot].set(samp.top_k)
+        self.top_p = self.top_p.at[slot].set(samp.top_p)
+        self.min_p = self.min_p.at[slot].set(samp.min_p)
+        self.rep_pen = self.rep_pen.at[slot].set(
+            samp.repetition_penalty)
+        # the slot's seen-token set starts as the PROMPT's tokens
+        # (vLLM counts prompt + output for repetition_penalty);
+        # built host-side — one small transfer per admission
+        import numpy as _np
+
+        seen_row = _np.zeros((self.cfg.vocab_size,), bool)
+        seen_row[_np.asarray(req.prompt, _np.int64)] = True
+        self.presence = self.presence.at[slot].set(
+            jnp.asarray(seen_row))
+        key = jax.random.PRNGKey(req.seed)
+        self.keys = self.keys.at[slot].set(key)
+        self.prompt_len = self.prompt_len.at[slot].set(t_p)
+
+        # generation 0 comes from the prefill logits, with the
+        # request key folded at index 0 (same recipe the chunk
+        # step uses for every later index)
+        first = int(self._first(
+            logits[None, :],
+            jnp.asarray([samp.temperature], jnp.float32),
+            jnp.asarray([samp.top_k], jnp.int32),
+            jnp.asarray([samp.top_p], jnp.float32),
+            jnp.asarray([samp.min_p], jnp.float32),
+            jnp.asarray([samp.repetition_penalty], jnp.float32),
+            jnp.asarray(seen_row)[None, :],
+            jax.random.fold_in(key, 0)[None, :])[0])
+        # the first token joins the seen set too
+        self.presence = self.presence.at[slot, first].set(True)
+        # TTFT clock: the EARLIEST first-token time survives a
+        # recompute preemption (the user saw that token then)
+        import time as _time
+
+        clock = self._req_clock.get(req.request_id)
+        if clock is not None and "first" not in clock:
+            clock["first"] = _time.monotonic()
+        self.slot_req[slot] = req
+        self.slot_emitted[slot] = [first]
+        self.lengths = self.lengths.at[slot].set(t_p)
+        self.last_token = self.last_token.at[slot].set(first)
+        active = first != req.eos_id and req.max_new > 1
+        self.active = self.active.at[slot].set(active)
+        self._on_admitted(slot, req, first)
+        if not active:
+            self._finish(slot)
 
     def _retire(self, emitted) -> None:
         import jax
@@ -1151,6 +1225,7 @@ class ServingEngine:
             "active": int(sum(1 for r in self.slot_req
                               if r is not None)),
             "queued": len(self.queue),
+            "pending_prefill": len(self._pending),
             "finished": len(self.finished),
         }
         if self.prefix_cache is not None:
@@ -1278,6 +1353,11 @@ class PagedServingEngine(ServingEngine):
             raise ValueError(
                 f"{type(self).__name__} does not support mesh "
                 "serving yet; use the dense-grid engines")
+        if serving.prefill_chunk > 0:
+            raise ValueError(
+                "chunked prefill is not composed with paged storage "
+                "yet (prompt windows would need per-window block "
+                "scatters); use the dense-grid engines")
         if serving.paged_blocks < 2:
             raise ValueError(
                 "PagedServingEngine needs ServingConfig.paged_blocks"
@@ -1630,21 +1710,17 @@ class SpeculativeServingEngine(ServingEngine):
                 self.params, dparams)
         self.prefix_cache = None
 
-    def _prefill_slot(self, slot: int, req: Request):
-        logits = super()._prefill_slot(slot, req)
+    def _prefill_extras(self, slot: int, req: Request) -> None:
         if self._draft is not None:
             # the draft model's own prompt k/v, same padded bucket
+            # (one dispatch — the draft is small; runs at activation
+            # on both the whole-prompt and chunked admission paths)
             import jax.numpy as jnp
-            import numpy as np
 
-            t_p = len(req.prompt)
-            pad = _bucket(t_p)
-            tokens = np.zeros((1, pad), np.int32)
-            tokens[0, :t_p] = req.prompt
             self.draft_cache, _ = self._draft_prefill(
-                self.draft_cache, jnp.asarray(tokens),
-                jnp.int32(t_p), slot)
-        return logits
+                self.draft_cache,
+                jnp.asarray(_padded_window(req.prompt)),
+                jnp.int32(len(req.prompt)), slot)
 
     def _check_sampling(self, samp: SamplingConfig) -> None:
         if samp.repetition_penalty != 1.0:
@@ -1667,9 +1743,11 @@ class SpeculativeServingEngine(ServingEngine):
         self.total = self.total.at[slot].set(t_p + 1)
 
     def step_round(self) -> None:
-        """Admit, scan spec_windows verify windows for the grid in
-        one dispatch, retire."""
+        """Admit, advance chunked prefills, scan spec_windows verify
+        windows for the grid in one dispatch, retire."""
         self._admit()
+        if self._pending:
+            self._advance_prefills()
         if not any(r is not None for r in self.slot_req):
             return
         sampling_state = self._sampling_state()

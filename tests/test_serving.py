@@ -326,7 +326,7 @@ def test_report_shape(cfg, params):
     eng = serving.ServingEngine(params, cfg, sc)
     rep = eng.report()
     assert rep == {"slots": 2, "active": 0, "queued": 0,
-                   "finished": 0}
+                   "pending_prefill": 0, "finished": 0}
 
 
 # -- speculative decoding inside the grid -----------------------------
@@ -373,6 +373,71 @@ def test_speculative_grid_matches_dense_grid(cfg, params):
     dense = run(serving.ServingEngine)
     spec = run(serving.SpeculativeServingEngine, speculative_k=3)
     assert dense == spec
+
+
+def test_chunked_prefill_matches_whole_prompt(cfg, params):
+    """Chunked prefill (prompts entering in prefill_chunk windows,
+    interleaved with decode rounds) emits exactly the whole-prompt
+    engine's streams — prompt lengths below / at / straddling the
+    window size, greedy and sampled mixed, more requests than
+    slots."""
+    P = 8
+    lens = [3, P, P + 1, 2 * P + 5, 2 * P]
+    reqs = []
+    for i, ln in enumerate(lens):
+        samp = (decode.SamplingConfig(temperature=1.1)
+                if i % 2 else None)
+        reqs.append(serving.Request(
+            f"c{i}", make_prompt(120 + i, ln, cfg.vocab_size),
+            max_new=6, sampling=samp, seed=50 + i))
+
+    def run(**extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                                   **extra)
+        eng = serving.ServingEngine(params, cfg, sc)
+        import dataclasses as _dc
+
+        for r in reqs:
+            eng.submit(_dc.replace(r))
+        return {c.request_id: (c.tokens, c.finish_reason)
+                for c in eng.run()}
+
+    assert run() == run(prefill_chunk=P)
+
+
+def test_chunked_prefill_speculative_engine(cfg, params):
+    """The speculative grid composes with chunked prefill: same
+    streams as its whole-prompt admission."""
+    import dataclasses as _dc
+
+    reqs = [serving.Request(
+        f"s{i}", make_prompt(130 + i, 5 + 4 * i, cfg.vocab_size),
+        max_new=7) for i in range(3)]
+
+    def run(**extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=48,
+                                   speculative_k=3, **extra)
+        eng = serving.SpeculativeServingEngine(params, cfg, sc)
+        for r in reqs:
+            eng.submit(_dc.replace(r))
+        return {c.request_id: tuple(c.tokens) for c in eng.run()}
+
+    assert run() == run(prefill_chunk=8)
+
+
+def test_chunked_prefill_guards(cfg, params):
+    with pytest.raises(ValueError, match="prefix"):
+        serving.ServingEngine(
+            params, cfg,
+            serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                                  prefill_chunk=8,
+                                  prefix_cache_entries=4))
+    with pytest.raises(ValueError, match="paged"):
+        serving.PagedServingEngine(
+            params, cfg,
+            serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                                  paged_blocks=12, block_size=8,
+                                  prefill_chunk=8))
 
 
 def test_min_p_filter_math():
