@@ -218,18 +218,19 @@ def paged_decode_chunk(params, pools, tables, lengths, last_token,
                        cfg: ModelConfig, chunk: int):
     """One scheduling quantum over the paged pool: gather the block
     view once, run the shared chunk scan, scatter the chunk buffer
-    back. Returns (pools, lengths, last_token, emitted, presence)."""
+    back. Returns (pools, lengths, last_token, emitted, presence,
+    lps)."""
     import jax.numpy as jnp
 
     from kind_tpu_sim.models.serving import _chunk_scan
 
     view = gather_view(pools, tables)
-    token, small, emitted, presence = _chunk_scan(
+    token, small, emitted, presence, lps = _chunk_scan(
         params, view, lengths, last_token, active, sampling_state,
         presence, cfg=cfg, chunk=chunk)
     pools = scatter_rows(pools, tables, lengths, small, active)
     lengths = jnp.where(active, lengths + chunk, lengths)
-    return pools, lengths, token, emitted, presence
+    return pools, lengths, token, emitted, presence, lps
 
 
 def paged_suffix(params, pools, tokens, true_len, base, table_row, *,
@@ -430,12 +431,12 @@ def paged_decode_chunk_kernel(params, pools, tables, lengths,
         return _block_decode_kernel(
             x, bparams, cfg, pool_lc, tables, small_lc, lengths, i)
 
-    token, small, emitted, presence = _chunk_scan(
+    token, small, emitted, presence, lps = _chunk_scan(
         params, pools, lengths, last_token, active, sampling_state,
         presence, cfg=cfg, chunk=chunk, block_fn=block_fn)
     pools = scatter_rows(pools, tables, lengths, small, active)
     lengths = jnp.where(active, lengths + chunk, lengths)
-    return pools, lengths, token, emitted, presence
+    return pools, lengths, token, emitted, presence, lps
 
 
 def paged_verify_step(params, pools, tables, out, total, active,

@@ -121,6 +121,14 @@ class Request:
     seed: Optional[int] = None
     cache_prefix: bool = False   # store this prompt's KV for reuse
     #                              by later prefix-sharing requests
+    logprobs: bool = False       # return each generated token's
+    #                              log-probability under the RAW
+    #                              model distribution (log_softmax
+    #                              of the unfiltered fp32 logits —
+    #                              temperature/filter/penalty-
+    #                              independent, comparable across
+    #                              requests; vLLM reports the
+    #                              processed distribution instead)
 
 
 @dataclasses.dataclass
@@ -135,6 +143,9 @@ class Completion:
     # e2e_s = submit -> completion.
     ttft_s: Optional[float] = None
     e2e_s: Optional[float] = None
+    # one raw-model log-probability per generated token, when the
+    # request set logprobs=True (None otherwise)
+    logprobs: Optional[List[float]] = None
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -430,11 +441,18 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
         # an inactive slot's held token must not re-mark itself)
         seen = seen.at[jnp.arange(b), nxt].set(
             seen[jnp.arange(b), nxt] | active)
-        return (nxt, new_small, seen), nxt
+        # raw-model logprob of the chosen token (Completion.logprobs
+        # when requested; a logsumexp over vocab — noise next to the
+        # step's weight read, so it is computed unconditionally)
+        lp = (jnp.take_along_axis(
+                  logits.astype(jnp.float32), nxt[:, None], 1)[:, 0]
+              - jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1))
+        return (nxt, new_small, seen), (nxt, lp)
 
-    (token, small, presence), emitted = jax.lax.scan(
+    (token, small, presence), (emitted, lps) = jax.lax.scan(
         step, (last_token, small0, presence), jnp.arange(chunk))
-    return token, small, emitted.swapaxes(0, 1), presence
+    return (token, small, emitted.swapaxes(0, 1), presence,
+            lps.swapaxes(0, 1))
 
 
 def _decode_chunk(params, cache, lengths, last_token, active,
@@ -449,7 +467,7 @@ def _decode_chunk(params, cache, lengths, last_token, active,
     lengths, last_token, emitted (slots, chunk), presence)."""
     import jax.numpy as jnp
 
-    token, small, emitted, presence = _chunk_scan(
+    token, small, emitted, presence, lps = _chunk_scan(
         params, cache, lengths, last_token, active, sampling_state,
         presence, cfg=cfg, chunk=chunk)
     new_cache = [
@@ -462,7 +480,7 @@ def _decode_chunk(params, cache, lengths, last_token, active,
         for big_lc, small_lc in zip(cache, small)
     ]
     lengths = jnp.where(active, lengths + chunk, lengths)
-    return new_cache, lengths, token, emitted, presence
+    return new_cache, lengths, token, emitted, presence, lps
 
 
 def _suffix_into_slot(params, cache, tokens, true_len, base, slot, *,
@@ -699,6 +717,18 @@ def _jitted_first():
     return jax.jit(_sample_rows)
 
 
+def _jitted_first_lp():
+    """Raw-model logprob of the first token — computed on device,
+    fetched as a scalar (a full vocab-row transfer per admission
+    would violate the file's batched-fetch discipline)."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.jit(
+        lambda logits, tok: jax.nn.log_softmax(
+            logits.astype(jnp.float32))[tok])
+
+
 def _jitted_suffix(cfg: ModelConfig):
     import functools
 
@@ -727,6 +757,7 @@ import functools as _functools
 _jitted_prefill = _functools.lru_cache(maxsize=32)(_jitted_prefill)
 _jitted_chunk = _functools.lru_cache(maxsize=32)(_jitted_chunk)
 _jitted_first = _functools.lru_cache(maxsize=1)(_jitted_first)
+_jitted_first_lp = _functools.lru_cache(maxsize=1)(_jitted_first_lp)
 _jitted_suffix = _functools.lru_cache(maxsize=32)(_jitted_suffix)
 _jitted_read = _functools.lru_cache(maxsize=32)(_jitted_read)
 _jitted_write = _functools.lru_cache(maxsize=1)(_jitted_write)
@@ -850,6 +881,9 @@ class ServingEngine:
         self.queue: List[Request] = []
         self.slot_req: List[Optional[Request]] = [None] * n
         self.slot_emitted: List[List[int]] = [[] for _ in range(n)]
+        # per-slot raw-model logprobs, parallel to slot_emitted
+        # (collected only for requests with logprobs=True)
+        self.slot_lps: List[List[float]] = [[] for _ in range(n)]
         # chunked prefill: slot -> {"req", "done"} for claimed slots
         # whose prompts are still streaming in
         self._pending: Dict[int, Dict[str, Any]] = {}
@@ -911,6 +945,7 @@ class ServingEngine:
 
     def submit(self, request: Request) -> None:
         self._capacity_check(request)
+        self._check_request(request)
         if request.sampling is not None:
             # at submit, not admission: a mid-run() rejection would
             # abandon co-tenants' drains, waste the prefill, and
@@ -946,8 +981,8 @@ class ServingEngine:
             self._advance_prefills()
         if not any(r is not None for r in self.slot_req):
             return
-        emitted = self._decode_round(self._sampling_state())
-        self._retire(emitted)
+        emitted, lps = self._decode_round(self._sampling_state())
+        self._retire(emitted, lps)
 
     def _sampling_state(self):
         """The per-slot sampling-parameter tuple every decode/verify
@@ -973,6 +1008,11 @@ class ServingEngine:
         reject repetition_penalty — the verify window's acceptance
         math has no in-window presence state yet)."""
 
+    def _check_request(self, request: Request) -> None:
+        """Per-engine request-feature gate, at submit (speculative
+        engines reject logprobs — the verify retire does not carry
+        per-window logprob rows yet)."""
+
     def _prefill_extras(self, slot: int, request: Request) -> None:
         """Post-target-prefill hook, run by _activate on BOTH the
         whole-prompt and chunked-prefill admission paths (the
@@ -983,12 +1023,13 @@ class ServingEngine:
         """Post-admission hook (speculative: seed the draft buffer)."""
 
     def _decode_round(self, sampling_state):
-        """Run one chunk over the big cache; returns emitted tokens."""
+        """Run one chunk over the big cache; returns (emitted
+        tokens, their raw-model logprobs)."""
         (self.cache, self.lengths, self.last_token, emitted,
-         self.presence) = self._chunk(self.cache, self.lengths,
-                                      self.last_token, self.active,
-                                      sampling_state, self.presence)
-        return emitted
+         self.presence, lps) = self._chunk(
+            self.cache, self.lengths, self.last_token, self.active,
+            sampling_state, self.presence)
+        return emitted, lps
 
     def poll(self) -> List[Completion]:
         out, self.finished = self.finished, []
@@ -1143,6 +1184,10 @@ class ServingEngine:
             jax.random.fold_in(key, 0)[None, :])[0])
         # the first token joins the seen set too
         self.presence = self.presence.at[slot, first].set(True)
+        self.slot_lps[slot] = []
+        if req.logprobs:
+            self.slot_lps[slot].append(
+                float(_jitted_first_lp()(logits, first)))
         # TTFT clock: the EARLIEST first-token time survives a
         # recompute preemption (the user saw that token then)
         import time as _time
@@ -1160,7 +1205,7 @@ class ServingEngine:
         if not active:
             self._finish(slot)
 
-    def _retire(self, emitted) -> None:
+    def _retire(self, emitted, lps) -> None:
         import jax
         import numpy as np
 
@@ -1168,7 +1213,8 @@ class ServingEngine:
         # remote-tunnel platforms each transfer is its own ~50ms RTT
         # (tools/spec_profile.py measured 8 per-slot active fetches
         # at ~0.4s/round — half the serving engine's wall time).
-        emitted, active_h = jax.device_get((emitted, self.active))
+        emitted, lps_h, active_h = jax.device_get(
+            (emitted, lps, self.active))
         emitted = np.asarray(emitted)
         for slot, req in enumerate(self.slot_req):
             if req is None or not bool(active_h[slot]):
@@ -1179,6 +1225,9 @@ class ServingEngine:
             if req.eos_id is not None and req.eos_id in new:
                 new = new[:new.index(req.eos_id) + 1]
             have.extend(new)
+            if req.logprobs:
+                self.slot_lps[slot].extend(
+                    float(v) for v in lps_h[slot, :len(new)])
             if (len(have) >= req.max_new or
                     (req.eos_id is not None and
                      have[-1] == req.eos_id)):
@@ -1204,9 +1253,12 @@ class ServingEngine:
         self.finished.append(Completion(
             request_id=req.request_id, prompt=list(req.prompt),
             tokens=list(toks), finish_reason=reason,
-            ttft_s=ttft, e2e_s=e2e))
+            ttft_s=ttft, e2e_s=e2e,
+            logprobs=(list(self.slot_lps[slot][:len(toks)])
+                      if req.logprobs else None)))
         self.slot_req[slot] = None
         self.slot_emitted[slot] = []
+        self.slot_lps[slot] = []
         self.active = self.active.at[slot].set(False)
         # Reset the slot's sampling params: a stale temp > 0 (or
         # penalty/min-p) on an idle slot would keep the all-default
@@ -1495,6 +1547,7 @@ class PagedServingEngine(ServingEngine):
         self.queue.insert(0, req)
         self.slot_req[slot] = None
         self.slot_emitted[slot] = []
+        self.slot_lps[slot] = []
         self.active = self.active.at[slot].set(False)
         self.temp = self.temp.at[slot].set(0.0)
         self.top_k = self.top_k.at[slot].set(0)
@@ -1570,15 +1623,17 @@ class PagedServingEngine(ServingEngine):
 
         # preemption may have emptied the grid mid-round
         if not any(r is not None for r in self.slot_req):
-            return np.zeros((self.serving.max_slots, chunk),
-                            np.int32)
+            return (np.zeros((self.serving.max_slots, chunk),
+                             np.int32),
+                    np.zeros((self.serving.max_slots, chunk),
+                             np.float32))
 
         (self.pools, self.lengths, self.last_token, emitted,
-         self.presence) = self._paged_chunk(
+         self.presence, lps) = self._paged_chunk(
             self.pools, jnp.asarray(tables), self.lengths,
             self.last_token, self.active, sampling_state,
             self.presence)
-        return emitted
+        return emitted, lps
 
     def _finish(self, slot: int) -> None:
         super()._finish(slot)
@@ -1730,6 +1785,13 @@ class SpeculativeServingEngine(ServingEngine):
                 "acceptance math has no in-window presence state); "
                 "use the chunked engines")
 
+    def _check_request(self, request: Request) -> None:
+        if request.logprobs:
+            raise ValueError(
+                "logprobs is not supported by the speculative "
+                "engines yet (the verify retire does not carry "
+                "per-window logprob rows); use the chunked engines")
+
     def _on_admitted(self, slot: int, request: Request,
                      first: int) -> None:
         import jax.numpy as jnp
@@ -1872,6 +1934,7 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
     _on_admitted = SpeculativeServingEngine._on_admitted
     _spec_retire = SpeculativeServingEngine._spec_retire
     _check_sampling = SpeculativeServingEngine._check_sampling
+    _check_request = SpeculativeServingEngine._check_request
 
     def report(self) -> Dict[str, Any]:
         out = super().report()  # paged stats + prefix cache
