@@ -750,6 +750,70 @@ def model_throughput(emit=None) -> dict | None:
                     str(exc)[:100]
             _note()
 
+            def run_longprompt(key: str, **cfg_extra):
+                """Chunked prefill's POSITIVE regime, measured: short
+                co-tenants decode while a LONG prompt admits. One
+                768-token request enters a busy grid of short
+                requests; the short requests' e2e latency is the
+                number that moves — whole-prompt admission stalls
+                their decode for the entire long prefill dispatch,
+                window admission interleaves."""
+                t_sec = time.monotonic()
+                LONG = 768  # the one copy: warm slice, submit
+                #             slice, and the reported field
+                sp_l = decode.serving_params(params, cfg)
+                sc = serving.ServingConfig(max_slots=batch,
+                                           max_len=1024, chunk=64,
+                                           **cfg_extra)
+                eng = serving.ServingEngine(sp_l, cfg, sc)
+                # warm both prompt buckets + chunk/suffix traces
+                eng.submit(serving.Request(
+                    "warm", np.asarray(tokens[0, :256]).tolist(), 2))
+                eng.submit(serving.Request(
+                    "warmL", np.asarray(
+                        (tokens[0, :LONG] + 1)
+                        % cfg.vocab_size).tolist(), 2))
+                eng.run()
+                eng.reset_latency()
+                # short cohort first, long request arrives behind it
+                for i in range(batch):
+                    eng.submit(serving.Request(
+                        f"{key}s{i}",
+                        np.asarray(tokens[0, :224]).tolist(), 96))
+                eng.submit(serving.Request(
+                    f"{key}L",
+                    np.asarray(tokens[0, :LONG]).tolist(), 64))
+                t0 = time.monotonic()
+                done = {c.request_id: c for c in eng.run()}
+                wall = time.monotonic() - t0
+                shorts = [c for rid, c in done.items()
+                          if rid != f"{key}L"]
+                e2es = sorted(c.e2e_s for c in shorts)
+                result[key] = {
+                    "short_requests": len(shorts),
+                    "long_prompt": LONG,
+                    "wall_s": round(wall, 2),
+                    "short_e2e_p50_s": round(
+                        e2es[len(e2es) // 2], 3),
+                    "short_e2e_max_s": round(e2es[-1], 3),
+                    "long_ttft_s": round(
+                        done[f"{key}L"].ttft_s, 3),
+                }
+                SECTION_S[key] = round(time.monotonic() - t_sec, 1)
+
+            try:
+                run_longprompt("serving_longprompt")
+            except Exception as exc:  # pragma: no cover
+                result["serving_longprompt_error"] = str(exc)[:100]
+            _note()
+            try:
+                run_longprompt("serving_longprompt_chunked",
+                               prefill_chunk=64)
+            except Exception as exc:  # pragma: no cover
+                result["serving_longprompt_chunked_error"] = \
+                    str(exc)[:100]
+            _note()
+
             # Paged-KV engine, both attention tiers, over the SAME
             # request stream. Gather tier: the memory model costs ~2
             # pool passes per chunk (view + scatter-back) — this
