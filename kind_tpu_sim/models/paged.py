@@ -447,8 +447,8 @@ def paged_verify_step(params, pools, tables, out, total, active,
     forward against it, scatter the window's k/v into each slot's
     own blocks at its base, and run the shared accept/emit
     (speculative._accept_and_emit — greedy argmax and rejection-
-    sampled acceptance both). Returns (pools, out, total, emit, m).
-    """
+    sampled acceptance both). Returns (pools, out, total, emit, m,
+    lp)."""
     from kind_tpu_sim.models.speculative import (
         _accept_and_emit,
         _window_forward,
@@ -460,9 +460,9 @@ def paged_verify_step(params, pools, tables, out, total, active,
     # window k/v land at each slot's own positions base..base+k —
     # scatter_rows' per-slot starts; inactive slots write garbage
     pools = scatter_rows(pools, tables, base, rows, active)
-    out, total, emit, m = _accept_and_emit(
+    out, total, emit, m, lp = _accept_and_emit(
         logits, draft, out, total, active, sampling_state, k=k)
-    return pools, out, total, emit, m
+    return pools, out, total, emit, m, lp
 
 
 def paged_verify_scan(params, pools, tables, out, total, active,
@@ -479,20 +479,20 @@ def paged_verify_scan(params, pools, tables, out, total, active,
     step_round), so in-scan writes never outrun the table; each
     window re-gathers the view because the pools advanced.
 
-    Returns (pools, out, total, emits (W, b, k+1), ms (W, b)).
-    """
+    Returns (pools, out, total, emits (W, b, k+1), ms (W, b),
+    lps (W, b, k+1))."""
     import jax
 
     def body(carry, _):
         pools, out, total = carry
-        pools, out, total, emit, m = paged_verify_step(
+        pools, out, total, emit, m, lp = paged_verify_step(
             params, pools, tables, out, total, active,
             sampling_state, cfg=cfg, k=k)
-        return (pools, out, total), (emit, m)
+        return (pools, out, total), (emit, m, lp)
 
-    (pools, out, total), (emits, ms) = jax.lax.scan(
+    (pools, out, total), (emits, ms, lps) = jax.lax.scan(
         body, (pools, out, total), None, length=windows)
-    return pools, out, total, emits, ms
+    return pools, out, total, emits, ms, lps
 
 
 # ---------------------------------------------------------------------

@@ -228,6 +228,19 @@ def _prefill_into_slot(params, cache, tokens, true_len, slot, *,
     return new_cache, logits[0].astype(jnp.float32)
 
 
+def _raw_token_lp(logits, toks):
+    """log_softmax of the RAW fp32 logits at the chosen tokens —
+    THE one copy of the logprob convention (raw-model distribution,
+    filter/penalty/temperature-independent; Completion.logprobs).
+    logits (..., vocab), toks (...) int -> (...) fp32."""
+    import jax
+    import jax.numpy as jnp
+
+    lg = logits.astype(jnp.float32)
+    return (jnp.take_along_axis(lg, toks[..., None], -1)[..., 0]
+            - jax.nn.logsumexp(lg, axis=-1))
+
+
 def _apply_rep_penalty(logits, rep_pen, presence):
     """HF/vLLM-style repetition penalty per row: logits of tokens
     already seen (prompt or output — ``presence`` (b, vocab) bool)
@@ -444,9 +457,7 @@ def _chunk_scan(params, big_cache, lengths, last_token, active,
         # raw-model logprob of the chosen token (Completion.logprobs
         # when requested; a logsumexp over vocab — noise next to the
         # step's weight read, so it is computed unconditionally)
-        lp = (jnp.take_along_axis(
-                  logits.astype(jnp.float32), nxt[:, None], 1)[:, 0]
-              - jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1))
+        lp = _raw_token_lp(logits, nxt)
         return (nxt, new_small, seen), (nxt, lp)
 
     (token, small, presence), (emitted, lps) = jax.lax.scan(
@@ -725,8 +736,8 @@ def _jitted_first_lp():
     import jax.numpy as jnp
 
     return jax.jit(
-        lambda logits, tok: jax.nn.log_softmax(
-            logits.astype(jnp.float32))[tok])
+        lambda logits, tok: _raw_token_lp(
+            logits[None], jnp.asarray(tok)[None])[0])
 
 
 def _jitted_suffix(cfg: ModelConfig):
@@ -901,6 +912,7 @@ class ServingEngine:
         self._lat_count = 0
         self._lat_ttft_max = 0.0
         self._lat_e2e_max = 0.0
+        self._lat_itl_max = 0.0
         self._first = _jitted_first()
         self._init_storage()
 
@@ -1009,9 +1021,7 @@ class ServingEngine:
         math has no in-window presence state yet)."""
 
     def _check_request(self, request: Request) -> None:
-        """Per-engine request-feature gate, at submit (speculative
-        engines reject logprobs — the verify retire does not carry
-        per-window logprob rows yet)."""
+        """Per-engine request-feature gate, at submit."""
 
     def _prefill_extras(self, slot: int, request: Request) -> None:
         """Post-target-prefill hook, run by _activate on BOTH the
@@ -1246,10 +1256,20 @@ class ServingEngine:
         if clock is not None and "submit" in clock:
             ttft = round(clock.get("first", now) - clock["submit"], 6)
             e2e = round(now - clock["submit"], 6)
-            self._lat_window.append((ttft, e2e))
+            # mean inter-token latency: decode time spread over the
+            # post-first tokens (the vLLM ITL observable — how
+            # smoothly tokens flowed after the first). Single-token
+            # completions have NO inter-token interval: they carry
+            # None and are excluded from the distribution (a 0.0
+            # sample would drag itl_p50 toward zero).
+            itl = ((e2e - ttft) / (len(toks) - 1)
+                   if len(toks) > 1 else None)
+            self._lat_window.append((ttft, e2e, itl))
             self._lat_count += 1
             self._lat_ttft_max = max(self._lat_ttft_max, ttft)
             self._lat_e2e_max = max(self._lat_e2e_max, e2e)
+            if itl is not None:
+                self._lat_itl_max = max(self._lat_itl_max, itl)
         self.finished.append(Completion(
             request_id=req.request_id, prompt=list(req.prompt),
             tokens=list(toks), finish_reason=reason,
@@ -1283,8 +1303,10 @@ class ServingEngine:
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.report()
         if self._lat_count:
-            ttfts = sorted(t for t, _ in self._lat_window)
-            e2es = sorted(e for _, e in self._lat_window)
+            ttfts = sorted(t for t, _, _ in self._lat_window)
+            e2es = sorted(e for _, e, _ in self._lat_window)
+            itls = sorted(i for _, _, i in self._lat_window
+                          if i is not None)
             out["latency"] = {
                 "completed": self._lat_count,
                 "ttft_p50_s": round(ttfts[len(ttfts) // 2], 4),
@@ -1292,6 +1314,11 @@ class ServingEngine:
                 "e2e_p50_s": round(e2es[len(e2es) // 2], 4),
                 "e2e_max_s": round(self._lat_e2e_max, 4),
             }
+            if itls:
+                out["latency"]["itl_p50_s"] = round(
+                    itls[len(itls) // 2], 4)
+                out["latency"]["itl_max_s"] = round(
+                    self._lat_itl_max, 4)
         return out
 
     def reset_latency(self) -> None:
@@ -1301,6 +1328,7 @@ class ServingEngine:
         self._lat_count = 0
         self._lat_ttft_max = 0.0
         self._lat_e2e_max = 0.0
+        self._lat_itl_max = 0.0
 
 
 def _jitted_paged_prefill(cfg: ModelConfig):
@@ -1785,13 +1813,6 @@ class SpeculativeServingEngine(ServingEngine):
                 "acceptance math has no in-window presence state); "
                 "use the chunked engines")
 
-    def _check_request(self, request: Request) -> None:
-        if request.logprobs:
-            raise ValueError(
-                "logprobs is not supported by the speculative "
-                "engines yet (the verify retire does not carry "
-                "per-window logprob rows); use the chunked engines")
-
     def _on_admitted(self, slot: int, request: Request,
                      first: int) -> None:
         import jax.numpy as jnp
@@ -1814,31 +1835,33 @@ class SpeculativeServingEngine(ServingEngine):
             return
         sampling_state = self._sampling_state()
         if self._draft is None:
-            (self.cache, self.out, self.total, emits,
-             ms) = self._spec_step(self.cache, self.out, self.total,
-                                   self.active, sampling_state)
+            (self.cache, self.out, self.total, emits, ms,
+             lps) = self._spec_step(self.cache, self.out,
+                                    self.total, self.active,
+                                    sampling_state)
         else:
             (self.cache, self.draft_cache, self.out, self.total,
-             emits, ms) = self._spec_step(
+             emits, ms, lps) = self._spec_step(
                 self.cache, self.draft_cache, self.out, self.total,
                 self.active, sampling_state)
-        self._spec_retire(emits, ms)
+        self._spec_retire(emits, ms, lps)
 
-    def _spec_retire(self, emits, ms) -> None:
+    def _spec_retire(self, emits, ms, lps) -> None:
         """Ragged per-slot retirement after a scanned verify
         dispatch: each active slot takes its accepted-prefix+bonus
-        tokens per window, budget- and eos-truncated on host like the
-        chunk engine's retire. ``emits``/``ms`` are stacked
-        (W, b, k+1)/(W, b); a slot that finished in window w has its
-        later windows' surplus tokens discarded here (they were junk
-        by construction)."""
+        tokens (and, for logprobs requests, their raw-model
+        logprobs) per window, budget- and eos-truncated on host like
+        the chunk engine's retire. ``emits``/``ms``/``lps`` are
+        stacked (W, b, k+1)/(W, b)/(W, b, k+1); a slot that finished
+        in window w has its later windows' surplus tokens discarded
+        here (they were junk by construction)."""
         import jax
 
         # One batched device_get for everything the host loop needs —
         # separate np.asarray calls (and per-slot active indexing) are
         # one tunnel RTT EACH (tools/spec_profile.py).
-        emit_h, m_h, active_h = jax.device_get(
-            (emits, ms, self.active))
+        emit_h, m_h, lps_h, active_h = jax.device_get(
+            (emits, ms, lps, self.active))
         W = emit_h.shape[0]
         # verify_steps counts USEFUL windows (those that delivered at
         # least one token to some slot), not the scan length: junk
@@ -1859,6 +1882,10 @@ class SpeculativeServingEngine(ServingEngine):
                 if req.eos_id is not None and req.eos_id in new:
                     new = new[:new.index(req.eos_id) + 1]
                 have.extend(new)
+                if req.logprobs:
+                    self.slot_lps[slot].extend(
+                        float(v)
+                        for v in lps_h[w, slot, :len(new)])
                 used = max(used, w + 1)
                 if (req.eos_id is not None and have and
                         have[-1] == req.eos_id):
@@ -1934,7 +1961,6 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
     _on_admitted = SpeculativeServingEngine._on_admitted
     _spec_retire = SpeculativeServingEngine._spec_retire
     _check_sampling = SpeculativeServingEngine._check_sampling
-    _check_request = SpeculativeServingEngine._check_request
 
     def report(self) -> Dict[str, Any]:
         out = super().report()  # paged stats + prefix cache
@@ -1961,11 +1987,11 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
         if not any(r is not None for r in self.slot_req):
             return  # preemption emptied the grid
         sampling_state = self._sampling_state()
-        (self.pools, self.out, self.total, emits,
-         ms) = self._spec_step(self.pools, jnp.asarray(tables),
-                               self.out, self.total, self.active,
-                               sampling_state)
-        self._spec_retire(emits, ms)
+        (self.pools, self.out, self.total, emits, ms,
+         lps) = self._spec_step(self.pools, jnp.asarray(tables),
+                                self.out, self.total, self.active,
+                                sampling_state)
+        self._spec_retire(emits, ms, lps)
 
 
 def engines_report(cfg: ModelConfig = None) -> Dict[str, Any]:

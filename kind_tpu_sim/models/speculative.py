@@ -252,7 +252,7 @@ def _verify_step(params, cache, out, total, *, cfg: ModelConfig,
     # shared greedy acceptance/emission (all rows active, no
     # sampling state) — ONE copy of the accept math for every
     # speculative path
-    out, total, _, m = _accept_and_emit(
+    out, total, _, m, _lp = _accept_and_emit(
         logits, draft, out, total, jnp.ones((b,), bool), None, k=k)
     return new_cache, out, total, m
 
@@ -315,9 +315,9 @@ def _grid_verify_step(params, cache, out, total, active,
     ``sampling_state`` carries per-slot SamplingParams, rejection-
     sampled acceptance for temp > 0 slots (greedy argmax acceptance
     otherwise; the two mix freely in one grid). Returns
-    (cache, out, total, emit (b, k+1), m) where row b's real new
-    tokens this step are emit[b, :m[b]+1] (accepted drafts + bonus).
-    """
+    (cache, out, total, emit (b, k+1), m, lp (b, k+1)) — row b's
+    real new tokens this step are emit[b, :m[b]+1] (accepted drafts
+    + bonus), lp their raw-model logprobs."""
     draft, base, logits, rows = _window_forward(
         params, cache, out, total, cfg=cfg, k=k, draft=draft)
     new_cache = [
@@ -327,9 +327,9 @@ def _grid_verify_step(params, cache, out, total, active,
         }
         for layer_cache, r in zip(cache, rows)
     ]
-    out, total, emit, m = _accept_and_emit(
+    out, total, emit, m, lp = _accept_and_emit(
         logits, draft, out, total, active, sampling_state, k=k)
-    return new_cache, out, total, emit, m
+    return new_cache, out, total, emit, m, lp
 
 
 def _window_forward(params, cache_like, out, total, *,
@@ -371,7 +371,9 @@ def _accept_and_emit(logits, draft, out, total, active,
     paged storage): greedy argmax acceptance, rejection-sampled
     acceptance for temp > 0 slots when sampling_state is given, emit
     window construction, and the out/total update (active-masked).
-    Returns (out, total, emit (b, k+1), m)."""
+    Returns (out, total, emit (b, k+1), m, lp (b, k+1)) — lp is the
+    raw-model log_softmax at each emitted window token (positions
+    past m are junk, like emit's; Completion.logprobs material)."""
     import jax
     import jax.numpy as jnp
 
@@ -444,7 +446,10 @@ def _accept_and_emit(logits, draft, out, total, active,
                                 jnp.clip(total, 0, L - (k + 1)))
     out = jnp.where(active[:, None], new_out, out)
     total = jnp.where(active, total + m + 1, total)
-    return out, total, emit, m
+    from kind_tpu_sim.models.serving import _raw_token_lp
+
+    lp = _raw_token_lp(logits, emit)
+    return out, total, emit, m, lp
 
 
 def _grid_verify_scan(params, cache, out, total, active,
@@ -466,20 +471,20 @@ def _grid_verify_scan(params, cache, out, total, active,
     computing until the scan ends (its surplus tokens are discarded
     by the host's budget/eos truncation, so streams stay exact).
 
-    Returns (cache, out, total, emits (W, b, k+1), ms (W, b)).
-    """
+    Returns (cache, out, total, emits (W, b, k+1), ms (W, b),
+    lps (W, b, k+1))."""
     import jax
 
     def body(carry, _):
         cache, out, total = carry
-        cache, out, total, emit, m = _grid_verify_step(
+        cache, out, total, emit, m, lp = _grid_verify_step(
             params, cache, out, total, active, sampling_state,
             cfg=cfg, k=k)
-        return (cache, out, total), (emit, m)
+        return (cache, out, total), (emit, m, lp)
 
-    (cache, out, total), (emits, ms) = jax.lax.scan(
+    (cache, out, total), (emits, ms, lps) = jax.lax.scan(
         body, (cache, out, total), None, length=windows)
-    return cache, out, total, emits, ms
+    return cache, out, total, emits, ms, lps
 
 
 def _jitted_grid_scan(cfg: ModelConfig, k: int, windows: int):
@@ -509,22 +514,23 @@ def _grid_draft_verify_scan(params, draft_params, cache, draft_cache,
     exactness contracts carry over verbatim.
 
     Returns (cache, draft_cache, out, total, emits (W, b, k+1),
-    ms (W, b)).
-    """
+    ms (W, b), lps (W, b, k+1))."""
     import jax
 
     def body(carry, _):
         cache, draft_cache, out, total = carry
         draft, draft_cache = _draft_propose(
             draft_params, draft_cache, out, total, dcfg=dcfg, k=k)
-        cache, out, total, emit, m = _grid_verify_step(
+        cache, out, total, emit, m, lp = _grid_verify_step(
             params, cache, out, total, active, sampling_state,
             cfg=cfg, k=k, draft=draft)
-        return (cache, draft_cache, out, total), (emit, m)
+        return (cache, draft_cache, out, total), (emit, m, lp)
 
-    (cache, draft_cache, out, total), (emits, ms) = jax.lax.scan(
-        body, (cache, draft_cache, out, total), None, length=windows)
-    return cache, draft_cache, out, total, emits, ms
+    (cache, draft_cache, out, total), (emits, ms,
+                                       lps) = jax.lax.scan(
+        body, (cache, draft_cache, out, total), None,
+        length=windows)
+    return cache, draft_cache, out, total, emits, ms, lps
 
 
 def _jitted_grid_draft_scan(cfg: ModelConfig, dcfg: ModelConfig,
@@ -664,7 +670,7 @@ def _draft_verify_step(params, draft_params, cache, draft_cache,
         for lc, r in zip(cache, rows)
     ]
     b, _ = out.shape
-    out, total, _, m = _accept_and_emit(
+    out, total, _, m, _lp = _accept_and_emit(
         logits, draft, out, total, jnp.ones((b,), bool), None, k=k)
     return new_cache, draft_cache, out, total, m
 

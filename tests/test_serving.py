@@ -430,14 +430,39 @@ def test_logprobs_match_reference(cfg, params):
         assert done["co"].logprobs is None
 
 
-def test_spec_engines_reject_logprobs(cfg, params):
-    sc = serving.ServingConfig(max_slots=2, max_len=48,
-                               speculative_k=3)
-    eng = serving.SpeculativeServingEngine(params, cfg, sc)
-    with pytest.raises(ValueError, match="logprobs"):
-        eng.submit(serving.Request(
-            "l", make_prompt(57, 5, cfg.vocab_size), max_new=4,
-            logprobs=True))
+def test_spec_engine_logprobs_match_dense(cfg, params):
+    """Logprobs through the speculative engines: identical tokens
+    to the dense grid, logprobs equal at bf16 tolerance (the verify
+    window computes them from the same raw logits that drive
+    acceptance), through grid and paged storage."""
+    prompt = make_prompt(57, 6, cfg.vocab_size)
+
+    def run(make):
+        eng = make()
+        eng.submit(serving.Request("l", prompt, max_new=6,
+                                   logprobs=True))
+        return {c.request_id: c for c in eng.run()}["l"]
+
+    dense = run(lambda: serving.ServingEngine(
+        params, cfg,
+        serving.ServingConfig(max_slots=2, max_len=48, chunk=8)))
+    spec = run(lambda: serving.SpeculativeServingEngine(
+        params, cfg,
+        serving.ServingConfig(max_slots=2, max_len=48,
+                              speculative_k=3)))
+    paged_spec = run(lambda: serving.PagedSpeculativeServingEngine(
+        params, cfg,
+        serving.ServingConfig(max_slots=2, max_len=48,
+                              speculative_k=3, paged_blocks=14,
+                              block_size=8)))
+    assert spec.tokens == dense.tokens
+    assert paged_spec.tokens == dense.tokens
+    assert len(spec.logprobs) == len(dense.tokens)
+    assert len(paged_spec.logprobs) == len(dense.tokens)
+    np.testing.assert_allclose(spec.logprobs, dense.logprobs,
+                               atol=2e-2)
+    np.testing.assert_allclose(paged_spec.logprobs, dense.logprobs,
+                               atol=2e-2)
 
 
 def test_chunked_prefill_matches_whole_prompt(cfg, params):

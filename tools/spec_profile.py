@@ -83,9 +83,9 @@ def main() -> int:
     for _ in range(n):
         sampling_state = eng._sampling_state()
         t0 = time.monotonic()
-        (eng.cache, eng.out, eng.total, emit,
-         m) = eng._spec_step(eng.cache, eng.out, eng.total,
-                             eng.active, sampling_state)
+        (eng.cache, eng.out, eng.total, emit, m,
+         _lps) = eng._spec_step(eng.cache, eng.out, eng.total,
+                                eng.active, sampling_state)
         T["dispatch"] += time.monotonic() - t0
         t0 = time.monotonic()
         emit_h = np.asarray(emit)
