@@ -943,12 +943,6 @@ class ServingEngine:
             _jitted_chunk(cfg, serving.chunk), self.params)
         self._suffix = functools.partial(_jitted_suffix(cfg),
                                          self.params)
-        if (serving.prefill_chunk > 0
-                and serving.prefix_cache_entries > 0):
-            raise ValueError(
-                "chunked prefill does not compose with the prefix "
-                "cache yet (store/lookup assume whole-prompt "
-                "admission); pick one")
         self.prefix_cache = (
             PrefixCache(serving.prefix_cache_entries)
             if serving.prefix_cache_entries > 0 else None)
@@ -1065,19 +1059,10 @@ class ServingEngine:
         import jax.numpy as jnp
 
         t_p = len(req.prompt)
-        hit = None
-        if self.prefix_cache is not None:
-            # feasibility lives in lookup(): infeasible entries
-            # aren't counted as hits and a shorter stored prefix
-            # that fits is preferred
-            hit = self.prefix_cache.lookup(
-                req.prompt, max_len=self.serving.max_len)
-        if hit is not None:
-            # prefix-cache admission: device-copy the stored
-            # rows, run ONLY the suffix through the model
-            p = hit["len"]
-            self.cache = _jitted_write()(self.cache, hit["kv"],
-                                         slot)
+        p = self._restore_prefix(slot, req)
+        if p > 0:
+            # prefix-cache admission: stored rows are in; run ONLY
+            # the suffix through the model
             suffix = req.prompt[p:]
             self.cache, logits = self._suffix(
                 self.cache, jnp.asarray(_padded_window(suffix)),
@@ -1086,17 +1071,39 @@ class ServingEngine:
             self.cache, logits = self._prefill(
                 self.cache, jnp.asarray(_padded_window(req.prompt)),
                 jnp.int32(t_p), slot)
-        if (req.cache_prefix and self.prefix_cache is not None):
-            # store AFTER the slot holds the full prompt's k/v
-            # (either admission path), padded to a bucket so the
-            # readback kernel traces per bucket, not per length
-            bucket = min(_bucket(t_p), self.serving.max_len)
-            self.prefix_cache.store(req.prompt, {
-                "kv": _jitted_read(bucket)(self.cache, slot),
-                "len": t_p,
-                "pad": bucket,
-            })
+        self._store_prefix(slot, req)
         return logits
+
+    def _restore_prefix(self, slot: int, req: Request) -> int:
+        """Device-copy the longest usable stored prefix of the
+        request's prompt into ``slot`` (THE one copy of the hit-
+        restore recipe — whole-prompt and chunked admission both);
+        returns the restored length (0 = miss/no cache). Feasibility
+        lives in lookup(): infeasible entries aren't counted as hits
+        and a shorter stored prefix that fits is preferred."""
+        if self.prefix_cache is None:
+            return 0
+        hit = self.prefix_cache.lookup(
+            req.prompt, max_len=self.serving.max_len)
+        if hit is None:
+            return 0
+        self.cache = _jitted_write()(self.cache, hit["kv"], slot)
+        return hit["len"]
+
+    def _store_prefix(self, slot: int, req: Request) -> None:
+        """Store the slot's full-prompt k/v for prefix sharing (THE
+        one copy of the store recipe). Call AFTER the slot holds the
+        whole prompt — either admission path; padded to a bucket so
+        the readback kernel traces per bucket, not per length."""
+        if not (req.cache_prefix and self.prefix_cache is not None):
+            return
+        t_p = len(req.prompt)
+        bucket = min(_bucket(t_p), self.serving.max_len)
+        self.prefix_cache.store(req.prompt, {
+            "kv": _jitted_read(bucket)(self.cache, slot),
+            "len": t_p,
+            "pad": bucket,
+        })
 
     def _admit(self) -> None:
         for slot in range(self.serving.max_slots):
@@ -1112,8 +1119,14 @@ class ServingEngine:
             if self.serving.prefill_chunk > 0:
                 # chunked prefill: the slot is claimed but inactive;
                 # _advance_prefills feeds one prompt window per
-                # round until the prompt is in, then activates
-                self._pending[slot] = {"req": req, "done": 0}
+                # round until the prompt is in, then activates.
+                # A prefix-cache hit fast-forwards the progress
+                # cursor — the stored rows are device-copied in and
+                # only the remaining suffix streams in windows.
+                self._pending[slot] = {
+                    "req": req,
+                    "done": self._restore_prefix(slot, req),
+                }
                 continue
             logits = self._prefill_slot(slot, req)
             self._activate(slot, req, logits)
@@ -1147,6 +1160,7 @@ class ServingEngine:
                     jnp.int32(done), slot)
             st["done"] = done + w
             if st["done"] >= t_p:
+                self._store_prefix(slot, req)
                 del self._pending[slot]
                 self._activate(slot, req, logits)
 
@@ -1747,10 +1761,6 @@ class SpeculativeServingEngine(ServingEngine):
                 "SpeculativeServingEngine ignores paged_blocks/"
                 "paged_kernel; speculation over the paged pool is "
                 "not composed yet")
-        if serving.prefix_cache_entries > 0:
-            raise ValueError(
-                "prefix caching is not supported with the "
-                "speculative engine yet")
         n = serving.max_slots
         W = serving.spec_windows
         # + W*(k+1) rows: each of the W scanned windows can advance a
@@ -1791,7 +1801,14 @@ class SpeculativeServingEngine(ServingEngine):
             self._spec_step = functools.partial(
                 _jitted_grid_draft_scan(cfg, dcfg, k, W),
                 self.params, dparams)
-        self.prefix_cache = None
+        # Prefix caching composes: storage is the same slot grid
+        # (just with W*(k+1) extra rows), the read/write row kernels
+        # are row-count-agnostic, and the verify window attends
+        # cache rows < base regardless of how they were written —
+        # a restored prefix is indistinguishable from a prefilled one
+        self.prefix_cache = (
+            PrefixCache(serving.prefix_cache_entries)
+            if serving.prefix_cache_entries > 0 else None)
 
     def _prefill_extras(self, slot: int, req: Request) -> None:
         if self._draft is not None:

@@ -516,18 +516,88 @@ def test_chunked_prefill_speculative_engine(cfg, params):
 
 
 def test_chunked_prefill_guards(cfg, params):
-    with pytest.raises(ValueError, match="prefix"):
-        serving.ServingEngine(
-            params, cfg,
-            serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
-                                  prefill_chunk=8,
-                                  prefix_cache_entries=4))
     with pytest.raises(ValueError, match="paged"):
         serving.PagedServingEngine(
             params, cfg,
             serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
                                   paged_blocks=12, block_size=8,
                                   prefill_chunk=8))
+
+
+def _prefix_stream(engine_cls, params, cfg, reqs, **extra):
+    """Run a shared-prefix request stream; returns (streams dict,
+    prefix-cache hit count)."""
+    import dataclasses as _dc
+
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                               prefix_cache_entries=4, **extra)
+    eng = engine_cls(params, cfg, sc)
+    for r in reqs:
+        eng.submit(_dc.replace(r))
+    out = {c.request_id: tuple(c.tokens) for c in eng.run()}
+    hits = (eng.prefix_cache.hits
+            if eng.prefix_cache is not None else 0)
+    return out, hits
+
+
+@pytest.mark.parametrize("chunked", [False, True])
+def test_prefix_cache_composes_with_speculative(cfg, params,
+                                                chunked):
+    """Prefix caching in the speculative grid engines: a stored
+    prompt's KV restores into the (wider) spec slot grid, the verify
+    windows attend it exactly like prefilled rows — streams equal
+    the dense engine's, with real hits. Parametrized over chunked
+    prefill so the TRIPLE composition (speculative + chunked +
+    prefix cache) is pinned too."""
+    extra = {"prefill_chunk": 8} if chunked else {}
+    shared = make_prompt(140, 12, cfg.vocab_size)
+    reqs = [
+        serving.Request("store", shared, max_new=5,
+                        cache_prefix=True),
+        serving.Request("reuse", shared + [3, 5], max_new=5),
+        serving.Request("other", make_prompt(141, 9, cfg.vocab_size),
+                        max_new=5),
+    ]
+    dense, dense_hits = _prefix_stream(serving.ServingEngine,
+                                       params, cfg, reqs)
+    spec, spec_hits = _prefix_stream(
+        serving.SpeculativeServingEngine, params, cfg, reqs,
+        speculative_k=3, **extra)
+    assert dense == spec
+    assert dense_hits >= 1
+    if not chunked:
+        # chunked admission claims both same-round slots before the
+        # store exists (the vLLM-APC race) — hits only guaranteed
+        # for whole-prompt admission here
+        assert spec_hits >= 1
+
+
+def test_prefix_cache_composes_with_chunked_prefill(cfg, params):
+    """Chunked prefill + prefix cache: a hit fast-forwards the
+    window cursor (only the suffix streams in), a chunked admission
+    still stores at completion — streams equal whole-prompt
+    admission with the same hit count. The store request drains
+    first: a chunked store only exists once its prompt finished
+    streaming, so a same-round reuse would (correctly) miss."""
+    shared = make_prompt(142, 17, cfg.vocab_size)
+
+    def run(**extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                                   prefix_cache_entries=4, **extra)
+        eng = serving.ServingEngine(params, cfg, sc)
+        eng.submit(serving.Request("store", shared, max_new=5,
+                                   cache_prefix=True))
+        out = {c.request_id: tuple(c.tokens) for c in eng.run()}
+        eng.submit(serving.Request("reuse", shared + [7, 2, 9],
+                                   max_new=5))
+        out.update({c.request_id: tuple(c.tokens)
+                    for c in eng.run()})
+        return out, eng.prefix_cache.hits
+
+    whole, whole_hits = run()
+    chunked, chunked_hits = run(prefill_chunk=8)
+    assert whole == chunked
+    assert whole_hits == chunked_hits == 1
 
 
 def test_min_p_filter_math():
