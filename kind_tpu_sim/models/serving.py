@@ -1058,20 +1058,15 @@ class ServingEngine:
         block-pool scatter path)."""
         import jax.numpy as jnp
 
-        t_p = len(req.prompt)
-        p = self._restore_prefix(slot, req)
-        if p > 0:
-            # prefix-cache admission: stored rows are in; run ONLY
-            # the suffix through the model
-            suffix = req.prompt[p:]
-            self.cache, logits = self._suffix(
-                self.cache, jnp.asarray(_padded_window(suffix)),
-                jnp.int32(len(suffix)), jnp.int32(p), slot)
-        else:
-            self.cache, logits = self._prefill(
-                self.cache, jnp.asarray(_padded_window(req.prompt)),
-                jnp.int32(t_p), slot)
-        self._store_prefix(slot, req)
+        # whole-prompt admission IS the chunked machinery with one
+        # window covering the entire (post-hit) suffix: claim/restore
+        # -> one window forward -> store. ONE admission recipe.
+        p = self._claim_pending(slot, req)
+        suffix = req.prompt[p:]
+        logits = self._prefill_window(
+            slot, req, jnp.asarray(_padded_window(suffix)),
+            len(suffix), p)
+        self._store_pending(slot, req)
         return logits
 
     def _restore_prefix(self, slot: int, req: Request) -> int:
@@ -1121,11 +1116,11 @@ class ServingEngine:
                 # _advance_prefills feeds one prompt window per
                 # round until the prompt is in, then activates.
                 # A prefix-cache hit fast-forwards the progress
-                # cursor — the stored rows are device-copied in and
-                # only the remaining suffix streams in windows.
+                # cursor — the stored prefix is restored and only
+                # the remaining suffix streams in windows.
                 self._pending[slot] = {
                     "req": req,
-                    "done": self._restore_prefix(slot, req),
+                    "done": self._claim_pending(slot, req),
                 }
                 continue
             logits = self._prefill_slot(slot, req)
@@ -1147,22 +1142,40 @@ class ServingEngine:
             w = min(P, t_p - done)
             window = jnp.asarray(
                 _padded_window(req.prompt[done:done + w]))
-            if done == 0:
-                # first window: plain prefill write at base 0 (the
-                # cheap no-cache-attention path)
-                self.cache, logits = self._prefill(
-                    self.cache, window, jnp.int32(w), slot)
-            else:
-                # later windows: the suffix kernel — a verify-style
-                # window attending the slot's [0, done) prefix
-                self.cache, logits = self._suffix(
-                    self.cache, window, jnp.int32(w),
-                    jnp.int32(done), slot)
+            logits = self._prefill_window(slot, req, window, w, done)
             st["done"] = done + w
             if st["done"] >= t_p:
-                self._store_prefix(slot, req)
+                self._store_pending(slot, req)
                 del self._pending[slot]
                 self._activate(slot, req, logits)
+
+    def _claim_pending(self, slot: int, req: Request) -> int:
+        """Chunked-prefill claim hook: per-storage bookkeeping when
+        a slot is claimed for window streaming; returns the restored
+        prefix length (the window cursor's start)."""
+        return self._restore_prefix(slot, req)
+
+    def _prefill_window(self, slot: int, req: Request, window,
+                        w: int, done: int):
+        """One prompt window's dispatch (chunked prefill): plain
+        prefill at base 0 (the cheap no-cache-attention path), the
+        suffix kernel — a verify-style window attending the slot's
+        [0, done) prefix — afterwards. Returns the window's logits
+        (only the final window's are consumed, by _activate)."""
+        import jax.numpy as jnp
+
+        if done == 0:
+            self.cache, logits = self._prefill(
+                self.cache, window, jnp.int32(w), slot)
+        else:
+            self.cache, logits = self._suffix(
+                self.cache, window, jnp.int32(w), jnp.int32(done),
+                slot)
+        return logits
+
+    def _store_pending(self, slot: int, req: Request) -> None:
+        """Chunked-prefill completion hook (prefix-cache store)."""
+        self._store_prefix(slot, req)
 
     def _activate(self, slot: int, req: Request, logits) -> None:
         """Post-prefill admission: sampling vectors, presence, first
@@ -1447,11 +1460,6 @@ class PagedServingEngine(ServingEngine):
             raise ValueError(
                 f"{type(self).__name__} does not support mesh "
                 "serving yet; use the dense-grid engines")
-        if serving.prefill_chunk > 0:
-            raise ValueError(
-                "chunked prefill is not composed with paged storage "
-                "yet (prompt windows would need per-window block "
-                "scatters); use the dense-grid engines")
         if serving.paged_blocks < 2:
             raise ValueError(
                 "PagedServingEngine needs ServingConfig.paged_blocks"
@@ -1512,63 +1520,70 @@ class PagedServingEngine(ServingEngine):
                 return False
         return True
 
-    def _prefill_slot(self, slot: int, req: Request):
-        import jax.numpy as jnp
-        import numpy as np
+    # admission routes through the base's claim/window/store hooks —
+    # one recipe for whole-prompt AND chunked prefill; the overrides
+    # below supply the block-pool storage semantics
 
+    def _claim_pending(self, slot: int, req: Request) -> int:
+        """Claim, paged: allocate the whole prompt's blocks up front
+        (windows or the single whole-suffix forward stream into
+        them; _can_admit already gated the full need) — with a
+        block-granular prefix hit sharing the stored blocks
+        (refcounted, zero-copy) and starting the cursor at the
+        (block-aligned) shared length."""
         from kind_tpu_sim.models import paged
 
         t_p = len(req.prompt)
         bsz = self.serving.block_size
         self._admit_counter += 1
         self.slot_admit_seq[slot] = self._admit_counter
-
         hit = (self.prefix_cache.lookup(req.prompt)
                if self.prefix_cache is not None else None)
         if hit is not None:
-            # zero-copy admission: point the table at the shared
-            # prefix blocks (refcounted), allocate own blocks only
-            # for the suffix, run only the suffix forward
-            base = hit["len"]  # block-aligned by construction
+            base = hit["len"]
             own = self.alloc.alloc(
                 paged.blocks_needed(t_p - base, bsz))
             assert own is not None  # _can_admit covered full t_p
             self.alloc.share(hit["blocks"])
-            blocks = list(hit["blocks"]) + own
-            self.slot_blocks[slot] = blocks
+            self.slot_blocks[slot] = list(hit["blocks"]) + own
+            return base
+        n = paged.blocks_needed(t_p, bsz)
+        blocks = self.alloc.alloc(n)
+        assert blocks is not None  # _can_admit gated this
+        self.slot_blocks[slot] = blocks
+        return 0
 
-            suffix = req.prompt[base:]
-            w_pad = _bucket(len(suffix))
-            tokens = np.zeros((1, w_pad), np.int32)
-            tokens[0, :len(suffix)] = suffix
-            width = paged.width_bucket(len(blocks))
-            table_row = np.zeros((width,), np.int32)
-            table_row[:len(blocks)] = blocks
-            self.pools, logits = self._paged_suffix(
-                self.pools, jnp.asarray(tokens),
-                jnp.int32(len(suffix)), jnp.int32(base),
+    def _prefill_window(self, slot: int, req: Request, window,
+                        w: int, done: int):
+        """One prompt window through the block pool: every window is
+        a suffix-style forward attending the slot's [0, done) prefix
+        through its table (base 0 takes the plain paged prefill
+        path, which skips the prefix gather)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kind_tpu_sim.models import paged
+
+        blocks = self.slot_blocks[slot]
+        width = paged.width_bucket(len(blocks))
+        table_row = np.zeros((width,), np.int32)
+        table_row[:len(blocks)] = blocks
+        if done == 0:
+            self.pools, logits = self._paged_prefill(
+                self.pools, window, jnp.int32(w),
                 jnp.asarray(table_row))
         else:
-            n = paged.blocks_needed(t_p, bsz)
-            blocks = self.alloc.alloc(n)
-            assert blocks is not None  # _can_admit gated this
-            self.slot_blocks[slot] = blocks
-
-            width = paged.width_bucket(n)
-            table_row = np.zeros((width,), np.int32)
-            table_row[:n] = blocks
-            pad = _bucket(t_p)
-            tokens = np.zeros((1, pad), np.int32)
-            tokens[0, :t_p] = req.prompt
-            self.pools, logits = self._paged_prefill(
-                self.pools, jnp.asarray(tokens), jnp.int32(t_p),
+            self.pools, logits = self._paged_suffix(
+                self.pools, window, jnp.int32(w), jnp.int32(done),
                 jnp.asarray(table_row))
+        return logits
+
+    def _store_pending(self, slot: int, req: Request) -> None:
         if req.cache_prefix and self.prefix_cache is not None:
-            # shares (refcounts) the slot's full-prefix blocks — no
-            # copy; the entry holds them alive past slot retirement
+            # zero-copy: share the slot's blocks (they hold the full
+            # prompt only now, at window-stream completion)
             self.prefix_cache.store(req.prompt,
                                     self.slot_blocks[slot])
-        return logits
 
     def _preempt_youngest(self) -> bool:
         """Evict the most recently admitted active slot: free its

@@ -515,13 +515,45 @@ def test_chunked_prefill_speculative_engine(cfg, params):
     assert run() == run(prefill_chunk=8)
 
 
-def test_chunked_prefill_guards(cfg, params):
-    with pytest.raises(ValueError, match="paged"):
-        serving.PagedServingEngine(
-            params, cfg,
-            serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
-                                  paged_blocks=12, block_size=8,
-                                  prefill_chunk=8))
+def test_chunked_prefill_paged_matches_whole_prompt(cfg, params):
+    """Chunked prefill over PAGED storage: prompt windows stream
+    into pre-allocated blocks (suffix-style forwards through the
+    slot's table) — streams equal whole-prompt paged admission,
+    which equals the dense grid. Block-granular prefix sharing
+    composes: a stored prompt's blocks are shared and the cursor
+    starts at the shared length."""
+    import dataclasses as _dc
+
+    shared = make_prompt(150, 16, cfg.vocab_size)
+    reqs = [
+        serving.Request("store", shared, max_new=5,
+                        cache_prefix=True),
+        serving.Request("mid", make_prompt(151, 9, cfg.vocab_size),
+                        max_new=6),
+    ]
+    follow = serving.Request("reuse", shared + [4, 4, 1], max_new=5)
+
+    def run(engine_cls, **extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                                   prefix_cache_entries=4, **extra)
+        eng = engine_cls(params, cfg, sc)
+        for r in reqs:
+            eng.submit(_dc.replace(r))
+        out = {c.request_id: tuple(c.tokens) for c in eng.run()}
+        eng.submit(_dc.replace(follow))
+        out.update({c.request_id: tuple(c.tokens)
+                    for c in eng.run()})
+        return out, (eng.prefix_cache.hits
+                     if eng.prefix_cache is not None else 0)
+
+    paged_kw = {"paged_blocks": 24, "block_size": 8}
+    dense, _ = run(serving.ServingEngine)
+    paged_whole, pw_hits = run(serving.PagedServingEngine,
+                               **paged_kw)
+    paged_chunked, pc_hits = run(serving.PagedServingEngine,
+                                 prefill_chunk=8, **paged_kw)
+    assert dense == paged_whole == paged_chunked
+    assert pw_hits == pc_hits == 1
 
 
 def _prefix_stream(engine_cls, params, cfg, reqs, **extra):
