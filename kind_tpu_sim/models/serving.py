@@ -2028,8 +2028,9 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
 
 def engines_report(cfg: ModelConfig = None) -> Dict[str, Any]:
     """One smoke over the WHOLE serving matrix: the same greedy
-    request stream through all four engines — dense grid, paged,
-    speculative grid, paged+speculative — must emit identical
+    request stream through the engine configurations — dense grid,
+    chunked-prefill grid, paged, speculative grid,
+    paged+speculative — must emit identical
     streams (and match the solo decoder; serving_report pins that
     leg). Pod / slice-smoke friendly: the strongest single check
     that the storage and verify tiers compose without drift."""
@@ -2055,6 +2056,9 @@ def engines_report(cfg: ModelConfig = None) -> Dict[str, Any]:
         "grid": run(lambda: ServingEngine(
             params, cfg, ServingConfig(max_slots=2, max_len=48,
                                        chunk=8))),
+        "grid_chunked_prefill": run(lambda: ServingEngine(
+            params, cfg, ServingConfig(max_slots=2, max_len=48,
+                                       chunk=8, prefill_chunk=8))),
         "paged": run(lambda: PagedServingEngine(
             params, cfg, ServingConfig(max_slots=2, max_len=48,
                                        chunk=8, paged_blocks=12,

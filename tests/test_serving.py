@@ -975,7 +975,8 @@ def test_engines_report_matrix_agrees():
     rep = serving.engines_report()
     assert rep["ok"], rep
     assert rep["all_streams_identical"]
-    assert rep["engines"] == ["grid", "paged", "paged_spec", "spec"]
+    assert rep["engines"] == ["grid", "grid_chunked_prefill",
+                              "paged", "paged_spec", "spec"]
 
 
 def test_request_latency_metrics(cfg, params):
