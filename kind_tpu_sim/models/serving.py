@@ -812,22 +812,38 @@ def _shard_params(params: Params, cfg: ModelConfig, mesh) -> Params:
     )
 
 
-def _shard_cache(cache, mesh):
-    """Slot-grid KV storage placed (slots='data', kv_heads='model'):
-    each layer's k/v is (slots, rows, kv, hd); QuantArray components
-    share the geometry (scale is (slots, rows, kv, 1))."""
+def _shard_kv_storage(storage, mesh, shard_slots: bool):
+    """Place per-layer KV storage on a mesh — THE one copy of the
+    KV-placement recipe. Layout is (leading, rows, kv, hd) where
+    ``leading`` is slots (dense grid; sharded over 'data' when
+    shard_slots) or num_blocks (paged pool; ALWAYS global — the pool
+    is shared across slots, table gathers/scatters touch the
+    replicated block axis while each chip holds its kv-head shard).
+    device_put applies one sharding to every pytree leaf, so a
+    QuantArray's q and scale (same geometry) place together without
+    special-casing."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    sh = NamedSharding(mesh, P("data" if "data" in mesh.axis_names
-                               else None, None,
+    lead = ("data" if shard_slots and "data" in mesh.axis_names
+            else None)
+    sh = NamedSharding(mesh, P(lead, None,
                                "model" if "model" in mesh.axis_names
                                else None, None))
-    # device_put applies one sharding to every pytree leaf, so a
-    # QuantArray's q and scale (same (slots, rows, kv, ...) geometry)
-    # place together without special-casing
     return [{"k": jax.device_put(lc["k"], sh),
-             "v": jax.device_put(lc["v"], sh)} for lc in cache]
+             "v": jax.device_put(lc["v"], sh)} for lc in storage]
+
+
+def _shard_cache(cache, mesh):
+    """Slot-grid KV: slots over 'data', kv heads over 'model'."""
+    return _shard_kv_storage(cache, mesh, shard_slots=True)
+
+
+def _shard_pools(pools, mesh):
+    """Paged pools: kv heads over 'model' only (block axis global).
+    Validated: sharded paged chunk emissions are bit-identical to
+    unsharded."""
+    return _shard_kv_storage(pools, mesh, shard_slots=False)
 
 
 # ---------------------------------------------------------------------
@@ -857,10 +873,18 @@ class ServingEngine:
             # All mesh rejections fire BEFORE the weight transfer:
             # on a real multi-host mesh _shard_params moves the full
             # model, which an invalid config must not pay for.
-            if serving.paged_blocks or serving.paged_kernel:
+            if serving.paged_kernel:
                 raise ValueError(
-                    "paged engines do not support mesh serving yet; "
-                    "use the dense-grid engines")
+                    "the Pallas paged-attention kernel tier does "
+                    "not partition under a mesh (pallas_call does "
+                    "not auto-shard); use the gather tier")
+            if (serving.paged_blocks
+                    and _mesh_axis(mesh, "data") > 1):
+                raise ValueError(
+                    "paged mesh serving shards kv heads over "
+                    "'model' only — the block pool is global across "
+                    "slots, so the slot axis cannot shard over "
+                    "'data'; use a mesh without a data axis")
             _check_mesh_divisibility(cfg, n, mesh)
             # Tensor-parallel serving: commit the params with the
             # Megatron 'model'-axis shardings (transformer.
@@ -1452,20 +1476,14 @@ class PagedServingEngine(ServingEngine):
         from kind_tpu_sim.models import paged
 
         cfg, serving = self.cfg, self.serving
-        if self.mesh is not None:
-            # loud, not silent: the block pool is global across
-            # slots, so 'data'-sharding the slot axis doesn't apply;
-            # pool sharding over 'model' plus table-driven gathers
-            # is future work
-            raise ValueError(
-                f"{type(self).__name__} does not support mesh "
-                "serving yet; use the dense-grid engines")
         if serving.paged_blocks < 2:
             raise ValueError(
                 "PagedServingEngine needs ServingConfig.paged_blocks"
                 " >= 2 (block 0 is the garbage sink)")
         self.pools = paged.init_pools(cfg, serving.paged_blocks,
                                       serving.block_size)
+        if self.mesh is not None:
+            self.pools = _shard_pools(self.pools, self.mesh)
         self.alloc = paged.BlockAllocator(serving.paged_blocks)
         self.slot_blocks = [[] for _ in range(serving.max_slots)]
         self.slot_admit_seq = [0] * serving.max_slots

@@ -832,12 +832,55 @@ def test_mesh_serving_guards(cfg, params):
             params, cfg,
             serving.ServingConfig(max_slots=2, max_len=48, chunk=8),
             mesh=wide)
-    with pytest.raises(ValueError, match="mesh"):
+    # paged: no 'data'-axis sharding (the pool is global), and the
+    # Pallas kernel tier does not partition
+    with pytest.raises(ValueError, match="data axis"):
         serving.PagedServingEngine(
             params, cfg,
             serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
                                   paged_blocks=12, block_size=8),
             mesh=mesh)
+    tp = Mesh(_np.array(jax.devices()[:2]).reshape(2), ("model",))
+    with pytest.raises(ValueError, match="kernel"):
+        serving.PagedServingEngine(
+            params, cfg,
+            serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                                  paged_blocks=12, block_size=8,
+                                  paged_kernel=True),
+            mesh=tp)
+
+
+def test_mesh_serving_paged(cfg, params):
+    """Paged engines over a pure-TP mesh: pools shard kv heads over
+    'model' (the block axis stays global), table gathers/scatters
+    ride GSPMD — streams equal the unsharded paged engine, chunked
+    and speculative tiers both, preemption pressure included via the
+    small pool."""
+    import jax
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    tp = Mesh(_np.array(jax.devices()[:2]).reshape(2), ("model",))
+    reqs = [(make_prompt(160 + i, 5 + 2 * i, cfg.vocab_size), 7)
+            for i in range(4)]
+
+    def run(engine_cls, mesh_arg, **extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=48,
+                                   paged_blocks=14, block_size=8,
+                                   **extra)
+        eng = engine_cls(params, cfg, sc, mesh=mesh_arg)
+        for i, (p, n) in enumerate(reqs):
+            eng.submit(serving.Request(f"pm{i}", p, max_new=n))
+        return {c.request_id: tuple(c.tokens) for c in eng.run()}
+
+    plain = run(serving.PagedServingEngine, None, chunk=8)
+    sharded = run(serving.PagedServingEngine, tp, chunk=8)
+    assert plain == sharded
+    spec_plain = run(serving.PagedSpeculativeServingEngine, None,
+                     speculative_k=3)
+    spec_sharded = run(serving.PagedSpeculativeServingEngine, tp,
+                       speculative_k=3)
+    assert spec_plain == spec_sharded == plain
 
 
 def test_draft_model_grid_matches_dense_grid(cfg, params):
