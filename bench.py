@@ -498,16 +498,6 @@ def model_throughput(emit=None) -> dict | None:
         jax.block_until_ready(null())
         null_dt = med(lambda: jax.block_until_ready(null()), 5)
 
-        def make_counter(counter: dict):
-            """Wrap engine dispatch methods so ``counter['n']`` counts
-            jit calls (for null_dt overhead correction)."""
-            def deco(fn):
-                def wrapped(*a, **k):
-                    counter["n"] += 1
-                    return fn(*a, **k)
-                return wrapped
-            return deco
-
         # Greedy decode throughput (KV-cache scan; single readback),
         # on the bf16 serving snapshot (decode is weight-bandwidth-
         # bound; the snapshot halves the bytes per step). Prefill is
@@ -661,54 +651,112 @@ def model_throughput(emit=None) -> dict | None:
             result["decode_error"] = str(exc)[:100]
         _note()
 
-        # Continuous-batching serving engine (models/serving.py): a
-        # mixed-length request stream through the slot grid — the
-        # vLLM-analog number. Wall time is corrected for the per-
-        # dispatch RPC overhead (one null_dt per jitted call) so the
-        # figure reflects device throughput, comparable to the raw
-        # decode number above; the uncorrected wall rate is reported
-        # alongside. TPU-only: on CPU hosts this measures nothing.
+        # Continuous-batching serving engines (models/serving.py):
+        # request streams through the slot grid — the vLLM-analog
+        # numbers. Every engine entry now carries a per-phase WALL
+        # decomposition (VERDICT r03 weak #5: the serving-vs-decode
+        # gap was unattributed): each dispatch/readback method is
+        # wrapped with a counting wall timer. Measured reality on
+        # the tunnel: jit dispatches ENQUEUE asynchronously (their
+        # wall is ~0), and the wall actually accrues at the sync
+        # points — retire_fetch (the per-round device_get) and
+        # first_readback (one RTT per admission) — so those two
+        # phases absorb device time + RTT and the aggregate
+        # device_tokens_per_s still comes from the null_dt
+        # correction over total calls. ``host_other_s`` (wall in no
+        # phase) stays published so unattributed time is visible.
+        # TPU-only: on CPU hosts this measures nothing.
         if backend == "tpu":
             from kind_tpu_sim.models import serving
 
-            def run_serving(key: str, **cfg_extra):
-                """One dense-grid engine measurement over the
-                canonical request stream. Ragged max_new exercises
-                retirement + re-admission; prompt lengths stay
-                inside ONE prefill bucket so the phase pays a single
-                prefill compile (~1 min/bucket on the remote-compile
-                tunnel)."""
-                _serving_t0 = time.monotonic()
-                sp_l = decode.serving_params(params, cfg)
-                sc = serving.ServingConfig(max_slots=batch,
-                                           max_len=1024, chunk=64,
-                                           **cfg_extra)
-                eng = serving.ServingEngine(sp_l, cfg, sc)
+            # ONE bf16 serving snapshot for every engine entry —
+            # re-deriving it per entry would re-run the device-side
+            # transform ~9 times inside the budgeted capture window
+            sp_serve = decode.serving_params(params, cfg)
+
+            _PHASE_ATTRS = (
+                ("_chunk", "decode_chunk"),
+                ("_paged_chunk", "decode_chunk"),
+                ("_prefill", "prefill"),
+                ("_paged_prefill", "prefill"),
+                ("_suffix", "suffix_window"),
+                ("_paged_suffix", "suffix_window"),
+                ("_spec_step", "verify_scan"),
+                ("_first", "first_sample"),
+                ("_first_read", "first_readback"),
+                ("_retire", "retire_fetch"),
+                ("_spec_retire", "retire_fetch"),
+            )
+            # readback phases sync the device; their wall absorbs
+            # in-flight async dispatch work and is excluded from the
+            # per-call RTT correction
+            _READBACK_PHASES = ("retire_fetch", "first_readback")
+
+            def instrument_phases(eng) -> dict:
+                """Wrap the engine's dispatch/fetch methods with
+                counting wall timers; returns the live phase dict
+                {label: [n_calls, wall_s]}."""
+                phases: dict = {}
+
+                def timed(fn, label):
+                    def wrapped(*a, **k):
+                        t0 = time.monotonic()
+                        out = fn(*a, **k)
+                        st = phases.setdefault(label, [0, 0.0])
+                        st[0] += 1
+                        st[1] += time.monotonic() - t0
+                        return out
+                    return wrapped
+
+                for attr, label in _PHASE_ATTRS:
+                    if hasattr(eng, attr):
+                        setattr(eng, attr,
+                                timed(getattr(eng, attr), label))
+                return phases
+
+            def canonical_stream(key: str, n_req: int,
+                                 lens=(192, 224, 256),
+                                 news=(64, 128, 192)):
+                """The shared request stream: same RandomState(0)
+                draw across engines, so entries compare the ENGINE,
+                not the workload. Prompt lengths stay inside one
+                prefill bucket (one compile per bucket on the
+                remote-compile tunnel)."""
                 rng = np.random.RandomState(0)
-                lens_s = [192, 224, 256]
                 reqs = []
-                for i in range(2 * batch):
-                    p_len = int(rng.choice(lens_s))
-                    max_new = int(rng.choice([64, 128, 192]))
-                    prompt_arr = tokens[0, :p_len]
+                for i in range(n_req):
+                    p_len = int(rng.choice(lens))
+                    max_new = int(rng.choice(news))
                     reqs.append(serving.Request(
                         f"{key}{i}",
-                        np.asarray(prompt_arr).tolist(), max_new))
-                # Warm THIS engine's jit wrappers (a fresh engine
-                # would compile its own): one request in the shared
-                # prefill bucket, plus one chunk step.
-                eng.submit(serving.Request(
-                    "warm", np.asarray(tokens[0, :256]).tolist(), 2))
-                eng.run()
+                        np.asarray(tokens[0, :p_len]).tolist(),
+                        max_new))
+                return reqs
 
-                dispatches = {"n": 0}
-                count = make_counter(dispatches)
-                eng._chunk = count(eng._chunk)
-                eng._prefill = count(eng._prefill)
-                eng._suffix = count(eng._suffix)  # chunked windows
-                eng._first = count(eng._first)  # per-admission sample
-                eng.reset_latency()  # warm request's TTFT is compile
-                #                      time, not serving latency
+            def measure_engine(key: str, eng, reqs,
+                               warm_lens=(256,)):
+                """Shared engine measurement: warm this engine's jit
+                wrappers (one request per prompt bucket + chunk
+                trace), then run ``reqs`` with per-phase accounting.
+                Returns the (live) entry dict stored at
+                result[key]."""
+                t_sec = time.monotonic()
+                for j, wl in enumerate(warm_lens):
+                    # np.resize: warm prompts can exceed max_seq
+                    # (tokens is only max_seq wide) — a truncated
+                    # warm would silently leave its prefill bucket
+                    # cold and push the ~1min compile into the
+                    # timed run
+                    eng.submit(serving.Request(
+                        f"warm{j}",
+                        np.resize(np.asarray(tokens[0]),
+                                  wl).tolist(), 2))
+                eng.run()
+                phases = instrument_phases(eng)
+                if hasattr(eng, "verify_steps"):
+                    eng.verify_steps = 0  # warm-up windows are
+                    #                       compile, not serving
+                eng.reset_latency()
                 for r in reqs:
                     eng.submit(r)
                 t0 = time.monotonic()
@@ -716,22 +764,62 @@ def model_throughput(emit=None) -> dict | None:
                 wall = time.monotonic() - t0
                 gen = sum(len(c.tokens) for c in done)
                 assert len(done) == len(reqs)
-                device = wall - dispatches["n"] * null_dt
+                jit_calls = sum(
+                    st[0] for lbl, st in phases.items()
+                    if lbl not in _READBACK_PHASES)
+                device = wall - jit_calls * null_dt
                 entry = {
                     "requests": len(done),
                     "generated_tokens": gen,
-                    "slots": sc.max_slots,
+                    "slots": eng.serving.max_slots,
                     "wall_tokens_per_s": round(gen / wall),
-                    "dispatches": dispatches["n"],
+                    "dispatches": jit_calls,
                 }
                 if device > 0.2 * wall:
                     entry["device_tokens_per_s"] = round(gen / device)
+                entry["phases"] = {
+                    lbl: {"n": st[0], "wall_s": round(st[1], 3)}
+                    for lbl, st in sorted(phases.items())}
+                entry["host_other_s"] = round(
+                    wall - sum(st[1] for st in phases.values()), 3)
+                dc = phases.get("decode_chunk")
+                if dc and dc[0]:
+                    # every chunk dispatch computes max_slots*chunk
+                    # token-rows whether or not slots are live —
+                    # delivered decode tokens over computed rows IS
+                    # the occupancy/waste story
+                    rows = (dc[0] * eng.serving.max_slots
+                            * eng.serving.chunk)
+                    admits = phases.get("first_sample", [0, 0.0])[0]
+                    entry["decode_rows_computed"] = rows
+                    entry["decode_occupancy_pct"] = round(
+                        100.0 * max(gen - admits, 0) / rows, 1)
+                if (phases.get("verify_scan")
+                        and hasattr(eng, "verify_steps")):
+                    entry["draft_k"] = eng.serving.speculative_k
+                    entry["spec_windows"] = eng.serving.spec_windows
+                    entry["verify_steps"] = eng.verify_steps
+                    entry["tokens_per_window"] = round(
+                        gen / max(eng.verify_steps, 1), 2)
                 lat = eng.report().get("latency")
                 if lat:
                     entry["latency"] = lat
                 result[key] = entry
-                SECTION_S[key] = round(
-                    time.monotonic() - _serving_t0, 1)
+                SECTION_S[key] = round(time.monotonic() - t_sec, 1)
+                return entry
+
+            def run_serving(key: str, reqs=None, **cfg_extra):
+                """One dense-grid engine measurement (canonical
+                request stream by default; ragged max_new exercises
+                retirement + re-admission)."""
+                sp_l = sp_serve
+                cfg_extra.setdefault("chunk", 64)
+                sc = serving.ServingConfig(max_slots=batch,
+                                           max_len=1024, **cfg_extra)
+                eng = serving.ServingEngine(sp_l, cfg, sc)
+                measure_engine(key, eng,
+                               reqs if reqs is not None
+                               else canonical_stream(key, 2 * batch))
 
             try:
                 run_serving("serving")
@@ -750,29 +838,35 @@ def model_throughput(emit=None) -> dict | None:
                     str(exc)[:100]
             _note()
 
-            def run_longprompt(key: str, **cfg_extra):
+            def run_longprompt(key: str, LONG: int = 768,
+                               max_len: int = 1024, **cfg_extra):
                 """Chunked prefill's POSITIVE regime, measured: short
                 co-tenants decode while a LONG prompt admits. One
-                768-token request enters a busy grid of short
+                LONG-token request enters a busy grid of short
                 requests; the short requests' e2e latency is the
                 number that moves — whole-prompt admission stalls
                 their decode for the entire long prefill dispatch,
-                window admission interleaves."""
+                window admission interleaves. The default 768 regime
+                sits near the crossover (r03 measured it a slight
+                loss, r04 cap1 a win); LONG=4096 is the predicted
+                clear-win regime (docs/SERVING.md)."""
                 t_sec = time.monotonic()
-                LONG = 768  # the one copy: warm slice, submit
-                #             slice, and the reported field
-                sp_l = decode.serving_params(params, cfg)
+                sp_l = sp_serve
                 sc = serving.ServingConfig(max_slots=batch,
-                                           max_len=1024, chunk=64,
+                                           max_len=max_len, chunk=64,
                                            **cfg_extra)
                 eng = serving.ServingEngine(sp_l, cfg, sc)
+                # prompt source long enough for any LONG (tokens is
+                # only max_seq wide; tile it for the 4k regime)
+                long_prompt = np.resize(
+                    np.asarray(tokens[0]), LONG).tolist()
                 # warm both prompt buckets + chunk/suffix traces
                 eng.submit(serving.Request(
                     "warm", np.asarray(tokens[0, :256]).tolist(), 2))
                 eng.submit(serving.Request(
-                    "warmL", np.asarray(
-                        (tokens[0, :LONG] + 1)
-                        % cfg.vocab_size).tolist(), 2))
+                    "warmL",
+                    [(t + 1) % cfg.vocab_size for t in long_prompt],
+                    2))
                 eng.run()
                 eng.reset_latency()
                 # short cohort first, long request arrives behind it
@@ -781,8 +875,7 @@ def model_throughput(emit=None) -> dict | None:
                         f"{key}s{i}",
                         np.asarray(tokens[0, :224]).tolist(), 96))
                 eng.submit(serving.Request(
-                    f"{key}L",
-                    np.asarray(tokens[0, :LONG]).tolist(), 64))
+                    f"{key}L", list(long_prompt), 64))
                 t0 = time.monotonic()
                 done = {c.request_id: c for c in eng.run()}
                 wall = time.monotonic() - t0
@@ -836,63 +929,31 @@ def model_throughput(emit=None) -> dict | None:
                 """One paged-engine measurement over the canonical
                 request stream (identical by construction across
                 tiers: same RandomState(0) draw)."""
-                t_section = time.monotonic()
                 sc_p = serving.ServingConfig(
                     max_slots=batch, max_len=1024, chunk=64,
                     paged_blocks=pool_blocks, block_size=block,
                     **cfg_extra)
                 eng_p = serving.PagedServingEngine(sp, cfg, sc_p)
-                eng_p.submit(serving.Request(
-                    "warm", np.asarray(tokens[0, :256]).tolist(), 2))
-                eng_p.run()  # compile prefill bucket + chunk trace
-                d = {"n": 0}
-                c = make_counter(d)
-                eng_p._paged_chunk = c(eng_p._paged_chunk)
-                eng_p._paged_prefill = c(eng_p._paged_prefill)
-                eng_p._first = c(eng_p._first)
-                eng_p.reset_latency()  # exclude warm-up compile
-                rng = np.random.RandomState(0)
-                for i in range(2 * batch):
-                    p_len = int(rng.choice(lens))
-                    max_new = int(rng.choice([64, 128, 192]))
-                    eng_p.submit(serving.Request(
-                        f"{key}{i}",
-                        np.asarray(tokens[0, :p_len]).tolist(),
-                        max_new))
-                t0 = time.monotonic()
-                done_p = eng_p.run()
-                wall = time.monotonic() - t0
-                gen_p = sum(len(cm.tokens) for cm in done_p)
-                assert len(done_p) == 2 * batch
-                dev = wall - d["n"] * null_dt
-                entry = {
-                    "requests": len(done_p),
-                    "generated_tokens": gen_p,
+                entry = measure_engine(
+                    key, eng_p,
+                    canonical_stream(key, 2 * batch, lens=lens))
+                entry.update({
                     "pool_blocks": pool_blocks,
                     "block_size": block,
                     "preemptions": eng_p.preemptions,
                     "kv_positions_vs_grid": round(
-                        pool_blocks * block / (batch * 1024), 3),
-                    "wall_tokens_per_s": round(gen_p / wall),
-                    "dispatches": d["n"],
-                }
-                if dev > 0.2 * wall:
-                    entry["device_tokens_per_s"] = round(gen_p / dev)
-                lat = eng_p.report().get("latency")
-                if lat:
-                    entry["latency"] = lat
-                result[key] = entry
-                SECTION_S[key] = round(
-                    time.monotonic() - t_section, 1)
+                        pool_blocks * block
+                        / (batch * sc_p.max_len), 3),
+                })
 
             try:
-                sp = decode.serving_params(params, cfg)
+                sp = sp_serve
                 run_paged("serving_paged")
             except Exception as exc:  # pragma: no cover
                 result["serving_paged_error"] = str(exc)[:100]
             _note()
             try:
-                sp = decode.serving_params(params, cfg)
+                sp = sp_serve
                 run_paged("serving_paged_kernel", paged_kernel=True)
             except Exception as exc:  # pragma: no cover
                 result["serving_paged_kernel_error"] = str(exc)[:100]
@@ -905,68 +966,20 @@ def model_throughput(emit=None) -> dict | None:
             # speculative tokens/step.
             from kind_tpu_sim.models import serving
 
-            def run_spec(key: str, engine_cls, **cfg_extra):
-                """One speculative-engine measurement over the
-                canonical request stream (same RandomState(0) draw as
-                the paged/grid entries)."""
-                _specs_t0 = time.monotonic()
-                sp_l = decode.serving_params(params, cfg)
+            def run_spec(key: str, engine_cls, reqs=None,
+                         **cfg_extra):
+                """One speculative-engine measurement (canonical
+                stream by default — same RandomState(0) draw as the
+                paged/grid entries)."""
+                sp_l = sp_serve
                 scs = serving.ServingConfig(
                     max_slots=batch, max_len=1024, speculative_k=4,
                     **cfg_extra)
                 engs = engine_cls(sp_l, cfg, scs)
-                rng = np.random.RandomState(0)
-                lens_s = [192, 224, 256]
-                reqs = []
-                for i in range(2 * batch):
-                    p_len = int(rng.choice(lens_s))
-                    max_new = int(rng.choice([64, 128, 192]))
-                    reqs.append(serving.Request(
-                        f"{key}{i}",
-                        np.asarray(tokens[0, :p_len]).tolist(),
-                        max_new))
-                engs.submit(serving.Request(
-                    "warm", np.asarray(tokens[0, :256]).tolist(), 2))
-                engs.run()
-                disp = {"n": 0}
-                counts = make_counter(disp)
-                engs._spec_step = counts(engs._spec_step)
-                # grid engine dispatches _prefill; the paged
-                # composition dispatches _paged_prefill instead
-                for attr in ("_prefill", "_paged_prefill"):
-                    if hasattr(engs, attr):
-                        setattr(engs, attr,
-                                counts(getattr(engs, attr)))
-                engs._first = counts(engs._first)
-                engs.verify_steps = 0  # exclude the warm request
-                engs.reset_latency()
-                for r in reqs:
-                    engs.submit(r)
-                t0 = time.monotonic()
-                dones = engs.run()
-                walls = time.monotonic() - t0
-                gens = sum(len(c.tokens) for c in dones)
-                assert len(dones) == len(reqs)
-                devs = walls - disp["n"] * null_dt
-                entry = {
-                    "requests": len(dones),
-                    "generated_tokens": gens,
-                    "draft_k": 4,
-                    "spec_windows": scs.spec_windows,
-                    "verify_steps": engs.verify_steps,
-                    "tokens_per_window": round(
-                        gens / max(engs.verify_steps, 1), 2),
-                    "wall_tokens_per_s": round(gens / walls),
-                    "dispatches": disp["n"],
-                }
-                if devs > 0.2 * walls:
-                    entry["device_tokens_per_s"] = round(gens / devs)
-                lat = engs.report().get("latency")
-                if lat:
-                    entry["latency"] = lat
-                result[key] = entry
-                SECTION_S[key] = round(
-                    time.monotonic() - _specs_t0, 1)
+                measure_engine(
+                    key, engs,
+                    reqs if reqs is not None
+                    else canonical_stream(key, 2 * batch))
 
             try:
                 run_spec("serving_speculative",
@@ -986,6 +999,147 @@ def model_throughput(emit=None) -> dict | None:
                 result["serving_paged_spec_error"] = str(exc)[:100]
             _note()
 
+            # ---- round-4 additions -------------------------------
+            # The r03 serving numbers sat 6x under the raw decode
+            # roof with no attribution, speculative/chunked-prefill
+            # never won, and the workload was toy-sized. The entries
+            # below measure each engine AT ITS OPERATING POINT.
+
+            def run_realistic(key: str):
+                """Mixed 224/1k/2k prompts, 16 slots, pool sized
+                UNDER worst-case concurrent demand: preemption and
+                pressure eviction must appear in the measurement,
+                and the paged-vs-grid HBM story is reported from
+                live pool accounting."""
+                sp_l = sp_serve
+                slots, blk_r, pool_r = 16, 64, 288
+                sc_r = serving.ServingConfig(
+                    max_slots=slots, max_len=2560, chunk=64,
+                    paged_blocks=pool_r, block_size=blk_r)
+                eng = serving.PagedServingEngine(sp_l, cfg, sc_r)
+                rng = np.random.RandomState(7)
+                reqs = []
+                for i in range(2 * slots):
+                    p_len = int(rng.choice([224, 1024, 2048]))
+                    prompt = ((np.resize(np.asarray(tokens[0]),
+                                         p_len) + i)
+                              % cfg.vocab_size).tolist()
+                    reqs.append(serving.Request(
+                        f"{key}{i}", prompt,
+                        int(rng.choice([64, 128, 256]))))
+                entry = measure_engine(
+                    key, eng, reqs, warm_lens=(224, 1024, 2048))
+                kv_pos_bytes = (2 * cfg.n_layers * cfg.kv_heads
+                                * cfg.head_dim * 2)  # bf16 k+v
+                entry.update({
+                    "pool_blocks": pool_r,
+                    "block_size": blk_r,
+                    "preemptions": eng.preemptions,
+                    "pool_hbm_mb": round(
+                        pool_r * blk_r * kv_pos_bytes / 2**20),
+                    "grid_equiv_hbm_mb": round(
+                        slots * sc_r.max_len * kv_pos_bytes
+                        / 2**20),
+                })
+
+            def uniform_stream(key: str, n_req: int, p_len: int,
+                               max_new: int):
+                """Uniform long-output stream: every request the same
+                shape, so slots retire in lockstep and the grid
+                stays full — the saturation workload."""
+                return [serving.Request(
+                    f"{key}{i}",
+                    ((np.asarray(tokens[0, :p_len]) + i)
+                     % cfg.vocab_size).tolist(), max_new)
+                    for i in range(n_req)]
+
+            # Dense grid at SATURATION: uniform 512-token outputs,
+            # chunk=256 (device work per dispatch ~4x the tunnel
+            # RTT, so wall stops being dispatch-bound). This is the
+            # entry that must approach the solo-decode roof
+            # (VERDICT r03 #2: >=50% of ~19k tok/s at saturation,
+            # or the decomposition says where it goes).
+            try:
+                run_serving("serving_saturated", chunk=256,
+                            reqs=uniform_stream(
+                                "serving_saturated", 2 * batch,
+                                192, 512))
+            except Exception as exc:  # pragma: no cover
+                result["serving_saturated_error"] = str(exc)[:100]
+            _note()
+
+            # Speculative at its operating point: long outputs amortize
+            # admission; W=16 windows per scan cuts dispatches ~4x vs
+            # the r03 W=4 entry. Compare wall vs serving_saturated
+            # (same stream) — the committed spec-vs-dense verdict.
+            try:
+                run_spec("serving_speculative_long",
+                         serving.SpeculativeServingEngine,
+                         reqs=uniform_stream(
+                             "serving_speculative_long", 2 * batch,
+                             192, 512),
+                         spec_windows=16)
+            except Exception as exc:  # pragma: no cover
+                result["serving_speculative_long_error"] = \
+                    str(exc)[:100]
+            _note()
+            # ...and W=16 on the SHORT canonical stream, against the
+            # r03 configuration (W=4): the dispatch-economics lever
+            # isolated.
+            try:
+                run_spec("serving_speculative_w16",
+                         serving.SpeculativeServingEngine,
+                         spec_windows=16)
+            except Exception as exc:  # pragma: no cover
+                result["serving_speculative_w16_error"] = \
+                    str(exc)[:100]
+            _note()
+
+            # Chunked prefill in its PREDICTED winning regime
+            # (docs/SERVING.md: multi-thousand-token prompts): a 4k
+            # prompt admits into a busy short-request grid.
+            try:
+                run_longprompt("serving_longprompt_4k", LONG=4096,
+                               max_len=4224)
+            except Exception as exc:  # pragma: no cover
+                result["serving_longprompt_4k_error"] = str(exc)[:100]
+            _note()
+            try:
+                run_longprompt("serving_longprompt_4k_chunked",
+                               LONG=4096, max_len=4224,
+                               prefill_chunk=64)
+            except Exception as exc:  # pragma: no cover
+                result["serving_longprompt_4k_chunked_error"] = \
+                    str(exc)[:100]
+            _note()
+
+            # Paged gather-vs-kernel tier delta, measured where it
+            # can be measured: the per-chunk gather+scatter is paid
+            # once per dispatch and amortizes over `chunk` decode
+            # steps, so at serving chunks the tiers tie (r03) and
+            # the delta is sub-ms — invisible under a ~60ms-RTT
+            # dispatch. This micro-bench scans N chunks in ONE
+            # dispatch (pure functions chain) at the kernel's target
+            # regime — long context, small chunk — so device time
+            # dominates the RTT and the tier delta is resolvable.
+            try:
+                result["paged_tier_micro"] = paged_tier_micro(
+                    params, cfg, med, null_dt)
+            except Exception as exc:  # pragma: no cover
+                result["paged_tier_micro_error"] = str(exc)[:100]
+            _note()
+
+            # Realistic mixed workload over the paged pool: 16
+            # slots, 128..2k prompts, deliberately under-provisioned
+            # pool so pressure eviction/preemption shows up in the
+            # numbers, and the padding-waste-vs-paged HBM accounting
+            # is measured, not computed (VERDICT r03 #8).
+            try:
+                run_realistic("serving_realistic")
+            except Exception as exc:  # pragma: no cover
+                result["serving_realistic_error"] = str(exc)[:100]
+            _note()
+
         # Speculative decoding (prompt-lookup drafts + exact greedy
         # verify): the hardware-independent story is tokens per
         # verify step (plain decode = 1.0) — each step pays one
@@ -999,7 +1153,7 @@ def model_throughput(emit=None) -> dict | None:
                 from kind_tpu_sim.models import speculative
 
                 _spec_t0 = time.monotonic()
-                sp2 = decode.serving_params(params, cfg)
+                sp2 = sp_serve
                 spec_prompt = tokens[:, :256]
                 spec_new, k = 256, 4
                 # warm (same shapes -> same traces; the jitted verify
@@ -1035,7 +1189,163 @@ def model_throughput(emit=None) -> dict | None:
         return {"error": str(exc)[:100]}
 
 
+def paged_tier_micro(params, cfg, med, null_dt: float,
+                     slots: int = 16, blk: int = 64, chunk: int = 8,
+                     N: int = 16, ctx0: int = 3968) -> dict:
+    """Gather-vs-Pallas paged-attention tier delta, device-resolved.
+
+    The per-chunk gather+scatter amortizes over `chunk` decode steps,
+    so at serving chunk sizes the tiers tie and the sub-ms delta
+    drowns under the ~60ms-per-dispatch tunnel RTT. Here N chunk
+    quanta are chained in ONE dispatch (the paged chunk fns are pure;
+    lax.scan carries pools/lengths) at the kernel's target regime —
+    16 slots, ~4k context (table width 64), chunk=8 — so device time
+    dominates the RTT and a per-chunk delta of even a few percent is
+    measurable. Reports per-chunk ms for both tiers and the ratio."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kind_tpu_sim.models import decode, paged
+
+    # defaults: ctx0 + N*chunk = 4096 exactly fills 64 blocks/slot
+    assert (ctx0 + N * chunk) % blk == 0
+    sp = decode.serving_params(params, cfg)
+    blocks_per = (ctx0 + chunk * N) // blk
+    width = paged.width_bucket(blocks_per)
+    pool_blocks = 1 + slots * blocks_per
+    tables_np = np.zeros((slots, width), np.int32)
+    nxt = 1
+    for s in range(slots):
+        tables_np[s, :blocks_per] = np.arange(nxt, nxt + blocks_per)
+        nxt += blocks_per
+    tables = jnp.asarray(tables_np)
+    active = jnp.ones((slots,), bool)
+    sampling = (jnp.zeros((slots,), jnp.float32),      # temp: greedy
+                jnp.zeros((slots,), jnp.int32),        # top_k
+                jnp.ones((slots,), jnp.float32),       # top_p
+                jnp.zeros((slots,), jnp.float32),      # min_p
+                jnp.ones((slots,), jnp.float32),       # rep_pen
+                jax.vmap(jax.random.PRNGKey)(
+                    jnp.zeros((slots,), jnp.uint32)),  # keys
+                jnp.full((slots,), ctx0, jnp.int32))   # prompt_len
+
+    def chained(step_fn):
+        step = functools.partial(step_fn, cfg=cfg, chunk=chunk)
+
+        def run(pools, lengths, last, presence):
+            def body(carry, _):
+                pools, lengths, last, presence = carry
+                (pools, lengths, last, emitted, presence,
+                 _lps) = step(sp, pools, tables, lengths, last,
+                              active, sampling, presence)
+                return ((pools, lengths, last, presence),
+                        emitted[:, -1])
+            carry, ems = jax.lax.scan(
+                body, (pools, lengths, last, presence), None,
+                length=N)
+            return ems.sum()
+        return jax.jit(run)
+
+    out: dict = {"slots": slots, "context": ctx0, "chunk": chunk,
+                 "chained_chunks": N, "table_width": width,
+                 "pool_blocks": pool_blocks}
+    for name, fn in (("gather", paged.paged_decode_chunk),
+                     ("kernel", paged.paged_decode_chunk_kernel)):
+        pools = paged.init_pools(cfg, pool_blocks, blk)
+        lengths = jnp.full((slots,), ctx0, jnp.int32)
+        last = jnp.ones((slots,), jnp.int32)
+        presence = jnp.zeros((slots, cfg.vocab_size), bool)
+        runner = chained(fn)
+        float(runner(pools, lengths, last, presence))  # compile
+        t = med(lambda: float(runner(pools, lengths, last,
+                                     presence)), 3)
+        t = max(t - null_dt, 1e-9)  # one dispatch+readback RTT
+        out[f"{name}_ms_per_chunk"] = round(1e3 * t / N, 3)
+        out[f"{name}_tokens_per_s"] = round(slots * chunk * N / t)
+    if out.get("kernel_ms_per_chunk"):
+        out["gather_over_kernel"] = round(
+            out["gather_ms_per_chunk"] / out["kernel_ms_per_chunk"],
+            3)
+    return out
+
+
 MODEL_CHILD_FLAG = "--model-child"
+
+# Round 3's official artifact lost its headline: the single JSON line
+# outgrew the driver's tail-capture window, which truncated the line's
+# HEAD and left "parsed": null (VERDICT.md weak #1). The fix is
+# structural: the FULL record is written to a file and printed first
+# (safe to truncate), and the LAST stdout line is a compact summary a
+# tail window can never cut — metric, value, per-phase samples, one
+# headline number per section.
+FULL_OUT_DEFAULT = REPO / "BENCH_FULL.json"
+
+
+def headline_numbers(model) -> dict:
+    """One scalar per model-bench section, small by construction.
+
+    Dict-valued sections (serving engines, speculative) contribute
+    their wall rate; scalar roofline/MFU keys pass through; errors are
+    clipped to 60 chars so a failed section is visible in the summary
+    without being able to bloat it."""
+    if not isinstance(model, dict):
+        return {}
+    h: dict = {}
+    for k in ("fwd_tokens_per_s", "fwd_mfu_pct", "train_mfu_pct",
+              "train_step_tokens_per_s", "train_variant",
+              "prefill_tokens_per_s", "decode_tokens_per_s",
+              "decode_gbps", "decode_int8_tokens_per_s",
+              "fwd_4k_flash_tokens_per_s", "fwdbwd_4k_flash_tokens_per_s",
+              "fwdbwd_4k_tokens_per_s"):
+        if k in model:
+            h[k] = model[k]
+    for k, v in model.items():
+        if isinstance(v, dict):
+            if "wall_tokens_per_s" in v:
+                h[k] = v["wall_tokens_per_s"]
+                if "device_tokens_per_s" in v:
+                    h[k + "_dev"] = v["device_tokens_per_s"]
+            elif "short_e2e_p50_s" in v:
+                h[k] = v["short_e2e_p50_s"]
+        elif k.endswith("_error"):
+            h[k] = str(v)[:60]
+    return h
+
+
+def emit_result(out: dict, out_path: str | None,
+                compact_extra: dict | None = None,
+                default_name: str | None = None) -> None:
+    """Write the full record to a file, print it (truncatable), then
+    print the compact summary as the guaranteed-parseable LAST line.
+    ``default_name`` keeps different run modes from sharing (and
+    silently overwriting) one default file."""
+    full_line = json.dumps(out)
+    full_path = (pathlib.Path(out_path) if out_path
+                 else (REPO / default_name if default_name
+                       else FULL_OUT_DEFAULT))
+    wrote = True
+    try:
+        full_path.write_text(full_line + "\n")
+    except OSError as exc:  # pragma: no cover - read-only fs etc.
+        wrote = False  # a pointer to a missing/STALE file would
+        #                read as this capture's evidence
+        print(f"warning: could not write {full_path}: {exc}",
+              file=sys.stderr)
+    print(full_line)
+    compact = {
+        "metric": out.get("metric"),
+        "value": out.get("value"),
+        "unit": out.get("unit"),
+        "vs_baseline": out.get("vs_baseline"),
+        "mode": out.get("mode"),
+        "full": full_path.name if wrote else None,
+    }
+    if compact_extra:
+        compact.update(compact_extra)
+    print(json.dumps(compact), flush=True)
 
 
 def model_child_main() -> int:
@@ -1322,10 +1632,10 @@ def bench_model_only(out_path: str | None) -> int:
         "section_seconds": dict(SECTION_S),
         "captured_unix": int(time.time()),
     }
-    line = json.dumps(artifact)
-    if out_path:
-        pathlib.Path(out_path).write_text(line + "\n")
-    print(line)
+    emit_result(artifact, out_path, {
+        "status": artifact["status"],
+        "headline": headline_numbers(phases.get("model")),
+    }, default_name="BENCH_FULL_MODEL.json")
     return 0 if ok else 1
 
 
@@ -1360,7 +1670,7 @@ def main(argv=None) -> int:
             "mode": "e2e",
             "extras": result["detail"],
         }
-        print(json.dumps(out))
+        emit_result(out, out_path)
         return 0
 
     phases = {}
@@ -1410,7 +1720,18 @@ def main(argv=None) -> int:
                 BASELINE_READY_BOUND_S / value, 2),
         ),
     }
-    print(json.dumps(out))
+    compact_extra = {
+        "phase_samples": phases.get("phase_samples"),
+        "headline": headline_numbers(phases.get("model")),
+    }
+    ring = phases.get("ring_attention")
+    if isinstance(ring, dict) and "ring_32k_tokens_per_s" in ring:
+        compact_extra["ring_32k_tokens_per_s"] = \
+            ring["ring_32k_tokens_per_s"]
+    mh = phases.get("multihost")
+    if isinstance(mh, dict):
+        compact_extra["multihost_ok"] = mh.get("ok")
+    emit_result(out, out_path, compact_extra)
     return 0
 
 

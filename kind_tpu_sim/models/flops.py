@@ -121,6 +121,73 @@ def mfu(tokens_per_s: float, flops_per_token: float,
     return 100.0 * achieved / (spec.peak_bf16_tflops * 1e12)
 
 
+def train_step_breakdown(cfg: ModelConfig, batch: int, seq: int,
+                         spec: ChipSpec, flash: bool = True,
+                         backward: bool = True) -> Dict[str, float]:
+    """Analytic per-component LOWER BOUNDS (ms) for one train step.
+
+    Each component is bounded by max(its matmul FLOPs at bf16 peak,
+    its unavoidable HBM bytes at peak bandwidth), assuming perfect
+    fusion inside a component and no overlap between components (the
+    sum is therefore a lower bound on the step, and measured_ms /
+    sum names how much of the gap the datasheet roofline CANNOT
+    explain — that part is kernel/MXU inefficiency, not physics).
+
+    Components: the five GEMM families (wqkv, wo, mlp up+down,
+    readout — fwd + dgrad + wgrad = 3x fwd FLOPs), attention (flash:
+    fwd + ~2.5x bwd incl. its recompute = 3.5x fwd FLOPs, near-zero
+    score HBM; dense: adds the fp32 (t,t) score-matrix round trips),
+    cross-entropy over the vocab (memory-bound: 3 fp32 passes over
+    (tokens, vocab) logits), embed gather + scatter-add grad,
+    optimizer update (7 fp32 passes over params: grad read, m/v
+    read+write, param read+write), and the per-layer elementwise
+    glue (norms/rotary/residuals, ~12 bf16 passes over activations
+    per layer fwd+bwd). ``backward=False`` gives the forward-only
+    (loss_fn) bounds — comparing the two explains why measured fwd
+    MFU sits BELOW train MFU: the memory-bound components (CE,
+    elementwise, embed) are a larger fraction of a forward-only
+    step, while the backward adds almost pure GEMM work."""
+    tokens = float(batch * seq)
+    peak = spec.peak_bf16_tflops * 1e12
+    bw = spec.hbm_gbps * 1e9
+    p = matmul_params(cfg)
+    d, L = cfg.d_model, cfg.n_layers
+
+    def ms(flops=0.0, bytes_=0.0):
+        return round(1e3 * max(flops / peak, bytes_ / bw), 3)
+
+    gemm_f = 6.0 if backward else 2.0
+    gemm_layer = gemm_f * p["per_layer_active"] * L * tokens
+    t_eff = (seq + 1) / 2.0
+    attn_fwd = 4.0 * d * t_eff * tokens * L
+    if flash:
+        attn = ms(flops=(3.5 if backward else 1.0) * attn_fwd,
+                  bytes_=10.0 * tokens * d * 2.0)
+    else:
+        # fp32 score matrix: write+read through softmax fwd, again
+        # in bwd — 4 passes over (heads, t, t) per layer (2 fwd-only)
+        score_bytes = ((4.0 if backward else 2.0) * L * cfg.n_heads
+                       * batch * float(seq) * seq * 4.0)
+        attn = ms(flops=(3.0 if backward else 1.0) * attn_fwd,
+                  bytes_=score_bytes)
+    n_params = float(p["total"])
+    out = {
+        "gemms_ms": ms(flops=gemm_layer),
+        "readout_gemm_ms": ms(flops=gemm_f * p["readout"] * tokens),
+        "attention_ms": attn,
+        "ce_loss_ms": ms(bytes_=(3.0 if backward else 2.0)
+                         * tokens * cfg.vocab_size * 4.0),
+        "embed_ms": ms(bytes_=tokens * d
+                       * ((2.0 + 4.0) if backward else 2.0)),
+        "optimizer_ms": (ms(bytes_=7.0 * n_params * 4.0)
+                         if backward else 0.0),
+        "elementwise_ms": ms(bytes_=(12.0 if backward else 5.0)
+                             * L * tokens * d * 2.0),
+    }
+    out["step_lower_bound_ms"] = round(sum(out.values()), 2)
+    return out
+
+
 # ---------------------------------------------------------------------
 # decode byte accounting (bandwidth roofline)
 

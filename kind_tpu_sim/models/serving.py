@@ -1059,6 +1059,14 @@ class ServingEngine:
             sampling_state, self.presence)
         return emitted, lps
 
+    def _first_read(self, arr) -> int:
+        """Scalar readback of the admission sample — a separate seam
+        so instrumentation can see it: jit dispatches enqueue
+        asynchronously, and on remote-tunnel platforms THIS sync is
+        where one full RTT per admission lands (it hid inside
+        _activate as unattributed host time before)."""
+        return int(arr[0])
+
     def poll(self) -> List[Completion]:
         out, self.finished = self.finished, []
         return out
@@ -1234,7 +1242,7 @@ class ServingEngine:
         # generation 0 comes from the prefill logits, with the
         # request key folded at index 0 (same recipe the chunk
         # step uses for every later index)
-        first = int(self._first(
+        first = self._first_read(self._first(
             logits[None, :],
             jnp.asarray([samp.temperature], jnp.float32),
             jnp.asarray([samp.top_k], jnp.int32),
@@ -1242,7 +1250,7 @@ class ServingEngine:
             jnp.asarray([samp.min_p], jnp.float32),
             jnp.asarray([samp.repetition_penalty], jnp.float32),
             jnp.asarray(seen_row)[None, :],
-            jax.random.fold_in(key, 0)[None, :])[0])
+            jax.random.fold_in(key, 0)[None, :]))
         # the first token joins the seen set too
         self.presence = self.presence.at[slot, first].set(True)
         self.slot_lps[slot] = []
@@ -1604,32 +1612,45 @@ class PagedServingEngine(ServingEngine):
                                     self.slot_blocks[slot])
 
     def _preempt_youngest(self) -> bool:
-        """Evict the most recently admitted active slot: free its
-        blocks and requeue its request AT THE FRONT for exact
-        recompute. Returns False if nothing was evictable."""
+        """Evict the most recently admitted slot — active OR pending
+        (a chunked prefill mid-stream): free its blocks and requeue
+        its request AT THE FRONT for exact recompute. Returns False
+        if nothing was evictable.
+
+        Pending slots claim their whole prompt's blocks up front
+        (_claim_pending) but hold slot_req[slot] = None until
+        activation; excluding them pinned those blocks under pool
+        pressure and broke _ensure_blocks' invariant that full
+        eviction always lets a lone surviving slot grow."""
         import jax.numpy as jnp  # noqa: F401 (device vectors below)
 
         candidates = [
             (self.slot_admit_seq[s], s)
             for s, r in enumerate(self.slot_req) if r is not None
         ]
+        candidates += [(self.slot_admit_seq[s], s)
+                       for s in self._pending]
         if not candidates:
             return False
         _, slot = max(candidates)
-        req = self.slot_req[slot]
+        if slot in self._pending:
+            # never activated: no sampling/presence state to clear
+            req = self._pending.pop(slot)["req"]
+        else:
+            req = self.slot_req[slot]
+            self.slot_req[slot] = None
+            self.slot_emitted[slot] = []
+            self.slot_lps[slot] = []
+            self.active = self.active.at[slot].set(False)
+            self.temp = self.temp.at[slot].set(0.0)
+            self.top_k = self.top_k.at[slot].set(0)
+            self.top_p = self.top_p.at[slot].set(1.0)
+            self.min_p = self.min_p.at[slot].set(0.0)
+            self.rep_pen = self.rep_pen.at[slot].set(1.0)
+            self.presence = self.presence.at[slot].set(False)
         self.alloc.free(self.slot_blocks[slot])
         self.slot_blocks[slot] = []
         self.queue.insert(0, req)
-        self.slot_req[slot] = None
-        self.slot_emitted[slot] = []
-        self.slot_lps[slot] = []
-        self.active = self.active.at[slot].set(False)
-        self.temp = self.temp.at[slot].set(0.0)
-        self.top_k = self.top_k.at[slot].set(0)
-        self.top_p = self.top_p.at[slot].set(1.0)
-        self.min_p = self.min_p.at[slot].set(0.0)
-        self.rep_pen = self.rep_pen.at[slot].set(1.0)
-        self.presence = self.presence.at[slot].set(False)
         self.preemptions += 1
         return True
 
@@ -2024,6 +2045,12 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
         import jax.numpy as jnp
 
         self._admit()
+        if self._pending:
+            # chunked prefill composes here exactly as in the grid
+            # speculative engine: pending slots stream one prompt
+            # window per round between verify dispatches (omitting
+            # this spun run() forever — pending never drained).
+            self._advance_prefills()
         if not any(r is not None for r in self.slot_req):
             return
         # block coverage for the WHOLE scanned dispatch: W windows

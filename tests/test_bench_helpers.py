@@ -1,0 +1,88 @@
+"""bench.py's importable helpers, exercised on the CPU backend.
+
+bench.py is the round-end evidence pipeline; a runtime error in a
+helper costs a whole on-TPU capture window (r03 lost its official
+artifact to an output-format bug), so the pure pieces get unit
+coverage here."""
+
+import json
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    spec = importlib.util.spec_from_file_location(
+        "bench", root / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_headline_numbers_compact(bench):
+    model = {
+        "train_mfu_pct": 43.5,
+        "decode_tokens_per_s": 18951,
+        "serving": {"wall_tokens_per_s": 615,
+                    "device_tokens_per_s": 1736},
+        "serving_longprompt": {"short_e2e_p50_s": 1.504},
+        "fwdbwd_4k_error": "x" * 500,
+        "ring": [1, 2, 3],  # non-dict, non-scalar: ignored
+    }
+    h = bench.headline_numbers(model)
+    assert h["serving"] == 615
+    assert h["serving_dev"] == 1736
+    assert h["serving_longprompt"] == 1.504
+    assert h["train_mfu_pct"] == 43.5
+    assert len(h["fwdbwd_4k_error"]) == 60
+    assert "ring" not in h
+    assert bench.headline_numbers(None) == {}
+    # the whole summary line must stay tail-window-safe
+    assert len(json.dumps(h)) < 2000
+
+
+def test_emit_result_last_line_compact(bench, tmp_path, capsys):
+    out = {"metric": "m", "value": 1.5, "unit": "s",
+           "vs_baseline": None, "mode": "sim",
+           "extras": {"big": "x" * 50_000}}
+    path = tmp_path / "full.json"
+    bench.emit_result(out, str(path), {"headline": {"a": 1}})
+    lines = capsys.readouterr().out.strip().splitlines()
+    # full record printed first (truncatable), compact line LAST
+    assert json.loads(lines[0]) == out
+    compact = json.loads(lines[-1])
+    assert compact["metric"] == "m"
+    assert compact["full"] == "full.json"
+    assert compact["headline"] == {"a": 1}
+    assert len(lines[-1]) < 1000
+    assert json.loads(path.read_text()) == out
+
+
+@pytest.mark.slow
+def test_paged_tier_micro_tiny(bench):
+    """The tier micro-bench runs end to end on CPU at toy shapes and
+    reports both tiers (kernel tier lowers through Pallas interpret
+    mode on CPU)."""
+    import jax
+
+    from kind_tpu_sim.models import transformer as tf
+
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=64)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+
+    def med(fn, n):
+        fn()
+        return 0.01
+
+    out = bench.paged_tier_micro(params, cfg, med, 0.0, slots=2,
+                                 blk=8, chunk=4, N=2, ctx0=24)
+    assert out["pool_blocks"] == 1 + 2 * 4
+    assert "gather_ms_per_chunk" in out
+    assert "kernel_ms_per_chunk" in out
+    assert out["gather_over_kernel"] > 0

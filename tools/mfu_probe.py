@@ -79,6 +79,92 @@ def measure_train(cfg, batch: int, steps: int) -> dict:
     }
 
 
+def _timed(fn) -> float:
+    t0 = time.monotonic()
+    fn()
+    return time.monotonic() - t0
+
+
+def gemm_micro(cfg, rows: int, spec) -> dict:
+    """Measured achievable TFLOPs for each GEMM SHAPE the train step
+    runs, isolated: (rows, K) @ (K, N) in bf16, R iterations chained
+    data-dependently inside one dispatch (lax.scan; XLA cannot CSE),
+    timed to a scalar readback. The point: the datasheet peak is not
+    achievable at every shape — d_model-sized K dims underfill the
+    MXU — so the honest step bound uses each shape's MEASURED
+    ceiling, and the residual vs that bound is what scheduling /
+    fusion actually loses (VERDICT r03 #7: name the residual)."""
+    import jax
+    import jax.numpy as jnp
+
+    d, ff, v = cfg.d_model, cfg.d_ff, cfg.vocab_size
+    qkv_n = (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim
+    # Forward shapes, their dgrad transposes (dy @ W^T — wide-K,
+    # narrow-N, a DIFFERENT achievable ceiling than forward), and
+    # one deep-contraction wgrad representative (x^T @ dy contracts
+    # over the tokens axis and typically runs much nearer peak).
+    # mlp_up's dgrad shape IS mlp_down's forward shape (and vice
+    # versa), so only wqkv/readout need explicit transposes.
+    shapes = {
+        "wqkv": (d, qkv_n),
+        "wo": (d, d),
+        "mlp_up": (d, ff),
+        "mlp_down": (ff, d),
+        "readout": (d, v),
+        "wqkv_T": (qkv_n, d),
+        "readout_T": (v, d),
+        "wgrad_deep": None,  # (d, rows) @ (rows, d)
+    }
+    # Per-dispatch overhead (remote tunnels: ~60ms RTT per call) must
+    # come off the measurement — the small shapes' device time is a
+    # few ms, so an uncorrected readback would understate their
+    # ceiling ~10x and poison the measured-bound residual story.
+    null = jax.jit(lambda: jnp.zeros((), jnp.float32))
+    float(null())
+    null_dt = min(_timed(lambda: float(null())) for _ in range(5))
+
+    out = {}
+    for name, kn in shapes.items():
+        if kn is None:
+            M, K, N = d, rows, d  # wgrad: (d, rows) @ (rows, d)
+        else:
+            K, N = kn
+            M = rows
+        R = 4 if max(N, K) >= 8192 else 8
+        w = jax.random.normal(
+            jax.random.PRNGKey(1), (K, N), jnp.bfloat16) * 0.01
+
+        @jax.jit
+        def run(x, w=w, R=R):
+            def body(x, _):
+                y = x @ w
+                s = y.sum(dtype=jnp.float32)
+                # data dependence carried through ONE element (the
+                # scan carry aliases in place): a full-matrix
+                # transform — or even a broadcast rescale — adds an
+                # HBM pass comparable to the small GEMMs and biases
+                # their ceiling low
+                return x.at[0, 0].add((0.0 * s).astype(x.dtype)), s
+            _, sums = jax.lax.scan(body, x, None, length=R)
+            return sums.sum()
+
+        x0 = jax.random.normal(
+            jax.random.PRNGKey(2), (M, K), jnp.bfloat16)
+        float(run(x0))  # compile + warm
+        best = min(_timed(lambda: float(run(x0)))
+                   for _ in range(3))
+        best = max(best - null_dt, 1e-9)
+        flops = 2.0 * M * K * N * R
+        tflops = flops / best / 1e12
+        out[name] = {
+            "shape": f"({M}x{K})@({K}x{N})",
+            "tflops": round(tflops, 1),
+            "pct_of_peak": round(
+                100.0 * tflops / spec.peak_bf16_tflops, 1),
+        }
+    return out
+
+
 OP_FAMILIES = (
     ("matmul", ("dot", "conv", "fusion.*dot", "gemm")),
     ("attention-softmax", ("softmax", "reduce_max", "exponential",
@@ -179,13 +265,95 @@ def main() -> int:
         results.append(entry)
         print(json.dumps(entry), file=sys.stderr, flush=True)
 
-    ok = [r for r in results if "error" not in r]
+    # The "bigger d_model" lever (VERDICT r03 #7): d_model 2048 /
+    # d_ff 8192 quadruples per-token GEMM work with MXU-friendlier
+    # K dims; its MFU (against its OWN flop count) says whether the
+    # flagship's 41-43% is a shape artifact or a step-level one.
+    if backend == "tpu" and not args.quick:
+        lever = dataclasses.replace(base, d_model=2048, d_ff=8192,
+                                    flash=True)
+        try:
+            m = measure_train(lever, 8, steps)
+            entry = {"config": "flash=True batch=8 d_model=2048",
+                     "flash": True, "batch": 8, "d_model": 2048,
+                     **m,
+                     "train_mfu_pct": round(F.mfu(
+                         m["tokens_per_s"],
+                         F.train_flops_per_token(
+                             lever, lever.max_seq - 1), spec), 1)}
+            results.append(entry)
+            print(json.dumps(entry), file=sys.stderr, flush=True)
+        except Exception as exc:
+            results.append({"config": "d_model=2048 lever",
+                            "error": str(exc)[:200]})
+        finally:
+            gc.collect()
+            jax.clear_caches()
+
+    ok = [r for r in results if "error" not in r
+          and "d_model" not in r]
     report = {
         "backend": backend,
         "chip": spec.name if spec else None,
         "seq": base.max_seq,
         "matrix": results,
     }
+    # Analytic roofline decomposition + measured GEMM-shape ceilings
+    # (the named-residual story): datasheet bound, per-shape measured
+    # bound, and the measured step against both.
+    if spec is not None and ok:
+        best0 = max(ok, key=lambda r: r.get("train_mfu_pct", 0))
+        b0, fl0 = best0["batch"], best0["flash"]
+        bd = F.train_step_breakdown(base, b0, base.max_seq - 1,
+                                    spec, flash=fl0)
+        bd["measured_ms"] = best0["step_ms"]
+        bd["measured_over_bound"] = round(
+            best0["step_ms"] / bd["step_lower_bound_ms"], 2)
+        report["breakdown_train"] = bd
+        report["breakdown_fwd"] = F.train_step_breakdown(
+            base, b0, base.max_seq - 1, spec, flash=fl0,
+            backward=False)
+        try:
+            gm = gemm_micro(base, b0 * (base.max_seq - 1), spec)
+            report["gemm_micro"] = gm
+            # Re-cost the GEMMs at their measured per-PASS ceilings:
+            # fwd at the forward shape's ceiling, dgrad at its
+            # transposed shape's, wgrad at the deep-contraction
+            # ceiling (each pass is 2*K*N flops/token).
+            qkv_n = ((base.n_heads + 2 * base.kv_heads)
+                     * base.head_dim)
+            fams = {
+                # fam: (K*N, fwd_key, dgrad_key, layers)
+                "wqkv": (base.d_model * qkv_n, "wqkv", "wqkv_T",
+                         base.n_layers),
+                "wo": (base.d_model * base.d_model, "wo", "wo",
+                       base.n_layers),
+                "mlp_up": (base.d_model * base.d_ff, "mlp_up",
+                           "mlp_down", base.n_layers),
+                "mlp_down": (base.d_ff * base.d_model, "mlp_down",
+                             "mlp_up", base.n_layers),
+                "readout": (base.d_model * base.vocab_size,
+                            "readout", "readout_T", 1),
+            }
+            tokens = float(b0 * (base.max_seq - 1))
+            c_wgrad = gm["wgrad_deep"]["tflops"] * 1e12
+            meas_gemm_ms = 0.0
+            for fam, (kn, fk, dk, layers) in fams.items():
+                pass_flops = 2.0 * kn * layers * tokens
+                meas_gemm_ms += 1e3 * pass_flops * (
+                    1.0 / (gm[fk]["tflops"] * 1e12)
+                    + 1.0 / (gm[dk]["tflops"] * 1e12)
+                    + 1.0 / c_wgrad)
+            non_gemm = (bd["attention_ms"] + bd["ce_loss_ms"]
+                        + bd["embed_ms"] + bd["optimizer_ms"]
+                        + bd["elementwise_ms"])
+            bound2 = round(meas_gemm_ms + non_gemm, 2)
+            report["step_bound_at_measured_gemm_ceilings_ms"] = \
+                bound2
+            report["measured_over_measured_bound"] = round(
+                best0["step_ms"] / bound2, 2)
+        except Exception as exc:
+            report["gemm_micro_error"] = str(exc)[:200]
     if ok:
         key = ("train_mfu_pct" if spec is not None
                else "tokens_per_s")

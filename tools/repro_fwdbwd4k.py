@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Minimal repro + reduction for the dense-4k batch-2 backward
+compile crash.
+
+Both r03 on-TPU captures (and r04 cap1) hit a deterministic remote-
+compile failure — ``INTERNAL: .../remote_compile: HTTP 500:
+tpu_compile_helper exit 1`` — when jitting the NON-flash backward of
+the bench model at (batch=2, seq=4096); batch 1 compiles
+(bench.py fwdbwd_4k fallback). This tool pins the bug down
+(VERDICT r03 next-step #6):
+
+* runs a MATRIX of reduced variants, each in its own subprocess (a
+  compile-helper crash must not poison sibling measurements or the
+  parent), recording ok / crash / timeout per variant;
+* fingerprints the failing HLO (size + sha256 of the lowered
+  StableHLO text — lowering is host-side and survives the compile
+  crash) so the platform bug is reportable;
+* tries the obvious workarounds (remat, fp32 accumulation off, seq
+  halving, layer reduction) and records which compile.
+
+Usage:  python tools/repro_fwdbwd4k.py [--out tools/FWDBWD4K_REPRO.json]
+Needs the TPU tunnel; each variant is bounded by --timeout (default
+300s, first compile on the tunnel is slow).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+# Each variant: (name, dict of overrides). Crash hypothesis space:
+# the XLA backward of non-flash attention at 4k materializes (t, t)
+# score matrices per head; batch 2 doubles that live set. Layers and
+# seq shrink the program, remat changes the backward's structure,
+# flash removes the materialization entirely.
+VARIANTS = [
+    ("b2_dense_L8", {"batch": 2}),                      # the crash
+    ("b1_dense_L8", {"batch": 1}),                      # known-good
+    ("b4_dense_L8", {"batch": 4}),                      # boundary up
+    ("b2_dense_L4", {"batch": 2, "layers": 4}),         # half program
+    ("b2_dense_L1", {"batch": 2, "layers": 1}),         # minimal
+    ("b2_dense_L8_seq2k", {"batch": 2, "seq": 2048}),   # half seq
+    ("b2_dense_L8_remat", {"batch": 2, "remat": True}),  # workaround?
+    ("b2_flash_L8", {"batch": 2, "flash": True}),       # known-good
+]
+
+# Plain-marker template (NOT str.format: the json.dumps braces below
+# would be parsed as replacement fields).
+CHILD = r"""
+import dataclasses, json, sys
+sys.path.insert(0, __REPO__)
+spec = json.loads(__SPEC__)
+import jax
+import jax.numpy as jnp
+from kind_tpu_sim.models import transformer as tf
+
+cfg = tf.bench_config()
+cfg = dataclasses.replace(
+    cfg, max_seq=spec.get("seq", 4096),
+    n_layers=spec.get("layers", cfg.n_layers),
+    flash=spec.get("flash", False),
+    remat=spec.get("remat", False))
+params = tf.init_params(jax.random.PRNGKey(0), cfg)
+tokens = tf.sample_batch(jax.random.PRNGKey(2), cfg, spec["batch"],
+                         cfg.max_seq)
+fn = jax.jit(jax.grad(
+    lambda p, t: tf.forward(p, t, cfg).astype(jnp.float32).sum()))
+lowered = fn.lower(params, tokens)
+text = lowered.as_text()
+print(json.dumps({"hlo_bytes": len(text),
+                  "hlo_sha256": __import__("hashlib")
+                  .sha256(text.encode()).hexdigest()}), flush=True)
+compiled = lowered.compile()  # the step that crashes the helper
+print(json.dumps({"compiled": True}), flush=True)
+"""
+
+
+def run_variant(name: str, spec: dict, timeout: int) -> dict:
+    t0 = time.monotonic()
+    out: dict = {"variant": name, "spec": spec}
+    try:
+        src = (CHILD
+               .replace("__REPO__", repr(str(REPO)))
+               .replace("__SPEC__", repr(json.dumps(spec))))
+        proc = subprocess.run(
+            [sys.executable, "-c", src],
+            capture_output=True, text=True, timeout=timeout)
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                out.update(json.loads(line))
+        out["status"] = ("compiled" if out.get("compiled")
+                         else "compile-crash")
+        if proc.returncode != 0 and not out.get("compiled"):
+            tail = (proc.stderr or proc.stdout).strip().splitlines()
+            out["error"] = " ".join(tail[-3:])[-300:]
+    except subprocess.TimeoutExpired:
+        out["status"] = "timeout"
+    out["seconds"] = round(time.monotonic() - t0, 1)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(
+        REPO / "tools" / "FWDBWD4K_REPRO.json"))
+    ap.add_argument("--timeout", type=int, default=300)
+    ap.add_argument("--only", help="comma-separated variant names")
+    args = ap.parse_args()
+    names = set(args.only.split(",")) if args.only else None
+    results = []
+    for name, spec in VARIANTS:
+        if names and name not in names:
+            continue
+        print(f"[{name}] ...", flush=True)
+        res = run_variant(name, spec, args.timeout)
+        print(f"[{name}] {res['status']} ({res['seconds']}s)",
+              flush=True)
+        results.append(res)
+    report = {
+        "bug": ("remote tpu_compile_helper HTTP 500 on the dense "
+                "(non-flash) 4k backward at batch>=2"),
+        "captured_unix": int(time.time()),
+        "results": results,
+    }
+    pathlib.Path(args.out).write_text(
+        json.dumps(report, indent=1) + "\n")
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
