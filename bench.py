@@ -678,12 +678,14 @@ def model_throughput(emit=None) -> dict | None:
                 ("_chunk", "decode_chunk"),
                 ("_paged_chunk", "decode_chunk"),
                 ("_prefill", "prefill"),
+                ("_prefill_many", "prefill"),
                 ("_paged_prefill", "prefill"),
                 ("_suffix", "suffix_window"),
                 ("_paged_suffix", "suffix_window"),
                 ("_spec_step", "verify_scan"),
                 ("_first", "first_sample"),
                 ("_first_read", "first_readback"),
+                ("_first_read_many", "first_readback"),
                 ("_retire", "retire_fetch"),
                 ("_spec_retire", "retire_fetch"),
             )
@@ -1013,9 +1015,14 @@ def model_throughput(emit=None) -> dict | None:
                 live pool accounting."""
                 sp_l = sp_serve
                 slots, blk_r, pool_r = 16, 64, 288
+                # fixed table width: the mixed 224/1k/2k prompts
+                # would otherwise re-bucket the width as slots grow
+                # and retrace the chunk kernel per width (~4s per
+                # decode dispatch in r4 run2 — compile, not serving)
                 sc_r = serving.ServingConfig(
                     max_slots=slots, max_len=2560, chunk=64,
-                    paged_blocks=pool_r, block_size=blk_r)
+                    paged_blocks=pool_r, block_size=blk_r,
+                    paged_width=64)
                 eng = serving.PagedServingEngine(sp_l, cfg, sc_r)
                 rng = np.random.RandomState(7)
                 reqs = []
@@ -1603,7 +1610,10 @@ def capture_model_section(phases: dict) -> None:
         SECTION_S["model_probe_failed"] = round(
             time.monotonic() - probe_t0, 1)
         return
-    budget = float(os.environ.get("BENCH_MODEL_BUDGET_S", "1200"))
+    # default sized for the full section list incl. the round-4
+    # operating-point entries (~8 extra prefill-bucket/trace
+    # compiles at ~1min each on the remote-compile tunnel)
+    budget = float(os.environ.get("BENCH_MODEL_BUDGET_S", "2400"))
     with stopwatch("model_total"):
         throughput = model_throughput_via_child(budget)
     # A child that died/hung before streaming its FIRST section must

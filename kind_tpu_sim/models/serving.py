@@ -91,6 +91,15 @@ class ServingConfig:
     paged_kernel: bool = False  # paged tier only: Pallas paged-
     #                             attention (direct block reads, no
     #                             gather view); bf16 pools only
+    paged_width: int = 0      # paged tier: fixed block-table width
+    #                           (0 = dynamic pow2 bucketing). Mixed
+    #                           long/short workloads re-bucket the
+    #                           width as slots grow (8->16->32->64),
+    #                           and every new width retraces the
+    #                           chunk/prefill kernels (~1min each on
+    #                           remote-compile platforms); fixing it
+    #                           at the workload's max trades a
+    #                           bigger gather view for ONE trace
     prefill_chunk: int = 0    # >0: chunked prefill (the vLLM TTFT/
     #                           ITL smoother) — prompts enter the
     #                           grid in windows of this many tokens,
@@ -712,6 +721,39 @@ def _jitted_prefill(cfg: ModelConfig):
                    donate_argnums=(1,))
 
 
+def _prefill_many_into_slots(params, cache, tokens, true_lens,
+                             slots, *, cfg: ModelConfig):
+    """K whole-prompt prefills in ONE dispatch: lax.scan over the
+    single-slot prefill, so the device work is identical to K
+    separate dispatches but the per-dispatch host/RTT cost is paid
+    once (on remote-tunnel platforms each dispatch is ~60ms — the
+    dominant cost of an admission wave). ``tokens`` is (K, L_pad)
+    within one prefill bucket; callers pad K to a power of two with
+    DUPLICATES of row 0 — a duplicate rewrites the same slot with
+    the same values, which is idempotent. Returns (cache, (K, vocab)
+    fp32 logits at each row's true last position)."""
+    import jax
+
+    def body(cache, xs):
+        tok, tl, sl = xs
+        cache, logits = _prefill_into_slot(params, cache,
+                                           tok[None, :], tl, sl,
+                                           cfg=cfg)
+        return cache, logits
+
+    return jax.lax.scan(body, cache, (tokens, true_lens, slots))
+
+
+def _jitted_prefill_many(cfg: ModelConfig):
+    import functools
+
+    import jax
+
+    return jax.jit(
+        functools.partial(_prefill_many_into_slots, cfg=cfg),
+        donate_argnums=(1,))
+
+
 def _jitted_chunk(cfg: ModelConfig, chunk: int):
     import functools
 
@@ -766,6 +808,8 @@ def _jitted_write():
 import functools as _functools
 
 _jitted_prefill = _functools.lru_cache(maxsize=32)(_jitted_prefill)
+_jitted_prefill_many = _functools.lru_cache(maxsize=32)(
+    _jitted_prefill_many)
 _jitted_chunk = _functools.lru_cache(maxsize=32)(_jitted_chunk)
 _jitted_first = _functools.lru_cache(maxsize=1)(_jitted_first)
 _jitted_first_lp = _functools.lru_cache(maxsize=1)(_jitted_first_lp)
@@ -963,6 +1007,8 @@ class ServingEngine:
         # wrappers per engine.
         self._prefill = functools.partial(_jitted_prefill(cfg),
                                           self.params)
+        self._prefill_many = functools.partial(
+            _jitted_prefill_many(cfg), self.params)
         self._chunk = functools.partial(
             _jitted_chunk(cfg, serving.chunk), self.params)
         self._suffix = functools.partial(_jitted_suffix(cfg),
@@ -1083,24 +1129,6 @@ class ServingEngine:
 
     # -- internals -----------------------------------------------------
 
-    def _prefill_slot(self, slot: int, req: Request):
-        """Write the prompt's k/v into the slot's cache storage and
-        return the fp32 logits at the prompt's last position (the
-        grid implementation; PagedServingEngine overrides with the
-        block-pool scatter path)."""
-        import jax.numpy as jnp
-
-        # whole-prompt admission IS the chunked machinery with one
-        # window covering the entire (post-hit) suffix: claim/restore
-        # -> one window forward -> store. ONE admission recipe.
-        p = self._claim_pending(slot, req)
-        suffix = req.prompt[p:]
-        logits = self._prefill_window(
-            slot, req, jnp.asarray(_padded_window(suffix)),
-            len(suffix), p)
-        self._store_pending(slot, req)
-        return logits
-
     def _restore_prefix(self, slot: int, req: Request) -> int:
         """Device-copy the longest usable stored prefix of the
         request's prompt into ``slot`` (THE one copy of the hit-
@@ -1133,6 +1161,7 @@ class ServingEngine:
         })
 
     def _admit(self) -> None:
+        claims = []
         for slot in range(self.serving.max_slots):
             if (self.slot_req[slot] is not None
                     or slot in self._pending or not self.queue):
@@ -1155,8 +1184,132 @@ class ServingEngine:
                     "done": self._claim_pending(slot, req),
                 }
                 continue
-            logits = self._prefill_slot(slot, req)
-            self._activate(slot, req, logits)
+            claims.append((slot, req))
+        if claims:
+            self._admit_claims(claims)
+
+    def _admit_claims(self, claims) -> None:
+        """Admit this round's whole-prompt claims. Prefix-cache hits
+        and lone misses take the single-slot recipe; two or more
+        same-bucket misses share ONE stacked prefill dispatch and
+        ONE first-token sample+readback (_admit_group) — on remote
+        platforms an admission wave costs ~3 RTTs instead of ~3 per
+        request.
+
+        Intra-wave prefix sharing is preserved: a claim whose prompt
+        extends a cache_prefix store still pending in this wave
+        flushes the wave first (sequential admission would have
+        stored before this claim ran, and the store only exists
+        after its prefill) — flushing costs batching, never
+        correctness."""
+        if not self._batch_admission():
+            # no batching tier (paged block tables): keep strictly
+            # sequential admission — claim, window, store, activate
+            # per slot — so block-granular intra-wave prefix
+            # sharing (each store visible to the NEXT claim)
+            # behaves exactly as before batching existed
+            for slot, req in claims:
+                self._admit_single(slot, req,
+                                   self._claim_pending(slot, req))
+            return
+        groups: Dict[int, list] = {}
+        wave_stores: list = []
+        for slot, req in claims:
+            if any(len(sp) <= len(req.prompt)
+                   and req.prompt[:len(sp)] == sp
+                   for sp in wave_stores):
+                self._flush_groups(groups)
+                groups, wave_stores = {}, []
+            p = self._claim_pending(slot, req)
+            if p:
+                # hit: restore already happened in claim; only the
+                # suffix runs — per-slot (suffix lengths vary)
+                self._admit_single(slot, req, p)
+                continue
+            groups.setdefault(
+                _bucket(len(req.prompt)), []).append((slot, req))
+            if req.cache_prefix and self.prefix_cache is not None:
+                wave_stores.append(list(req.prompt))
+        self._flush_groups(groups)
+
+    def _flush_groups(self, groups) -> None:
+        for bucket, grp in sorted(groups.items()):
+            if len(grp) == 1 or not self._batch_admission():
+                for slot, req in grp:
+                    self._admit_single(slot, req, 0)
+                continue
+            self._admit_group(grp)
+
+    def _admit_single(self, slot: int, req: Request,
+                      done: int) -> None:
+        """One slot's whole-prompt admission (claim already done):
+        the post-hit suffix (or full prompt at done=0) as one
+        window, store, activate."""
+        import jax.numpy as jnp
+
+        suffix = req.prompt[done:]
+        logits = self._prefill_window(
+            slot, req, jnp.asarray(_padded_window(suffix)),
+            len(suffix), done)
+        self._store_pending(slot, req)
+        self._activate(slot, req, logits)
+
+    def _batch_admission(self) -> bool:
+        """Whether this engine's storage supports the stacked
+        admission dispatch (the dense slot grid does; the paged
+        engines' per-slot block tables don't compose with it yet)."""
+        return True
+
+    def _admit_group(self, grp) -> None:
+        """One same-bucket admission wave: stacked prefill (K padded
+        to a power of two with idempotent duplicates of row 0, so
+        trace count stays O(log slots) per bucket), one batched
+        first-token sample, one readback for all K tokens."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        K = len(grp)
+        K_pad = 1
+        while K_pad < K:
+            K_pad *= 2
+        padded = grp + [grp[0]] * (K_pad - K)
+        toks = np.stack([
+            _padded_window(req.prompt)[0] for _, req in padded])
+        lens = np.asarray([len(req.prompt) for _, req in padded],
+                          np.int32)
+        slots = np.asarray([slot for slot, _ in padded], np.int32)
+        self.cache, logits_k = self._prefill_many(
+            self.cache, jnp.asarray(toks), jnp.asarray(lens),
+            jnp.asarray(slots))
+        samps = [req.sampling or SamplingConfig(temperature=0.0)
+                 for _, req in grp]
+        seen = np.zeros((K, self.cfg.vocab_size), bool)
+        for i, (_, req) in enumerate(grp):
+            seen[i, np.asarray(req.prompt, np.int64)] = True
+        keys = jnp.stack([
+            jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
+            for _, req in grp])
+        firsts = self._first_read_many(self._first(
+            logits_k[:K],
+            jnp.asarray([s.temperature for s in samps], jnp.float32),
+            jnp.asarray([s.top_k for s in samps], jnp.int32),
+            jnp.asarray([s.top_p for s in samps], jnp.float32),
+            jnp.asarray([s.min_p for s in samps], jnp.float32),
+            jnp.asarray([s.repetition_penalty for s in samps],
+                        jnp.float32),
+            jnp.asarray(seen), keys))
+        for i, (slot, req) in enumerate(grp):
+            self._store_pending(slot, req)
+            self._activate_with_first(slot, req, logits_k[i],
+                                      firsts[i])
+
+    def _first_read_many(self, arr) -> list:
+        """One batched readback of an admission wave's first tokens
+        (the batched analog of _first_read — one RTT for K slots)."""
+        import jax
+
+        return [int(v) for v in jax.device_get(arr)]
 
     def _advance_prefills(self) -> None:
         """One prompt window per pending slot per scheduling round
@@ -1210,9 +1363,37 @@ class ServingEngine:
         self._store_prefix(slot, req)
 
     def _activate(self, slot: int, req: Request, logits) -> None:
-        """Post-prefill admission: sampling vectors, presence, first
-        token, clocks, slot bookkeeping (shared by the whole-prompt
-        and chunked-prefill paths)."""
+        """Post-prefill admission, single-slot path: sample the
+        first token from the prefill logits (one dispatch + one
+        scalar readback), then the shared bookkeeping."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as _np
+
+        samp = req.sampling or SamplingConfig(temperature=0.0)
+        seen_row = _np.zeros((self.cfg.vocab_size,), bool)
+        seen_row[_np.asarray(req.prompt, _np.int64)] = True
+        # generation 0 comes from the prefill logits, with the
+        # request key folded at index 0 (same recipe the chunk
+        # step uses for every later index)
+        first = self._first_read(self._first(
+            logits[None, :],
+            jnp.asarray([samp.temperature], jnp.float32),
+            jnp.asarray([samp.top_k], jnp.int32),
+            jnp.asarray([samp.top_p], jnp.float32),
+            jnp.asarray([samp.min_p], jnp.float32),
+            jnp.asarray([samp.repetition_penalty], jnp.float32),
+            jnp.asarray(seen_row)[None, :],
+            jax.random.fold_in(
+                jax.random.PRNGKey(req.seed), 0)[None, :]))
+        self._activate_with_first(slot, req, logits, first)
+
+    def _activate_with_first(self, slot: int, req: Request, logits,
+                             first: int) -> None:
+        """Admission bookkeeping shared by the single-slot and
+        batched (_admit_group) paths: sampling vectors, presence,
+        clocks, draft seeding, finish-if-inactive. ``first`` is the
+        already-sampled generation-0 token."""
         import jax.numpy as jnp
 
         import jax
@@ -1238,19 +1419,6 @@ class ServingEngine:
         key = jax.random.PRNGKey(req.seed)
         self.keys = self.keys.at[slot].set(key)
         self.prompt_len = self.prompt_len.at[slot].set(t_p)
-
-        # generation 0 comes from the prefill logits, with the
-        # request key folded at index 0 (same recipe the chunk
-        # step uses for every later index)
-        first = self._first_read(self._first(
-            logits[None, :],
-            jnp.asarray([samp.temperature], jnp.float32),
-            jnp.asarray([samp.top_k], jnp.int32),
-            jnp.asarray([samp.top_p], jnp.float32),
-            jnp.asarray([samp.min_p], jnp.float32),
-            jnp.asarray([samp.repetition_penalty], jnp.float32),
-            jnp.asarray(seen_row)[None, :],
-            jax.random.fold_in(key, 0)[None, :]))
         # the first token joins the seen set too
         self.presence = self.presence.at[slot, first].set(True)
         self.slot_lps[slot] = []
@@ -1550,6 +1718,12 @@ class PagedServingEngine(ServingEngine):
     # one recipe for whole-prompt AND chunked prefill; the overrides
     # below supply the block-pool storage semantics
 
+    def _batch_admission(self) -> bool:
+        # per-slot block tables: the stacked prefill dispatch would
+        # need ragged (slot, table_row) pairs per scan step — not
+        # composed yet, so paged admission stays per-slot
+        return False
+
     def _claim_pending(self, slot: int, req: Request) -> int:
         """Claim, paged: allocate the whole prompt's blocks up front
         (windows or the single whole-suffix forward stream into
@@ -1591,7 +1765,7 @@ class PagedServingEngine(ServingEngine):
         from kind_tpu_sim.models import paged
 
         blocks = self.slot_blocks[slot]
-        width = paged.width_bucket(len(blocks))
+        width = self._table_width(len(blocks))
         table_row = np.zeros((width,), np.int32)
         table_row[:len(blocks)] = blocks
         if done == 0:
@@ -1695,14 +1869,27 @@ class PagedServingEngine(ServingEngine):
             assert got is not None
             self.slot_blocks[s].extend(got)
 
-    def _build_tables(self):
-        """Device block table bucketed to the longest slot's block
-        count (pow-2 width bounds retraces)."""
-        import numpy as np
-
+    def _table_width(self, n_blocks: int) -> int:
+        """Block-table width: fixed (ServingConfig.paged_width) or
+        pow-2 bucketed. A slot outgrowing a fixed width would have
+        its writes silently routed to the garbage block — fail loud
+        instead."""
         from kind_tpu_sim.models import paged
 
-        width = paged.width_bucket(
+        if self.serving.paged_width:
+            if n_blocks > self.serving.paged_width:
+                raise ValueError(
+                    f"slot needs {n_blocks} blocks; paged_width is "
+                    f"fixed at {self.serving.paged_width}")
+            return self.serving.paged_width
+        return paged.width_bucket(n_blocks)
+
+    def _build_tables(self):
+        """Device block table bucketed to the longest slot's block
+        count (pow-2 width bounds retraces; paged_width fixes it)."""
+        import numpy as np
+
+        width = self._table_width(
             max((len(b) for b in self.slot_blocks), default=1) or 1)
         tables = np.zeros((self.serving.max_slots, width), np.int32)
         for s, blks in enumerate(self.slot_blocks):
@@ -1829,6 +2016,8 @@ class SpeculativeServingEngine(ServingEngine):
         self.verify_steps = 0
         self._prefill = functools.partial(_jitted_prefill(cfg),
                                           self.params)
+        self._prefill_many = functools.partial(
+            _jitted_prefill_many(cfg), self.params)
         self._suffix = functools.partial(_jitted_suffix(cfg),
                                          self.params)
         if self._draft is None:
@@ -2116,6 +2305,16 @@ def engines_report(cfg: ModelConfig = None) -> Dict[str, Any]:
                                        speculative_k=3,
                                        paged_blocks=12,
                                        block_size=8))),
+        # the FULL composition: paged + speculative + chunked
+        # prefill (regression surface for the r4 pending-advance
+        # fix — this configuration used to hang run())
+        "paged_spec_chunked": run(
+            lambda: PagedSpeculativeServingEngine(
+                params, cfg, ServingConfig(max_slots=2, max_len=48,
+                                           speculative_k=3,
+                                           paged_blocks=12,
+                                           block_size=8,
+                                           prefill_chunk=8))),
     }
     agree = all(o == outs["grid"] for o in outs.values())
     return {

@@ -556,6 +556,77 @@ def test_chunked_prefill_paged_matches_whole_prompt(cfg, params):
     assert pw_hits == pc_hits == 1
 
 
+def test_batched_admission_matches_per_slot(cfg, params):
+    """An admission wave of same-bucket whole-prompt requests runs
+    as ONE stacked prefill dispatch + one batched first-token
+    readback (_admit_group); the streams must equal the per-slot
+    admission path exactly — same kernels, same per-row sampling
+    math, just fewer dispatches. Mixed greedy+sampled, mixed
+    buckets (grouping must split them), and re-admission waves."""
+    import dataclasses as _dc
+
+    reqs = []
+    for i in range(8):
+        # two buckets: lengths 4..7 (bucket 8) and 9..12 (bucket 16)
+        length = (4 + i) if i < 4 else (5 + i)
+        samp = (serving.SamplingConfig(temperature=1.1)
+                if i % 2 else None)
+        reqs.append(serving.Request(
+            f"b{i}", make_prompt(200 + i, length, cfg.vocab_size),
+            max_new=6, sampling=samp, seed=i))
+
+    def run(force_per_slot):
+        sc = serving.ServingConfig(max_slots=4, max_len=48, chunk=8)
+        eng = serving.ServingEngine(params, cfg, sc)
+        if force_per_slot:
+            eng._batch_admission = lambda: False
+        waves = {"n": 0}
+        orig = eng._admit_group
+
+        def counting(grp):
+            waves["n"] += 1
+            return orig(grp)
+        eng._admit_group = counting
+        for r in reqs:
+            eng.submit(_dc.replace(r))
+        out = {c.request_id: tuple(c.tokens) for c in eng.run()}
+        return out, waves["n"]
+
+    batched, batched_waves = run(False)
+    per_slot, per_slot_waves = run(True)
+    assert batched == per_slot
+    assert per_slot_waves == 0
+    # first round: 4 free slots, head-of-queue order gives 4 claims
+    # across 2 buckets -> at least one multi-request wave
+    assert batched_waves >= 1
+
+
+def test_paged_fixed_width_matches_dynamic(cfg, params):
+    """ServingConfig.paged_width pins the block-table width (one
+    kernel trace for mixed-length workloads) — streams must equal
+    dynamic pow-2 bucketing exactly (extra table columns point at
+    the garbage block and are masked), and a slot outgrowing the
+    fixed width fails loud, not silently-garbage-routed."""
+    import dataclasses as _dc
+
+    reqs = [serving.Request(
+        f"w{i}", make_prompt(210 + i, 6 + 5 * i, cfg.vocab_size),
+        max_new=8) for i in range(4)]
+
+    def run(**extra):
+        sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                                   paged_blocks=24, block_size=8,
+                                   **extra)
+        eng = serving.PagedServingEngine(params, cfg, sc)
+        for r in reqs:
+            eng.submit(_dc.replace(r))
+        return {c.request_id: tuple(c.tokens) for c in eng.run()}
+
+    assert run() == run(paged_width=8)
+    with pytest.raises(ValueError, match="paged_width"):
+        run(paged_width=1)
+
+
 def test_chunked_prefill_paged_spec_engine(cfg, params):
     """The FULL composition: paged storage + speculative verify +
     chunked prefill. Regression for a silent hang: step_round never
@@ -1093,7 +1164,8 @@ def test_engines_report_matrix_agrees():
     assert rep["ok"], rep
     assert rep["all_streams_identical"]
     assert rep["engines"] == ["grid", "grid_chunked_prefill",
-                              "paged", "paged_spec", "spec"]
+                              "paged", "paged_spec",
+                              "paged_spec_chunked", "spec"]
 
 
 def test_request_latency_metrics(cfg, params):
