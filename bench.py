@@ -776,6 +776,12 @@ def model_throughput(emit=None) -> dict | None:
                     "slots": eng.serving.max_slots,
                     "wall_tokens_per_s": round(gen / wall),
                     "dispatches": jit_calls,
+                    # sync readbacks (first tokens + retire fetches):
+                    # each is >= 1 RTT of wall the dispatch-count
+                    # correction does NOT subtract
+                    "readbacks": sum(
+                        st[0] for lbl, st in phases.items()
+                        if lbl in _READBACK_PHASES),
                 }
                 if device > 0.2 * wall:
                     entry["device_tokens_per_s"] = round(gen / device)
@@ -1073,6 +1079,19 @@ def model_throughput(emit=None) -> dict | None:
                                 192, 512))
             except Exception as exc:  # pragma: no cover
                 result["serving_saturated_error"] = str(exc)[:100]
+            _note()
+            # chunk=512: one decode dispatch + one retire per
+            # request wave — the fewest scheduling rounds the
+            # workload admits, so the wall rate's remaining distance
+            # to the solo-decode roof is pure admission+readback
+            try:
+                run_serving("serving_saturated_512", chunk=512,
+                            reqs=uniform_stream(
+                                "serving_saturated_512", 2 * batch,
+                                192, 512))
+            except Exception as exc:  # pragma: no cover
+                result["serving_saturated_512_error"] = \
+                    str(exc)[:100]
             _note()
 
             # Speculative at its operating point: long outputs amortize

@@ -728,10 +728,12 @@ def _prefill_many_into_slots(params, cache, tokens, true_lens,
     separate dispatches but the per-dispatch host/RTT cost is paid
     once (on remote-tunnel platforms each dispatch is ~60ms — the
     dominant cost of an admission wave). ``tokens`` is (K, L_pad)
-    within one prefill bucket; callers pad K to a power of two with
+    within one prefill bucket; callers pad K to max_slots with
     DUPLICATES of row 0 — a duplicate rewrites the same slot with
-    the same values, which is idempotent. Returns (cache, (K, vocab)
-    fp32 logits at each row's true last position)."""
+    the same values (idempotent), and the fixed K means exactly ONE
+    trace per prompt bucket, so a single warm-up request compiles
+    everything a measured run will dispatch. Returns (cache,
+    (K, vocab) fp32 logits at each row's true last position)."""
     import jax
 
     def body(cache, xs):
@@ -1233,11 +1235,12 @@ class ServingEngine:
         self._flush_groups(groups)
 
     def _flush_groups(self, groups) -> None:
+        # every miss — even a lone one — goes through the stacked
+        # dispatch: same ~3 RTTs as the single-slot path, and ONE
+        # trace per bucket that the warm-up's single request already
+        # compiled (a pow-2-by-wave-size padding scheme compiled a
+        # fresh trace per wave size INSIDE measured runs)
         for bucket, grp in sorted(groups.items()):
-            if len(grp) == 1 or not self._batch_admission():
-                for slot, req in grp:
-                    self._admit_single(slot, req, 0)
-                continue
             self._admit_group(grp)
 
     def _admit_single(self, slot: int, req: Request,
@@ -1261,19 +1264,20 @@ class ServingEngine:
         return True
 
     def _admit_group(self, grp) -> None:
-        """One same-bucket admission wave: stacked prefill (K padded
-        to a power of two with idempotent duplicates of row 0, so
-        trace count stays O(log slots) per bucket), one batched
-        first-token sample, one readback for all K tokens."""
+        """One same-bucket admission wave: stacked prefill, one
+        batched first-token sample, one readback for all K tokens.
+        K is padded to max_slots with idempotent duplicates of row 0
+        — EXACTLY one prefill trace and one sample trace per prompt
+        bucket, so the engine's single warm-up request compiles
+        everything the measured run will dispatch. The duplicate
+        rows' device cost is a few extra window forwards (~ms),
+        cheaper than one extra dispatch on any remote platform."""
         import jax
         import jax.numpy as jnp
         import numpy as np
 
         K = len(grp)
-        K_pad = 1
-        while K_pad < K:
-            K_pad *= 2
-        padded = grp + [grp[0]] * (K_pad - K)
+        padded = grp + [grp[0]] * (self.serving.max_slots - K)
         toks = np.stack([
             _padded_window(req.prompt)[0] for _, req in padded])
         lens = np.asarray([len(req.prompt) for _, req in padded],
@@ -1283,15 +1287,15 @@ class ServingEngine:
             self.cache, jnp.asarray(toks), jnp.asarray(lens),
             jnp.asarray(slots))
         samps = [req.sampling or SamplingConfig(temperature=0.0)
-                 for _, req in grp]
-        seen = np.zeros((K, self.cfg.vocab_size), bool)
-        for i, (_, req) in enumerate(grp):
+                 for _, req in padded]
+        seen = np.zeros((len(padded), self.cfg.vocab_size), bool)
+        for i, (_, req) in enumerate(padded):
             seen[i, np.asarray(req.prompt, np.int64)] = True
         keys = jnp.stack([
             jax.random.fold_in(jax.random.PRNGKey(req.seed), 0)
-            for _, req in grp])
+            for _, req in padded])
         firsts = self._first_read_many(self._first(
-            logits_k[:K],
+            logits_k,
             jnp.asarray([s.temperature for s in samps], jnp.float32),
             jnp.asarray([s.top_k for s in samps], jnp.int32),
             jnp.asarray([s.top_p for s in samps], jnp.float32),

@@ -99,7 +99,7 @@ def run_variant(name: str, spec: dict, timeout: int) -> dict:
                          else "compile-crash")
         if proc.returncode != 0 and not out.get("compiled"):
             tail = (proc.stderr or proc.stdout).strip().splitlines()
-            out["error"] = " ".join(tail[-3:])[-300:]
+            out["error"] = " ".join(tail[-12:])[-900:]
     except subprocess.TimeoutExpired:
         out["status"] = "timeout"
     out["seconds"] = round(time.monotonic() - t0, 1)
@@ -126,6 +126,7 @@ def main() -> int:
     report = {
         "bug": ("remote tpu_compile_helper HTTP 500 on the dense "
                 "(non-flash) 4k backward at batch>=2"),
+        "diagnosis": DIAGNOSIS,
         "captured_unix": int(time.time()),
         "results": results,
     }
@@ -133,6 +134,21 @@ def main() -> int:
         json.dumps(report, indent=1) + "\n")
     print(f"wrote {args.out}")
     return 0
+
+
+DIAGNOSIS = (
+    "HBM exhaustion at XLA buffer assignment, not a miscompile: "
+    "the dense backward keeps each layer's fp32 (batch, heads, t, "
+    "t) score matrix live for the bwd pass — 2 x 16 heads x 4096^2 "
+    "x 4B = 2.1 GB/layer x 8 layers = ~17 GB > the v5e's 16 GB at "
+    "batch 2 (the crash log's 'Allocation type: HLO temp'). Every "
+    "variant that shrinks the live set compiles: batch 1 (8.6 GB), "
+    "4 layers, seq 2k; remat does NOT help (jax.checkpoint at "
+    "block granularity still materializes each block's scores in "
+    "its bwd); flash attention avoids the matrices entirely and is "
+    "the supported path. The residual PLATFORM bug is error "
+    "quality: the compile helper should surface RESOURCE_EXHAUSTED "
+    "instead of crashing with exit 1 / HTTP 500.")
 
 
 if __name__ == "__main__":
