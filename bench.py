@@ -937,6 +937,10 @@ def model_throughput(emit=None) -> dict | None:
                 """One paged-engine measurement over the canonical
                 request stream (identical by construction across
                 tiers: same RandomState(0) draw)."""
+                # fixed width: one trace per bucket AND batched
+                # admission (the workload's 448-position ceiling
+                # needs 7 blocks)
+                cfg_extra.setdefault("paged_width", 8)
                 sc_p = serving.ServingConfig(
                     max_slots=batch, max_len=1024, chunk=64,
                     paged_blocks=pool_blocks, block_size=block,
@@ -1002,7 +1006,8 @@ def model_throughput(emit=None) -> dict | None:
             try:
                 run_spec("serving_paged_spec",
                          serving.PagedSpeculativeServingEngine,
-                         paged_blocks=pool_blocks, block_size=block)
+                         paged_blocks=pool_blocks, block_size=block,
+                         paged_width=8)
             except Exception as exc:  # pragma: no cover
                 result["serving_paged_spec_error"] = str(exc)[:100]
             _note()

@@ -1217,8 +1217,7 @@ class ServingEngine:
         groups: Dict[int, list] = {}
         wave_stores: list = []
         for slot, req in claims:
-            if any(len(sp) <= len(req.prompt)
-                   and req.prompt[:len(sp)] == sp
+            if any(self._wave_share_hit(sp, req.prompt)
                    for sp in wave_stores):
                 self._flush_groups(groups)
                 groups, wave_stores = {}, []
@@ -1259,9 +1258,17 @@ class ServingEngine:
 
     def _batch_admission(self) -> bool:
         """Whether this engine's storage supports the stacked
-        admission dispatch (the dense slot grid does; the paged
-        engines' per-slot block tables don't compose with it yet)."""
+        admission dispatch (the dense slot grid always does; paged
+        engines need a fixed table width)."""
         return True
+
+    def _wave_share_hit(self, stored_prompt, prompt) -> bool:
+        """Would a store still pending in this admission wave serve
+        this prompt? (Dense PrefixCache: the stored prompt must be
+        an exact prefix; the paged engine overrides with its
+        block-granular rule.)"""
+        return (len(stored_prompt) <= len(prompt)
+                and prompt[:len(stored_prompt)] == stored_prompt)
 
     def _admit_group(self, grp) -> None:
         """One same-bucket admission wave: stacked prefill, one
@@ -1278,14 +1285,7 @@ class ServingEngine:
 
         K = len(grp)
         padded = grp + [grp[0]] * (self.serving.max_slots - K)
-        toks = np.stack([
-            _padded_window(req.prompt)[0] for _, req in padded])
-        lens = np.asarray([len(req.prompt) for _, req in padded],
-                          np.int32)
-        slots = np.asarray([slot for slot, _ in padded], np.int32)
-        self.cache, logits_k = self._prefill_many(
-            self.cache, jnp.asarray(toks), jnp.asarray(lens),
-            jnp.asarray(slots))
+        logits_k = self._prefill_group(padded)
         samps = [req.sampling or SamplingConfig(temperature=0.0)
                  for _, req in padded]
         seen = np.zeros((len(padded), self.cfg.vocab_size), bool)
@@ -1307,6 +1307,23 @@ class ServingEngine:
             self._store_pending(slot, req)
             self._activate_with_first(slot, req, logits_k[i],
                                       firsts[i])
+
+    def _prefill_group(self, padded):
+        """Storage half of an admission wave (dense grid): the
+        stacked whole-prompt prefill. Returns (n, vocab) logits,
+        rows beyond the real K being ignorable duplicates."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        toks = np.stack([
+            _padded_window(req.prompt)[0] for _, req in padded])
+        lens = np.asarray([len(req.prompt) for _, req in padded],
+                          np.int32)
+        slots = np.asarray([slot for slot, _ in padded], np.int32)
+        self.cache, logits_k = self._prefill_many(
+            self.cache, jnp.asarray(toks), jnp.asarray(lens),
+            jnp.asarray(slots))
+        return logits_k
 
     def _first_read_many(self, arr) -> list:
         """One batched readback of an admission wave's first tokens
@@ -1454,8 +1471,16 @@ class ServingEngine:
         # remote-tunnel platforms each transfer is its own ~50ms RTT
         # (tools/spec_profile.py measured 8 per-slot active fetches
         # at ~0.4s/round — half the serving engine's wall time).
-        emitted, lps_h, active_h = jax.device_get(
-            (emitted, lps, self.active))
+        # The logprobs plane rides along ONLY when some in-flight
+        # request asked for it — it is a whole (slots, chunk) fp32
+        # array per round that most workloads never read.
+        if any(r is not None and r.logprobs for r in self.slot_req):
+            emitted, lps_h, active_h = jax.device_get(
+                (emitted, lps, self.active))
+        else:
+            emitted, active_h = jax.device_get(
+                (emitted, self.active))
+            lps_h = None
         emitted = np.asarray(emitted)
         for slot, req in enumerate(self.slot_req):
             if req is None or not bool(active_h[slot]):
@@ -1573,6 +1598,38 @@ def _jitted_paged_prefill(cfg: ModelConfig):
                    donate_argnums=(1,))
 
 
+def _paged_prefill_many(params, pools, tokens, true_lens, tables, *,
+                        cfg: ModelConfig):
+    """K whole-prompt paged prefills in ONE dispatch (lax.scan over
+    paged_prefill) — the block-pool analog of
+    _prefill_many_into_slots, enabled by a FIXED table width
+    (ServingConfig.paged_width): uniform (width,) rows make the
+    stacked shapes static. Duplicate rows rewrite the same blocks
+    with the same values (idempotent padding)."""
+    import jax
+
+    from kind_tpu_sim.models.paged import paged_prefill
+
+    def body(pools, xs):
+        tok, tl, row = xs
+        pools, logits = paged_prefill(params, pools, tok[None, :],
+                                      tl, row, cfg=cfg)
+        return pools, logits
+
+    return jax.lax.scan(body, pools,
+                        (tokens, true_lens, tables))
+
+
+def _jitted_paged_prefill_many(cfg: ModelConfig):
+    import functools
+
+    import jax
+
+    return jax.jit(
+        functools.partial(_paged_prefill_many, cfg=cfg),
+        donate_argnums=(1,))
+
+
 def _jitted_paged_chunk(cfg: ModelConfig, chunk: int):
     import functools
 
@@ -1611,6 +1668,8 @@ def _jitted_paged_chunk_kernel(cfg: ModelConfig, chunk: int):
 
 _jitted_paged_prefill = _functools.lru_cache(maxsize=32)(
     _jitted_paged_prefill)
+_jitted_paged_prefill_many = _functools.lru_cache(maxsize=32)(
+    _jitted_paged_prefill_many)
 _jitted_paged_chunk = _functools.lru_cache(maxsize=32)(
     _jitted_paged_chunk)
 _jitted_paged_suffix = _functools.lru_cache(maxsize=32)(
@@ -1679,6 +1738,8 @@ class PagedServingEngine(ServingEngine):
             if serving.prefix_cache_entries > 0 else None)
         self._paged_prefill = functools.partial(
             _jitted_paged_prefill(cfg), self.params)
+        self._paged_prefill_many = functools.partial(
+            _jitted_paged_prefill_many(cfg), self.params)
         if serving.paged_kernel:
             if cfg.int8_kv:
                 raise ValueError(
@@ -1723,10 +1784,42 @@ class PagedServingEngine(ServingEngine):
     # below supply the block-pool storage semantics
 
     def _batch_admission(self) -> bool:
-        # per-slot block tables: the stacked prefill dispatch would
-        # need ragged (slot, table_row) pairs per scan step — not
-        # composed yet, so paged admission stays per-slot
-        return False
+        # with a FIXED table width the stacked prefill's (K, width)
+        # rows are static shapes and the dense batching recipe
+        # applies; dynamic per-slot width bucketing would retrace
+        # the stacked dispatch per wave shape, so it stays per-slot
+        return bool(self.serving.paged_width)
+
+    def _prefill_group(self, padded):
+        """Storage half of an admission wave, paged: stacked
+        whole-prompt prefills streaming into each slot's
+        already-claimed blocks through uniform fixed-width table
+        rows."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        width = self.serving.paged_width
+        toks = np.stack([
+            _padded_window(req.prompt)[0] for _, req in padded])
+        lens = np.asarray([len(req.prompt) for _, req in padded],
+                          np.int32)
+        tables = np.zeros((len(padded), width), np.int32)
+        for i, (slot, _) in enumerate(padded):
+            blocks = self.slot_blocks[slot]
+            self._table_width(len(blocks))  # loud overflow check
+            tables[i, :len(blocks)] = blocks
+        self.pools, logits_k = self._paged_prefill_many(
+            self.pools, jnp.asarray(toks), jnp.asarray(lens),
+            jnp.asarray(tables))
+        return logits_k
+
+    def _wave_share_hit(self, stored_prompt, prompt) -> bool:
+        # block-granular sharing: a pending store helps this claim
+        # if they share at least one full block of common prefix —
+        # only the first block needs comparing
+        bsz = self.serving.block_size
+        return (len(stored_prompt) >= bsz and len(prompt) >= bsz
+                and stored_prompt[:bsz] == prompt[:bsz])
 
     def _claim_pending(self, slot: int, req: Request) -> int:
         """Claim, paged: allocate the whole prompt's blocks up front
@@ -2123,9 +2216,16 @@ class SpeculativeServingEngine(ServingEngine):
 
         # One batched device_get for everything the host loop needs —
         # separate np.asarray calls (and per-slot active indexing) are
-        # one tunnel RTT EACH (tools/spec_profile.py).
-        emit_h, m_h, lps_h, active_h = jax.device_get(
-            (emits, ms, lps, self.active))
+        # one tunnel RTT EACH (tools/spec_profile.py). The logprobs
+        # plane (W, slots, k+1 fp32) rides along only when a live
+        # request asked for it.
+        if any(r is not None and r.logprobs for r in self.slot_req):
+            emit_h, m_h, lps_h, active_h = jax.device_get(
+                (emits, ms, lps, self.active))
+        else:
+            emit_h, m_h, active_h = jax.device_get(
+                (emits, ms, self.active))
+            lps_h = None
         W = emit_h.shape[0]
         # verify_steps counts USEFUL windows (those that delivered at
         # least one token to some slot), not the scan length: junk

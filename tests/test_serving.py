@@ -601,6 +601,42 @@ def test_batched_admission_matches_per_slot(cfg, params):
     assert batched_waves >= 1
 
 
+def test_batched_admission_paged_fixed_width(cfg, params):
+    """Fixed-width paged engines batch admission too (uniform table
+    rows make the stacked shapes static): streams equal sequential
+    admission exactly, and dynamic-width engines stay sequential."""
+    import dataclasses as _dc
+
+    reqs = [serving.Request(
+        f"pb{i}", make_prompt(230 + i, 4 + 2 * i, cfg.vocab_size),
+        max_new=6, seed=i) for i in range(6)]
+
+    def run(paged_width, force_per_slot=False):
+        sc = serving.ServingConfig(max_slots=4, max_len=64, chunk=8,
+                                   paged_blocks=40, block_size=8,
+                                   paged_width=paged_width)
+        eng = serving.PagedServingEngine(params, cfg, sc)
+        if force_per_slot:
+            eng._batch_admission = lambda: False
+        waves = {"n": 0}
+        orig = eng._admit_group
+
+        def counting(grp):
+            waves["n"] += 1
+            return orig(grp)
+        eng._admit_group = counting
+        for r in reqs:
+            eng.submit(_dc.replace(r))
+        out = {c.request_id: tuple(c.tokens) for c in eng.run()}
+        return out, waves["n"]
+
+    batched, waves = run(4)
+    sequential, seq_waves = run(4, force_per_slot=True)
+    dynamic, dyn_waves = run(0)
+    assert batched == sequential == dynamic
+    assert waves >= 1 and seq_waves == 0 and dyn_waves == 0
+
+
 def test_paged_fixed_width_matches_dynamic(cfg, params):
     """ServingConfig.paged_width pins the block-table width (one
     kernel trace for mixed-length workloads) — streams must equal
