@@ -115,14 +115,6 @@ def gemm_micro(cfg, rows: int, spec) -> dict:
         "readout_T": (v, d),
         "wgrad_deep": None,  # (d, rows) @ (rows, d)
     }
-    # Per-dispatch overhead (remote tunnels: ~60ms RTT per call) must
-    # come off the measurement — the small shapes' device time is a
-    # few ms, so an uncorrected readback would understate their
-    # ceiling ~10x and poison the measured-bound residual story.
-    null = jax.jit(lambda: jnp.zeros((), jnp.float32))
-    float(null())
-    null_dt = min(_timed(lambda: float(null())) for _ in range(5))
-
     out = {}
     for name, kn in shapes.items():
         if kn is None:
@@ -133,8 +125,7 @@ def gemm_micro(cfg, rows: int, spec) -> dict:
         # Repetitions sized so the chain's DEVICE time is ~80ms at
         # datasheet peak — well above per-dispatch RTT jitter. R=8
         # left the small shapes' ~3ms of device work inside the
-        # ±5ms RTT noise and the null_dt subtraction produced
-        # absurd ceilings (5e8 TFLOPs in the first r4 probe).
+        # ±5ms RTT noise (5e8 TFLOPs in the first r4 probe).
         iter_flops = 2.0 * M * K * N
         R = min(1024, max(
             8, int(0.08 * spec.peak_bf16_tflops * 1e12
@@ -142,32 +133,49 @@ def gemm_micro(cfg, rows: int, spec) -> dict:
         w = jax.random.normal(
             jax.random.PRNGKey(1), (K, N), jnp.bfloat16) * 0.01
 
-        @jax.jit
-        def run(x, w=w, R=R):
-            def body(x, _):
-                y = x @ w
-                s = y.sum(dtype=jnp.float32)
-                # data dependence carried through ONE element (the
-                # scan carry aliases in place): a full-matrix
-                # transform — or even a broadcast rescale — adds an
-                # HBM pass comparable to the small GEMMs and biases
-                # their ceiling low
-                return x.at[0, 0].add((0.0 * s).astype(x.dtype)), s
-            _, sums = jax.lax.scan(body, x, None, length=R)
-            return sums.sum()
+        def chain(length):
+            @jax.jit
+            def run(x):
+                def body(x, _):
+                    y = x @ w
+                    s = y.sum(dtype=jnp.float32)
+                    # data dependence carried through ONE element
+                    # (the scan carry aliases in place): a full-
+                    # matrix transform — or even a broadcast
+                    # rescale — adds an HBM pass comparable to the
+                    # small GEMMs and biases their ceiling low
+                    return (x.at[0, 0].add(
+                        (0.0 * s).astype(x.dtype)), s)
+                _, sums = jax.lax.scan(body, x, None, length=length)
+                return sums.sum()
+            return run
 
+        # DIFFERENCE two chain lengths: device-per-iter =
+        # (t(2R) - t(R)) / R, which cancels the dispatch RTT
+        # exactly — subtracting a separately-calibrated null_dt
+        # proved fragile (tunnel RTT drifts minutes-scale, and a
+        # stale null left shapes 'unresolved' or >100% of peak in
+        # the second r4 probe).
         x0 = jax.random.normal(
             jax.random.PRNGKey(2), (M, K), jnp.bfloat16)
-        float(run(x0))  # compile + warm
-        best = min(_timed(lambda: float(run(x0)))
-                   for _ in range(3))
+        run1, run2 = chain(R), chain(2 * R)
+        float(run1(x0))
+        float(run2(x0))  # compile + warm both
+        s1 = sorted(_timed(lambda: float(run1(x0)))
+                    for _ in range(3))
+        s2 = sorted(_timed(lambda: float(run2(x0)))
+                    for _ in range(3))
+        t1, t2 = s1[0], s2[0]
+        device = t2 - t1
+        # noise floor from THIS window's measured jitter (the
+        # sample spread), not a fixed constant: a degraded tunnel
+        # must yield 'unresolved', never an inflated ceiling
+        jitter = max(s1[-1] - s1[0], s2[-1] - s2[0])
         entry = {"shape": f"({M}x{K})@({K}x{N})", "reps": R}
-        if best < 2.0 * null_dt:
-            # device work never cleared the RTT noise floor — an
-            # unresolved shape must say so, not publish garbage
+        if device < max(0.02, 2.0 * jitter):
             entry["unresolved"] = True
         else:
-            tflops = 2.0 * M * K * N * R / (best - null_dt) / 1e12
+            tflops = 2.0 * M * K * N * R / device / 1e12
             entry["tflops"] = round(tflops, 1)
             entry["pct_of_peak"] = round(
                 100.0 * tflops / spec.peak_bf16_tflops, 1)
