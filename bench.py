@@ -1098,6 +1098,29 @@ def model_throughput(emit=None) -> dict | None:
                 result["serving_saturated_512_error"] = \
                     str(exc)[:100]
             _note()
+            # ...and ROUND PIPELINING counterparts: round N+1
+            # dispatches before round N's readback, hiding the
+            # per-round RTT behind device work (the readbacks were
+            # the attributed floor above). serving_overlap mirrors
+            # the canonical `serving` entry (chunk 64, ragged
+            # stream); serving_saturated_overlap mirrors
+            # serving_saturated_512 (chunk 512, uniform stream) —
+            # compare each against its OWN workload twin.
+            try:
+                run_serving("serving_overlap", overlap_rounds=True)
+            except Exception as exc:  # pragma: no cover
+                result["serving_overlap_error"] = str(exc)[:100]
+            _note()
+            try:
+                run_serving("serving_saturated_overlap", chunk=512,
+                            overlap_rounds=True,
+                            reqs=uniform_stream(
+                                "serving_saturated_overlap",
+                                2 * batch, 192, 512))
+            except Exception as exc:  # pragma: no cover
+                result["serving_saturated_overlap_error"] = \
+                    str(exc)[:100]
+            _note()
 
             # Speculative at its operating point: long outputs amortize
             # admission; W=16 windows per scan cuts dispatches ~4x vs

@@ -100,6 +100,16 @@ class ServingConfig:
     #                           remote-compile platforms); fixing it
     #                           at the workload's max trades a
     #                           bigger gather view for ONE trace
+    overlap_rounds: bool = False  # software-pipeline run(): round
+    #                               N+1 dispatches before round N's
+    #                               results are fetched, hiding the
+    #                               per-round readback RTT behind
+    #                               device work. Dense/spec grids
+    #                               only (the paged engines' block
+    #                               accounting host-syncs every
+    #                               round). Costs one lagged round
+    #                               per retirement + one trailing
+    #                               discarded round per drain.
     prefill_chunk: int = 0    # >0: chunked prefill (the vLLM TTFT/
     #                           ITL smoother) — prompts enter the
     #                           grid in windows of this many tokens,
@@ -1057,10 +1067,25 @@ class ServingEngine:
         self._admit()
         if self._pending:
             self._advance_prefills()
+        handles = self._round_dispatch()
+        if handles is not None:
+            self._round_retire(handles)
+
+    def _round_dispatch(self):
+        """Dispatch one decode round for the grid (async on remote
+        platforms); returns (result handles, slot-owner snapshot) or
+        None when no slot is live. The owner snapshot lets a
+        pipelined retire (overlap_rounds) discard results for slots
+        that were freed and re-admitted between dispatch and
+        retire."""
         if not any(r is not None for r in self.slot_req):
-            return
+            return None
         emitted, lps = self._decode_round(self._sampling_state())
-        self._retire(emitted, lps)
+        return (emitted, lps), list(self.slot_req)
+
+    def _round_retire(self, handles) -> None:
+        (emitted, lps), owners = handles
+        self._retire(emitted, lps, owners)
 
     def _sampling_state(self):
         """The per-slot sampling-parameter tuple every decode/verify
@@ -1121,11 +1146,32 @@ class ServingEngine:
 
     def run(self) -> List[Completion]:
         """Drain queue + grid to completion; returns all completions
-        in finish order."""
+        in finish order. With ``overlap_rounds`` the loop is
+        software-pipelined: round N+1 is DISPATCHED before round N's
+        results are fetched, so the per-round readback RTT hides
+        behind the next round's device work. The price is one lagged
+        round per retirement (a slot that finished keeps computing
+        until its results are fetched — wasted rows the occupancy
+        stat already counts) and one trailing discarded round per
+        drain; owner snapshots keep a re-admitted slot from being
+        credited with its predecessor's in-flight tokens."""
         done: List[Completion] = []
-        while (self.queue or self._pending or
+        if not self.serving.overlap_rounds:
+            while (self.queue or self._pending or
+                   any(r is not None for r in self.slot_req)):
+                self.step_round()
+                done.extend(self.poll())
+            return done
+        pending = None
+        while (self.queue or self._pending or pending is not None or
                any(r is not None for r in self.slot_req)):
-            self.step_round()
+            nxt = self._round_dispatch()
+            if pending is not None:
+                self._round_retire(pending)
+            pending = nxt
+            self._admit()
+            if self._pending:
+                self._advance_prefills()
             done.extend(self.poll())
         return done
 
@@ -1463,7 +1509,7 @@ class ServingEngine:
         if not active:
             self._finish(slot)
 
-    def _retire(self, emitted, lps) -> None:
+    def _retire(self, emitted, lps, owners=None) -> None:
         import jax
         import numpy as np
 
@@ -1484,6 +1530,11 @@ class ServingEngine:
         emitted = np.asarray(emitted)
         for slot, req in enumerate(self.slot_req):
             if req is None or not bool(active_h[slot]):
+                continue
+            if owners is not None and owners[slot] is not req:
+                # pipelined retire: this slot was freed and
+                # re-admitted after the round was dispatched — its
+                # rows belong to the previous tenant, discard
                 continue
             have = self.slot_emitted[slot]
             budget = req.max_new - len(have)
@@ -1719,6 +1770,13 @@ class PagedServingEngine(ServingEngine):
             raise ValueError(
                 "PagedServingEngine needs ServingConfig.paged_blocks"
                 " >= 2 (block 0 is the garbage sink)")
+        if serving.overlap_rounds:
+            raise ValueError(
+                "overlap_rounds is dense/spec-grid only: the paged "
+                "block accounting (_ensure_blocks) host-syncs on "
+                "occupancy every round, so there is no RTT to hide "
+                "and preemption between a dispatched round and its "
+                "retire is not composed")
         self.pools = paged.init_pools(cfg, serving.paged_blocks,
                                       serving.block_size)
         if self.mesh is not None:
@@ -2182,14 +2240,11 @@ class SpeculativeServingEngine(ServingEngine):
         self.out = self.out.at[slot].set(jnp.asarray(row))
         self.total = self.total.at[slot].set(t_p + 1)
 
-    def step_round(self) -> None:
-        """Admit, advance chunked prefills, scan spec_windows verify
-        windows for the grid in one dispatch, retire."""
-        self._admit()
-        if self._pending:
-            self._advance_prefills()
+    def _round_dispatch(self):
+        """One scanned verify dispatch for the grid (the spec analog
+        of the chunk round); returns (handles, owner snapshot)."""
         if not any(r is not None for r in self.slot_req):
-            return
+            return None
         sampling_state = self._sampling_state()
         if self._draft is None:
             (self.cache, self.out, self.total, emits, ms,
@@ -2201,9 +2256,13 @@ class SpeculativeServingEngine(ServingEngine):
              emits, ms, lps) = self._spec_step(
                 self.cache, self.draft_cache, self.out, self.total,
                 self.active, sampling_state)
-        self._spec_retire(emits, ms, lps)
+        return (emits, ms, lps), list(self.slot_req)
 
-    def _spec_retire(self, emits, ms, lps) -> None:
+    def _round_retire(self, handles) -> None:
+        (emits, ms, lps), owners = handles
+        self._spec_retire(emits, ms, lps, owners)
+
+    def _spec_retire(self, emits, ms, lps, owners=None) -> None:
         """Ragged per-slot retirement after a scanned verify
         dispatch: each active slot takes its accepted-prefix+bonus
         tokens (and, for logprobs requests, their raw-model
@@ -2232,9 +2291,20 @@ class SpeculativeServingEngine(ServingEngine):
         # windows after every slot finished mid-scan would inflate
         # the tokens-per-window stat and can exceed the generated
         # token count on short-request workloads.
-        used = 1 if any(r is not None for r in self.slot_req) else 0
+        # used counts windows that actually delivered tokens — it
+        # starts at 0 and only the delivery loop raises it, so a
+        # pipelined zombie round (all rows owner-discarded) or a
+        # drained grid cannot inflate verify_steps. Sequentially a
+        # live round always delivers >=1 token in window 0 (accept
+        # emits at least the bonus token), so this matches the old
+        # "any slot present" baseline on the non-overlap path.
+        used = 0
         for slot, req in enumerate(self.slot_req):
             if req is None or not bool(active_h[slot]):
+                continue
+            if owners is not None and owners[slot] is not req:
+                # pipelined retire: slot re-admitted after this scan
+                # was dispatched — rows belong to the old tenant
                 continue
             have = self.slot_emitted[slot]
             for w in range(W):
@@ -2320,10 +2390,11 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
             _jitted_paged_spec(self.cfg, k, W), self.params)
 
     # the draft-buffer seeding and ragged retirement are the
-    # speculative engine's, verbatim (no super() inside either, so
+    # speculative engine's, verbatim (no super() inside any, so
     # borrowing the unbound functions across the class tree is safe)
     _on_admitted = SpeculativeServingEngine._on_admitted
     _spec_retire = SpeculativeServingEngine._spec_retire
+    _round_retire = SpeculativeServingEngine._round_retire
     _check_sampling = SpeculativeServingEngine._check_sampling
 
     def report(self) -> Dict[str, Any]:
@@ -2334,18 +2405,14 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
         }
         return out
 
-    def step_round(self) -> None:
+    def _round_dispatch(self):
+        """One paged verify scan (the step_round dispatch half;
+        admission/prefill-advance/retire run in the base
+        step_round / pipelined run loop)."""
         import jax.numpy as jnp
 
-        self._admit()
-        if self._pending:
-            # chunked prefill composes here exactly as in the grid
-            # speculative engine: pending slots stream one prompt
-            # window per round between verify dispatches (omitting
-            # this spun run() forever — pending never drained).
-            self._advance_prefills()
         if not any(r is not None for r in self.slot_req):
-            return
+            return None
         # block coverage for the WHOLE scanned dispatch: W windows
         # advance a slot by up to W*(k+1) positions and the tables
         # are static across the scan, so every write must have a
@@ -2355,13 +2422,13 @@ class PagedSpeculativeServingEngine(PagedServingEngine):
         self._ensure_blocks(W * (k + 1), self.total)
         tables = self._build_tables()
         if not any(r is not None for r in self.slot_req):
-            return  # preemption emptied the grid
+            return None  # preemption emptied the grid
         sampling_state = self._sampling_state()
         (self.pools, self.out, self.total, emits, ms,
          lps) = self._spec_step(self.pools, jnp.asarray(tables),
                                 self.out, self.total, self.active,
                                 sampling_state)
-        self._spec_retire(emits, ms, lps)
+        return (emits, ms, lps), list(self.slot_req)
 
 
 def engines_report(cfg: ModelConfig = None) -> Dict[str, Any]:

@@ -601,6 +601,55 @@ def test_batched_admission_matches_per_slot(cfg, params):
     assert batched_waves >= 1
 
 
+def test_overlap_rounds_matches_sequential(cfg, params):
+    """Software-pipelined run() (overlap_rounds): round N+1
+    dispatches before round N's readback. Streams must equal the
+    sequential scheduler exactly — same chunks, same truncation —
+    across mixed greedy/sampled/eos workloads with re-admission
+    (the owner snapshot keeps a re-admitted slot from absorbing its
+    predecessor's in-flight zombie round)."""
+    import dataclasses as _dc
+
+    reqs = []
+    for i in range(8):
+        samp = (serving.SamplingConfig(temperature=1.2)
+                if i % 3 == 1 else None)
+        reqs.append(serving.Request(
+            f"ov{i}", make_prompt(240 + i, 5 + 2 * i, cfg.vocab_size),
+            max_new=4 + 2 * (i % 3), sampling=samp, seed=i,
+            eos_id=3 if i % 4 == 2 else None))
+
+    def run(engine_cls, **extra):
+        sc = serving.ServingConfig(max_slots=3, max_len=64, chunk=8,
+                                   **extra)
+        eng = engine_cls(params, cfg, sc)
+        for r in reqs:
+            eng.submit(_dc.replace(r))
+        return {c.request_id: tuple(c.tokens) for c in eng.run()}
+
+    assert (run(serving.ServingEngine)
+            == run(serving.ServingEngine, overlap_rounds=True))
+    spec_reqs = [r for r in reqs if r.sampling is None]
+
+    def run_spec_eng(**extra):
+        sc = serving.ServingConfig(max_slots=3, max_len=64,
+                                   speculative_k=3, **extra)
+        eng = serving.SpeculativeServingEngine(params, cfg, sc)
+        for r in spec_reqs:
+            eng.submit(_dc.replace(r))
+        return {c.request_id: tuple(c.tokens) for c in eng.run()}
+
+    assert run_spec_eng() == run_spec_eng(overlap_rounds=True)
+
+
+def test_overlap_rounds_rejected_on_paged(cfg, params):
+    with pytest.raises(ValueError, match="overlap_rounds"):
+        serving.PagedServingEngine(
+            params, cfg, serving.ServingConfig(
+                max_slots=2, max_len=48, chunk=8, paged_blocks=12,
+                block_size=8, overlap_rounds=True))
+
+
 def test_batched_admission_paged_fixed_width(cfg, params):
     """Fixed-width paged engines batch admission too (uniform table
     rows make the stacked shapes static): streams equal sequential
