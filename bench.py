@@ -1104,7 +1104,10 @@ def model_throughput(emit=None) -> dict | None:
             # the attributed floor above). serving_overlap mirrors
             # the canonical `serving` entry (chunk 64, ragged
             # stream); serving_saturated_overlap mirrors
-            # serving_saturated_512 (chunk 512, uniform stream) —
+            # serving_saturated (chunk 256: TWO rounds per wave, so
+            # there is a fetch to hide — at chunk 512 every wave is
+            # one round and the finish-all prediction makes overlap
+            # degenerate to the sequential schedule by design) —
             # compare each against its OWN workload twin.
             try:
                 run_serving("serving_overlap", overlap_rounds=True)
@@ -1112,7 +1115,7 @@ def model_throughput(emit=None) -> dict | None:
                 result["serving_overlap_error"] = str(exc)[:100]
             _note()
             try:
-                run_serving("serving_saturated_overlap", chunk=512,
+                run_serving("serving_saturated_overlap", chunk=256,
                             overlap_rounds=True,
                             reqs=uniform_stream(
                                 "serving_saturated_overlap",

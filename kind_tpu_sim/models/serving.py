@@ -1064,12 +1064,19 @@ class ServingEngine:
         """One scheduling quantum: admit into free slots, advance
         pending chunked prefills by one window each, then decode one
         chunk for the whole grid, then retire finished slots."""
-        self._admit()
-        if self._pending:
-            self._advance_prefills()
+        self._admit_and_advance()
         handles = self._round_dispatch()
         if handles is not None:
             self._round_retire(handles)
+
+    def _admit_and_advance(self) -> None:
+        """One scheduling quantum's admission work: fill free slots,
+        then advance each pending chunked prefill by exactly ONE
+        window (the pacing contract) — defined once for the
+        sequential and pipelined schedulers."""
+        self._admit()
+        if self._pending:
+            self._advance_prefills()
 
     def _round_dispatch(self):
         """Dispatch one decode round for the grid (async on remote
@@ -1165,15 +1172,47 @@ class ServingEngine:
         pending = None
         while (self.queue or self._pending or pending is not None or
                any(r is not None for r in self.slot_req)):
+            if pending is not None and self._round_finishes_all():
+                # the in-flight round provably completes every live
+                # slot (budget-bound, no eos): dispatching another
+                # round now would be a guaranteed all-zombie round —
+                # retire synchronously and refill the freed slots
+                # instead (window advancement stays once per
+                # iteration, at the bottom: the pacing contract)
+                self._round_retire(pending)
+                pending = None
+                self._admit()
             nxt = self._round_dispatch()
             if pending is not None:
                 self._round_retire(pending)
             pending = nxt
-            self._admit()
-            if self._pending:
-                self._advance_prefills()
+            self._admit_and_advance()
             done.extend(self.poll())
         return done
+
+    def _round_min_tokens(self) -> int:
+        """Guaranteed tokens per slot per round (the finish-all
+        prediction's lower bound): the chunk engine delivers exactly
+        ``chunk``; the speculative engines override with their
+        per-scan minimum."""
+        return self.serving.chunk
+
+    def _round_finishes_all(self) -> bool:
+        """Host-side prediction: does the IN-FLIGHT round complete
+        every live slot? Exact for budget-bound requests; an eos_id
+        makes early stop unpredictable, so those keep pipelining
+        (a possible zombie round) rather than a wrong sync."""
+        lo = self._round_min_tokens()
+        saw = False
+        for req, emitted in zip(self.slot_req, self.slot_emitted):
+            if req is None:
+                continue
+            saw = True
+            if req.eos_id is not None:
+                return False
+            if len(emitted) + lo < req.max_new:
+                return False
+        return saw
 
     # -- internals -----------------------------------------------------
 
@@ -2239,6 +2278,10 @@ class SpeculativeServingEngine(ServingEngine):
         row[t_p] = first
         self.out = self.out.at[slot].set(jnp.asarray(row))
         self.total = self.total.at[slot].set(t_p + 1)
+
+    def _round_min_tokens(self) -> int:
+        # every verify window accepts at least the bonus token
+        return self.serving.spec_windows
 
     def _round_dispatch(self):
         """One scanned verify dispatch for the grid (the spec analog
