@@ -816,15 +816,22 @@ def model_throughput(emit=None) -> dict | None:
                 SECTION_S[key] = round(time.monotonic() - t_sec, 1)
                 return entry
 
-            def run_serving(key: str, reqs=None, **cfg_extra):
+            def run_serving(key: str, reqs=None,
+                            params_override=None, cfg_override=None,
+                            **cfg_extra):
                 """One dense-grid engine measurement (canonical
                 request stream by default; ragged max_new exercises
-                retirement + re-admission)."""
-                sp_l = sp_serve
+                retirement + re-admission). Overrides let variant
+                snapshots (int8) share the one saturated
+                configuration instead of duplicating it."""
+                sp_l = (params_override if params_override is not None
+                        else sp_serve)
+                mcfg = cfg_override if cfg_override is not None \
+                    else cfg
                 cfg_extra.setdefault("chunk", 64)
                 sc = serving.ServingConfig(max_slots=batch,
                                            max_len=1024, **cfg_extra)
-                eng = serving.ServingEngine(sp_l, cfg, sc)
+                eng = serving.ServingEngine(sp_l, mcfg, sc)
                 measure_engine(key, eng,
                                reqs if reqs is not None
                                else canonical_stream(key, 2 * batch))
@@ -1122,6 +1129,35 @@ def model_throughput(emit=None) -> dict | None:
                                 2 * batch, 192, 512))
             except Exception as exc:  # pragma: no cover
                 result["serving_saturated_overlap_error"] = \
+                    str(exc)[:100]
+            _note()
+            # int8 W8A8 + int8 KV through the SAME saturated
+            # pipelined schedule: solo int8 decode runs ~1.8x bf16
+            # on the byte roofline — this is that win composed with
+            # continuous batching (int8 caches are outside the
+            # exact-argmax contract, so this entry is a rate, not a
+            # stream-equality check)
+            try:
+                import dataclasses as _dc
+
+                from kind_tpu_sim.models import quant
+
+                cfg_q = _dc.replace(cfg, int8_kv=True,
+                                    int8_native=True)
+                try:
+                    qp = qparams  # the solo-decode section's int8
+                    #               snapshot (quantize_params never
+                    #               reads int8_native — identical)
+                except NameError:  # decode section failed/skipped
+                    qp = quant.quantize_params(params, cfg_q)
+                run_serving("serving_saturated_int8",
+                            params_override=qp, cfg_override=cfg_q,
+                            chunk=256, overlap_rounds=True,
+                            reqs=uniform_stream(
+                                "serving_saturated_int8",
+                                2 * batch, 192, 512))
+            except Exception as exc:  # pragma: no cover
+                result["serving_saturated_int8_error"] = \
                     str(exc)[:100]
             _note()
 

@@ -134,15 +134,18 @@ def test_submit_validates_capacity(cfg, params):
         eng.submit(serving.Request("zero", [1, 2], max_new=0))
 
 
-def test_int8_serving_grid(cfg, params):
+@pytest.mark.parametrize("overlap", [False, True])
+def test_int8_serving_grid(cfg, params, overlap):
     """The engine runs on the int8-native serving snapshot too, and
     matches ITS single-sequence decoder (int8-vs-int8: both sides
-    quantize identically)."""
+    quantize identically). Round pipelining composes (the bench's
+    serving_saturated_int8 entry runs this combination)."""
     from kind_tpu_sim.models import quant
 
     cfg_q = dataclasses.replace(cfg, int8_kv=True, int8_native=True)
     qp = quant.quantize_params(params, cfg_q)
-    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8,
+                               overlap_rounds=overlap)
     eng = serving.ServingEngine(qp, cfg_q, sc)
     prompts = [make_prompt(20 + i, 5 + 4 * i, cfg.vocab_size)
                for i in range(2)]
