@@ -261,8 +261,15 @@ def model_throughput(emit=None) -> dict | None:
         # chip's datasheet; never label a CPU/GPU host as a TPU.
         spec = (F.chip_spec(jax.devices()[0].device_kind)
                 if backend == "tpu" else None)
-        cfg = (tf.bench_config() if backend == "tpu"
-               else tf.ModelConfig())
+        # Canonical flagship (round 5): the d2048 operating point the
+        # r4 MFU probe proved reaches 64.4% train MFU (d1024's
+        # K=1024 contractions cap at ~65% of MXU peak; see
+        # bench_config_large). BENCH_FLAGSHIP=d1024 re-runs the old
+        # shape for cross-round comparison.
+        flagship = os.environ.get("BENCH_FLAGSHIP", "large")
+        cfg = ((tf.bench_config() if flagship == "d1024"
+                else tf.bench_config_large())
+               if backend == "tpu" else tf.ModelConfig())
         batch = 8 if backend == "tpu" else 2
         steps = 10 if backend == "tpu" else 2
         params = tf.init_params(jax.random.PRNGKey(0), cfg)
@@ -693,11 +700,23 @@ def model_throughput(emit=None) -> dict | None:
             # in-flight async dispatch work and is excluded from the
             # per-call RTT correction
             _READBACK_PHASES = ("retire_fetch", "first_readback")
+            # host-side phases: neither dispatches (no RTT
+            # correction) nor readbacks — they exist to ATTRIBUTE
+            # host_other_s (r4's serving_realistic left 2.6s of a
+            # 5.8s run unexplained)
+            _HOST_PHASES = ("activate_host",)
+            _NON_DISPATCH_PHASES = _READBACK_PHASES + _HOST_PHASES
 
             def instrument_phases(eng) -> dict:
                 """Wrap the engine's dispatch/fetch methods with
                 counting wall timers; returns the live phase dict
-                {label: [n_calls, wall_s]}."""
+                {label: [n_calls, wall_s]}. Also counts admissions
+                (``eng._bench_activations``): one per
+                _activate_with_first call — NOT one per _first
+                dispatch, which under batched admission covers a
+                whole K-request wave and would credit K-1
+                prefill-sampled first tokens as decode deliveries
+                in the occupancy stat."""
                 phases: dict = {}
 
                 def timed(fn, label):
@@ -714,6 +733,13 @@ def model_throughput(emit=None) -> dict | None:
                     if hasattr(eng, attr):
                         setattr(eng, attr,
                                 timed(getattr(eng, attr), label))
+                # activation bookkeeping is a HOST phase: its count
+                # is the admission count the occupancy stat needs,
+                # its wall attributes the per-admission host work
+                # (presence rows, sampling vectors, clocks) that
+                # previously sat in host_other_s
+                eng._activate_with_first = timed(
+                    eng._activate_with_first, "activate_host")
                 return phases
 
             def canonical_stream(key: str, n_req: int,
@@ -743,6 +769,14 @@ def model_throughput(emit=None) -> dict | None:
                 Returns the (live) entry dict stored at
                 result[key]."""
                 t_sec = time.monotonic()
+                # Admission traces are per (prompt bucket x pow-2
+                # sub-wave size) since the wave decomposition made
+                # admission FLOPs proportional to the wave (VERDICT
+                # r4 #5) — compile the whole ladder up front so no
+                # trace compiles inside the measured run. The jitted
+                # kernels are lru-cached per cfg, so across the ~10
+                # same-shape engine entries the ladder compiles ONCE.
+                eng.warm_admission(warm_lens)
                 for j, wl in enumerate(warm_lens):
                     # np.resize: warm prompts can exceed max_seq
                     # (tokens is only max_seq wide) — a truncated
@@ -768,7 +802,7 @@ def model_throughput(emit=None) -> dict | None:
                 assert len(done) == len(reqs)
                 jit_calls = sum(
                     st[0] for lbl, st in phases.items()
-                    if lbl not in _READBACK_PHASES)
+                    if lbl not in _NON_DISPATCH_PHASES)
                 device = wall - jit_calls * null_dt
                 entry = {
                     "requests": len(done),
@@ -798,7 +832,12 @@ def model_throughput(emit=None) -> dict | None:
                     # the occupancy/waste story
                     rows = (dc[0] * eng.serving.max_slots
                             * eng.serving.chunk)
-                    admits = phases.get("first_sample", [0, 0.0])[0]
+                    # every admission's first token came from the
+                    # prefill sample, not a decode row — subtract
+                    # ACTIVATIONS (batched admission: one _first
+                    # dispatch covers a K-request wave)
+                    admits = phases.get("activate_host",
+                                        [0, 0.0])[0]
                     entry["decode_rows_computed"] = rows
                     entry["decode_occupancy_pct"] = round(
                         100.0 * max(gen - admits, 0) / rows, 1)
@@ -875,7 +914,11 @@ def model_throughput(emit=None) -> dict | None:
                 # only max_seq wide; tile it for the 4k regime)
                 long_prompt = np.resize(
                     np.asarray(tokens[0]), LONG).tolist()
-                # warm both prompt buckets + chunk/suffix traces
+                # warm both prompt buckets + chunk/suffix traces;
+                # the short cohort admits as one 8-wide wave, the
+                # long request always alone in its bucket
+                eng.warm_admission((224,))
+                eng.warm_admission((LONG,), sizes=(1,))
                 eng.submit(serving.Request(
                     "warm", np.asarray(tokens[0, :256]).tolist(), 2))
                 eng.submit(serving.Request(
@@ -1026,40 +1069,142 @@ def model_throughput(emit=None) -> dict | None:
             # below measure each engine AT ITS OPERATING POINT.
 
             def run_realistic(key: str):
-                """Mixed 224/1k/2k prompts, 16 slots, pool sized
-                UNDER worst-case concurrent demand: preemption and
-                pressure eviction must appear in the measurement,
-                and the paged-vs-grid HBM story is reported from
-                live pool accounting."""
+                """The vLLM-analog memory story at load-bearing
+                scale (VERDICT r4 #3): 64 mixed requests — 40
+                independents over 224/1k/2k prompts plus 8
+                prefix families (a 1024-token cached "system
+                prompt" head + 2 members extending it), pool sized
+                UNDER worst-case concurrent demand so preemption and
+                pressure eviction are sustained, not anecdotal.
+                Prefix-sharing economics are MEASURED from the
+                allocator/cache counters: blocks actually shared,
+                prefill tokens actually skipped, peak pool use."""
                 sp_l = sp_serve
                 slots, blk_r, pool_r = 16, 64, 288
-                # fixed table width: the mixed 224/1k/2k prompts
-                # would otherwise re-bucket the width as slots grow
-                # and retrace the chunk kernel per width (~4s per
+                # fixed table width: the mixed prompts would
+                # otherwise re-bucket the width as slots grow and
+                # retrace the chunk kernel per width (~4s per
                 # decode dispatch in r4 run2 — compile, not serving)
                 sc_r = serving.ServingConfig(
                     max_slots=slots, max_len=2560, chunk=64,
                     paged_blocks=pool_r, block_size=blk_r,
-                    paged_width=64)
+                    paged_width=64, prefix_cache_entries=8,
+                    # sparse wave sizes: 4 prompt buckets x this set
+                    # is 12 warm compiles instead of the 20 a full
+                    # pow-2 ladder to 16 would cost (~1min each on
+                    # the remote-compile tunnel); decomposition stays
+                    # exact (K = 4s and 1s), admission FLOPs stay
+                    # proportional to the wave
+                    admission_wave_sizes=(1, 4, 16))
                 eng = serving.PagedServingEngine(sp_l, cfg, sc_r)
                 rng = np.random.RandomState(7)
+                base = np.asarray(tokens[0])
                 reqs = []
-                for i in range(2 * slots):
+                for i in range(40):
                     p_len = int(rng.choice([224, 1024, 2048]))
-                    prompt = ((np.resize(np.asarray(tokens[0]),
-                                         p_len) + i)
+                    prompt = ((np.resize(base, p_len) + i)
                               % cfg.vocab_size).tolist()
                     reqs.append(serving.Request(
                         f"{key}{i}", prompt,
-                        int(rng.choice([64, 128, 256]))))
-                entry = measure_engine(
-                    key, eng, reqs, warm_lens=(224, 1024, 2048))
+                        int(rng.choice([128, 256]))))
+                for f in range(8):
+                    shared = ((np.resize(base, 1024) + 1000 + f)
+                              % cfg.vocab_size).tolist()
+                    # head: exactly the shared prefix, stored for
+                    # reuse; members extend it with distinct
+                    # suffixes (bucket 128) and hit block-aligned
+                    reqs.append(serving.Request(
+                        f"{key}f{f}h", shared,
+                        int(rng.choice([128, 256])),
+                        cache_prefix=True))
+                    for m in range(2):
+                        sfx = ((np.resize(base, 96 + 32 * m)
+                                + 7 * f + m) % cfg.vocab_size
+                               ).tolist()
+                        reqs.append(serving.Request(
+                            f"{key}f{f}m{m}", shared + sfx,
+                            int(rng.choice([128, 256]))))
+                # interleave families into the independent stream
+                # (deterministically) so hits happen mid-load, but
+                # keep each family's head ahead of its members
+                order = rng.permutation(len(reqs)).tolist()
+                heads = {f"{key}f{f}h" for f in range(8)}
+                fam_of = {}
+                for f in range(8):
+                    fam_of[f"{key}f{f}h"] = f
+                    for m in range(2):
+                        fam_of[f"{key}f{f}m{m}"] = f
+                seen_head: set = set()
+                fixed = []
+                deferred: dict = {}
+                for idx in order:
+                    r = reqs[idx]
+                    f = fam_of.get(r.request_id)
+                    if f is None or r.request_id in heads:
+                        fixed.append(r)
+                        if f is not None:
+                            seen_head.add(f)
+                            fixed.extend(deferred.pop(f, []))
+                    elif f in seen_head:
+                        fixed.append(r)
+                    else:
+                        deferred.setdefault(f, []).append(r)
+                for rs in deferred.values():
+                    fixed.extend(rs)
+                # warm the suffix-window trace (prefix hits run the
+                # post-hit suffix per-slot): store + hit a throwaway
+                # family, then flush cache/counters so the measured
+                # stats start clean
+                eng.warm_admission((224, 1024, 2048),
+                                   sizes=(1, 4, 16))
+                warm_pre = ((base[:1024].astype(np.int64) + 31337)
+                            % cfg.vocab_size).astype(int).tolist()
+                eng.submit(serving.Request(f"{key}wh", warm_pre, 2,
+                                           cache_prefix=True))
+                eng.run()
+                eng.submit(serving.Request(
+                    f"{key}wm", warm_pre + [3] * 96, 2))
+                eng.run()
+                while (eng.prefix_cache is not None
+                       and eng.prefix_cache.evict_lru()):
+                    pass
+                # counter flush must land AFTER measure_engine's own
+                # warm request (which performs a lookup-miss and an
+                # allocation) — piggyback on reset_latency, which
+                # measure_engine calls exactly between warm-up and
+                # the timed stream
+                inner_reset = eng.reset_latency
+
+                def reset_all():
+                    inner_reset()
+                    if eng.prefix_cache is not None:
+                        eng.prefix_cache.hits = 0
+                        eng.prefix_cache.misses = 0
+                        eng.prefix_cache.shared_blocks = 0
+                    eng.alloc.peak_in_use = 0
+                    eng.preemptions = 0
+
+                eng.reset_latency = reset_all
+                entry = measure_engine(key, eng, fixed,
+                                       warm_lens=(224,))
                 kv_pos_bytes = (2 * cfg.n_layers * cfg.kv_heads
                                 * cfg.head_dim * 2)  # bf16 k+v
+                blk_bytes = blk_r * kv_pos_bytes
+                pc = (eng.prefix_cache.report()
+                      if eng.prefix_cache is not None else {})
                 entry.update({
                     "pool_blocks": pool_r,
                     "block_size": blk_r,
                     "preemptions": eng.preemptions,
+                    "peak_blocks_in_use": eng.alloc.peak_in_use,
+                    "prefix_cache": pc,
+                    # measured, not computed: blocks a hit pointed
+                    # at instead of allocating+prefilling
+                    "prefix_prefill_tokens_skipped":
+                        pc.get("shared_blocks", 0) * blk_r,
+                    "prefix_hbm_saved_mb": round(
+                        pc.get("shared_blocks", 0) * blk_bytes
+                        / 2**20, 1),
                     "pool_hbm_mb": round(
                         pool_r * blk_r * kv_pos_bytes / 2**20),
                     "grid_equiv_hbm_mb": round(
@@ -1131,6 +1276,34 @@ def model_throughput(emit=None) -> dict | None:
                 result["serving_saturated_overlap_error"] = \
                     str(exc)[:100]
             _note()
+            # overlap_rounds in its DESIGN regime (VERDICT r4 weak
+            # #5: the knob shipped with zero configurations where it
+            # wins): depth-1 pipelining hides min(fetch RTT, chunk
+            # device time), so the win peaks where the two are
+            # comparable — chunk=8 puts ~8x16 token-rows (~25ms at
+            # the d2048 shape) against the ~55ms tunnel RTT. The
+            # sequential twin pays RTT+device per round; overlap
+            # should pay ~max(RTT, device). tools/overlap_probe.py
+            # sweeps the same trade with an injected async-device
+            # model on CPU.
+            try:
+                run_serving("serving_rtt_bound", chunk=8,
+                            reqs=uniform_stream(
+                                "serving_rtt_bound", 2 * batch,
+                                192, 128))
+            except Exception as exc:  # pragma: no cover
+                result["serving_rtt_bound_error"] = str(exc)[:100]
+            _note()
+            try:
+                run_serving("serving_rtt_bound_overlap", chunk=8,
+                            overlap_rounds=True,
+                            reqs=uniform_stream(
+                                "serving_rtt_bound_overlap",
+                                2 * batch, 192, 128))
+            except Exception as exc:  # pragma: no cover
+                result["serving_rtt_bound_overlap_error"] = \
+                    str(exc)[:100]
+            _note()
             # int8 W8A8 + int8 KV through the SAME saturated
             # pipelined schedule: solo int8 decode runs ~1.8x bf16
             # on the byte roofline — this is that win composed with
@@ -1185,6 +1358,74 @@ def model_throughput(emit=None) -> dict | None:
                          spec_windows=16)
             except Exception as exc:  # pragma: no cover
                 result["serving_speculative_w16_error"] = \
+                    str(exc)[:100]
+            _note()
+
+            # Speculation's LATENCY design regime, measured head-to-
+            # head (VERDICT r4 weak #2: the "latency feature" claim
+            # had no committed entry, and the W=16 saturated capture
+            # contradicted it): 2 slots, latency-bound stream, dense
+            # at small chunk vs spec at small W on the SAME
+            # requests. The entry pair either lands the ITL/e2e win
+            # or becomes the retraction's evidence.
+            def run_latency(key: str, **sc_extra):
+                sc_l = serving.ServingConfig(max_slots=2,
+                                             max_len=1024,
+                                             **sc_extra)
+                eng_cls = (serving.SpeculativeServingEngine
+                           if sc_extra.get("speculative_k")
+                           else serving.ServingEngine)
+                eng = eng_cls(sp_serve, cfg, sc_l)
+                measure_engine(
+                    key, eng,
+                    canonical_stream(key, 2, lens=(224,),
+                                     news=(128,)))
+
+            try:
+                run_latency("serving_latency_dense", chunk=8)
+            except Exception as exc:  # pragma: no cover
+                result["serving_latency_dense_error"] = \
+                    str(exc)[:100]
+            _note()
+            try:
+                run_latency("serving_latency_spec",
+                            speculative_k=4, spec_windows=2)
+            except Exception as exc:  # pragma: no cover
+                result["serving_latency_spec_error"] = str(exc)[:100]
+            _note()
+
+            # ...and the throughput flip the r4 crossover model says
+            # needs draft QUALITY: a high-acceptance workload —
+            # repetitive prompts whose continuations the prompt-
+            # lookup draft predicts almost perfectly — at W=64.
+            # Dense twin on the SAME stream (dense FLOPs are
+            # content-independent, but the comparison stays honest).
+            def motif_stream(key: str, n_req: int):
+                motif = np.asarray(tokens[0, :8])
+                return [serving.Request(
+                    f"{key}{i}",
+                    ((np.resize(motif, 192) + i)
+                     % cfg.vocab_size).tolist(), 512)
+                    for i in range(n_req)]
+
+            try:
+                run_spec("serving_speculative_flip",
+                         serving.SpeculativeServingEngine,
+                         reqs=motif_stream(
+                             "serving_speculative_flip", 2 * batch),
+                         spec_windows=64)
+            except Exception as exc:  # pragma: no cover
+                result["serving_speculative_flip_error"] = \
+                    str(exc)[:100]
+            _note()
+            try:
+                run_serving("serving_dense_flip_twin", chunk=256,
+                            overlap_rounds=True,
+                            reqs=motif_stream(
+                                "serving_dense_flip_twin",
+                                2 * batch))
+            except Exception as exc:  # pragma: no cover
+                result["serving_dense_flip_twin_error"] = \
                     str(exc)[:100]
             _note()
 
@@ -1633,6 +1874,27 @@ q, k, v = inputs(32768)
 s32, _ = timeit(ring, q, k, v, reps=1)
 out["ring_32k_s"] = round(s32, 3)
 out["ring_32k_tokens_per_s"] = round(32768 / out["ring_32k_s"])
+# Roofline (VERDICT r4 #8: the 32k number had no ceiling attached).
+# The ceiling for a cpu-sim entry is THIS HOST's measured attention
+# throughput: the dense-GSPMD 8k run achieves a flop rate on the
+# same shapes/codepath, and the ring computes exactly
+# flops.attention_flops more work at 32k (comm is linear in t and
+# accounted separately). achieved-vs-expected < 1 names the ring's
+# own overhead: P ppermute rotations per pass plus the online-
+# softmax rescale of the (o, l, m) accumulators each block.
+from kind_tpu_sim.models import flops as F
+fl8 = F.attention_flops(8192, 2, HD)
+fl32 = F.attention_flops(32768, 2, HD)
+host_ceiling = fl8 / dense_s          # flops/s, measured
+out["host_attn_gflops_per_s"] = round(host_ceiling / 1e9, 2)
+out["ring_32k_gflops_per_s"] = round(fl32 / s32 / 1e9, 2)
+out["ring_32k_expected_s"] = round(fl32 / host_ceiling, 3)
+out["ring_32k_pct_of_expected"] = round(
+    100.0 * out["ring_32k_expected_s"] / s32, 1)
+P = 8
+comm_bytes = 2 * (P - 1) * 32768 * 2 * HD * 4  # k+v rotations, fp32
+out["ring_32k_comm_mb"] = round(comm_bytes / 2**20, 1)
+out["ring_8k_overhead_vs_dense"] = round(ring_s / dense_s, 3)
 print(json.dumps(out))
 """
 
@@ -1696,10 +1958,13 @@ def capture_model_section(phases: dict) -> None:
         SECTION_S["model_probe_failed"] = round(
             time.monotonic() - probe_t0, 1)
         return
-    # default sized for the full section list incl. the round-4
-    # operating-point entries (~8 extra prefill-bucket/trace
-    # compiles at ~1min each on the remote-compile tunnel)
-    budget = float(os.environ.get("BENCH_MODEL_BUDGET_S", "2400"))
+    # default sized for the full section list incl. the round-5
+    # additions (latency duel, rtt-bound pair, 64-request realistic)
+    # at the d2048 flagship: on a COLD tunnel compile-cache the
+    # admission-ladder + chunk-size traces cost ~1min each; the
+    # streamed-partial protocol keeps every completed section either
+    # way
+    budget = float(os.environ.get("BENCH_MODEL_BUDGET_S", "3000"))
     with stopwatch("model_total"):
         throughput = model_throughput_via_child(budget)
     # A child that died/hung before streaming its FIRST section must
@@ -1824,6 +2089,9 @@ def main(argv=None) -> int:
     if isinstance(ring, dict) and "ring_32k_tokens_per_s" in ring:
         compact_extra["ring_32k_tokens_per_s"] = \
             ring["ring_32k_tokens_per_s"]
+        if "ring_32k_pct_of_expected" in ring:
+            compact_extra["ring_32k_pct_of_expected"] = \
+                ring["ring_32k_pct_of_expected"]
     mh = phases.get("multihost")
     if isinstance(mh, dict):
         compact_extra["multihost_ok"] = mh.get("ok")

@@ -104,6 +104,19 @@ def fwd_flops_per_token(cfg: ModelConfig, seq: int) -> float:
     return 2.0 * p["active"] + attn
 
 
+def attention_flops(seq: int, n_heads: int, head_dim: int,
+                    batch: int = 1, causal: bool = True) -> float:
+    """Total FLOPs of one attention computation (no projections):
+    4*head_dim per (query, key) pair per head — 2*d for q·k and 2*d
+    for probs·v — over t*(t+1)/2 causal pairs (t^2 bidirectional).
+
+    The ring-attention roofline uses this directly: the ring
+    computes exactly these FLOPs, blockwise, regardless of how many
+    devices the sequence is sharded over."""
+    pairs = (seq * (seq + 1) / 2.0) if causal else float(seq) * seq
+    return 4.0 * head_dim * n_heads * batch * pairs
+
+
 def train_flops_per_token(cfg: ModelConfig, seq: int) -> float:
     """Full train-step FLOPs per token: fwd + bwd (2x fwd) = 3x.
 

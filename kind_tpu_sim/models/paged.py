@@ -291,6 +291,12 @@ class PagedPrefixCache:
         self._len_count: dict = collections.Counter()
         self.hits = 0
         self.misses = 0
+        # Measured sharing economics (not a capacity computation):
+        # every hit adds the blocks the admission did NOT allocate or
+        # prefill — multiply by the engine's bytes/block for the HBM
+        # actually saved, by block_size for the prefill tokens
+        # actually skipped.
+        self.shared_blocks = 0
 
     def lookup(self, prompt: List[int]):
         """Longest stored full-block STRICT prefix (so the suffix is
@@ -304,6 +310,7 @@ class PagedPrefixCache:
             if entry is None:
                 continue
             self.hits += 1
+            self.shared_blocks += len(entry["blocks"])
             self.entries.move_to_end(key)
             return entry
         self.misses += 1
@@ -351,7 +358,8 @@ class PagedPrefixCache:
 
     def report(self) -> dict:
         return {"entries": len(self.entries), "hits": self.hits,
-                "misses": self.misses}
+                "misses": self.misses,
+                "shared_blocks": self.shared_blocks}
 
 
 def _block_decode_kernel(x, bparams, cfg: ModelConfig, pool_lc,
@@ -514,10 +522,17 @@ class BlockAllocator:
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._refs: dict = {}
+        # measured pool pressure: highest simultaneous allocation
+        # (the pool the workload ACTUALLY needed, vs provisioned)
+        self.peak_in_use = 0
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh blocks (ref 1 each), or None (all-or-nothing)."""
@@ -526,6 +541,7 @@ class BlockAllocator:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._refs[b] = 1
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
         return out
 
     def share(self, blocks: List[int]) -> None:

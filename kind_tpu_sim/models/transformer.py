@@ -76,6 +76,21 @@ def bench_config() -> ModelConfig:
                        n_kv_heads=4)
 
 
+def bench_config_large() -> ModelConfig:
+    """The flagship benchmark config (canonical from round 5): the
+    d_model=2048 operating point the round-4 MFU probe proved reaches
+    64.4% train MFU where d1024 caps at ~43% — every K=1024
+    contraction ran at ~65% of MXU peak (MFU_PROBE_r04.json
+    gemm_micro: wqkv/mlp_up/readout all 64.8-65.7%) while K>=1536
+    shapes hit 92-97%, so the fix is the shape, not the step.
+    head_dim rises to 128 (full MXU lane width) and d_ff to 8192;
+    everything else matches bench_config so entries stay
+    comparable."""
+    return ModelConfig(vocab_size=32768, d_model=2048, n_heads=16,
+                       n_layers=8, d_ff=8192, max_seq=1024, remat=False,
+                       n_kv_heads=4)
+
+
 # ---------------------------------------------------------------------
 # init
 

@@ -82,7 +82,13 @@ def _fake_child(monkeypatch, child_code: str):
 def test_streaming_child_keeps_partial_on_hang(monkeypatch):
     # child streams two sections then hangs: the parent must keep the
     # LAST streamed snapshot and mark truncation — the r02 failure
-    # mode (one hang discarding every measured number) must not recur
+    # mode (one hang discarding every measured number) must not recur.
+    # Load-independence (this guards the artifact pipeline, and a
+    # wall-clock budget racing a fresh interpreter on a loaded 1-core
+    # host flaked in the r4 judge run): the budget is generous and a
+    # FAKE CLOCK expires it only after the parent has PARSED the
+    # second snapshot — the expiry can never beat the data it is
+    # supposed to outlive.
     _fake_child(monkeypatch, (
         "import json, sys, time\n"
         "print(json.dumps({'model_partial': {'fwd_tokens_per_s': 1,"
@@ -91,11 +97,30 @@ def test_streaming_child_keeps_partial_on_hang(monkeypatch):
         " 'train_step_tokens_per_s': 2,"
         " 'section_seconds': {'fwd': 1.0, 'train': 2.0}}}),"
         " flush=True)\n"
-        "time.sleep(60)\n"
+        "time.sleep(600)\n"
     ))
-    result = bench.model_throughput_via_child(budget_s=3)
+    seen = {"second": False}
+    real_loads = json.loads
+
+    def spy_loads(s):
+        msg = real_loads(s)
+        if (isinstance(msg, dict) and "train_step_tokens_per_s"
+                in msg.get("model_partial", {})):
+            seen["second"] = True
+        return msg
+
+    import time as _time
+
+    real_mono = _time.monotonic
+
+    def fake_mono():
+        return real_mono() + (10**6 if seen["second"] else 0.0)
+
+    monkeypatch.setattr(bench.json, "loads", spy_loads)
+    monkeypatch.setattr(bench.time, "monotonic", fake_mono)
+    result = bench.model_throughput_via_child(budget_s=300)
     assert result["train_step_tokens_per_s"] == 2
-    assert "budget 3s exhausted" in result["truncated"]
+    assert "budget 300s exhausted" in result["truncated"]
     assert bench.SECTION_S.get("train") == 2.0
 
 
@@ -109,9 +134,30 @@ def test_streaming_child_coalesced_lines_not_lost(monkeypatch):
         "json.dumps({'model_partial': {'a': 1}}) + '\\n'"
         " + json.dumps({'model_partial': {'a': 1, 'b': 2}}) + '\\n')\n"
         "sys.stdout.flush()\n"
-        "time.sleep(60)\n"
+        "time.sleep(600)\n"
     ))
-    result = bench.model_throughput_via_child(budget_s=3)
+    # same fake-clock recipe as the hang test: expire the budget only
+    # once the coalesced SECOND line has been parsed, so host load
+    # can't turn a slow child start into a stale-snapshot failure
+    seen = {"second": False}
+    real_loads = json.loads
+
+    def spy_loads(s):
+        msg = real_loads(s)
+        if "b" in msg.get("model_partial", {}):
+            seen["second"] = True
+        return msg
+
+    import time as _time
+
+    real_mono = _time.monotonic
+
+    def fake_mono():
+        return real_mono() + (10**6 if seen["second"] else 0.0)
+
+    monkeypatch.setattr(bench.json, "loads", spy_loads)
+    monkeypatch.setattr(bench.time, "monotonic", fake_mono)
+    result = bench.model_throughput_via_child(budget_s=300)
     assert result.get("b") == 2
 
 

@@ -372,3 +372,30 @@ def test_block_allocator_refcounts():
         alloc.free(a)
     with pytest.raises(ValueError, match="unallocated"):
         alloc.share([a[0]])
+
+
+def test_same_round_claims_respect_block_budget(params):
+    """Two whole-prompt requests admitted in ONE round must not both
+    pass _can_admit against the same free-block count: _admit defers
+    _claim_pending to _admit_claims, so the gate has to account for
+    blocks already promised to this round's earlier claims (advisor
+    r4-high). 12 usable blocks, 2 free slots, two 8-block prompts:
+    the second queues for a later round — and both finish exactly."""
+    sc = serving.ServingConfig(max_slots=2, max_len=96, chunk=8,
+                               paged_blocks=13, block_size=8)
+    eng = serving.PagedServingEngine(params, CFG, sc)
+    rng = np.random.RandomState(3)
+    ps = [rng.randint(0, CFG.vocab_size, size=57).tolist()
+          for _ in range(2)]
+    for i, p in enumerate(ps):
+        eng.submit(serving.Request(f"big{i}", p, max_new=4))
+    # one admission round: must queue big1, not die allocating it
+    eng._admit_and_advance()
+    live = [r for r in eng.slot_req if r is not None]
+    assert len(live) + len(eng._pending) == 1
+    assert len(eng.queue) == 1
+    done = {c.request_id: c for c in eng.run()}
+    assert len(done) == 2
+    for i, p in enumerate(ps):
+        assert done[f"big{i}"].tokens == solo_greedy(params, p, 4), i
+    assert eng.report()["paged"]["blocks_in_use"] == 0

@@ -1,0 +1,171 @@
+"""overlap_rounds win-regime probe (VERDICT r4 weak #5): sequential
+vs software-pipelined run() under an injected ASYNCHRONOUS-DEVICE
+model, on CPU.
+
+The pipelined scheduler (ServingEngine.run with overlap_rounds)
+dispatches round N+1 before fetching round N's results, so it can
+hide at most min(fetch_rtt, chunk_device_time) per round — the win
+peaks where the two are comparable and vanishes at either extreme
+(the r4 on-TPU captures at chunk=256, where device time is ~4x the
+RTT, measured exactly that vanishing and were recorded as a
+negative). A synchronous CPU host can't show the effect natively
+(there is no async device to overlap with), so this probe models
+one, with the same contract the axon tunnel exhibits:
+
+* dispatch (``_chunk``) ENQUEUES: it completes immediately, and the
+  virtual device becomes busy for ``device_ms`` after its previous
+  work drains;
+* fetch (``_retire``) SYNCS: it blocks until the round's virtual
+  completion time, then pays ``rtt_ms`` of transfer latency.
+
+Sequential rounds therefore cost ~(device + rtt); pipelined rounds
+cost ~max(device, rtt) once the pipe fills. The probe sweeps three
+(device, rtt) points — rtt-dominant, balanced, device-dominant —
+and prints one JSON line with the measured walls and speedups. The
+balanced point is the committed evidence that the knob has a regime
+where it wins; the device-dominant point reproduces the r4 negative.
+
+Run:  python tools/overlap_probe.py [--out tools/OVERLAP_PROBE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def make_engine(params, cfg, serving_mod, overlap: bool,
+                device_ms: float, rtt_ms: float):
+    sc = serving_mod.ServingConfig(max_slots=4, max_len=64, chunk=8,
+                                   overlap_rounds=overlap)
+    eng = serving_mod.ServingEngine(params, cfg, sc)
+
+    state = {"free_at": 0.0, "ready": []}
+    inner_chunk = eng._chunk
+    inner_retire = eng._retire
+
+    def chunk(*a, **k):
+        out = inner_chunk(*a, **k)  # real (tiny) CPU compute
+        now = time.monotonic()
+        start = max(now, state["free_at"])
+        state["free_at"] = start + device_ms / 1e3
+        state["ready"].append(state["free_at"])
+        return out
+
+    def retire(*a, **k):
+        if state["ready"]:
+            ready = state["ready"].pop(0)
+            now = time.monotonic()
+            if ready > now:
+                time.sleep(ready - now)
+        time.sleep(rtt_ms / 1e3)
+        return inner_retire(*a, **k)
+
+    eng._chunk = chunk
+    eng._retire = retire
+    return eng
+
+
+def run_point(params, cfg, serving_mod, device_ms, rtt_ms,
+              n_req=8, max_new=56):
+    import numpy as np
+
+    walls = {}
+    streams = {}
+    for overlap in (False, True):
+        eng = make_engine(params, cfg, serving_mod, overlap,
+                          device_ms, rtt_ms)
+        rng = np.random.RandomState(0)
+        for i in range(n_req):
+            eng.submit(serving_mod.Request(
+                f"r{i}",
+                rng.randint(0, cfg.vocab_size, size=6).tolist(),
+                max_new))
+        t0 = time.monotonic()
+        done = eng.run()
+        walls[overlap] = time.monotonic() - t0
+        streams[overlap] = sorted(
+            (c.request_id, tuple(c.tokens)) for c in done)
+        assert len(done) == n_req
+    # exactness across schedulers is part of the probe's claim
+    assert streams[False] == streams[True], \
+        "overlap changed the emitted streams"
+    return {
+        "device_ms": device_ms,
+        "rtt_ms": rtt_ms,
+        "sequential_wall_s": round(walls[False], 3),
+        "overlap_wall_s": round(walls[True], 3),
+        "overlap_speedup": round(walls[False] / walls[True], 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(REPO / "tools"
+                                         / "OVERLAP_PROBE.json"))
+    args = ap.parse_args()
+
+    import jax
+
+    # the axon tunnel plugin self-registers even under
+    # JAX_PLATFORMS=cpu; pin the config BEFORE first device access
+    # or the probe's "injected" latencies ride a real 60ms-RTT
+    # tunnel (__graft_entry__.py documents the same pitfall)
+    jax.config.update("jax_platforms", "cpu")
+
+    from kind_tpu_sim.models import serving as serving_mod
+    from kind_tpu_sim.models import transformer as tf
+
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=64)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+
+    # warm every trace (prefill buckets, chunk, first-sample) before
+    # ANY timed point: the jitted kernels are lru-cached per cfg, so
+    # one throwaway run compiles for all engines — without this the
+    # first point's sequential wall carried ~5s of compiles and the
+    # "speedup" was a compile-cache artifact
+    warm = make_engine(params, cfg, serving_mod, False, 0.0, 0.0)
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        warm.submit(serving_mod.Request(
+            f"w{i}", rng.randint(0, cfg.vocab_size, size=6).tolist(),
+            24))
+    warm.run()
+
+    points = [
+        # rtt-dominant: pipelining can only hide the small device
+        # slice -> modest win
+        (10.0, 100.0),
+        # balanced: the design regime -> approaches 2x (fill/drain
+        # rounds and ~20ms/round of real host work on this 1-core
+        # VM keep it under the ideal)
+        (80.0, 80.0),
+        # device-dominant (the r4 on-TPU chunk=256 situation) ->
+        # win vanishes; committed so the negative stays on record
+        (100.0, 10.0),
+    ]
+    out = {"metric": "overlap_rounds_regime_sweep",
+           "model": "sim-tier tiny transformer, injected async "
+                    "device (dispatch enqueues, fetch syncs)",
+           "points": [run_point(params, cfg, serving_mod, d, r)
+                      for d, r in points]}
+    line = json.dumps(out)
+    pathlib.Path(args.out).write_text(line + "\n")
+    print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
