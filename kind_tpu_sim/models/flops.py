@@ -90,6 +90,49 @@ def matmul_params(cfg: ModelConfig) -> Dict[str, int]:
     }
 
 
+def step_peak_bytes(cfg: ModelConfig, batch: int, seq: int,
+                    flash: bool = False, backward: bool = True,
+                    optimizer: bool = True) -> float:
+    """Rough HBM high-water estimate for one fwd(+bwd+opt) step —
+    the OOM gate, not an allocator model (±30% is fine; the gate
+    margin absorbs it).
+
+    Exists because an OOM on the remote-tunnel platform POISONS the
+    device session: after r5 run2's dense-train d2048 OOM every
+    later allocation in the process failed RESOURCE_EXHAUSTED, so
+    risky variants must be skipped by arithmetic, not attempted and
+    caught.
+
+    Terms: weights bf16 (matmul_params 'total' — the embedding is
+    weight-tied, so it is already counted once as the readout);
+    grads bf16 + AdamW m/v in fp32 when training; fp32 logits (the
+    forward's output) plus their fp32 cotangent on the backward;
+    ~8 saved (b,t,d) residual activations and 2 (b,t,ff) MLP
+    activations per layer for the backward; and — the dense-
+    attention tax flash exists to remove — the per-layer
+    (b, heads, t, t) probability matrices the XLA backward keeps
+    in FP32 (scores accumulate with preferred_element_type=f32;
+    r5 run2 proved the bf16 estimate >20% low: the gated-as-fitting
+    dense-train variant OOMed and poisoned the session),
+    transient-only (x2 working set) on the forward."""
+    P = matmul_params(cfg)["total"]
+    b, t = batch, seq
+    bytes_ = 2.0 * P                       # bf16 weights
+    if backward:
+        bytes_ += 2.0 * P                  # bf16 grads
+        if optimizer:
+            bytes_ += 8.0 * P              # fp32 adam m+v
+        bytes_ += (8 * b * t * cfg.d_model * 2.0
+                   + 2 * b * t * cfg.d_ff * 2.0) * cfg.n_layers
+    # fp32 logits are the forward's live output either way; the
+    # backward also holds their cotangent
+    bytes_ += 4.0 * b * t * cfg.vocab_size * (2 if backward else 1)
+    if not flash:
+        probs = 4.0 * b * cfg.n_heads * float(t) * t   # fp32
+        bytes_ += probs * (cfg.n_layers if backward else 2)
+    return bytes_
+
+
 def fwd_flops_per_token(cfg: ModelConfig, seq: int) -> float:
     """Forward matmul FLOPs per token at sequence length ``seq``.
 
