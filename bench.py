@@ -380,10 +380,19 @@ def model_throughput(emit=None) -> dict | None:
             train_steps = 5 if backend == "tpu" else 2
 
             def measure_train(run_cfg, label, run_tokens, seq_count):
+                import functools as _ft
+
                 step_fn, init_state = tf.make_train_step(run_cfg)
                 state = init_state(jax.random.PRNGKey(3))
 
-                @jax.jit
+                # DONATE the state: returning the scanned final
+                # state without donation holds TWO copies of
+                # params+grads-equivalent+AdamW moments (~4.9 GB
+                # each at d2048) across the call — r5 run3 OOMed the
+                # proven-working flash variant on exactly this while
+                # the probe (which discards its final state) ran it
+                # at 169 ms.
+                @_ft.partial(jax.jit, donate_argnums=(0,))
                 def run_train(state, run_tokens):
                     def body(st, i):
                         shifted = (run_tokens + i) % run_cfg.vocab_size
@@ -393,14 +402,14 @@ def model_throughput(emit=None) -> dict | None:
                                         jnp.arange(train_steps))
 
                 with stopwatch(label):
-                    out_state, losses = run_train(state, run_tokens)
+                    state, losses = run_train(state, run_tokens)
                     jax.block_until_ready(losses)  # compile + warm
                 t0 = time.monotonic()
-                out_state, losses = run_train(state, run_tokens)
+                state, losses = run_train(state, run_tokens)
                 jax.block_until_ready(losses)
                 dt = (time.monotonic() - t0) / train_steps
                 assert float(losses[-1]) == float(losses[-1])  # NaN
-                del out_state, state  # free the optimizer tree
+                del state  # free the optimizer tree
                 return batch * seq_count / dt
 
             variants = {}
@@ -771,6 +780,13 @@ def model_throughput(emit=None) -> dict | None:
             # matrix, not everything after it.
             try:
                 sp_serve = decode.serving_params(params, cfg)
+                # ONE host copy of the token matrix for every
+                # stream builder below: np.asarray(tokens[0, :n])
+                # per request is a device slice + transfer (one
+                # ~60ms RTT EACH on the tunnel, and a fresh device
+                # allocation that explodes before require_serving
+                # on a poisoned session — r5 run3)
+                tokens_h = np.asarray(tokens)
             except Exception as exc:  # pragma: no cover
                 result["serving_snapshot_error"] = note_exc(exc)
                 sp_serve = None
@@ -866,7 +882,7 @@ def model_throughput(emit=None) -> dict | None:
                     max_new = int(rng.choice(news))
                     reqs.append(serving.Request(
                         f"{key}{i}",
-                        np.asarray(tokens[0, :p_len]).tolist(),
+                        tokens_h[0, :p_len].tolist(),
                         max_new))
                 return reqs
 
@@ -894,7 +910,7 @@ def model_throughput(emit=None) -> dict | None:
                     # timed run
                     eng.submit(serving.Request(
                         f"warm{j}",
-                        np.resize(np.asarray(tokens[0]),
+                        np.resize(tokens_h[0],
                                   wl).tolist(), 2))
                 eng.run()
                 phases = instrument_phases(eng)
@@ -1025,14 +1041,14 @@ def model_throughput(emit=None) -> dict | None:
                 # prompt source long enough for any LONG (tokens is
                 # only max_seq wide; tile it for the 4k regime)
                 long_prompt = np.resize(
-                    np.asarray(tokens[0]), LONG).tolist()
+                    tokens_h[0], LONG).tolist()
                 # warm both prompt buckets + chunk/suffix traces;
                 # the short cohort admits as one 8-wide wave, the
                 # long request always alone in its bucket
                 eng.warm_admission((224,))
                 eng.warm_admission((LONG,), sizes=(1,))
                 eng.submit(serving.Request(
-                    "warm", np.asarray(tokens[0, :256]).tolist(), 2))
+                    "warm", tokens_h[0, :256].tolist(), 2))
                 eng.submit(serving.Request(
                     "warmL",
                     [(t + 1) % cfg.vocab_size for t in long_prompt],
@@ -1043,7 +1059,7 @@ def model_throughput(emit=None) -> dict | None:
                 for i in range(batch):
                     eng.submit(serving.Request(
                         f"{key}s{i}",
-                        np.asarray(tokens[0, :224]).tolist(), 96))
+                        tokens_h[0, :224].tolist(), 96))
                 eng.submit(serving.Request(
                     f"{key}L", list(long_prompt), 64))
                 t0 = time.monotonic()
@@ -1213,7 +1229,7 @@ def model_throughput(emit=None) -> dict | None:
                     admission_wave_sizes=(1, 4, 16))
                 eng = serving.PagedServingEngine(sp_l, cfg, sc_r)
                 rng = np.random.RandomState(7)
-                base = np.asarray(tokens[0])
+                base = tokens_h[0]
                 reqs = []
                 for i in range(40):
                     p_len = int(rng.choice([224, 1024, 2048]))
@@ -1334,7 +1350,7 @@ def model_throughput(emit=None) -> dict | None:
                 stays full — the saturation workload."""
                 return [serving.Request(
                     f"{key}{i}",
-                    ((np.asarray(tokens[0, :p_len]) + i)
+                    ((tokens_h[0, :p_len] + i)
                      % cfg.vocab_size).tolist(), max_new)
                     for i in range(n_req)]
 
@@ -1517,7 +1533,7 @@ def model_throughput(emit=None) -> dict | None:
             # Dense twin on the SAME stream (dense FLOPs are
             # content-independent, but the comparison stays honest).
             def motif_stream(key: str, n_req: int):
-                motif = np.asarray(tokens[0, :8])
+                motif = tokens_h[0, :8]
                 return [serving.Request(
                     f"{key}{i}",
                     ((np.resize(motif, 192) + i)

@@ -349,6 +349,20 @@ def main() -> int:
     for flash, batch in matrix:
         cfg = dataclasses.replace(base, flash=flash)
         label = f"flash={flash} batch={batch}"
+        # An OOM poisons the remote device session (every later
+        # allocation in the process fails — bench.py r5 run2), so
+        # variants that step_peak_bytes predicts won't fit are
+        # skipped by arithmetic, exactly like the bench: at d2048
+        # this rules out dense@b8 (~14.7 GiB) and flash@b16
+        # (~16.7 GiB) on a 16 GiB v5e while keeping flash@b8.
+        if spec is not None and F.step_peak_bytes(
+                cfg, batch, base.max_seq,
+                flash=flash) > 0.7 * spec.hbm_gib * 2**30:
+            results.append({
+                "config": label,
+                "skipped": "estimated HBM peak > 70% of chip "
+                           "(OOM would poison the session)"})
+            continue
         try:
             m = measure_train(cfg, batch, steps)
         except Exception as exc:
@@ -403,7 +417,7 @@ def main() -> int:
             jax.clear_caches()
 
     ok = [r for r in results if "error" not in r
-          and "d_model" not in r]
+          and "skipped" not in r and "d_model" not in r]
     report = {
         "backend": backend,
         "chip": spec.name if spec else None,
@@ -480,7 +494,11 @@ def main() -> int:
         worst = min(ok, key=lambda r: r.get(key, 0))
         report["best"] = best["config"]
         # per-op attribution for best and worst: what the win IS
-        for tag, variant in (("best", best), ("worst", worst)):
+        # (one pass when the OOM gate left a single runnable config)
+        pairs = [("best", best)]
+        if worst is not best:
+            pairs.append(("worst", worst))
+        for tag, variant in pairs:
             cfg = dataclasses.replace(base, flash=variant["flash"])
             gc.collect()
             jax.clear_caches()
