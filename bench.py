@@ -1219,7 +1219,7 @@ def model_throughput(emit=None) -> dict | None:
                 # retrace the chunk kernel per width (~4s per
                 # decode dispatch in r4 run2 — compile, not serving)
                 sc_r = serving.ServingConfig(
-                    max_slots=slots, max_len=3392, chunk=64,
+                    max_slots=slots, max_len=3648, chunk=64,
                     paged_blocks=pool_r, block_size=blk_r,
                     paged_width=64, prefix_cache_entries=8,
                     # sparse wave sizes: 4 prompt buckets x this set
@@ -1238,12 +1238,16 @@ def model_throughput(emit=None) -> dict | None:
                         [224, 1024, 2048, 3072]))
                     prompt = ((np.resize(base, p_len) + i)
                               % cfg.vocab_size).tolist()
-                    # near-uniform long outputs: ragged short tails
-                    # idle slots during the drain and cost decode
-                    # occupancy (r5 run4: 79.3% at a 128/256 mix)
+                    # uniform LONG outputs, calibrated on runs 4-5:
+                    # ragged/short outputs retire slots fast enough
+                    # that growth always finds freed blocks (5
+                    # preemptions) and admission gaps dominate the
+                    # row budget (occupancy 60-79%); 512-token
+                    # outputs slow the churn so growth collides
+                    # with the pinned pool, and decode rounds
+                    # dominate the grid's row economics
                     reqs.append(serving.Request(
-                        f"{key}{i}", prompt,
-                        int(rng.choice([224, 256]))))
+                        f"{key}{i}", prompt, 512))
                 for f in range(8):
                     shared = ((np.resize(base, 1024) + 1000 + f)
                               % cfg.vocab_size).tolist()
@@ -1251,16 +1255,14 @@ def model_throughput(emit=None) -> dict | None:
                     # reuse; members extend it with distinct
                     # suffixes (bucket 128) and hit block-aligned
                     reqs.append(serving.Request(
-                        f"{key}f{f}h", shared,
-                        int(rng.choice([224, 256])),
+                        f"{key}f{f}h", shared, 512,
                         cache_prefix=True))
                     for m in range(2):
                         sfx = ((np.resize(base, 96 + 32 * m)
                                 + 7 * f + m) % cfg.vocab_size
                                ).tolist()
                         reqs.append(serving.Request(
-                            f"{key}f{f}m{m}", shared + sfx,
-                            int(rng.choice([224, 256]))))
+                            f"{key}f{f}m{m}", shared + sfx, 512))
                 # interleave families into the independent stream
                 # (deterministically) so hits happen mid-load, but
                 # keep each family's head ahead of its members
@@ -1596,8 +1598,15 @@ def model_throughput(emit=None) -> dict | None:
             # dominates the RTT and the tier delta is resolvable.
             if null_ok:
                 try:
+                    # half scale at the d2048 flagship: the 16-slot
+                    # 4k-context scan's compile deterministically
+                    # failed the remote compile helper (UNAVAILABLE
+                    # transport, runs 4-5) at this model size; the
+                    # regime (long context, small chunk) is intact
                     result["paged_tier_micro"] = paged_tier_micro(
-                        params, cfg, med, null_dt)
+                        params, cfg, med, null_dt,
+                        **({"slots": 8, "ctx0": 1984}
+                           if cfg.d_model >= 2048 else {}))
                 except Exception as exc:  # pragma: no cover
                     result["paged_tier_micro_error"] = \
                         str(exc)[:100]
