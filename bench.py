@@ -821,6 +821,7 @@ def model_throughput(emit=None) -> dict | None:
                 ("_retire", "retire_fetch"),
                 ("_spec_retire", "retire_fetch"),
                 ("_claim_pending", "claim_host"),
+                ("_preempt_youngest", "preempt_host"),
             )
             # readback phases sync the device; their wall absorbs
             # in-flight async dispatch work and is excluded from the
@@ -830,7 +831,8 @@ def model_throughput(emit=None) -> dict | None:
             # correction) nor readbacks — they exist to ATTRIBUTE
             # host_other_s (r4's serving_realistic left 2.6s of a
             # 5.8s run unexplained)
-            _HOST_PHASES = ("activate_host", "claim_host")
+            _HOST_PHASES = ("activate_host", "claim_host",
+                            "preempt_host")
             _NON_DISPATCH_PHASES = _READBACK_PHASES + _HOST_PHASES
 
             def instrument_phases(eng) -> dict:
@@ -1213,7 +1215,14 @@ def model_throughput(emit=None) -> dict | None:
                 prefill tokens actually skipped, peak pool use."""
                 require_serving()
                 sp_l = sp_serve
-                slots, blk_r, pool_r = 16, 64, 272
+                # grid matched to the pool (calibrated on runs 4-6):
+                # 16 slots over a 271-block pool left half the grid
+                # idle behind the block budget (occupancy 49.9-79.3%)
+                # — the pool sustains ~8 concurrent mixed requests
+                # (avg ~33 blocks each), so 8 slots keep the grid
+                # full while growth still collides at the margin
+                # (run6: 31 preemptions)
+                slots, blk_r, pool_r = 8, 64, 272
                 # fixed table width: the mixed prompts would
                 # otherwise re-bucket the width as slots grow and
                 # retrace the chunk kernel per width (~4s per
@@ -1223,12 +1232,12 @@ def model_throughput(emit=None) -> dict | None:
                     paged_blocks=pool_r, block_size=blk_r,
                     paged_width=64, prefix_cache_entries=8,
                     # sparse wave sizes: 4 prompt buckets x this set
-                    # is 12 warm compiles instead of the 20 a full
-                    # pow-2 ladder to 16 would cost (~1min each on
-                    # the remote-compile tunnel); decomposition stays
+                    # is 12 warm compiles instead of the 16 a full
+                    # pow-2 ladder would cost (~1min each on the
+                    # remote-compile tunnel); decomposition stays
                     # exact (K = 4s and 1s), admission FLOPs stay
                     # proportional to the wave
-                    admission_wave_sizes=(1, 4, 16))
+                    admission_wave_sizes=(1, 4, 8))
                 eng = serving.PagedServingEngine(sp_l, cfg, sc_r)
                 rng = np.random.RandomState(7)
                 base = tokens_h[0]
@@ -1295,7 +1304,7 @@ def model_throughput(emit=None) -> dict | None:
                 # family, then flush cache/counters so the measured
                 # stats start clean
                 eng.warm_admission((224, 1024, 2048, 3072),
-                                   sizes=(1, 4, 16))
+                                   sizes=(1, 4, 8))
                 warm_pre = ((base[:1024].astype(np.int64) + 31337)
                             % cfg.vocab_size).astype(int).tolist()
                 eng.submit(serving.Request(f"{key}wh", warm_pre, 2,
@@ -1608,18 +1617,32 @@ def model_throughput(emit=None) -> dict | None:
                         **({"slots": 8, "ctx0": 1984}
                            if cfg.d_model >= 2048 else {}))
                 except Exception as exc:  # pragma: no cover
-                    result["paged_tier_micro_error"] = \
-                        str(exc)[:100]
+                    if ("UNAVAILABLE" in str(exc)
+                            and cfg.d_model >= 2048):
+                        # the remote compile helper rejects the
+                        # scanned paged-chunk HLO at this model size
+                        # (transport failure, runs 4-6, full AND
+                        # half scale) — the tier verdict stands from
+                        # the d1024 measurement: gather 2.9x faster
+                        # (BENCH_LOCAL_r04 paged_tier_micro)
+                        result["paged_tier_micro_skipped"] = (
+                            "remote compile helper rejects the "
+                            "scanned HLO at d2048; d1024 verdict "
+                            "stands (gather 2.9x faster, r4)")
+                    else:
+                        result["paged_tier_micro_error"] = \
+                            note_exc(exc)
             else:
                 result["paged_tier_micro_skipped"] = \
                     "null_dt calibration failed"
             _note()
 
-            # Realistic mixed workload over the paged pool: 16
-            # slots, 128..2k prompts, deliberately under-provisioned
-            # pool so pressure eviction/preemption shows up in the
+            # Realistic mixed workload over the paged pool: 64
+            # requests, 224..3k prompts with prefix families, a
+            # deliberately under-provisioned pool (grid matched to
+            # it) so pressure eviction/preemption shows up in the
             # numbers, and the padding-waste-vs-paged HBM accounting
-            # is measured, not computed (VERDICT r03 #8).
+            # is measured, not computed (VERDICT r03 #8 / r4 #4).
             try:
                 run_realistic("serving_realistic")
             except Exception as exc:  # pragma: no cover
