@@ -38,6 +38,31 @@ else:
     jax.config.update("jax_platforms", "cpu")
 
 
+import pytest as _pytest  # noqa: E402
+
+
+@_pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Clear jax's compiled-executable caches at module teardown.
+
+    A full both-tiers run in ONE pytest process accumulates hundreds
+    of compiled executables; at that pressure XLA's CPU
+    backend_compile segfaulted deterministically mid-suite (jax
+    0.9.0, r5 — the same test green in isolation and in file-scoped
+    runs, 125 GB of host RAM free). Per-module cache clearing trades
+    a few repeated compiles for a bounded compiler working set.
+    CI runs the tiers as separate steps anyway; this protects the
+    single-invocation `pytest tests/` path.
+    """
+    yield
+    try:
+        import jax
+
+        jax.clear_caches()
+    except ImportError:
+        pass
+
+
 # -- native plugin fixtures (shared by test_plugin_grpc and
 # test_plugin_lifecycle) ----------------------------------------------
 
