@@ -91,3 +91,45 @@ def test_decode_roofline_shape():
     assert r["roof_gbps"] == 819.0
     assert r["achieved_gbps"] > 0
     assert abs(r["weight_mb"] + r["kv_mb"] - r["bytes_per_step_mb"]) < 0.25
+
+
+def test_step_peak_bytes_gate_calibration():
+    """Pins the r5 OOM-gate calibration: every historically-working
+    variant fits under 0.7x16GiB and every observed-OOM variant does
+    not (an OOM poisons the tunnel device session, so these
+    decisions are load-bearing — BENCH_LOCAL_r05_run2/3 are the
+    post-mortems)."""
+    from kind_tpu_sim.models import flops as F
+    from kind_tpu_sim.models import transformer as tf
+
+    lim = 0.7 * 16 * 2**30
+    large, small = tf.bench_config_large(), tf.bench_config()
+
+    def fits(cfg, b, t, **kw):
+        return F.step_peak_bytes(cfg, b, t, **kw) < lim
+
+    # train step (fwd+bwd+AdamW) at seq 1024
+    assert not fits(large, 8, 1024, flash=False)   # OOMed (run2)
+    assert fits(large, 8, 1024, flash=True)        # runs at ~169 ms
+    assert not fits(large, 16, 1024, flash=True)   # probe gate
+    assert fits(small, 8, 1024, flash=False)       # d1024 dense ok
+    assert fits(small, 8, 1024, flash=True)
+    # 4k fwd+bwd (no optimizer)
+    assert not fits(large, 2, 4096, flash=False, optimizer=False)
+    assert fits(large, 2, 4096, flash=True, optimizer=False)
+    # 4k forward-only dense fits even at d2048
+    assert fits(large, 2, 4096, flash=False, backward=False,
+                optimizer=False)
+
+
+def test_attention_flops_formula():
+    from kind_tpu_sim.models import flops as F
+
+    # causal: t*(t+1)/2 pairs, 4*d flops per pair per head
+    assert F.attention_flops(4, 2, 8) == 4 * 8 * 2 * (4 * 5 / 2)
+    # bidirectional doubles the large-t limit
+    assert F.attention_flops(128, 1, 16, causal=False) == \
+        4 * 16 * 128 * 128
+    # batch scales linearly
+    assert F.attention_flops(64, 2, 8, batch=3) == \
+        3 * F.attention_flops(64, 2, 8)
