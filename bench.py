@@ -8,13 +8,18 @@ measures the same thing at the strongest level the host allows:
 * **e2e mode** (docker+kind+kubectl available): `create tpu` for real,
   apply the TPU test pod, report measured schedule-to-Ready p50.
 * **sim mode** (no container daemon — e.g. the TPU bench host): the
-  full simulated bring-up path with the cluster virtualized:
-    1. orchestrator create pipeline over the fake control plane,
-    2. native device plugin cold start -> first ListAndWatch capacity
-       advertisement observed by a real gRPC client,
-    3. JAX slice smoke: 8 fake chips visible + psum verified
-       (subprocess on the virtual CPU backend),
-  value = total seconds until the simulated slice is proven usable.
+  full simulated bring-up path with the cluster virtualized, run as
+  TWO OVERLAPPED TRACKS on the warm-path runtime (sim_bringup):
+    a. JAX runtime: persistent worker (utils/worker_pool) spawns,
+       imports jax + inits the virtual 8-chip backend, runs the psum
+       acceptance smoke (compiles hit the persistent XLA cache under
+       .cache/jax);
+    b. control plane: orchestrator create pipeline over the fake
+       control plane, then native device plugin cold start -> first
+       ListAndWatch capacity advertisement via a real gRPC client;
+  value = measured wall until BOTH tracks are done — readiness is
+  max(track), not sum(phase); the serialization this hides is
+  published in extras.bringup (per-track seconds + overlap_saved_s).
 
 vs_baseline compares against the reference's 60s Ready bound — but
 only in e2e mode, where both sides measure a real kind cluster. In sim
@@ -43,20 +48,24 @@ sys.path.insert(0, str(REPO))
 BASELINE_READY_BOUND_S = 60.0  # reference CI gate (BASELINE.md)
 
 # Wall-clock per bench section (compiles included) — published in the
-# extras so slow sections are visible instead of inferred.
+# extras so slow sections are visible instead of inferred. Writes go
+# through profiling.stopwatch (thread-safe: the overlapped bring-up
+# records sections from the pool thread too).
 SECTION_S: dict = {}
 
+# Satellite knob: skip the accelerator model pass entirely (the probe
+# + child budget can dominate bench wall-clock on tunnel-less hosts).
+SKIP_MODEL_ENV = "KIND_TPU_SIM_SKIP_MODEL_BENCH"
 
 import contextlib
 
 
 @contextlib.contextmanager
 def stopwatch(name: str):
-    t0 = time.monotonic()
-    try:
+    from kind_tpu_sim import profiling
+
+    with profiling.stopwatch(name, SECTION_S):
         yield
-    finally:
-        SECTION_S[name] = round(time.monotonic() - t0, 1)
 
 
 def have(binary: str) -> bool:
@@ -1874,17 +1883,16 @@ def model_child_main() -> int:
     return 0
 
 
-def probe_accelerator(timeouts=(60, 120, 180),
-                      spacing_s: float = 15) -> tuple:
-    """Bounded accelerator probe with escalating retries.
+def probe_accelerator(timeouts=(15,), spacing_s: float = 5) -> tuple:
+    """Bounded accelerator liveness probe.
 
-    Round 2 lost every TPU number to ONE 180s probe timeout against a
-    transiently wedged tunnel (BENCH_r02.json). Escalating attempts
-    (60s, 120s, 180s, spaced) survive both failure modes: a tunnel
-    that recovers between attempts (any attempt passes) AND a slow-
-    but-healthy backend init (a consistently-90s init fails the 60s
-    attempt but passes the 120s one — a fixed short retry would fail
-    all three). Returns (ok, per-attempt errors).
+    ONE short (≤15s) attempt by default: the r05 run burned 6 minutes
+    (60s+120s+180s, spaced) against a hung experimental backend
+    before skipping the model pass — a backend that cannot list its
+    devices in 15s is not going to carry a 3000s capture. Hosts with
+    a known slow-but-healthy init can restore an escalating ladder by
+    passing more timeouts (the retry machinery is unchanged).
+    Returns (ok, per-attempt errors).
     """
     errors = []
     for i, timeout_s in enumerate(timeouts):
@@ -1977,6 +1985,141 @@ def model_throughput_via_child(budget_s: float) -> dict | None:
     return None
 
 
+def sim_bringup(phases: dict, samples: dict) -> tuple:
+    """Phase-overlapped sim-mode bring-up over the warm-path runtime.
+
+    Two concurrent tracks, both started at t0:
+
+    * JAX runtime: a persistent worker (utils/worker_pool) spawns,
+      imports jax + inits the 8-device virtual backend, and runs the
+      psum acceptance smoke — the whole track submitted before the
+      control plane starts, so its cold cost hides under (or rather,
+      over) the control-plane work instead of following it.
+    * control plane: orchestrator create pipeline + device-plugin
+      cold start to first ListAndWatch, on the main thread.
+
+    The headline is the measured wall until BOTH tracks are done —
+    readiness approaches max(track) instead of sum(phase) — and the
+    serialization this hides is published, not discarded:
+    extras carry per-track seconds and ``overlap_saved_s =
+    serialized - wall`` (>= 0 by construction, both tracks starting
+    together). Returns ``(value_seconds, pool)``; the pool stays
+    open so later bench sections (ring attention) reuse the warm
+    worker. Falls back to the pre-pool serial subprocess path when
+    the pool cannot come up (no usable jax), reporting
+    ``overlap_saved_s = 0``.
+    """
+    from kind_tpu_sim.metrics import overlap_attribution
+    from kind_tpu_sim.utils.shell import compilation_cache_dir
+
+    try:
+        from kind_tpu_sim.utils import worker_pool as wp
+    except ImportError:  # pragma: no cover
+        wp = None
+
+    cache = compilation_cache_dir()
+    try:
+        cache_state = ("disabled" if cache is None else
+                       "warm" if any(cache.iterdir()) else "cold")
+    except OSError:
+        cache_state = "cold"
+
+    # The native plugin BUILD (cmake+ninja, minutes on first run) is
+    # provisioning, not bring-up — r05 likewise paid it outside the
+    # measured window (min_of's first phase_plugin call timed only
+    # the post-build cold start). Keep it ahead of t0 explicitly.
+    with stopwatch("plugin_build"):
+        ensure_plugin_binary()
+
+    t0 = time.monotonic()
+    pool = smoke_fut = None
+    if wp is not None:
+        try:
+            pool = wp.WorkerPool(size=1, warm=True,
+                                 extra_env=wp.simulated_slice_env(8))
+            smoke_fut = pool.submit_async(
+                "psum_smoke", topology="2x4", expect_devices=8,
+                timeout=300)
+        except Exception as exc:  # pragma: no cover - no pool host
+            phases["worker_pool_error"] = str(exc)[:200]
+            pool = None
+
+    ctrl_t0 = time.monotonic()
+    orch_first = phase_orchestrator()
+    plugin_first = phase_plugin()
+    ctrl_s = time.monotonic() - ctrl_t0
+
+    jax_bringup_s = None
+    if smoke_fut is not None:
+        try:
+            first = smoke_fut.result(timeout=300)
+            jax_bringup_s = time.monotonic() - t0
+            phases["jax_smoke_report_ok"] = bool(first.get("ok"))
+        except Exception as exc:
+            phases["worker_pool_error"] = str(exc)[:200]
+            try:
+                pool.close()
+            except Exception:  # pragma: no cover
+                pass
+            pool = None
+    if jax_bringup_s is None:
+        # serial fallback: one cold subprocess smoke, after ctrl
+        jax_bringup_s = phase_jax_smoke()
+    ready_wall = time.monotonic() - t0
+    value = round(ready_wall, 3)
+
+    # -- post-ready attribution + spread samples (not in the value) --
+    orch_all = [orch_first] + [phase_orchestrator() for _ in range(2)]
+    samples["orchestrator_s"] = [round(x, 3) for x in orch_all]
+    phases["orchestrator_s"] = round(min(orch_all), 3)
+    if plugin_first is not None:
+        plugin_all = [plugin_first]
+        for _ in range(2):
+            more = phase_plugin()
+            if more is None:
+                break
+            plugin_all.append(more)
+        samples["plugin_ready_s"] = [round(x, 3) for x in plugin_all]
+        phases["plugin_ready_s"] = round(min(plugin_all), 3)
+    else:
+        samples["plugin_ready_s"] = []
+    if jax_bringup_s is not None:
+        # legacy key: the cold JAX bring-up this invocation paid —
+        # paid ONCE now, so one sample
+        phases["jax_smoke_s"] = round(jax_bringup_s, 3)
+        samples["jax_smoke_s"] = [phases["jax_smoke_s"]]
+    if pool is not None:
+        def warm_smoke():
+            t = time.monotonic()
+            pool.submit("psum_smoke", topology="2x4", timeout=120)
+            return time.monotonic() - t
+
+        t_warm, samples["jax_smoke_warm_s"] = min_of(warm_smoke)
+        if t_warm is not None:
+            phases["jax_smoke_warm_s"] = round(t_warm, 3)
+        try:
+            hello = pool.bringup()
+            phases["jax_worker"] = {
+                k: hello[k] for k in
+                ("pid", "warm_s", "devices", "backend")
+                if k in hello}
+        except Exception:  # pragma: no cover - attribution only
+            pass
+
+    tracks = {"control_plane": ctrl_s}
+    if jax_bringup_s is not None:
+        tracks["jax_runtime"] = jax_bringup_s
+    bringup = overlap_attribution(tracks, ready_wall)
+    bringup["compilation_cache"] = cache_state
+    bringup["overlapped"] = pool is not None
+    if pool is None:
+        # serial fallback ran the tracks back to back: no overlap to
+        # claim, whatever the clock arithmetic says
+        bringup["overlap_saved_s"] = 0.0
+    phases["bringup"] = bringup
+    return value, pool
+
+
 def min_of(fn, n: int = 3) -> tuple:
     """(min, samples) over n runs of a phase — min-of-N so the
     north-star metric separates host noise from real regressions
@@ -1992,7 +2135,7 @@ def min_of(fn, n: int = 3) -> tuple:
 
 
 RING_BENCH = r"""
-import json, os, sys, time
+import json, os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
@@ -2000,90 +2143,33 @@ os.environ["XLA_FLAGS"] = (
 sys.path.insert(0, os.environ["TPU_SIM_REPO"])
 import jax
 jax.config.update("jax_platforms", "cpu")
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from kind_tpu_sim.parallel.ring_attention import (
-    reference_attention, ring_attention)
-
-mesh = Mesh(np.array(jax.devices()), ("seq",))
-spec = NamedSharding(mesh, P(None, "seq", None, None))
-HD = 16
-
-def inputs(tokens):
-    import functools
-    @functools.partial(jax.jit, out_shardings=(spec, spec, spec))
-    def make():
-        shape = (1, tokens, 2, HD)
-        kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
-        return (jax.random.normal(kq, shape, jnp.float32),
-                jax.random.normal(kk, shape, jnp.float32),
-                jax.random.normal(kv, shape, jnp.float32))
-    return make()
-
-def timeit(fn, *args, reps=3):
-    # Returns (best_seconds, last_output): the warm-up output is kept
-    # so correctness checks don't pay for extra executions.
-    last = jax.block_until_ready(fn(*args))
-    best = None
-    for _ in range(reps):
-        t0 = time.monotonic()
-        last = jax.block_until_ready(fn(*args))
-        dt = time.monotonic() - t0
-        best = dt if best is None else min(best, dt)
-    return best, last
-
-out = {}
-q, k, v = inputs(8192)
-dense = jax.jit(lambda q, k, v: reference_attention(q, k, v))
-ring = lambda q, k, v: ring_attention(q, k, v, mesh, axis_name="seq")
-dense_s, dense_out = timeit(dense, q, k, v)
-ring_s, ring_out = timeit(ring, q, k, v)
-out["dense_8k_s"] = round(dense_s, 3)
-out["ring_8k_s"] = round(ring_s, 3)
-# correctness at the comparison point (outputs reused, not recomputed)
-np.testing.assert_allclose(np.array(ring_out), np.array(dense_out),
-                           atol=2e-4, rtol=2e-4)
-# 32k: the dense path would materialize a 32k x 32k score matrix per
-# head (4 GB fp32) — the ring's whole reason to exist. One timed rep:
-# an 80-second cpu-sim run repeated 3x was a third of the bench's
-# wall clock for a number that is about mechanism, not speed.
-q, k, v = inputs(32768)
-s32, _ = timeit(ring, q, k, v, reps=1)
-out["ring_32k_s"] = round(s32, 3)
-out["ring_32k_tokens_per_s"] = round(32768 / out["ring_32k_s"])
-# Roofline (VERDICT r4 #8: the 32k number had no ceiling attached).
-# The ceiling for a cpu-sim entry is THIS HOST's measured attention
-# throughput: the dense-GSPMD 8k run achieves a flop rate on the
-# same shapes/codepath, and the ring computes exactly
-# flops.attention_flops more work at 32k (comm is linear in t and
-# accounted separately). achieved-vs-expected < 1 names the ring's
-# own overhead: P ppermute rotations per pass plus the online-
-# softmax rescale of the (o, l, m) accumulators each block.
-from kind_tpu_sim.models import flops as F
-fl8 = F.attention_flops(8192, 2, HD)
-fl32 = F.attention_flops(32768, 2, HD)
-host_ceiling = fl8 / dense_s          # flops/s, measured
-out["host_attn_gflops_per_s"] = round(host_ceiling / 1e9, 2)
-out["ring_32k_gflops_per_s"] = round(fl32 / s32 / 1e9, 2)
-out["ring_32k_expected_s"] = round(fl32 / host_ceiling, 3)
-out["ring_32k_pct_of_expected"] = round(
-    100.0 * out["ring_32k_expected_s"] / s32, 1)
-P = 8
-comm_bytes = 2 * (P - 1) * 32768 * 2 * HD * 4  # k+v rotations, fp32
-out["ring_32k_comm_mb"] = round(comm_bytes / 2**20, 1)
-out["ring_8k_overhead_vs_dense"] = round(ring_s / dense_s, 3)
-print(json.dumps(out))
+from kind_tpu_sim.parallel.ring_attention import bench_report
+print(json.dumps(bench_report()))
 """
 
 
-def ring_attention_bench() -> dict | None:
+def ring_attention_bench(pool=None) -> dict | None:
     """Ring vs dense-GSPMD attention on the 8-device virtual slice
-    (cpu-sim tier — the mechanism comparison, not TPU wall-clock):
-    both at 8k where dense still fits, ring alone at 32k where the
-    dense score matrix (4 GB/head) cannot exist."""
+    (cpu-sim tier — the mechanism comparison, not TPU wall-clock);
+    measurement lives in ring_attention.bench_report. Runs on the
+    warm worker pool when one is up (no re-import, compiles hit the
+    persistent cache); falls back to the pre-pool subprocess."""
     import subprocess
 
+    if pool is not None:
+        try:
+            report = pool.submit(
+                "call",
+                target=("kind_tpu_sim.parallel.ring_attention:"
+                        "bench_report"),
+                timeout=900)
+            report["backend"] = "cpu-sim"
+            report["via"] = "worker_pool"
+            return report
+        except Exception as exc:  # pragma: no cover - fall back cold
+            fallback_cause = str(exc)[:120]
+    else:
+        fallback_cause = None
     try:
         env = cpu_child_env()
         env["TPU_SIM_REPO"] = str(REPO)
@@ -2094,6 +2180,8 @@ def ring_attention_bench() -> dict | None:
         )
         report = json.loads(proc.stdout.splitlines()[-1])
         report["backend"] = "cpu-sim"
+        if fallback_cause:
+            report["worker_pool_fallback"] = fallback_cause
         return report
     except (subprocess.SubprocessError, OSError,
             ValueError) as exc:  # pragma: no cover - best effort
@@ -2123,8 +2211,14 @@ def multihost_smoke() -> dict | None:
 
 
 def capture_model_section(phases: dict) -> None:
-    """Probe (with retries), then run the model pass via the streaming
-    child. Fills phases['model'] with whatever was measured."""
+    """Probe (bounded), then run the model pass via the streaming
+    child. Fills phases['model'] with whatever was measured — or an
+    explicit skip marker when the operator opted out."""
+    skip = os.environ.get(SKIP_MODEL_ENV)
+    if skip:
+        phases["model"] = {
+            "skipped": f"{SKIP_MODEL_ENV}={skip} (operator opt-out)"}
+        return
     probe_t0 = time.monotonic()
     probe_ok, probe_errors = probe_accelerator()
     if not probe_ok:
@@ -2160,6 +2254,17 @@ def bench_model_only(out_path: str | None) -> int:
     phases: dict = {}
     capture_model_section(phases)
     m = phases.get("model")
+    if isinstance(m, dict) and "skipped" in m:
+        artifact = {
+            "metric": "tpu_model_throughput",
+            "mode": "model-only",
+            "status": "skipped",
+            "model": m,
+            "captured_unix": int(time.time()),
+        }
+        emit_result(artifact, out_path, {"status": "skipped"},
+                    default_name="BENCH_FULL_MODEL.json")
+        return 0
     ok = (isinstance(m, dict) and "error" not in m
           and not m.get("device_poisoned"))
     errs = ([k for k in m if k.endswith("_error")]
@@ -2221,35 +2326,31 @@ def main(argv=None) -> int:
         emit_result(out, out_path)
         return 0
 
-    phases = {}
-    # Min-of-3 per phase: the headline is the best the stack can do
-    # on this host; the per-run samples are published so a regression
-    # is distinguishable from host noise (round 2's 3x jax_smoke
-    # swing had no spread on record to judge it against).
+    phases: dict = {}
     samples: dict = {}
-    t_orch, samples["orchestrator_s"] = min_of(phase_orchestrator)
-    phases["orchestrator_s"] = round(t_orch, 3)
-    t_plugin, samples["plugin_ready_s"] = min_of(phase_plugin)
-    if t_plugin is not None:
-        phases["plugin_ready_s"] = round(t_plugin, 3)
-    t_jax, samples["jax_smoke_s"] = min_of(phase_jax_smoke)
-    if t_jax is not None:
-        phases["jax_smoke_s"] = round(t_jax, 3)
-    phases["phase_samples"] = samples
+    # Warm-path bring-up (sim_bringup): the JAX runtime track
+    # (worker-pool spawn + import + psum smoke) overlaps the
+    # control-plane phases; the headline is the measured wall until
+    # both are done. Per-phase spread samples still published so a
+    # regression is distinguishable from host noise.
+    pool = None
+    try:
+        value, pool = sim_bringup(phases, samples)
+        phases["phase_samples"] = samples
 
-    capture_model_section(phases)
-    with stopwatch("multihost"):
-        multihost = multihost_smoke()
-    if multihost:
-        phases["multihost"] = multihost
-    with stopwatch("ring_attention"):
-        ring = ring_attention_bench()
-    if ring:
-        phases["ring_attention"] = ring
+        capture_model_section(phases)
+        with stopwatch("multihost"):
+            multihost = multihost_smoke()
+        if multihost:
+            phases["multihost"] = multihost
+        with stopwatch("ring_attention"):
+            ring = ring_attention_bench(pool)
+        if ring:
+            phases["ring_attention"] = ring
+    finally:
+        if pool is not None:
+            pool.close()
     phases["section_seconds"] = dict(SECTION_S)
-
-    value = round(
-        t_orch + (t_plugin or 0.0) + (t_jax or 0.0), 3)
     # vs_baseline is only an apples-to-apples number in e2e mode
     # (real kind vs the reference's real 60s CI bound). The sim-mode
     # stack-ready time is a virtualized cluster; publish the ratio as
@@ -2264,12 +2365,15 @@ def main(argv=None) -> int:
                  "to the reference's real-kind 60s Ready bound"),
         "extras": dict(
             phases,
+            overlap_saved_s=phases.get("bringup", {}).get(
+                "overlap_saved_s", 0.0),
             sim_vs_reference_bound=round(
                 BASELINE_READY_BOUND_S / value, 2),
         ),
     }
     compact_extra = {
         "phase_samples": phases.get("phase_samples"),
+        "bringup": phases.get("bringup"),
         "headline": headline_numbers(phases.get("model")),
     }
     ring = phases.get("ring_attention")

@@ -190,6 +190,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     smoke.add_argument("--json", action="store_true", dest="as_json")
 
+    jsmoke = sub.add_parser(
+        "jax-smoke",
+        help=(
+            "no-cluster warm-path smoke: run the collectives suite "
+            "on the persistent JAX worker pool (utils/worker_pool) "
+            "and report cold bring-up vs warm resubmission timings"
+        ),
+    )
+    jsmoke.add_argument(
+        "--chips", type=int, default=8,
+        help="virtual devices the pooled worker exposes",
+    )
+    jsmoke.add_argument("--topology", default="2x4")
+    jsmoke.add_argument(
+        "--repeat", type=int, default=3,
+        help="total suite runs (first is the cold bring-up)",
+    )
+    jsmoke.add_argument("--json", action="store_true", dest="as_json")
+
     man = sub.add_parser(
         "manifests",
         help=(
@@ -316,6 +335,54 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
                   "identical streams "
                   f"{'OK' if eng_rep['ok'] else 'FAILED'}")
         print("SLICE SMOKE " + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+def run_jax_smoke(args: argparse.Namespace) -> int:
+    """Warm-path smoke: one persistent worker, the collectives suite
+    submitted ``--repeat`` times. The first run pays worker warm-up
+    (jax import + backend init, amortized by the persistent XLA
+    compilation cache); the rest measure the warm path the pool
+    exists for — the same session reusing the same live backend."""
+    import time
+
+    from kind_tpu_sim.utils import worker_pool as wp
+
+    t0 = time.monotonic()
+    runs = []
+    with wp.WorkerPool(
+            size=1, warm=True,
+            extra_env=wp.simulated_slice_env(args.chips)) as pool:
+        first = pool.submit("collectives_suite",
+                            topology=args.topology, timeout=300)
+        cold_s = time.monotonic() - t0
+        ok = bool(first["ok"])
+        for _ in range(max(0, args.repeat - 1)):
+            t1 = time.monotonic()
+            rep = pool.submit("collectives_suite",
+                              topology=args.topology, timeout=120)
+            runs.append(round(time.monotonic() - t1, 4))
+            ok = ok and bool(rep["ok"])
+        hello = pool.bringup()
+    report = {
+        "ok": ok,
+        "devices": first.get("devices"),
+        "worker_pid": first.get("worker_pid"),
+        "worker_warm_s": hello.get("warm_s"),
+        "cold_suite_s": round(cold_s, 3),
+        "warm_suite_s": runs,
+        "collectives": {k: v.get("ok") for k, v in first.items()
+                        if isinstance(v, dict) and "ok" in v},
+    }
+    if args.as_json:
+        print(json.dumps(report))
+    else:
+        print(f"worker {report['worker_pid']}: "
+              f"{report['devices']} devices, warm-up "
+              f"{report['worker_warm_s']}s, cold suite "
+              f"{report['cold_suite_s']}s, warm "
+              f"{report['warm_suite_s']}")
+        print("JAX SMOKE " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
 
 
@@ -610,6 +677,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # Cluster-free subcommands: no Simulator, no container runtime.
         if args.command == "slice-smoke":
             return run_slice_smoke(args)
+        if args.command == "jax-smoke":
+            return run_jax_smoke(args)
         if args.command == "train-smoke":
             return run_train_smoke(args)
         if args.command == "manifests":

@@ -30,6 +30,10 @@ def psum_smoke(mesh=None) -> Dict[str, object]:
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from kind_tpu_sim.utils.jax_compat import ensure_shard_map
+
+    ensure_shard_map()
+
     from kind_tpu_sim.parallel.mesh import slice_mesh
 
     if mesh is None:
@@ -69,6 +73,10 @@ def ring_permute_smoke(mesh=None) -> Dict[str, object]:
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
+    from kind_tpu_sim.utils.jax_compat import ensure_shard_map
+
+    ensure_shard_map()
+
     from kind_tpu_sim.parallel.mesh import slice_mesh
 
     if mesh is None:
@@ -100,6 +108,10 @@ def all_gather_smoke(mesh=None) -> Dict[str, object]:
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
+
+    from kind_tpu_sim.utils.jax_compat import ensure_shard_map
+
+    ensure_shard_map()
 
     from kind_tpu_sim.parallel.mesh import slice_mesh
 
@@ -138,6 +150,10 @@ def hierarchical_psum_smoke(mesh) -> Dict[str, object]:
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
+
+    from kind_tpu_sim.utils.jax_compat import ensure_shard_map
+
+    ensure_shard_map()
 
     if "dcn" not in mesh.axis_names:
         raise ValueError(f"mesh has no 'dcn' axis: {mesh.axis_names}")

@@ -120,6 +120,9 @@ def slice_smoke() -> dict:
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from kind_tpu_sim.utils.jax_compat import ensure_shard_map
+
+    ensure_shard_map()
     n_proc = jax.process_count()
     local = jax.local_device_count()
     me = jax.process_index()
@@ -228,19 +231,43 @@ def _chips_from_env(environ=None) -> int:
     return max(1, chips)
 
 
-def _worker_main() -> int:
+def _worker_report() -> dict:
     """One simulated TPU worker: the exact code path a jax-multihost
-    pod runs, driven purely by the plugin-injected env contract."""
-    import json
-
+    pod runs, driven purely by the plugin-injected env contract.
+    Must run in a process where jax has not loaded yet (the identity
+    config below is init-time-only) — either the ``__main__`` path or
+    a COLD worker-pool process (`worker_pool.run_grid`)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    chips = _chips_from_env()
+    import re
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     # The simulated host exposes its slice share as XLA host devices;
     # gloo carries the cross-process ("DCN") collectives.
-    jax.config.update("jax_num_cpu_devices", _chips_from_env())
-    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    try:
+        jax.config.update("jax_num_cpu_devices", chips)
+    except AttributeError:
+        # pre-0.5 jax: the device count is an XLA flag, read at
+        # backend init (which hasn't happened yet in this process).
+        # FORCE the slice's own chip count — an inherited 8-device
+        # flag from the launching session must not leak in.
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={chips}"
+        ).strip()
+    identity = identity_from_env()
+    if identity is not None and identity.num_processes > 1:
+        # Multi-process only: pre-0.5 jaxlib's gloo factory requires
+        # the distributed client, so a single-host worker (which
+        # never calls jax.distributed.initialize) must stay on the
+        # default in-process collectives.
+        jax.config.update("jax_cpu_collectives_implementation",
+                          "gloo")
 
     initialize_from_env()
     report = global_device_report()
@@ -254,7 +281,13 @@ def _worker_main() -> int:
     if ring_tokens:
         report.update(ring_long_context_smoke(ring_tokens))
         report["ok"] = report["ok"] and report["ring_ok"]
-    print(json.dumps(report), flush=True)
+    return report
+
+
+def _worker_main() -> int:
+    import json
+
+    print(json.dumps(_worker_report()), flush=True)
     # A failed check is reported in the JSON (the launcher aggregates
     # `ok`); a non-zero exit is reserved for crashes, where there is
     # no report to read.
@@ -313,78 +346,27 @@ def _launch_once(s, timeout: float, ring_tokens: int = 0) -> List[dict]:
 
 
 def _launch_grid(worker_envs: List[dict], timeout: float) -> List[dict]:
-    """Spawn one worker process per env dict (each env carries the
-    full plugin-style identity incl. its rendezvous port), wait for
-    all, and return their JSON reports in spawn order."""
-    import json
-    import pathlib
-    import subprocess
-    import sys
-    import tempfile
-    import time
+    """Run one COLD worker-pool process per env dict (each env carries
+    the full plugin-style identity incl. its rendezvous port), wait
+    for all, and return their reports in spawn order.
 
-    n = len(worker_envs)
-    repo_root = str(pathlib.Path(__file__).resolve().parents[2])
-    with tempfile.TemporaryDirectory() as logdir:
-        logs = pathlib.Path(logdir)
-        procs = []
-        try:
-            from kind_tpu_sim.utils.shell import cpu_subprocess_env
+    Delegating to :func:`worker_pool.run_grid` buys the slice driver
+    the pool's protocol transport (framed results instead of
+    last-stdout-line scraping — stray worker prints can no longer
+    corrupt a report), its crash diagnostics, and the persistent XLA
+    compilation-cache wiring every pool child inherits. Workers stay
+    cold on purpose: the per-process identity env must be read before
+    jax first loads."""
+    from kind_tpu_sim.utils import worker_pool
 
-            for worker in range(n):
-                env = cpu_subprocess_env()
-                env.update(worker_envs[worker])
-                env["JAX_PLATFORMS"] = "cpu"
-                env["PYTHONPATH"] = repo_root + os.pathsep + env.get(
-                    "PYTHONPATH", "")
-                # Files, not pipes: a worker chatty enough to fill a
-                # 64KB pipe buffer would block mid-rendezvous and hang
-                # the whole slice. Spawning happens inside the
-                # try/finally: a mid-loop failure must still kill the
-                # workers already launched.
-                out = open(logs / f"worker-{worker}.out", "w+")
-                err = open(logs / f"worker-{worker}.err", "w+")
-                procs.append((subprocess.Popen(
-                    [sys.executable, "-m",
-                     "kind_tpu_sim.parallel.multihost"],
-                    env=env, stdout=out, stderr=err, text=True,
-                ), out, err))
-            # Wait on ALL workers concurrently: one crashed worker
-            # leaves its peers blocked in the rendezvous, so waiting
-            # in rank order would burn the whole timeout and blame
-            # the wrong process.
-            deadline = time.monotonic() + timeout
-            pending = set(range(n))
-            while pending:
-                for worker in sorted(pending):
-                    rc = procs[worker][0].poll()
-                    if rc is not None:
-                        pending.discard(worker)
-                        if rc != 0:
-                            err_text = (
-                                logs / f"worker-{worker}.err"
-                            ).read_text()
-                            raise RuntimeError(
-                                f"slice worker {worker} crashed "
-                                f"(rc={rc}):\n{err_text[-2000:]}")
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"slice workers {sorted(pending)} still "
-                        f"running after {timeout}s")
-                if pending:
-                    time.sleep(0.05)
-            reports = []
-            for worker in range(n):
-                out_text = (logs / f"worker-{worker}.out").read_text()
-                reports.append(json.loads(out_text.splitlines()[-1]))
-            return reports
-        finally:
-            for proc, out, err in procs:
-                if proc.poll() is None:
-                    proc.kill()
-                    proc.wait()
-                out.close()
-                err.close()
+    envs = []
+    for env in worker_envs:
+        env = dict(env)
+        env["JAX_PLATFORMS"] = "cpu"
+        envs.append(env)
+    return worker_pool.run_grid(
+        envs, "kind_tpu_sim.parallel.multihost:_worker_report",
+        timeout)
 
 
 _BIND_ERRORS = ("address already in use", "failed to bind",

@@ -69,8 +69,9 @@ def _pipeline_local(x_mb, stage_blocks, *, cfg, axis, n_micro):
     stages = jax.lax.psum(1, axis)
     idx = jax.lax.axis_index(axis)
 
-    pvary = functools.partial(jax.lax.pcast, axis_name=axis,
-                              to="varying")
+    from kind_tpu_sim.utils import jax_compat
+
+    pvary = functools.partial(jax_compat.pvary, axis_name=axis)
     state = pvary(jnp.zeros_like(x_mb[0]))
     outputs = pvary(jnp.zeros_like(x_mb))
 
@@ -110,6 +111,10 @@ def _pipeline_local(x_mb, stage_blocks, *, cfg, axis, n_micro):
 def _build_pipeline(mesh, cfg, stage_axis: str, n_micro: int):
     import jax
     from jax.sharding import PartitionSpec as P
+
+    from kind_tpu_sim.utils.jax_compat import ensure_shard_map
+
+    ensure_shard_map()
 
     data_axis = "data" if "data" in mesh.axis_names else None
     x_spec = P(None, data_axis, None, None)   # (M, mb, t, d)

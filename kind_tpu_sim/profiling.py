@@ -23,8 +23,35 @@ import gzip
 import json
 import os
 import pathlib
+import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, MutableMapping, Optional
+
+_SECTION_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def stopwatch(name: str, store: MutableMapping, ndigits: int = 1):
+    """Record a section's wall seconds into ``store[name]``.
+
+    The shared section accountant for bench.py/CLI phase attribution:
+    thread-safe (the overlapped bring-up records control-plane and
+    worker-pool sections from different threads) and exception-safe
+    (a failing section still reports how long it burned)."""
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        elapsed = round(time.monotonic() - t0, ndigits)
+        with _SECTION_LOCK:
+            store[name] = elapsed
+
+
+def record_section(name: str, seconds: float, store: MutableMapping,
+                   ndigits: int = 3) -> None:
+    """Thread-safe store of an externally-measured section time."""
+    with _SECTION_LOCK:
+        store[name] = round(seconds, ndigits)
 
 
 @contextlib.contextmanager

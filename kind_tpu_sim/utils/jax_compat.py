@@ -1,0 +1,86 @@
+"""Version-compatibility shims over the jax/jaxlib surface.
+
+The simulator targets the jax 0.9.x API (top-level ``jax.shard_map``,
+``jaxlib._jax``, dict-valued ``cost_analysis``) but must also run on
+the 0.4.x line some hosts ship, where the same surfaces live under
+``jax.experimental.shard_map`` / ``jaxlib.xla_extension`` and
+``cost_analysis`` returns a one-element list. Every shim resolves the
+modern name first so on a current jax this module is a no-op pass-
+through; nothing here changes behavior, only where a name is found.
+
+Import stays lazy (functions, not module-level ``import jax``) for the
+same reason the rest of the tree imports jax inside functions: the
+orchestrator/topology layers must work with no jax installed at all.
+"""
+
+from __future__ import annotations
+
+
+def ensure_shard_map():
+    """Return ``jax.shard_map``, installing it from
+    ``jax.experimental.shard_map`` on jax versions that predate the
+    top-level export. Call after ``import jax``, before the first
+    ``jax.shard_map(...)`` use; idempotent."""
+    import jax
+
+    try:
+        return jax.shard_map
+    except AttributeError:
+        pass
+    from jax.experimental.shard_map import shard_map
+
+    # Top-level alias so existing `jax.shard_map(...)` call sites work
+    # unchanged; module attribute assignment bypasses jax's
+    # deprecation __getattr__, so the alias wins on later lookups.
+    jax.shard_map = shard_map
+    return shard_map
+
+
+def pvary(x, axis_name):
+    """Mark ``x`` device-varying over ``axis_name`` inside a
+    shard_map body, across the API generations: ``jax.lax.pcast``
+    (0.9+), ``jax.lax.pvary`` (0.5-0.8). Pre-varying-manifest jax
+    (0.4.x) needs no cast at all — replicated operands are accepted
+    by the collectives — so identity is the correct fallback."""
+    import jax
+
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_name=axis_name, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_name)
+    return x
+
+
+def jaxlib_extension():
+    """The jaxlib C-extension module under either layout:
+    ``jaxlib._jax`` (0.5+) or ``jaxlib.xla_extension`` (0.4.x).
+    Returns None when neither import resolves."""
+    try:
+        import jaxlib._jax as ext  # noqa: F401 - jax >= 0.5 layout
+
+        return ext
+    except ImportError:
+        pass
+    try:
+        import jaxlib.xla_extension as ext  # 0.4.x layout
+
+        return ext
+    except ImportError:
+        return None
+
+
+def jaxlib_extension_name() -> str:
+    """The import path :func:`jaxlib_extension` resolved (for error
+    messages naming what is actually installed)."""
+    ext = jaxlib_extension()
+    return ext.__name__ if ext is not None else "jaxlib._jax"
+
+
+def cost_analysis_dict(compiled) -> dict:
+    """``compiled.cost_analysis()`` as a flat dict on every jax line:
+    0.4.x returns a one-element list of per-computation dicts, 0.5+
+    returns the dict directly."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return dict(cost or {})

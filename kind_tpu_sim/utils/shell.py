@@ -21,17 +21,66 @@ log = logging.getLogger("kind-tpu-sim")
 # startup ~0.6-1.7s. CPU-only Python subprocesses strip them.
 TUNNEL_ENV_PREFIXES = ("_AXON", "PALLAS_AXON")
 
+# Warm-path knobs (docs/PERFORMANCE.md): where the XLA persistent
+# compilation cache lives, and the off switch.
+CACHE_DIR_ENV = "KIND_TPU_SIM_CACHE_DIR"
+NO_CACHE_ENV = "KIND_TPU_SIM_NO_COMPILATION_CACHE"
+
+
+def compilation_cache_dir():
+    """The repo-local XLA compilation-cache directory (a pathlib.Path),
+    or None when caching is disabled via NO_CACHE_ENV. Override the
+    location with CACHE_DIR_ENV; default is `<repo>/.cache/jax`
+    (gitignored) so psum/ring/transformer compiles amortize across
+    bench and CLI invocations on the same host."""
+    import os
+    import pathlib
+
+    if os.environ.get(NO_CACHE_ENV):
+        return None
+    override = os.environ.get(CACHE_DIR_ENV)
+    if override:
+        return pathlib.Path(override)
+    repo = pathlib.Path(__file__).resolve().parents[2]
+    return repo / ".cache" / "jax"
+
+
+def compilation_cache_env() -> Dict[str, str]:
+    """Env vars that point a JAX child at the persistent compilation
+    cache. Empty when caching is disabled or the dir is uncreatable
+    (read-only checkout): a child must never fail bring-up over a
+    cache it can live without."""
+    cache = compilation_cache_dir()
+    if cache is None:
+        return {}
+    try:
+        cache.mkdir(parents=True, exist_ok=True)
+    except OSError:
+        return {}
+    return {
+        "JAX_COMPILATION_CACHE_DIR": str(cache),
+        # The simulator's hot programs (psum smoke, collectives)
+        # compile in well under jax's 1s default threshold — cache
+        # everything.
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    }
+
 
 def cpu_subprocess_env(base: Optional[Dict[str, str]] = None
                        ) -> Dict[str, str]:
     """Copy of the environment for a CPU-only Python child, with
-    TPU-tunnel startup hooks stripped (see TUNNEL_ENV_PREFIXES)."""
+    TPU-tunnel startup hooks stripped (see TUNNEL_ENV_PREFIXES) and
+    the persistent XLA compilation cache wired in (setdefault, so an
+    explicit caller/env choice wins)."""
     import os
 
     env = dict(os.environ if base is None else base)
     for key in list(env):
         if key.startswith(TUNNEL_ENV_PREFIXES):
             del env[key]
+    for key, value in compilation_cache_env().items():
+        env.setdefault(key, value)
     return env
 
 

@@ -1,0 +1,656 @@
+"""Persistent JAX worker pool — the simulator's warm-path runtime.
+
+The bench/CLI cold path used to pay `import jax` + backend init +
+XLA compile in a fresh subprocess for EVERY JAX-touching phase
+(r05: 99.7% of the stack-ready headline was one cold subprocess).
+This module makes that cost once-per-session: long-lived CPU-backend
+Python workers, preforked once per bench/CLI process, that callers
+submit JAX jobs to over a small length-prefixed JSON protocol on the
+worker's stdin/stdout pipes.
+
+Protocol (both directions): 4-byte big-endian length, then a UTF-8
+JSON object. The worker's FIRST frame is a hello carrying its pid and
+(when preforked warm) the measured warm-up seconds; every later frame
+answers exactly one request, in order:
+
+    request:  {"id": 3, "job": "psum_smoke", "kwargs": {...}}
+    response: {"id": 3, "ok": true, "result": {...}, "elapsed_s": 0.04}
+
+The worker rebinds its real stdout to stderr before serving, so stray
+prints (jax warnings, absl logs) can never corrupt the framing.
+
+Failure contract: a job that raises inside the worker returns
+``ok: false`` and surfaces as :class:`JobError` (no respawn — the
+worker is still healthy). A worker that DIES mid-job (EOF on the
+pipe) is respawned and the job retried once; a second death raises
+:class:`WorkerCrash` with the worker's stderr tail. A job deadline
+kills the (possibly wedged) worker and raises ``TimeoutError``
+without retrying — retrying a timeout would double the wait.
+
+Two spawn temperatures:
+
+* warm (default) — the worker imports jax and initializes the
+  backend immediately at spawn; the hello reports ``warm_s``.
+  bench.py overlaps this warm-up with the orchestrator/plugin
+  bring-up phases.
+* cold (``warm=False`` / :func:`run_grid`) — a bare protocol loop
+  with nothing imported; used by the multihost slice driver, whose
+  workers must set per-process identity env before jax ever loads.
+
+Workers inherit :func:`kind_tpu_sim.utils.shell.cpu_subprocess_env`,
+so the persistent XLA compilation cache (``.cache/jax``) is wired in
+for every pooled job too.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import queue
+import selectors
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+log = logging.getLogger("kind-tpu-sim")
+
+WARM_ENV = "KIND_TPU_SIM_POOL_WARM"
+
+# A frame bigger than this is protocol corruption, not data.
+MAX_FRAME_BYTES = 64 * 1024 * 1024
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+class JobError(RuntimeError):
+    """The job raised inside the worker (worker itself is healthy)."""
+
+    def __init__(self, message: str, remote_traceback: str = ""):
+        super().__init__(message)
+        self.remote_traceback = remote_traceback
+
+
+class WorkerCrash(RuntimeError):
+    """The worker process died before answering."""
+
+
+# ---------------------------------------------------------------------
+# framing
+
+
+def write_frame(stream, obj) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    stream.write(struct.pack(">I", len(payload)) + payload)
+    stream.flush()
+
+
+def read_frame(stream):
+    """Blocking frame read from a binary stream; None on clean EOF."""
+    header = stream.read(4)
+    if not header:
+        return None
+    if len(header) < 4:
+        raise EOFError("truncated frame header")
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME_BYTES:
+        raise EOFError(f"implausible frame length {length}")
+    payload = b""
+    while len(payload) < length:
+        chunk = stream.read(length - len(payload))
+        if not chunk:
+            raise EOFError("truncated frame payload")
+        payload += chunk
+    return json.loads(payload.decode("utf-8"))
+
+
+# ---------------------------------------------------------------------
+# worker side
+
+
+def _warmup() -> dict:
+    """Import jax and initialize the backend (the once-per-session
+    cost the pool exists to amortize)."""
+    import jax
+
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        # Pin the config even when a site plugin (axon) registers
+        # itself regardless of the env var — same defense as
+        # tests/conftest.py.
+        jax.config.update("jax_platforms", platforms)
+    return {
+        "devices": jax.device_count(),
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+    }
+
+
+def _job_ping() -> dict:
+    return {"pid": os.getpid()}
+
+
+def _job_psum_smoke(topology: str = "2x4",
+                    expect_devices: Optional[int] = None) -> dict:
+    """The BASELINE acceptance gate: all advertised fake chips visible
+    and a psum verified over them."""
+    info = _warmup()
+    if expect_devices is not None and info["devices"] != expect_devices:
+        raise RuntimeError(
+            f"{info['devices']} devices visible, expected "
+            f"{expect_devices}")
+    from kind_tpu_sim import topology as topo
+    from kind_tpu_sim.parallel import collectives, mesh
+
+    report = collectives.psum_smoke(
+        mesh.slice_mesh(topo.make_slice(topology=topology)))
+    if not report.get("ok"):
+        raise RuntimeError(f"psum smoke failed: {report}")
+    report["worker_pid"] = os.getpid()
+    return report
+
+
+def _job_collectives_suite(topology: str = "2x4") -> dict:
+    info = _warmup()
+    from kind_tpu_sim import topology as topo
+    from kind_tpu_sim.parallel import collectives, mesh
+
+    report = collectives.run_all(
+        mesh.slice_mesh(topo.make_slice(topology=topology)))
+    report["devices"] = info["devices"]
+    report["worker_pid"] = os.getpid()
+    return report
+
+
+def _job_call(target: str, kwargs: Optional[dict] = None):
+    """Generic job: ``module.path:attr`` resolved and called in the
+    (warm) worker — how bench.py runs the ring bench and the multihost
+    grid runs its per-host report without a bespoke job each."""
+    import importlib
+
+    mod_name, _, attr_path = target.partition(":")
+    if not attr_path:
+        raise ValueError(f"target {target!r} must be 'module:attr'")
+    obj = importlib.import_module(mod_name)
+    for attr in attr_path.split("."):
+        obj = getattr(obj, attr)
+    return obj(**(kwargs or {}))
+
+
+def _job_psum_cache_probe(topology: str = "2x4") -> dict:
+    """psum smoke + XLA persistent-cache hit/miss counters.
+
+    The diagnostic behind the warm-path story: a first-ever run
+    reports misses (the cache is being populated), a later worker on
+    the same cache dir reports hits (the compile was skipped). Must
+    run before any other compile in this worker so the counters
+    belong to the smoke alone."""
+    import jax
+
+    counts = {"cache_hits": 0, "cache_misses": 0}
+
+    def listener(event: str, **kw) -> None:
+        for key in counts:
+            if event.endswith(key):
+                counts[key] += 1
+
+    jax.monitoring.register_event_listener(listener)
+    report = _job_psum_smoke(topology=topology)
+    report.update(counts)
+    report["cache_enabled"] = bool(
+        os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+    return report
+
+
+def _job_crash(code: int = 13) -> None:
+    """Die without answering — the chaos hook the crash-recovery
+    tests (and `chaos`-minded users) exercise the respawn path with."""
+    os._exit(code)
+
+
+JOBS = {
+    "ping": _job_ping,
+    "warmup": _warmup,
+    "psum_smoke": _job_psum_smoke,
+    "psum_cache_probe": _job_psum_cache_probe,
+    "collectives_suite": _job_collectives_suite,
+    "call": _job_call,
+    "crash": _job_crash,
+}
+
+
+def _serve() -> int:
+    """Worker main loop: hello, then answer requests until EOF."""
+    import traceback
+
+    # Bind the protocol to the ORIGINAL stdout, then point fd 1 at
+    # stderr: later stray writes (warnings, absl) land in the log
+    # channel instead of corrupting frames.
+    proto_fd = os.dup(1)
+    os.dup2(2, 1)
+    out = os.fdopen(proto_fd, "wb")
+    inp = sys.stdin.buffer
+
+    hello = {"hello": True, "pid": os.getpid()}
+    if os.environ.get(WARM_ENV) == "1":
+        t0 = time.monotonic()
+        try:
+            hello.update(_warmup())
+            hello["warm_s"] = round(time.monotonic() - t0, 3)
+        except Exception as exc:  # surfaced to the parent, not fatal
+            hello["warm_error"] = f"{type(exc).__name__}: {exc}"[:500]
+    write_frame(out, hello)
+
+    while True:
+        try:
+            req = read_frame(inp)
+        except EOFError:
+            return 1
+        if req is None or req.get("op") == "shutdown":
+            return 0
+        resp = {"id": req.get("id")}
+        t0 = time.monotonic()
+        try:
+            job = JOBS[req["job"]]
+            resp["result"] = job(**(req.get("kwargs") or {}))
+            resp["ok"] = True
+        except Exception as exc:
+            resp["ok"] = False
+            resp["error"] = f"{type(exc).__name__}: {exc}"[:2000]
+            resp["traceback"] = traceback.format_exc()[-2000:]
+        resp["elapsed_s"] = round(time.monotonic() - t0, 6)
+        write_frame(out, resp)
+
+
+# ---------------------------------------------------------------------
+# parent side
+
+
+def _pool_child_env(extra_env: Optional[Dict[str, str]] = None,
+                    warm: bool = True) -> Dict[str, str]:
+    from kind_tpu_sim.utils.shell import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
+    env.update(extra_env or {})
+    env["PYTHONPATH"] = (str(REPO_ROOT) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    env[WARM_ENV] = "1" if warm else "0"
+    return env
+
+
+def simulated_slice_env(chips: int = 8) -> Dict[str, str]:
+    """Env for a worker simulating one host of a slice: CPU backend
+    exposing ``chips`` virtual devices (the jax-tpu-pod trick)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags
+                 + f" --xla_force_host_platform_device_count={chips}"
+                 ).strip()
+    return {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": flags}
+
+
+class _WorkerProc:
+    """One protocol worker process + its read buffer and stderr log."""
+
+    def __init__(self, env: Dict[str, str],
+                 stderr_path: Optional[pathlib.Path] = None):
+        self._buf = b""
+        self.hello: Optional[dict] = None
+        self.spawned_at = time.monotonic()
+        if stderr_path is None:
+            fd, name = tempfile.mkstemp(prefix="tpu-sim-worker-",
+                                        suffix=".err")
+            self.stderr_path = pathlib.Path(name)
+            self._stderr_file = os.fdopen(fd, "wb")
+            self._own_stderr = True
+        else:
+            self.stderr_path = stderr_path
+            self._stderr_file = open(stderr_path, "wb")
+            self._own_stderr = False
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "kind_tpu_sim.utils.worker_pool",
+             "--serve"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self._stderr_file, env=env,
+        )
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stderr_tail(self, n: int = 2000) -> str:
+        try:
+            self._stderr_file.flush()
+            return self.stderr_path.read_text(errors="replace")[-n:]
+        except OSError:
+            return ""
+
+    def read_frame(self, deadline: float):
+        """One frame from the worker's stdout, or raise: WorkerCrash
+        on EOF/death, TimeoutError past ``deadline``."""
+        fd = self.proc.stdout.fileno()
+        sel = selectors.DefaultSelector()
+        sel.register(self.proc.stdout, selectors.EVENT_READ)
+        try:
+            while True:
+                frame, self._buf = _try_parse(self._buf)
+                if frame is not None:
+                    return frame
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    raise TimeoutError(
+                        f"worker {self.pid} gave no answer in time")
+                if not sel.select(timeout=min(remain, 1.0)):
+                    if not self.alive():
+                        raise WorkerCrash(
+                            f"worker {self.pid} exited "
+                            f"(rc={self.proc.returncode}): "
+                            f"{self.stderr_tail()}")
+                    continue
+                data = os.read(fd, 65536)
+                if not data:
+                    raise WorkerCrash(
+                        f"worker {self.pid} closed its pipe "
+                        f"(rc={self.proc.poll()}): "
+                        f"{self.stderr_tail()}")
+                self._buf += data
+        finally:
+            sel.close()
+
+    def ensure_ready(self, deadline: float) -> dict:
+        if self.hello is None:
+            self.hello = self.read_frame(deadline)
+        return self.hello
+
+    def request(self, req: dict, deadline: float) -> dict:
+        self.ensure_ready(deadline)
+        try:
+            write_frame(self.proc.stdin, req)
+        except (BrokenPipeError, OSError) as exc:
+            raise WorkerCrash(
+                f"worker {self.pid} pipe closed: {exc}; "
+                f"{self.stderr_tail()}") from exc
+        return self.read_frame(deadline)
+
+    def kill(self) -> None:
+        if self.alive():
+            self.proc.kill()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover
+            pass
+        self.close_files()
+
+    def shutdown(self, grace_s: float = 2.0) -> None:
+        try:
+            if self.alive():
+                write_frame(self.proc.stdin, {"op": "shutdown"})
+                self.proc.stdin.close()
+                self.proc.wait(timeout=grace_s)
+        except (OSError, subprocess.TimeoutExpired):
+            pass
+        self.kill()
+
+    def close_files(self) -> None:
+        try:
+            self._stderr_file.close()
+        except OSError:  # pragma: no cover
+            pass
+        if self._own_stderr:
+            try:
+                self.stderr_path.unlink()
+            except OSError:  # pragma: no cover
+                pass
+
+
+def _try_parse(buf: bytes):
+    """(frame, rest) if ``buf`` holds a complete frame, else
+    (None, buf)."""
+    if len(buf) < 4:
+        return None, buf
+    (length,) = struct.unpack(">I", buf[:4])
+    if length > MAX_FRAME_BYTES:
+        raise WorkerCrash(f"implausible frame length {length}")
+    if len(buf) < 4 + length:
+        return None, buf
+    return json.loads(buf[4:4 + length].decode("utf-8")), buf[4 + length:]
+
+
+_SHUTDOWN = object()
+
+
+class WorkerPool:
+    """Preforked protocol workers + a submit queue.
+
+    ``submit_async`` returns a :class:`concurrent.futures.Future`;
+    one dispatcher thread per worker drains the shared queue, so a
+    pool of size N runs N jobs concurrently and a caller never blocks
+    on spawn/warm-up unless it asks for a result.
+    """
+
+    def __init__(self, size: int = 1, warm: bool = True,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 job_timeout: float = 300.0):
+        self._env = _pool_child_env(extra_env, warm=warm)
+        self._timeout = job_timeout
+        self._queue: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._closed = False
+        self.respawns = 0
+        self._procs: List[Optional[_WorkerProc]] = []
+        self._threads: List[threading.Thread] = []
+        for slot in range(size):
+            self._procs.append(_WorkerProc(self._env))
+            thread = threading.Thread(
+                target=self._dispatch, args=(slot,),
+                name=f"tpu-sim-pool-{slot}", daemon=True)
+            thread.start()
+            self._threads.append(thread)
+
+    # -- submission ---------------------------------------------------
+
+    def submit_async(self, job: str, *, timeout: Optional[float] = None,
+                     **kwargs) -> Future:
+        if self._closed:
+            raise RuntimeError("pool is closed")
+        with self._lock:
+            self._next_id += 1
+            req_id = self._next_id
+        req = {"id": req_id, "job": job, "kwargs": kwargs}
+        fut: Future = Future()
+        self._queue.put((fut, req, timeout or self._timeout))
+        return fut
+
+    def submit(self, job: str, *, timeout: Optional[float] = None,
+               **kwargs):
+        return self.submit_async(job, timeout=timeout,
+                                 **kwargs).result()
+
+    # -- introspection ------------------------------------------------
+
+    def worker_pids(self) -> List[int]:
+        return [p.pid for p in self._procs if p is not None]
+
+    def bringup(self, timeout: float = 120.0) -> dict:
+        """A ready worker's hello: pid, and for warm pools the
+        measured ``warm_s`` (jax import + backend init) and device
+        count."""
+        info = dict(self.submit("ping", timeout=timeout))
+        for proc in self._procs:
+            if proc is not None and proc.hello:
+                info.update(proc.hello)
+                break
+        return info
+
+    # -- dispatch -----------------------------------------------------
+
+    def _respawn(self, slot: int) -> _WorkerProc:
+        old = self._procs[slot]
+        if old is not None:
+            old.kill()
+        self.respawns += 1
+        proc = _WorkerProc(self._env)
+        self._procs[slot] = proc
+        return proc
+
+    def _dispatch(self, slot: int) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _SHUTDOWN:
+                return
+            fut, req, timeout = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            attempts_left = 1  # one respawn+retry per job
+            while True:
+                proc = self._procs[slot]
+                if proc is None or not proc.alive():
+                    proc = self._respawn(slot)
+                deadline = time.monotonic() + timeout
+                try:
+                    resp = proc.request(req, deadline)
+                except WorkerCrash as exc:
+                    self._procs[slot] = None
+                    proc.kill()
+                    if attempts_left > 0:
+                        attempts_left -= 1
+                        log.warning(
+                            "pool worker died (%s); respawning and "
+                            "retrying job %s once", exc, req["job"])
+                        continue
+                    fut.set_exception(exc)
+                    break
+                except TimeoutError as exc:
+                    # A wedged worker is useless — kill it; but do
+                    # NOT rerun the job (doubling a 300s wait).
+                    self._procs[slot] = None
+                    proc.kill()
+                    fut.set_exception(exc)
+                    break
+                if resp.get("ok"):
+                    fut.set_result(resp.get("result"))
+                else:
+                    fut.set_exception(JobError(
+                        resp.get("error", "job failed"),
+                        resp.get("traceback", "")))
+                break
+
+    # -- lifecycle ----------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._threads:
+            self._queue.put(_SHUTDOWN)
+        for thread in self._threads:
+            thread.join(timeout=5)
+        for proc in self._procs:
+            if proc is not None:
+                proc.shutdown()
+
+    def __enter__(self) -> "WorkerPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------
+# cold grid (multihost slice driver)
+
+
+def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
+             timeout: float,
+             kwargs_list: Optional[Sequence[dict]] = None) -> List:
+    """Spawn one COLD protocol worker per env dict, run ``target``
+    (a ``module:attr`` callable) in each, and return the results in
+    spawn order.
+
+    The multihost slice launcher: each env carries the full
+    plugin-style identity (worker id, hostnames, rendezvous port), so
+    jax must not load before the job sets it all up — hence cold
+    workers. Semantics match the old file-based launcher: a crashed
+    worker raises RuntimeError with its stderr tail (killing the
+    rest), workers still pending at the deadline raise TimeoutError.
+    """
+    procs: List[_WorkerProc] = []
+    with tempfile.TemporaryDirectory() as logdir:
+        logs = pathlib.Path(logdir)
+        try:
+            for worker, extra in enumerate(worker_envs):
+                env = _pool_child_env(extra, warm=False)
+                procs.append(_WorkerProc(
+                    env, stderr_path=logs / f"worker-{worker}.err"))
+            deadline = time.monotonic() + timeout
+            for worker, proc in enumerate(procs):
+                try:
+                    write_frame(proc.proc.stdin, {
+                        "id": worker, "job": "call",
+                        "kwargs": {
+                            "target": target,
+                            "kwargs": (kwargs_list[worker]
+                                       if kwargs_list else {}),
+                        },
+                    })
+                except (BrokenPipeError, OSError):
+                    raise RuntimeError(
+                        f"slice worker {worker} crashed at spawn "
+                        f"(rc={proc.proc.poll()}):\n"
+                        f"{proc.stderr_tail()}")
+            results: List = [None] * len(procs)
+            pending = set(range(len(procs)))
+            while pending:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"slice workers {sorted(pending)} still "
+                        f"running after {timeout}s")
+                for worker in sorted(pending):
+                    proc = procs[worker]
+                    try:
+                        frame = proc.read_frame(
+                            min(deadline,
+                                time.monotonic() + 0.25))
+                    except TimeoutError:
+                        continue
+                    except WorkerCrash:
+                        rc = proc.proc.poll()
+                        raise RuntimeError(
+                            f"slice worker {worker} crashed "
+                            f"(rc={rc}):\n{proc.stderr_tail()}")
+                    if frame.get("hello"):
+                        continue  # cold hello precedes the result
+                    if not frame.get("ok"):
+                        raise RuntimeError(
+                            f"slice worker {worker} job failed: "
+                            f"{frame.get('error')}\n"
+                            f"{frame.get('traceback', '')[-1000:]}")
+                    results[worker] = frame.get("result")
+                    pending.discard(worker)
+            return results
+        finally:
+            for proc in procs:
+                proc.kill()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--serve" in argv:
+        return _serve()
+    print("usage: python -m kind_tpu_sim.utils.worker_pool --serve",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,8 +16,10 @@ CFG = tf.ModelConfig(vocab_size=512, d_model=128, n_heads=4,
 
 
 def _xla_flops(fn, *args):
-    cost = jax.jit(fn).lower(*args).compile().cost_analysis()
-    assert cost and cost.get("flops"), "cost analysis unavailable"
+    from kind_tpu_sim.utils.jax_compat import cost_analysis_dict
+
+    cost = cost_analysis_dict(jax.jit(fn).lower(*args).compile())
+    assert cost.get("flops"), "cost analysis unavailable"
     return float(cost["flops"])
 
 

@@ -39,6 +39,20 @@ def test_ring_attention_causal_matches_reference(qkv, ring_mesh):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("double_buffer", [True, False])
+def test_ring_attention_both_orderings_match(qkv, ring_mesh,
+                                             double_buffer):
+    """The double-buffered K/V rotation and the serial ordering are
+    numerically identical — the prefetch is a schedule change, not a
+    math change."""
+    q, k, v = qkv
+    fn = ra._build_ring_attention(ring_mesh, "chip", True, None,
+                                  None, None, double_buffer)
+    ref = ra.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.array(fn(q, k, v)), np.array(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_ring_attention_noncausal_matches_reference(qkv, ring_mesh):
     q, k, v = qkv
     out = ra.ring_attention(q, k, v, ring_mesh, axis_name="chip",

@@ -123,8 +123,9 @@ def test_activate_keeps_real_accelerators_identity():
     env = cpu_subprocess_env()
     env["TPU_SIM_REPO"] = str(REPO)
     env.pop("JAX_PLATFORMS", None)
-    proc = subprocess.run(
-        [sys.executable, "-c", r"""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", r"""
 import json, os, sys
 sys.path.insert(0, os.environ["TPU_SIM_REPO"])
 from kind_tpu_sim import tpu_platform
@@ -137,8 +138,14 @@ except Exception:
     raise SystemExit(0)
 print(json.dumps({"skip": False, "platform": ds[0].platform}))
 """],
-        capture_output=True, text=True, timeout=300, env=env,
-    )
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        # A registered non-cpu plugin (e.g. libtpu with no hardware
+        # behind it) can hang its client init forever; that host has
+        # no usable accelerator to assert passthrough on.
+        pytest.skip("non-cpu backend init hung; no usable "
+                    "accelerator on this host")
     assert proc.returncode == 0, proc.stderr[-800:]
     report = json.loads(proc.stdout.splitlines()[-1])
     if report.get("skip") or report.get("platform") == "tpu":
@@ -157,9 +164,11 @@ def test_check_compat_clean_on_pinned_jax():
 
 
 def test_activate_raises_loudly_on_incompatible_jax(monkeypatch):
-    import jaxlib._jax as _jax
-
     from kind_tpu_sim import tpu_platform
+    from kind_tpu_sim.utils.jax_compat import jaxlib_extension
+
+    _jax = jaxlib_extension()
+    assert _jax is not None
 
     monkeypatch.setattr(tpu_platform, "_ACTIVATED", False)
     monkeypatch.delattr(_jax, "get_tfrt_cpu_client")

@@ -1,0 +1,170 @@
+"""Warm-path runtime: the persistent JAX worker pool.
+
+The pool is now the hot path of every bench/CLI JAX phase, so its
+contract is pinned directly: protocol framing, worker reuse (the whole
+point — jax import paid once per session), crash-recovery respawn,
+the persistent-XLA-cache hit on a second worker, and the cold-grid
+transport the multihost slice driver rides on.
+"""
+
+import io
+import os
+import signal
+import time
+
+import pytest
+
+from kind_tpu_sim.utils import worker_pool as wp
+
+
+# -- framing (no subprocess) ------------------------------------------
+
+
+def test_frame_roundtrip():
+    buf = io.BytesIO()
+    wp.write_frame(buf, {"id": 1, "job": "ping"})
+    wp.write_frame(buf, {"id": 2, "kwargs": {"x": [1, 2]}})
+    buf.seek(0)
+    assert wp.read_frame(buf) == {"id": 1, "job": "ping"}
+    assert wp.read_frame(buf) == {"id": 2, "kwargs": {"x": [1, 2]}}
+    assert wp.read_frame(buf) is None  # clean EOF
+
+
+def test_frame_truncation_detected():
+    buf = io.BytesIO()
+    wp.write_frame(buf, {"id": 1})
+    data = buf.getvalue()
+    with pytest.raises(EOFError):
+        wp.read_frame(io.BytesIO(data[:-2]))
+    with pytest.raises(EOFError):
+        wp.read_frame(io.BytesIO(data[:3]))
+
+
+def test_try_parse_partial_then_complete():
+    buf = io.BytesIO()
+    wp.write_frame(buf, {"a": 1})
+    data = buf.getvalue()
+    frame, rest = wp._try_parse(data[:3])
+    assert frame is None and rest == data[:3]
+    frame, rest = wp._try_parse(data + b"XYZ")
+    assert frame == {"a": 1} and rest == b"XYZ"
+
+
+# -- live pool (cold workers: no jax import, fast) --------------------
+
+
+def test_worker_reused_across_submissions():
+    with wp.WorkerPool(size=1, warm=False) as pool:
+        pid1 = pool.submit("ping", timeout=60)["pid"]
+        pid2 = pool.submit("ping", timeout=60)["pid"]
+    assert pid1 == pid2
+    assert pool.respawns == 0
+
+
+def test_crash_recovery_respawns_and_retries():
+    with wp.WorkerPool(size=1, warm=False) as pool:
+        pid1 = pool.submit("ping", timeout=60)["pid"]
+        os.kill(pid1, signal.SIGKILL)
+        # the next job must ride the respawn path and still succeed
+        pid2 = pool.submit("ping", timeout=60)["pid"]
+        assert pid2 != pid1
+        assert pool.respawns >= 1
+
+
+def test_job_error_does_not_kill_worker():
+    with wp.WorkerPool(size=1, warm=False) as pool:
+        pid1 = pool.submit("ping", timeout=60)["pid"]
+        with pytest.raises(wp.JobError, match="malformed topology"):
+            pool.submit("call", timeout=60,
+                        target="kind_tpu_sim.topology:make_slice",
+                        kwargs={"topology": "nonsense"})
+        # same worker is still serving: errors are answers, not
+        # crashes
+        assert pool.submit("ping", timeout=60)["pid"] == pid1
+        assert pool.respawns == 0
+
+
+def test_crash_job_exhausts_retry_then_pool_recovers():
+    with wp.WorkerPool(size=1, warm=False) as pool:
+        with pytest.raises(wp.WorkerCrash):
+            pool.submit("crash", timeout=60)
+        # one respawn+retry happened (the retried crash also dies),
+        # and a fresh worker still serves afterwards
+        assert pool.respawns >= 1
+        assert pool.submit("ping", timeout=60)["pid"] > 0
+
+
+def test_unknown_job_is_a_job_error():
+    with wp.WorkerPool(size=1, warm=False) as pool:
+        with pytest.raises(wp.JobError, match="KeyError"):
+            pool.submit("no-such-job", timeout=60)
+
+
+# -- warm path with the persistent XLA compilation cache --------------
+
+
+@pytest.fixture()
+def cache_env(tmp_path, monkeypatch):
+    cache = tmp_path / "xla-cache"
+    monkeypatch.setenv("KIND_TPU_SIM_CACHE_DIR", str(cache))
+    monkeypatch.delenv("KIND_TPU_SIM_NO_COMPILATION_CACHE",
+                       raising=False)
+    return cache
+
+
+def test_psum_smoke_populates_cache_then_hits(cache_env):
+    """The compile is paid once per cache, not once per process: a
+    fresh worker on a fresh cache dir reports misses and populates
+    it; a SECOND fresh worker on the same dir reports hits."""
+    env = wp.simulated_slice_env(8)
+    with wp.WorkerPool(size=1, warm=False, extra_env=env) as pool:
+        first = pool.submit("psum_cache_probe", timeout=300)
+    assert first["ok"] and first["cache_enabled"]
+    assert first["cache_misses"] >= 1
+    assert first["cache_hits"] == 0
+    entries = [p for p in cache_env.rglob("*") if p.is_file()]
+    assert entries, "first run must populate the cache dir"
+
+    with wp.WorkerPool(size=1, warm=False, extra_env=env) as pool:
+        second = pool.submit("psum_cache_probe", timeout=300)
+    assert second["ok"]
+    assert second["worker_pid"] != first["worker_pid"]
+    assert second["cache_hits"] >= 1, (
+        "second in-process psum run must skip the compile via the "
+        "persistent cache")
+
+
+def test_warm_smoke_reuses_live_backend(cache_env):
+    """Within one pool session the second smoke runs on the already-
+    initialized backend: same pid, and an order of magnitude under
+    any plausible cold bring-up."""
+    env = wp.simulated_slice_env(8)
+    with wp.WorkerPool(size=1, warm=True, extra_env=env) as pool:
+        first = pool.submit("psum_smoke", timeout=300,
+                            expect_devices=8)
+        t0 = time.monotonic()
+        second = pool.submit("psum_smoke", timeout=120)
+        warm_s = time.monotonic() - t0
+        hello = pool.bringup()
+    assert first["ok"] and second["ok"]
+    assert second["worker_pid"] == first["worker_pid"]
+    assert "warm_s" in hello  # jax import+init, measured worker-side
+    assert warm_s < 5.0  # vs ~2s cold; generous for loaded hosts
+
+
+# -- cold grid (the multihost transport) ------------------------------
+
+
+def test_run_grid_returns_reports_in_order():
+    results = wp.run_grid(
+        [{"GRID_PROBE": str(i)} for i in range(3)],
+        "os:getpid", timeout=60)
+    assert len(results) == 3
+    assert len(set(results)) == 3  # three distinct processes
+
+
+def test_run_grid_surfaces_worker_job_failure():
+    with pytest.raises(RuntimeError, match="job failed"):
+        wp.run_grid([{}],
+                    "kind_tpu_sim.topology:no_such_function",
+                    timeout=60)
