@@ -2371,6 +2371,15 @@ def main(argv=None) -> int:
                 BASELINE_READY_BOUND_S / value, 2),
         ),
     }
+    # Self-healing observability (docs/CHAOS.md): any retries,
+    # respawns, or requeues the runtime performed during this bench
+    # ride along in extras — a bench that silently recovered is a
+    # different datum than one that ran clean.
+    from kind_tpu_sim import metrics as _metrics
+
+    recovery = _metrics.recovery_log().counts()
+    if recovery:
+        out["extras"]["recovery"] = recovery
     compact_extra = {
         "phase_samples": phases.get("phase_samples"),
         "bringup": phases.get("bringup"),

@@ -1,7 +1,11 @@
-"""Fault injection for the simulated cluster.
+"""Deterministic chaos engine for the simulated cluster.
 
 The reference has no fault-injection capability (SURVEY.md §5 "failure
-detection — minimal"); this subsystem exceeds it deliberately:
+detection — minimal"); this subsystem exceeds it deliberately, in two
+tiers:
+
+**Manual levers** (:class:`ChaosManager` — the original 87-line
+surface, still the `chaos fail/heal/kill-node/start-node` CLI):
 
 * ``fail`` / ``heal`` — drive the device plugin's health channel by
   writing device IDs into the node's unhealthy file
@@ -11,19 +15,183 @@ detection — minimal"); this subsystem exceeds it deliberately:
   (kind-gpu-sim.sh:113,116) cannot model.
 * ``kill-node`` / ``start-node`` — stop/start the kind node container
   itself to exercise scheduler failover of accelerator pods.
+
+**Seeded scenario engine** (`chaos run` / `chaos soak`,
+docs/CHAOS.md): :class:`ChaosSchedule` derives a :class:`FaultPlan` —
+which fault kind hits which target at which step — purely from
+``KIND_TPU_SIM_CHAOS_SEED``, so a failing chaos run replays exactly.
+Named scenarios drive a fault plan end-to-end through a real recovery
+path (exec retry/backoff, worker respawn, grid-cell requeue,
+preemption checkpoint/resume, serving slot requeue) and assert the
+recovery INVARIANT (results identical to fault-free, trajectory
+continuous, no corrupted streams) while publishing every injected
+fault and recovery action through metrics.recovery_log().
 """
 
 from __future__ import annotations
 
+import dataclasses
 import logging
-from typing import List, Optional
+import os
+import random
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence
 
-from kind_tpu_sim import manifests
+from kind_tpu_sim import manifests, metrics
 from kind_tpu_sim.cluster import ClusterManager
 from kind_tpu_sim.config import SimConfig
 from kind_tpu_sim.runtime import ContainerRuntime
+from kind_tpu_sim.utils.shell import (
+    ExecResult,
+    FakeExecutor,
+    RetryPolicy,
+    run_with_retry,
+)
 
 log = logging.getLogger("kind-tpu-sim")
+
+CHAOS_SEED_ENV = "KIND_TPU_SIM_CHAOS_SEED"
+
+# The fault vocabulary. Each kind maps onto the layer that recovers
+# from it (docs/CHAOS.md has the full matrix).
+FAULT_KINDS = (
+    "worker_crash",      # protocol worker os._exit mid-job
+    "worker_hang",       # protocol worker wedges; deadline kill
+    "device_flap",       # plugin health channel: fail then heal
+    "node_kill",         # kind node container stopped
+    "node_restart",      # ... and started again
+    "preempt_sigterm",   # SIGTERM mid-train-step (TPU maintenance)
+    "cmd_transient",     # kubectl/runtime command fails transiently
+    "slot_failure",      # serving slot/engine dies mid-stream
+)
+
+
+def resolve_seed(seed: Optional[int] = None) -> int:
+    """Explicit seed > env (KIND_TPU_SIM_CHAOS_SEED) > 0."""
+    if seed is not None:
+        return int(seed)
+    try:
+        return int(os.environ.get(CHAOS_SEED_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One planned fault: ``kind`` strikes ``target`` at schedule
+    index ``at`` (the unit — step, round, request number — belongs to
+    the scenario consuming the plan). ``param`` carries the kind's
+    magnitude (hang seconds, transient-failure count...)."""
+
+    kind: str
+    at: int
+    target: int = 0
+    param: float = 0.0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """An ordered, immutable fault schedule — the replayable artifact
+    a chaos run is defined by."""
+
+    seed: int
+    events: tuple
+
+    def for_kind(self, kind: str) -> List[FaultEvent]:
+        return [e for e in self.events if e.kind == kind]
+
+    def as_dict(self) -> dict:
+        return {"seed": self.seed,
+                "events": [e.as_dict() for e in self.events]}
+
+
+class ChaosSchedule:
+    """Seeded fault-plan generator: the same ``seed`` and arguments
+    produce the IDENTICAL plan, always — determinism is the whole
+    point (a chaos failure you cannot replay is a flake, not a
+    finding). Each ``plan()`` derives its own sub-seed from the
+    canonical argument repr, so two plans with different shapes never
+    share a stream and argument order cannot perturb results."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self.seed = resolve_seed(seed)
+
+    def plan(self, kinds: Sequence[str] = ("worker_crash",),
+             n_faults: int = 1, horizon: int = 8,
+             targets: int = 2) -> FaultPlan:
+        """``n_faults`` events drawn over ``horizon`` schedule slots
+        and ``targets`` possible victims, kinds cycled through the
+        seeded stream. ``param`` is drawn per kind: hang seconds in
+        [1, 5], transient counts in [1, 3], else 0."""
+        for kind in kinds:
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r}; known: "
+                    f"{', '.join(FAULT_KINDS)}")
+        key = repr((self.seed, tuple(kinds), int(n_faults),
+                    int(horizon), int(targets)))
+        rng = random.Random(zlib.crc32(key.encode("utf-8")))
+        events = []
+        for _ in range(n_faults):
+            kind = rng.choice(list(kinds))
+            if kind == "worker_hang":
+                param = float(rng.randint(1, 5))
+            elif kind == "cmd_transient":
+                param = float(rng.randint(1, 3))
+            else:
+                param = 0.0
+            events.append(FaultEvent(
+                kind=kind,
+                at=rng.randrange(max(1, horizon)),
+                target=rng.randrange(max(1, targets)),
+                param=param,
+            ))
+        events.sort(key=lambda e: (e.at, e.target, e.kind))
+        return FaultPlan(seed=self.seed, events=tuple(events))
+
+
+class FlakyExecutor(FakeExecutor):
+    """FakeExecutor that injects TRANSIENT failures from a fault
+    plan: commands matching ``flaky_prefix`` fail their first
+    ``fail_attempts`` invocations with a retryable error, then
+    delegate to the normal rule table. The unit under test is the
+    retry layer (shell.run_with_retry): the command stream must
+    complete as if nothing happened, with the retries observable in
+    metrics.recovery_log()."""
+
+    def __init__(self, rules=None, binaries=None,
+                 flaky_prefix: str = "kubectl",
+                 fail_attempts: int = 2,
+                 error_text: str = ("Unable to connect to the server: "
+                                    "dial tcp 127.0.0.1:6443: connect:"
+                                    " connection refused")):
+        super().__init__(rules, binaries)
+        self.flaky_prefix = flaky_prefix
+        self.fail_attempts = fail_attempts
+        self.error_text = error_text
+        self.injected_failures = 0
+        self._attempts: Dict[str, int] = {}
+
+    def run(self, argv, *, input_text=None, check=True, capture=True,
+            env=None, timeout=None):
+        joined = " ".join(argv)
+        if joined.startswith(self.flaky_prefix):
+            seen = self._attempts.get(joined, 0)
+            if seen < self.fail_attempts:
+                self._attempts[joined] = seen + 1
+                self.injected_failures += 1
+                self.calls.append((list(argv), input_text))
+                result = ExecResult(1, "", self.error_text)
+                if check and not result.ok:
+                    from kind_tpu_sim.utils.shell import CommandError
+
+                    raise CommandError(argv, result)
+                return result
+        return super().run(argv, input_text=input_text, check=check,
+                           capture=capture, env=env, timeout=timeout)
 
 
 class ChaosManager:
@@ -85,3 +253,349 @@ class ChaosManager:
     def start_node(self, node: str) -> None:
         self.rt.run("start", node)
         log.info("started node container %s", node)
+
+
+# ---------------------------------------------------------------------
+# named scenarios (the `chaos run` / `chaos soak` surface)
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    name: str
+    fn: Callable[[int], dict]
+    description: str
+    needs_jax: bool = False
+    slow: bool = False
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def _scenario(name: str, description: str, needs_jax: bool = False,
+              slow: bool = False):
+    def register(fn):
+        SCENARIOS[name] = Scenario(name, fn, description,
+                                   needs_jax=needs_jax, slow=slow)
+        return fn
+
+    return register
+
+
+def _fake_chaos_manager(num_slices: int = 1) -> ChaosManager:
+    """A ChaosManager over the dry-run control plane — scenario
+    plumbing for the device/node fault kinds, no daemon needed."""
+    from kind_tpu_sim.fakes import dry_run_executor
+    from kind_tpu_sim.registry import LocalRegistry
+    from kind_tpu_sim.runtime import detect_runtime
+
+    cfg = SimConfig(runtime="fake", num_slices=num_slices)
+    executor = dry_run_executor(cfg)
+    # detect_runtime('fake') binds the SAME recording executor, so
+    # scenarios can assert on the full command stream afterwards
+    runtime = detect_runtime(executor, prefer="fake")
+    cluster = ClusterManager(cfg, runtime,
+                             LocalRegistry(cfg, runtime))
+    return ChaosManager(cfg, runtime, cluster)
+
+
+@_scenario("flaky-exec",
+           "transient kubectl failures recovered by the classified "
+           "retry policy (exponential backoff + jitter)")
+def _scenario_flaky_exec(seed: int) -> dict:
+    plan = ChaosSchedule(seed).plan(kinds=("cmd_transient",),
+                                    n_faults=2, horizon=4, targets=1)
+    fail_attempts = max(1, int(plan.events[0].param))
+    fake = FlakyExecutor(fail_attempts=fail_attempts)
+    policy = RetryPolicy(max_retries=3, base_ms=1.0, seed=seed)
+    commands = (["kubectl", "get", "nodes", "-o", "jsonpath={..}"],
+                ["kubectl", "get", "pods", "-A", "-o", "json"])
+    results = [run_with_retry(fake, argv, policy=policy)
+               for argv in commands]
+    ok = all(r.ok for r in results)
+    return {
+        "plan": plan.as_dict(),
+        "injected_failures": fake.injected_failures,
+        "commands_completed": sum(1 for r in results if r.ok),
+        "ok": bool(ok and fake.injected_failures
+                   == fail_attempts * len(commands)),
+    }
+
+
+@_scenario("worker-crash-grid",
+           "a slice worker killed mid-sweep; its grid cells requeue "
+           "on survivors and results match the fault-free run")
+def _scenario_worker_crash_grid(seed: int) -> dict:
+    from kind_tpu_sim.parallel import multihost
+
+    plan = ChaosSchedule(seed).plan(kinds=("worker_crash",),
+                                    n_faults=1, horizon=6, targets=2)
+    ev = plan.events[0]
+    cells = [{"cell": i, "payload": seed} for i in range(6)]
+    clean, _ = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0)
+    faulted, stats = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0,
+        fault=("crash", ev.at % len(cells)))
+    return {
+        "plan": plan.as_dict(),
+        "cells": len(cells),
+        "faults_injected": stats["faults_injected"],
+        "requeues": stats["requeues"],
+        "respawns": stats["respawns"],
+        "results_identical": faulted == clean,
+        "ok": bool(faulted == clean
+                   and stats["faults_injected"] == 1
+                   and stats["requeues"] >= 1),
+    }
+
+
+@_scenario("worker-hang-grid",
+           "a slice worker wedges mid-cell; the deadline kill "
+           "requeues its cell and the sweep still completes")
+def _scenario_worker_hang_grid(seed: int) -> dict:
+    from kind_tpu_sim.parallel import multihost
+
+    plan = ChaosSchedule(seed).plan(kinds=("worker_hang",),
+                                    n_faults=1, horizon=5, targets=2)
+    ev = plan.events[0]
+    cells = [{"cell": i, "payload": seed} for i in range(5)]
+    clean, _ = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0)
+    faulted, stats = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0, cell_timeout=3.0,
+        fault=("hang", ev.at % len(cells), ev.param * 20))
+    return {
+        "plan": plan.as_dict(),
+        "cells": len(cells),
+        "faults_injected": stats["faults_injected"],
+        "requeues": stats["requeues"],
+        "results_identical": faulted == clean,
+        "ok": bool(faulted == clean
+                   and stats["faults_injected"] == 1
+                   and stats["requeues"] >= 1),
+    }
+
+
+@_scenario("device-flap",
+           "seeded fail/heal cycles through the device plugin's "
+           "health channel (dry-run control plane)")
+def _scenario_device_flap(seed: int) -> dict:
+    plan = ChaosSchedule(seed).plan(kinds=("device_flap",),
+                                    n_faults=3, horizon=6, targets=2)
+    mgr = _fake_chaos_manager()
+    workers = mgr.cluster.worker_nodes()
+    flaps = 0
+    for ev in plan.events:
+        node = workers[ev.target % len(workers)]
+        mgr.fail_devices(node, [])
+        mgr.heal(node)
+        flaps += 1
+        metrics.recovery_log().record("device_flap", node=node)
+    cmds = mgr.rt.executor.commands()
+    fails = sum(1 for c in cmds if "cat >" in c or "exec -i" in c)
+    heals = sum(1 for c in cmds
+                if f"rm -f {manifests.UNHEALTHY_FILE}" in c)
+    return {
+        "plan": plan.as_dict(),
+        "flaps": flaps,
+        "fail_writes": fails,
+        "heal_writes": heals,
+        # every flap must end healed — the recovery invariant
+        "ok": bool(flaps == len(plan.events) and heals == flaps),
+    }
+
+
+@_scenario("node-flap",
+           "seeded kill/restart cycles of kind node containers "
+           "(dry-run control plane)")
+def _scenario_node_flap(seed: int) -> dict:
+    plan = ChaosSchedule(seed).plan(
+        kinds=("node_kill", "node_restart"), n_faults=4, horizon=8,
+        targets=2)
+    mgr = _fake_chaos_manager()
+    workers = mgr.cluster.worker_nodes()
+    killed: List[str] = []
+    for ev in plan.events:
+        node = workers[ev.target % len(workers)]
+        if ev.kind == "node_kill":
+            mgr.kill_node(node)
+            killed.append(node)
+            metrics.recovery_log().record("node_kill", node=node)
+        else:
+            mgr.start_node(node)
+            metrics.recovery_log().record("node_restart", node=node)
+    # recovery invariant: every killed node is restarted before the
+    # scenario ends, whatever order the plan drew
+    for node in set(killed):
+        mgr.start_node(node)
+    cmds = mgr.rt.executor.commands()
+    stops = [c for c in cmds if c.startswith("docker stop")]
+    starts = [c for c in cmds if c.startswith("docker start")]
+    ok = all(any(s.endswith(node) for s in starts)
+             for node in set(killed))
+    return {
+        "plan": plan.as_dict(),
+        "kills": len(stops),
+        "restarts": len(starts),
+        "ok": bool(ok),
+    }
+
+
+@_scenario("preempt-train",
+           "SIGTERM mid-step; checkpoint written, resume reproduces "
+           "the uninterrupted loss trajectory", needs_jax=True,
+           slow=True)
+def _scenario_preempt_train(seed: int) -> dict:
+    import signal
+    import tempfile
+
+    from kind_tpu_sim.models import checkpoint as ckpt
+    from kind_tpu_sim.models import transformer as tf
+
+    plan = ChaosSchedule(seed).plan(kinds=("preempt_sigterm",),
+                                    n_faults=1, horizon=5, targets=1)
+    kill_step = plan.events[0].at + 1
+    total = 8
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=16)
+    with tempfile.TemporaryDirectory() as tmp:
+        straight_dir = os.path.join(tmp, "straight")
+        chaos_dir = os.path.join(tmp, "chaos")
+        _, straight = ckpt.train_with_checkpointing(
+            cfg, straight_dir, total_steps=total,
+            checkpoint_every=total)
+
+        def preempt(step: int) -> None:
+            if step == kill_step:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        preempted_at = None
+        try:
+            ckpt.train_with_checkpointing(
+                cfg, chaos_dir, total_steps=total,
+                checkpoint_every=total, on_step=preempt)
+        except ckpt.Preempted as exc:
+            preempted_at = exc.step
+            losses = exc.losses
+        else:
+            losses = {}
+        _, resumed = ckpt.train_with_checkpointing(
+            cfg, chaos_dir, total_steps=total,
+            checkpoint_every=total)
+        combined = {**losses, **resumed}
+        drift = max(abs(combined[i] - straight[i])
+                    for i in range(total))
+    return {
+        "plan": plan.as_dict(),
+        "preempted_at_step": preempted_at,
+        "resume_max_loss_drift": drift,
+        "ok": bool(preempted_at == kill_step + 1 and drift == 0.0),
+    }
+
+
+@_scenario("serving-slot-failure",
+           "a serving slot dies mid-stream; its request requeues and "
+           "every accepted request completes uncorrupted",
+           needs_jax=True, slow=True)
+def _scenario_serving_slot_failure(seed: int) -> dict:
+    import jax
+    import numpy as np
+
+    from kind_tpu_sim.models import transformer as tf
+    from kind_tpu_sim.models.serving import (
+        Request,
+        ServingConfig,
+        ServingEngine,
+    )
+
+    plan = ChaosSchedule(seed).plan(kinds=("slot_failure",),
+                                    n_faults=1, horizon=2, targets=2)
+    ev = plan.events[0]
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=64)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           size=4 + 3 * i).tolist()
+               for i in range(4)]
+    sc = ServingConfig(max_slots=2, max_len=48, chunk=8)
+
+    def run(inject: bool):
+        eng = ServingEngine(params, cfg, sc)
+        for i, p in enumerate(prompts):
+            # max_new > 2 chunks so the injected failure lands on a
+            # slot that is still mid-stream (a real displacement)
+            eng.submit(Request(f"c{i}", p, max_new=20,
+                               seed=seed + i))
+        if inject:
+            for _ in range(ev.at + 1):
+                eng.step_round()
+            eng.inject_slot_failure(ev.target)
+            eng.restore_slot(ev.target)
+        comps = eng.poll() + eng.run()
+        return ({c.request_id: tuple(c.tokens) for c in comps}, eng)
+
+    clean, _ = run(inject=False)
+    faulted, eng = run(inject=True)
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(prompts),
+        "slot_failures": eng.slot_failures,
+        "requeues": eng.requeues,
+        "streams_identical": faulted == clean,
+        "ok": bool(faulted == clean and eng.slot_failures == 1
+                   and eng.requeues >= 1),
+    }
+
+
+def run_scenario(name: str, seed: Optional[int] = None) -> dict:
+    """Run one named scenario; the report carries the seed, the
+    derived fault plan, the recovery-log delta (fault/recovery event
+    counts attributable to THIS run), and the invariant verdict."""
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r}; known: "
+            f"{', '.join(sorted(SCENARIOS))}")
+    seed = resolve_seed(seed)
+    before = metrics.recovery_log().counts()
+    report = SCENARIOS[name].fn(seed)
+    report.update({
+        "scenario": name,
+        "seed": seed,
+        "recovery_events": metrics.recovery_log().snapshot_since(
+            before),
+    })
+    return report
+
+
+def soak(iterations: int = 10, seed: Optional[int] = None,
+         include_slow: bool = False) -> dict:
+    """Repeated seeded scenario runs (the `chaos soak` CLI): the
+    iteration stream is itself derived from the seed, so a soak that
+    finds a failure names the exact (scenario, seed) pair to replay
+    with `chaos run`."""
+    seed = resolve_seed(seed)
+    rng = random.Random(zlib.crc32(f"soak:{seed}".encode("utf-8")))
+    names = sorted(n for n, s in SCENARIOS.items()
+                   if include_slow or not s.slow)
+    runs = []
+    failures = 0
+    for i in range(iterations):
+        name = rng.choice(names)
+        sub_seed = rng.randrange(2 ** 31)
+        report = run_scenario(name, seed=sub_seed)
+        runs.append({"scenario": name, "seed": sub_seed,
+                     "ok": report["ok"]})
+        if not report["ok"]:
+            failures += 1
+            log.error("soak failure: replay with "
+                      "`chaos run --scenario %s --seed %d`",
+                      name, sub_seed)
+    return {
+        "seed": seed,
+        "iterations": iterations,
+        "failures": failures,
+        "runs": runs,
+        "recovery_events": metrics.recovery_log().counts(),
+        "ok": failures == 0,
+    }

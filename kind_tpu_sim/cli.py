@@ -126,11 +126,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     chaos = sub.add_parser(
         "chaos", parents=[common],
-        help="fault injection against the simulated devices/nodes",
+        help=(
+            "fault injection: manual levers (fail/heal/kill-node/"
+            "start-node) against a live cluster, or the seeded "
+            "scenario engine (run/soak) — deterministic fault plans "
+            "driven end-to-end through the recovery paths, no "
+            "cluster needed (docs/CHAOS.md)"
+        ),
     )
     chaos.add_argument(
         "action",
-        choices=["fail", "heal", "kill-node", "start-node"],
+        choices=["fail", "heal", "kill-node", "start-node",
+                 "run", "soak"],
     )
     chaos.add_argument("--node", default=None,
                        help="target node container name")
@@ -150,6 +157,26 @@ def build_parser() -> argparse.ArgumentParser:
         help="match the create-time multislice shape so --worker "
              "range checks cover every slice's nodes",
     )
+    chaos.add_argument(
+        "--scenario", default=None,
+        help="named scenario for 'run' (or 'all' / omit to list); "
+             "see `chaos run` output for the registry",
+    )
+    chaos.add_argument(
+        "--seed", type=int, default=None,
+        help="fault-plan seed (default: KIND_TPU_SIM_CHAOS_SEED or "
+             "0); the same seed replays the identical fault schedule",
+    )
+    chaos.add_argument(
+        "--iterations", type=int, default=10,
+        help="seeded scenario runs for 'soak'",
+    )
+    chaos.add_argument(
+        "--include-slow", action="store_true",
+        help="run/soak may pick the multi-second jax scenarios "
+             "(preempt-train, serving-slot-failure)",
+    )
+    chaos.add_argument("--json", action="store_true", dest="as_json")
 
     smoke = sub.add_parser(
         "slice-smoke",
@@ -383,6 +410,56 @@ def run_jax_smoke(args: argparse.Namespace) -> int:
               f"{report['cold_suite_s']}s, warm "
               f"{report['warm_suite_s']}")
         print("JAX SMOKE " + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+def run_chaos_engine(args: argparse.Namespace) -> int:
+    """`chaos run` / `chaos soak`: the seeded scenario engine —
+    cluster-free (fake control plane + cold worker processes), so
+    recovery invariants are checkable anywhere tier-1 tests run."""
+    from kind_tpu_sim import chaos as chaos_mod
+
+    if args.action == "soak":
+        report = chaos_mod.soak(iterations=args.iterations,
+                                seed=args.seed,
+                                include_slow=args.include_slow)
+        if args.as_json:
+            print(json.dumps(report))
+        else:
+            for run in report["runs"]:
+                print(f"  {run['scenario']:<24} seed={run['seed']:<12}"
+                      f" {'OK' if run['ok'] else 'FAILED'}")
+            print(f"CHAOS SOAK ({report['iterations']} runs, seed "
+                  f"{report['seed']}) "
+                  + ("OK" if report["ok"] else
+                     f"FAILED ({report['failures']} failures)"))
+        return 0 if report["ok"] else 1
+
+    if not args.scenario:
+        print("available scenarios (chaos run --scenario NAME):")
+        for name in sorted(chaos_mod.SCENARIOS):
+            s = chaos_mod.SCENARIOS[name]
+            tag = " [slow]" if s.slow else ""
+            print(f"  {name:<24} {s.description}{tag}")
+        return 0
+    names = (sorted(n for n, s in chaos_mod.SCENARIOS.items()
+                    if args.include_slow or not s.slow)
+             if args.scenario == "all" else [args.scenario])
+    reports = [chaos_mod.run_scenario(n, seed=args.seed)
+               for n in names]
+    ok = all(r["ok"] for r in reports)
+    if args.as_json:
+        out = reports[0] if len(reports) == 1 else {
+            "ok": ok, "scenarios": reports}
+        print(json.dumps(out))
+    else:
+        for rep in reports:
+            events = ", ".join(
+                f"{k}={v}" for k, v in
+                sorted(rep.get("recovery_events", {}).items())) or "-"
+            print(f"  {rep['scenario']:<24} seed={rep['seed']} "
+                  f"{'OK' if rep['ok'] else 'FAILED'}  [{events}]")
+        print("CHAOS RUN " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
 
 
@@ -685,6 +762,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_manifests(args)
         if args.command == "profile":
             return run_profile(args)
+        if args.command == "chaos" and args.action in ("run", "soak"):
+            return run_chaos_engine(args)
         cfg = config_from_args(args)
         sim = Simulator(cfg)
         if args.command == "create":

@@ -10,6 +10,7 @@ from CI timeouts.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import json
@@ -115,6 +116,62 @@ def overlap_attribution(track_seconds: Dict[str, float],
     out["overlap_saved_s"] = round(
         max(0.0, serialized - wall_seconds), 3)
     return out
+
+
+class RecoveryLog:
+    """Thread-safe counter + bounded trail of fault/recovery events.
+
+    The chaos engine's observability contract (docs/CHAOS.md): every
+    injected fault and every recovery action a layer takes — exec
+    retry, worker respawn, cell requeue, slot requeue, preemption
+    save — is ``record()``-ed here, so scenario reports and bench
+    extras publish recovery as measured counts, not just assertions.
+    Events keep only a bounded recent window; counts are exact.
+    """
+
+    def __init__(self, window: int = 256):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = collections.Counter()
+        self._events = collections.deque(maxlen=window)
+
+    def record(self, event: str, **info) -> None:
+        with self._lock:
+            self._counts[event] += 1
+            self._events.append({"event": event, **info})
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def snapshot_since(self, before: Dict[str, int]) -> Dict[str, int]:
+        """Counts delta vs an earlier ``counts()`` snapshot — how a
+        scenario attributes exactly ITS faults/recoveries when the
+        process-global log is shared."""
+        now = self.counts()
+        out = {k: now[k] - before.get(k, 0) for k in now
+               if now[k] - before.get(k, 0)}
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._events.clear()
+
+    def as_dict(self) -> Dict[str, object]:
+        return {"counts": self.counts(), "events": self.events()}
+
+
+_RECOVERY_LOG = RecoveryLog()
+
+
+def recovery_log() -> RecoveryLog:
+    """The process-global fault/recovery event log (layers record
+    into it by default; chaos scenarios snapshot/delta it)."""
+    return _RECOVERY_LOG
 
 
 def parse_k8s_time(stamp: str) -> float:

@@ -21,8 +21,68 @@ reproduce the uninterrupted loss trajectory bit-for-bit.
 
 from __future__ import annotations
 
+import contextlib
 import pathlib
-from typing import Any, Optional
+import signal as _signal
+import threading
+from typing import Any, Callable, Optional
+
+
+class Preempted(RuntimeError):
+    """Raised when a preemption signal interrupted training AFTER the
+    in-flight step finished and a checkpoint was written; carries
+    everything a supervisor needs to resume."""
+
+    def __init__(self, step: int, losses: dict):
+        self.step = step
+        self.losses = dict(losses)
+        super().__init__(
+            f"training preempted at step {step} "
+            f"(checkpoint saved; resume from latest_step)")
+
+
+class PreemptionGuard:
+    """SIGTERM-to-flag adapter (the TPU maintenance-event analog).
+
+    A real TPU VM gets SIGTERM ~30s before preemption; dying mid-step
+    loses the step and risks a torn save. The guard converts the
+    signal into a flag the train loop polls at step boundaries, so
+    the loop finishes its step, checkpoints, and exits loudly.
+    Signal handlers only install on the main thread; elsewhere the
+    guard still works via ``trip()`` (the chaos engine's injection
+    lever).
+    """
+
+    def __init__(self):
+        self._tripped = threading.Event()
+
+    def trip(self, *_args) -> None:
+        self._tripped.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._tripped.is_set()
+
+
+@contextlib.contextmanager
+def preemption_guard(signals=(getattr(_signal, "SIGTERM", None),)):
+    """Install a PreemptionGuard over ``signals`` for the block,
+    restoring prior handlers on exit. Off the main thread (where
+    signal.signal raises), the guard degrades to trip()-only."""
+    guard = PreemptionGuard()
+    previous = []
+    for sig in signals:
+        if sig is None:
+            continue
+        try:
+            previous.append((sig, _signal.signal(sig, guard.trip)))
+        except ValueError:  # not the main thread
+            pass
+    try:
+        yield guard
+    finally:
+        for sig, handler in previous:
+            _signal.signal(sig, handler)
 
 
 def _manager(directory, max_to_keep: int = 3):
@@ -129,14 +189,28 @@ def abstract_like(state: Any) -> Any:
 def train_with_checkpointing(cfg, directory, *, total_steps: int,
                              checkpoint_every: int, batch: int = 4,
                              mesh=None, seed: int = 0,
-                             learning_rate: float = 1e-2):
+                             learning_rate: float = 1e-2,
+                             on_step: Optional[
+                                 Callable[[int], None]] = None,
+                             handle_preemption: bool = True):
     """Run (or resume) the flagship training loop with periodic saves.
 
     Picks up from `latest_step(directory)` when present — the
     interrupted and uninterrupted trajectories are identical because
     step i's batch is derived from `seed` and i, not from loop state.
     Returns (final_state, losses_by_step dict).
+
+    Preemption safety (docs/CHAOS.md): with ``handle_preemption`` a
+    SIGTERM arriving mid-run is converted to a flag, the in-flight
+    step finishes, a checkpoint is written at that exact step, and
+    :class:`Preempted` is raised — a following call resumes from it
+    and the combined loss trajectory matches the uninterrupted run
+    bit-for-bit. ``on_step(i)`` is the chaos injection hook, called
+    after step ``i``'s loss is recorded and before the preemption
+    check / checkpoint decision.
     """
+    import contextlib as _ctx
+
     import jax
 
     from kind_tpu_sim.models import transformer as tf
@@ -150,24 +224,37 @@ def train_with_checkpointing(cfg, directory, *, total_steps: int,
     # re-scan the directory and restart orbax's async machinery at
     # every checkpoint.
     mgr = _manager(directory)
+    guard_cm = (preemption_guard() if handle_preemption
+                else _ctx.nullcontext(PreemptionGuard()))
     try:
-        start = 0
-        resumed = mgr.latest_step()
-        if resumed is not None:
-            state = mgr.restore(
-                resumed,
-                args=ocp.args.StandardRestore(abstract_like(state)))
-            start = resumed
-        losses = {}
-        for i in range(start, total_steps):
-            tokens = tf.sample_batch(
-                jax.random.fold_in(jax.random.PRNGKey(seed), i),
-                cfg, batch, cfg.max_seq)
-            state, loss = step_fn(state, tokens)
-            losses[i] = float(loss)
-            done = i + 1
-            if done % checkpoint_every == 0 or done == total_steps:
-                _save_with(mgr, done, state)
+        with guard_cm as guard:
+            start = 0
+            resumed = mgr.latest_step()
+            if resumed is not None:
+                state = mgr.restore(
+                    resumed,
+                    args=ocp.args.StandardRestore(
+                        abstract_like(state)))
+                start = resumed
+            losses = {}
+            for i in range(start, total_steps):
+                tokens = tf.sample_batch(
+                    jax.random.fold_in(jax.random.PRNGKey(seed), i),
+                    cfg, batch, cfg.max_seq)
+                state, loss = step_fn(state, tokens)
+                losses[i] = float(loss)
+                if on_step is not None:
+                    on_step(i)
+                done = i + 1
+                if guard.preempted:
+                    from kind_tpu_sim import metrics
+
+                    _save_with(mgr, done, state)
+                    metrics.recovery_log().record(
+                        "preemption_checkpoint", step=done)
+                    raise Preempted(done, losses)
+                if done % checkpoint_every == 0 or done == total_steps:
+                    _save_with(mgr, done, state)
     finally:
         mgr.close()
     return state, losses

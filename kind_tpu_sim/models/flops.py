@@ -118,18 +118,30 @@ def step_peak_bytes(cfg: ModelConfig, batch: int, seq: int,
     P = matmul_params(cfg)["total"]
     b, t = batch, seq
     bytes_ = 2.0 * P                       # bf16 weights
+    per_layer_acts = (8 * b * t * cfg.d_model * 2.0
+                      + 2 * b * t * cfg.d_ff * 2.0)
     if backward:
         bytes_ += 2.0 * P                  # bf16 grads
         if optimizer:
             bytes_ += 8.0 * P              # fp32 adam m+v
-        bytes_ += (8 * b * t * cfg.d_model * 2.0
-                   + 2 * b * t * cfg.d_ff * 2.0) * cfg.n_layers
+        if cfg.remat:
+            # jax.checkpoint per block saves only the block-boundary
+            # residual per layer; one block's internals exist
+            # transiently during its recompute, not layers-deep
+            bytes_ += (b * t * cfg.d_model * 2.0 * cfg.n_layers
+                       + per_layer_acts)
+        else:
+            bytes_ += per_layer_acts * cfg.n_layers
     # fp32 logits are the forward's live output either way; the
     # backward also holds their cotangent
     bytes_ += 4.0 * b * t * cfg.vocab_size * (2 if backward else 1)
     if not flash:
         probs = 4.0 * b * cfg.n_heads * float(t) * t   # fp32
-        bytes_ += probs * (cfg.n_layers if backward else 2)
+        # remat backward recomputes scores one layer at a time (a
+        # transient x2 working set, like the forward), instead of
+        # holding every layer's fp32 probabilities to the backward
+        held = (cfg.n_layers if backward and not cfg.remat else 2)
+        bytes_ += probs * held
     return bytes_
 
 

@@ -132,6 +132,20 @@ class ServingConfig:
     #                           pending slot, interleaved with the
     #                           grid's decode chunks instead of
     #                           stalling them for a whole prompt
+    max_queue: int = 0        # >0: admission-side load shedding —
+    #                           submit() raises EngineSaturated once
+    #                           this many requests are queued, so a
+    #                           reduced-capacity engine (failed/
+    #                           quarantined slots) rejects new work
+    #                           loudly instead of growing an
+    #                           unbounded backlog; accepted requests
+    #                           always complete. 0 = unbounded.
+
+
+class EngineSaturated(RuntimeError):
+    """submit() shed a request: the queue is at ServingConfig.
+    max_queue. Accepted (already-queued/in-flight) requests are
+    unaffected — shedding happens at admission, never mid-stream."""
 
 
 @dataclasses.dataclass
@@ -1015,6 +1029,12 @@ class ServingEngine:
         # its whole history on every report().
         import collections as _collections
 
+        # chaos/self-healing state (docs/CHAOS.md): quarantined
+        # slots, and the fault/recovery counters report() publishes
+        self._failed_slots: set = set()
+        self.slot_failures = 0
+        self.requeues = 0
+        self.shed = 0
         self._req_clock: Dict[str, Dict[str, float]] = {}
         self._lat_window = _collections.deque(maxlen=1024)
         self._lat_count = 0
@@ -1060,6 +1080,21 @@ class ServingEngine:
     # -- public surface ------------------------------------------------
 
     def submit(self, request: Request) -> None:
+        if (self.serving.max_queue
+                and len(self.queue) >= self.serving.max_queue):
+            # graceful shed: reject at admission (the caller gets a
+            # typed error to back off on) — in-flight streams are
+            # untouched, which is the whole point of shedding here
+            # instead of under memory pressure mid-decode
+            from kind_tpu_sim import metrics
+
+            self.shed += 1
+            metrics.recovery_log().record(
+                "request_shed", request=request.request_id,
+                queued=len(self.queue))
+            raise EngineSaturated(
+                f"queue at max_queue={self.serving.max_queue}; "
+                f"request {request.request_id!r} shed")
         self._capacity_check(request)
         self._check_request(request)
         if request.sampling is not None:
@@ -1209,12 +1244,15 @@ class ServingEngine:
         if not self.serving.overlap_rounds:
             while (self.queue or self._pending or
                    any(r is not None for r in self.slot_req)):
+                self._assert_serviceable()
                 self.step_round()
                 done.extend(self.poll())
             return done
         pending = None
         while (self.queue or self._pending or pending is not None or
                any(r is not None for r in self.slot_req)):
+            if pending is None:
+                self._assert_serviceable()
             if pending is not None and self._round_finishes_all():
                 # the in-flight round provably completes every live
                 # slot (budget-bound, no eos): dispatching another
@@ -1300,7 +1338,9 @@ class ServingEngine:
         reserved = 0
         for slot in range(self.serving.max_slots):
             if (self.slot_req[slot] is not None
-                    or slot in self._pending or not self.queue):
+                    or slot in self._pending
+                    or slot in self._failed_slots
+                    or not self.queue):
                 continue
             if not self._can_admit(self.queue[0], reserved):
                 # FCFS: a head-of-queue request that can't take this
@@ -1503,6 +1543,16 @@ class ServingEngine:
         grid-proportional padding FLOPs."""
         import jax
 
+        if (any(r is not None for r in self.slot_req)
+                or self._pending):
+            # the dummy prefills scatter into slots 0..w-1's KV rows
+            # (or through their block tables): on a live grid that
+            # silently corrupts in-flight streams — warming is a
+            # BEFORE-traffic operation, enforced, not assumed
+            raise RuntimeError(
+                "warm_admission requires an idle engine (no live "
+                "slots, no pending prefills): its dummy prefills "
+                "overwrite slot KV state")
         if (not self._batch_admission()
                 or self.serving.prefill_chunk > 0):
             # chunked-prefill engines admit through per-slot windows
@@ -1756,19 +1806,95 @@ class ServingEngine:
             ttft_s=ttft, e2e_s=e2e,
             logprobs=(list(self.slot_lps[slot][:len(toks)])
                       if req.logprobs else None)))
+        self._clear_slot(slot)
+        self._release_storage(slot)
+
+    def _clear_slot(self, slot: int) -> None:
+        """Reset a slot's host bookkeeping and device vectors (no
+        Completion, no storage release) — shared by retirement,
+        recompute preemption, and chaos slot failure. The sampling
+        resets matter beyond hygiene: a stale temp > 0 (or
+        penalty/min-p) on an idle slot would keep the all-default
+        lax.cond fast path off for every later chunk."""
         self.slot_req[slot] = None
         self.slot_emitted[slot] = []
         self.slot_lps[slot] = []
         self.active = self.active.at[slot].set(False)
-        # Reset the slot's sampling params: a stale temp > 0 (or
-        # penalty/min-p) on an idle slot would keep the all-default
-        # lax.cond fast path off for every later chunk.
         self.temp = self.temp.at[slot].set(0.0)
         self.top_k = self.top_k.at[slot].set(0)
         self.top_p = self.top_p.at[slot].set(1.0)
         self.min_p = self.min_p.at[slot].set(0.0)
         self.rep_pen = self.rep_pen.at[slot].set(1.0)
         self.presence = self.presence.at[slot].set(False)
+
+    def _release_storage(self, slot: int) -> None:
+        """Free the slot's KV storage (paged: its blocks). Dense
+        grids pre-allocate per slot — nothing to release; the next
+        tenant's prefill overwrites the rows."""
+
+    def _evict_slot(self, slot: int) -> Optional[Request]:
+        """Tear a claimed slot down WITHOUT a completion and return
+        its displaced request (None when the slot is idle): the
+        shared eviction recipe behind recompute preemption and chaos
+        slot failure. Handles both an ACTIVE slot and a mid-stream
+        chunked prefill (pending slots hold storage too)."""
+        if slot in self._pending:
+            req = self._pending.pop(slot)["req"]
+            self._release_storage(slot)
+            return req
+        req = self.slot_req[slot]
+        if req is None:
+            return None
+        self._clear_slot(slot)
+        self._release_storage(slot)
+        return req
+
+    # -- chaos / self-healing surface ----------------------------------
+
+    def inject_slot_failure(self, slot: int,
+                            quarantine: bool = True) -> bool:
+        """Simulate an engine/slot failure (the chaos lever): the
+        slot's in-flight or mid-prefill request is requeued AT THE
+        FRONT for exact recompute — generation is a pure function of
+        (request, seed, index), so the replayed stream is identical
+        to the uninterrupted one and no corrupted tokens can reach a
+        Completion — its storage is released, and the slot is
+        quarantined from admission until :meth:`restore_slot`.
+        Returns whether a request was actually displaced."""
+        from kind_tpu_sim import metrics
+
+        if not 0 <= slot < self.serving.max_slots:
+            raise ValueError(f"slot {slot} out of range")
+        req = self._evict_slot(slot)
+        if quarantine:
+            self._failed_slots.add(slot)
+        self.slot_failures += 1
+        metrics.recovery_log().record(
+            "slot_failure", slot=slot,
+            request=req.request_id if req else None)
+        if req is not None:
+            self.queue.insert(0, req)
+            self.requeues += 1
+            metrics.recovery_log().record(
+                "slot_requeue", slot=slot, request=req.request_id)
+        return req is not None
+
+    def restore_slot(self, slot: int) -> None:
+        """Lift a slot's quarantine (the heal half of the chaos
+        lever); it becomes admissible at the next scheduling round."""
+        self._failed_slots.discard(slot)
+
+    def _assert_serviceable(self) -> None:
+        """A drain loop with queued work, nothing in flight, and
+        every slot quarantined would spin forever — fail loudly with
+        the recovery hint instead."""
+        if (self.queue and not self._pending
+                and not any(r is not None for r in self.slot_req)
+                and len(self._failed_slots) >= self.serving.max_slots):
+            raise RuntimeError(
+                f"all {self.serving.max_slots} slots are quarantined "
+                f"with {len(self.queue)} request(s) queued; call "
+                "restore_slot() or shed the queue")
 
     def report(self) -> Dict[str, Any]:
         """Pod/bench-friendly state snapshot."""
@@ -1780,6 +1906,14 @@ class ServingEngine:
             "pending_prefill": len(self._pending),
             "finished": len(self.finished),
         }
+        if (self.slot_failures or self.requeues or self.shed
+                or self._failed_slots):
+            out["chaos"] = {
+                "slot_failures": self.slot_failures,
+                "requeues": self.requeues,
+                "shed": self.shed,
+                "quarantined": sorted(self._failed_slots),
+            }
         if self.prefix_cache is not None:
             out["prefix_cache"] = self.prefix_cache.report()
         if self._lat_count:
@@ -2132,6 +2266,10 @@ class PagedServingEngine(ServingEngine):
             self.prefix_cache.store(req.prompt,
                                     self.slot_blocks[slot])
 
+    def _release_storage(self, slot: int) -> None:
+        self.alloc.free(self.slot_blocks[slot])
+        self.slot_blocks[slot] = []
+
     def _preempt_youngest(self) -> bool:
         """Evict the most recently admitted slot — active OR pending
         (a chunked prefill mid-stream): free its blocks and requeue
@@ -2143,8 +2281,6 @@ class PagedServingEngine(ServingEngine):
         activation; excluding them pinned those blocks under pool
         pressure and broke _ensure_blocks' invariant that full
         eviction always lets a lone surviving slot grow."""
-        import jax.numpy as jnp  # noqa: F401 (device vectors below)
-
         candidates = [
             (self.slot_admit_seq[s], s)
             for s, r in enumerate(self.slot_req) if r is not None
@@ -2154,23 +2290,7 @@ class PagedServingEngine(ServingEngine):
         if not candidates:
             return False
         _, slot = max(candidates)
-        if slot in self._pending:
-            # never activated: no sampling/presence state to clear
-            req = self._pending.pop(slot)["req"]
-        else:
-            req = self.slot_req[slot]
-            self.slot_req[slot] = None
-            self.slot_emitted[slot] = []
-            self.slot_lps[slot] = []
-            self.active = self.active.at[slot].set(False)
-            self.temp = self.temp.at[slot].set(0.0)
-            self.top_k = self.top_k.at[slot].set(0)
-            self.top_p = self.top_p.at[slot].set(1.0)
-            self.min_p = self.min_p.at[slot].set(0.0)
-            self.rep_pen = self.rep_pen.at[slot].set(1.0)
-            self.presence = self.presence.at[slot].set(False)
-        self.alloc.free(self.slot_blocks[slot])
-        self.slot_blocks[slot] = []
+        req = self._evict_slot(slot)
         self.queue.insert(0, req)
         self.preemptions += 1
         return True
@@ -2264,11 +2384,6 @@ class PagedServingEngine(ServingEngine):
             self.last_token, self.active, sampling_state,
             self.presence)
         return emitted, lps
-
-    def _finish(self, slot: int) -> None:
-        super()._finish(slot)
-        self.alloc.free(self.slot_blocks[slot])
-        self.slot_blocks[slot] = []
 
     def report(self) -> Dict[str, Any]:
         out = super().report()

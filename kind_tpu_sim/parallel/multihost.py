@@ -314,11 +314,17 @@ def _pick_ports(n: int) -> List[int]:
             sock.close()
 
 
-def _with_port_retry(thunk, attempts: int):
-    """Run a launch thunk, retrying ONLY the coordinator-port TOCTOU
-    race (bind failure, or the rendezvous timeout a port collision
-    degenerates into); any other failure is deterministic and
-    rerunning just doubles the latency to the real error."""
+def _with_launch_retry(thunk, attempts: int):
+    """Run a launch thunk, retrying the TRANSIENT launch failures: the
+    coordinator-port TOCTOU race (bind failure, or the rendezvous
+    timeout a port collision degenerates into) and a crashed slice
+    worker (preemption/OOM-style process death — the whole
+    jax.distributed world is dead with it, so the recovery unit is a
+    clean relaunch). A worker whose JOB failed is deterministic
+    ("job failed" — an assertion inside the report) and rerunning
+    just doubles the latency to the real error."""
+    from kind_tpu_sim import metrics
+
     attempts = max(1, attempts)
     for attempt in range(attempts):
         try:
@@ -326,9 +332,17 @@ def _with_port_retry(thunk, attempts: int):
         except (RuntimeError, TimeoutError) as exc:
             msg = str(exc).lower()
             retryable = (isinstance(exc, TimeoutError)
-                         or any(pat in msg for pat in _BIND_ERRORS))
+                         or any(pat in msg for pat in _BIND_ERRORS)
+                         or ("crashed" in msg
+                             and "job failed" not in msg))
             if not retryable or attempt == attempts - 1:
                 raise
+            metrics.recovery_log().record(
+                "slice_relaunch", attempt=attempt + 1,
+                cause=str(exc).splitlines()[0][:120])
+            log.warning("slice launch attempt %d failed (%s); "
+                        "relaunching", attempt + 1,
+                        str(exc).splitlines()[0])
     raise AssertionError("unreachable")
 
 
@@ -392,7 +406,7 @@ def launch_local_slice(topology: str = "2x2x2",
     from kind_tpu_sim import topology as topo
 
     s = topo.make_slice(accelerator=accelerator, topology=topology)
-    return _with_port_retry(
+    return _with_launch_retry(
         lambda: _launch_once(s, timeout, ring_tokens=ring_tokens),
         attempts)
 
@@ -431,7 +445,7 @@ def launch_local_multislice(num_slices: int = 2,
                 envs.append(env)
         return envs
 
-    flat = _with_port_retry(
+    flat = _with_launch_retry(
         lambda: _launch_grid(build_envs(), timeout), attempts)
     per_slice = [flat[sid * h:(sid + 1) * h]
                  for sid in range(num_slices)]
@@ -456,6 +470,56 @@ def launch_local_multislice(num_slices: int = 2,
                     f"bad MEGASCALE_NUM_SLICES in slice {sid}: "
                     f"{rep.get('megascale_num_slices')!r}")
     return per_slice
+
+
+def grid_cell_probe(cell: int = 0, payload: int = 0,
+                    spin: int = 0) -> dict:
+    """One deterministic grid cell: a pure function of (cell,
+    payload) — the work unit scatter_grid_cells' recovery contract
+    is proven against (a faulted run must return exactly the
+    fault-free results). ``spin`` burns a little CPU so chaos tests
+    can widen the crash window without sleeping."""
+    value = (cell * 2654435761 + payload * 97 + 12345) % (2 ** 32)
+    for _ in range(max(0, spin)):
+        value = (value * 6364136223846793005 + 1442695040888963407) \
+            % (2 ** 64)
+    return {"cell": cell, "payload": payload, "value": value}
+
+
+def scatter_grid_cells(cells: List[dict],
+                       target: str = (
+                           "kind_tpu_sim.parallel.multihost:"
+                           "grid_cell_probe"),
+                       workers: int = 2,
+                       timeout: float = 120.0,
+                       cell_timeout: Optional[float] = None,
+                       chips: int = 1,
+                       fault: Optional[tuple] = None,
+                       max_respawns: int = 1):
+    """Fan independent grid cells out over cold slice workers with
+    dead-worker recovery: a worker that crashes or hangs mid-cell has
+    that cell requeued on the survivors (or its own respawn), so one
+    preempted host no longer aborts the whole sweep
+    (worker_pool.run_cells carries the scheduling; this wrapper adds
+    the simulated-slice env shape).
+
+    ``fault`` = ("crash"|"hang", cell_index[, seconds]) is the
+    chaos engine's deterministic kill/hang lever: whichever worker
+    draws that cell dies (or wedges) mid-cell, exactly once — see
+    worker_pool.run_cells. Returns (results, stats); results are
+    cell-indexed and identical to a fault-free run.
+    """
+    from kind_tpu_sim.utils import worker_pool
+
+    envs = []
+    for w in range(workers):
+        env = dict(worker_pool.simulated_slice_env(chips))
+        env["TPU_SIM_GRID_WORKER"] = str(w)
+        envs.append(env)
+    return worker_pool.run_cells(
+        envs, target, cells, timeout=timeout,
+        cell_timeout=cell_timeout, max_respawns=max_respawns,
+        fault=fault)
 
 
 if __name__ == "__main__":

@@ -20,19 +20,33 @@ import logging
 import os
 from typing import List, Optional, Sequence
 
-from kind_tpu_sim.utils.shell import ExecResult, Executor, FakeExecutor
+from kind_tpu_sim.utils.shell import (
+    ExecResult,
+    Executor,
+    FakeExecutor,
+    RetryPolicy,
+    run_with_retry,
+)
 
 log = logging.getLogger("kind-tpu-sim")
 
 
 class ContainerRuntime:
-    """A detected docker or podman runtime bound to an executor."""
+    """A detected docker or podman runtime bound to an executor.
 
-    def __init__(self, name: str, executor: Executor):
+    Every command goes through the classified retry policy
+    (shell.run_with_retry): transient daemon/socket failures back off
+    and retry, deterministic errors surface immediately. Pass
+    ``retry=RetryPolicy(max_retries=0)`` to opt out.
+    """
+
+    def __init__(self, name: str, executor: Executor,
+                 retry: Optional[RetryPolicy] = None):
         if name not in ("docker", "podman"):
             raise ValueError(f"unsupported container runtime {name!r}")
         self.name = name
         self.executor = executor
+        self.retry = retry or RetryPolicy.from_env()
 
     # the `cr` equivalent (kind-gpu-sim.sh:64-66)
     def run(
@@ -41,8 +55,9 @@ class ContainerRuntime:
         input_text: Optional[str] = None,
         check: bool = True,
     ) -> ExecResult:
-        return self.executor.run(
-            [self.name, *args], input_text=input_text, check=check
+        return run_with_retry(
+            self.executor, [self.name, *args], policy=self.retry,
+            input_text=input_text, check=check
         )
 
     def try_run(self, *args: str, input_text: Optional[str] = None) -> ExecResult:
@@ -95,12 +110,19 @@ def detect_runtime(
 
 def kubectl(executor: Executor, *args: str,
             input_text: Optional[str] = None,
-            check: bool = True) -> ExecResult:
-    return executor.run(["kubectl", *args], input_text=input_text, check=check)
+            check: bool = True,
+            retry: Optional[RetryPolicy] = None) -> ExecResult:
+    """kubectl with the classified retry policy: apiserver blips and
+    etcd leader changes retry with backoff; NotFound/Forbidden/
+    invalid-flag errors surface immediately."""
+    return run_with_retry(executor, ["kubectl", *args], policy=retry,
+                          input_text=input_text, check=check)
 
 
-def kind(executor: Executor, *args: str, check: bool = True) -> ExecResult:
-    return executor.run(["kind", *args], check=check)
+def kind(executor: Executor, *args: str, check: bool = True,
+         retry: Optional[RetryPolicy] = None) -> ExecResult:
+    return run_with_retry(executor, ["kind", *args], policy=retry,
+                          check=check)
 
 
 def kubectl_lines(executor: Executor, *args: str) -> List[str]:

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random
 import subprocess
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 log = logging.getLogger("kind-tpu-sim")
@@ -96,20 +98,170 @@ class ExecResult:
 
 
 class CommandError(RuntimeError):
-    def __init__(self, argv: Sequence[str], result: ExecResult):
+    def __init__(self, argv: Sequence[str], result: ExecResult,
+                 attempts: int = 1):
         self.argv = list(argv)
         self.result = result
+        self.attempts = attempts
+        tried = f" after {attempts} attempts" if attempts > 1 else ""
         super().__init__(
-            f"command failed ({result.returncode}): {' '.join(argv)}\n"
-            f"{result.stderr.strip()}"
+            f"command failed ({result.returncode}){tried}: "
+            f"{' '.join(argv)}\n{result.stderr.strip()}"
         )
+
+
+# ---------------------------------------------------------------------
+# classified retry (docs/CHAOS.md "Retry policy")
+#
+# Real clusters fail transiently — apiserver blips, etcd leader
+# changes, container runtime socket hiccups — and the orchestrator
+# used to abort a whole create/bench on the first one. Every kubectl/
+# runtime command now routes through run_with_retry: TRANSIENT
+# failures back off (exponential + jitter) and retry; FATAL ones
+# (typos, missing objects, RBAC) surface immediately — retrying a
+# deterministic error just doubles the latency to the real message.
+
+# Error-text fragments that mark a failure as worth retrying.
+TRANSIENT_PATTERNS = (
+    "connection refused", "connection reset", "connection timed out",
+    "timed out", "i/o timeout", "context deadline exceeded",
+    "tls handshake", "temporarily unavailable",
+    "service unavailable", "too many requests", "try again",
+    "etcdserver: request timed out", "etcdserver: leader changed",
+    "the object has been modified", "no route to host", "dial tcp",
+    "internal error occurred", "transport is closing",
+    "unexpected eof", "broken pipe",
+)
+
+# Deterministic failures — checked FIRST so "...invalid... timed
+# out"-ish composites don't retry a request that can never succeed.
+FATAL_PATTERNS = (
+    "not found", "notfound", "no such", "unknown command",
+    "unknown flag", "unrecognized", "invalid", "forbidden",
+    "unauthorized", "already exists",
+    "executable file not found",
+)
+
+# Exit codes of timeout-style kills (`timeout` uses 124; SIGKILL'd
+# children report 137) — transient by definition.
+TRANSIENT_RETURNCODES = (124, 137)
+
+MAX_RETRIES_ENV = "KIND_TPU_SIM_MAX_RETRIES"
+RETRY_BASE_MS_ENV = "KIND_TPU_SIM_RETRY_BASE_MS"
+CMD_TIMEOUT_ENV = "KIND_TPU_SIM_CMD_TIMEOUT_S"
+
+
+def classify_failure(result: ExecResult) -> str:
+    """'transient' or 'fatal' for a failed ExecResult.
+
+    Fatal patterns win over transient ones; an unrecognized error is
+    FATAL (never retry what we can't name — a wrong default here
+    turns every real bug into N× the wait)."""
+    text = (result.stderr + "\n" + result.stdout).lower()
+    if any(pat in text for pat in FATAL_PATTERNS):
+        return "fatal"
+    if result.returncode in TRANSIENT_RETURNCODES:
+        return "transient"
+    if any(pat in text for pat in TRANSIENT_PATTERNS):
+        return "transient"
+    return "fatal"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff knobs for transient command failures.
+
+    ``seed`` pins the jitter (chaos tests assert exact schedules);
+    None draws entropy. ``deadline_s`` is the PER-COMMAND wall cap
+    (None = no cap) — a wedged kubectl is killed and classified
+    transient instead of hanging the whole pipeline."""
+
+    max_retries: int = 3
+    base_ms: float = 50.0
+    max_ms: float = 2000.0
+    deadline_s: Optional[float] = None
+    seed: Optional[int] = None
+
+    @classmethod
+    def from_env(cls, environ: Optional[Dict[str, str]] = None
+                 ) -> "RetryPolicy":
+        import os
+
+        env = os.environ if environ is None else environ
+
+        def num(key, default, cast):
+            try:
+                return cast(env[key])
+            except (KeyError, ValueError):
+                return default
+
+        return cls(
+            max_retries=num(MAX_RETRIES_ENV, 3, int),
+            base_ms=num(RETRY_BASE_MS_ENV, 50.0, float),
+            deadline_s=num(CMD_TIMEOUT_ENV, None, float),
+            seed=num("KIND_TPU_SIM_CHAOS_SEED", None, int),
+        )
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        """Delay before retry ``attempt`` (0-based): exponential
+        doubling from base_ms, full jitter on top, capped at
+        max_ms."""
+        base = min(self.base_ms * (2 ** attempt), self.max_ms)
+        return (base + rng.uniform(0.0, self.base_ms)) / 1000.0
+
+
+def run_with_retry(
+    executor: "Executor",
+    argv: Sequence[str],
+    *,
+    policy: Optional[RetryPolicy] = None,
+    input_text: Optional[str] = None,
+    check: bool = True,
+    env: Optional[Dict[str, str]] = None,
+) -> ExecResult:
+    """Run ``argv`` through ``executor`` with the classified retry
+    policy: transient failures back off and retry (up to
+    ``max_retries``); fatal ones (and exhaustion) surface as
+    CommandError carrying the attempt count. Every retry is recorded
+    in metrics.recovery_log() so recovery is observable."""
+    from kind_tpu_sim import metrics
+
+    policy = policy or RetryPolicy.from_env()
+    rng = random.Random(policy.seed)
+    attempts = max(0, policy.max_retries) + 1
+    result = ExecResult(1, "", "retry loop did not run")
+    for attempt in range(attempts):
+        result = executor.run(
+            argv, input_text=input_text, check=False, env=env,
+            timeout=policy.deadline_s)
+        if result.ok:
+            return result
+        if (classify_failure(result) == "fatal"
+                or attempt == attempts - 1):
+            break
+        delay = policy.backoff_s(attempt, rng)
+        metrics.recovery_log().record(
+            "exec_retry", cmd=argv[0] if argv else "",
+            attempt=attempt + 1, delay_s=round(delay, 4),
+            stderr=result.stderr.strip()[-120:])
+        log.warning("transient failure (%s), retry %d/%d in %.3fs: %s",
+                    result.stderr.strip()[:120] or result.returncode,
+                    attempt + 1, policy.max_retries, delay,
+                    " ".join(argv))
+        time.sleep(delay)
+    if check and not result.ok:
+        raise CommandError(argv, result, attempts=attempt + 1)
+    return result
 
 
 class Executor:
     """Interface: run an external command, optionally with stdin text.
 
     ``env`` adds variables on top of the inherited environment for that
-    one command only (never mutates ``os.environ``).
+    one command only (never mutates ``os.environ``). ``timeout`` is a
+    per-command wall deadline: a command still running past it is
+    killed and reported as ExecResult(returncode=124) — classified
+    transient by the retry layer, never an exception.
     """
 
     def run(
@@ -120,6 +272,7 @@ class Executor:
         check: bool = True,
         capture: bool = True,
         env: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
     ) -> ExecResult:
         raise NotImplementedError
 
@@ -143,6 +296,7 @@ class SystemExecutor(Executor):
         check: bool = True,
         capture: bool = True,
         env: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
     ) -> ExecResult:
         log.debug("exec: %s", " ".join(argv))
         full_env = None
@@ -150,13 +304,25 @@ class SystemExecutor(Executor):
             import os
 
             full_env = {**os.environ, **env}
-        proc = subprocess.run(
-            list(argv),
-            input=input_text,
-            text=True,
-            capture_output=capture,
-            env=full_env,
-        )
+        try:
+            proc = subprocess.run(
+                list(argv),
+                input=input_text,
+                text=True,
+                capture_output=capture,
+                env=full_env,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired as exc:
+            # deadline kill, not a crash: report the `timeout`-style
+            # exit code so classify_failure treats it as transient
+            result = ExecResult(
+                124, "",
+                f"command timed out after {exc.timeout}s: "
+                f"{' '.join(argv)}")
+            if check:
+                raise CommandError(argv, result) from exc
+            return result
         result = ExecResult(proc.returncode, proc.stdout or "", proc.stderr or "")
         if check and not result.ok:
             raise CommandError(argv, result)
@@ -198,6 +364,7 @@ class FakeExecutor(Executor):
         check: bool = True,
         capture: bool = True,
         env: Optional[Dict[str, str]] = None,
+        timeout: Optional[float] = None,
     ) -> ExecResult:
         argv = list(argv)
         self.calls.append((argv, input_text))

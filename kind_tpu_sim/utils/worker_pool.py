@@ -63,6 +63,15 @@ log = logging.getLogger("kind-tpu-sim")
 
 WARM_ENV = "KIND_TPU_SIM_POOL_WARM"
 
+# Injectable chaos fault for a protocol worker (docs/CHAOS.md):
+# "crash@N" kills the worker (os._exit) when it RECEIVES its Nth
+# request (1-based); "hang@N:S" sleeps S seconds before answering it.
+# The parent strips this variable when it respawns a worker, so an
+# injected fault is transient by construction — exactly the failure
+# the recovery paths (respawn+retry, cell requeue, deadline kill)
+# exist for.
+CHAOS_FAULT_ENV = "KIND_TPU_SIM_CHAOS_FAULT"
+
 # A frame bigger than this is protocol corruption, not data.
 MAX_FRAME_BYTES = 64 * 1024 * 1024
 
@@ -214,6 +223,14 @@ def _job_crash(code: int = 13) -> None:
     os._exit(code)
 
 
+def _job_hang(seconds: float = 3600.0) -> dict:
+    """Wedge without answering for ``seconds`` — the chaos hook for
+    the deadline-kill path (the parent must TimeoutError and kill,
+    never wait out a hung worker)."""
+    time.sleep(seconds)
+    return {"slept_s": seconds}
+
+
 JOBS = {
     "ping": _job_ping,
     "warmup": _warmup,
@@ -222,7 +239,24 @@ JOBS = {
     "collectives_suite": _job_collectives_suite,
     "call": _job_call,
     "crash": _job_crash,
+    "hang": _job_hang,
 }
+
+
+def _parse_fault(spec: Optional[str]):
+    """CHAOS_FAULT_ENV spec -> (kind, request_no, param) or None.
+
+    Formats: "crash@2" (exit on receiving request 2), "hang@1:30"
+    (sleep 30s before answering request 1). Malformed specs are
+    ignored — a chaos knob must never break a healthy worker."""
+    if not spec or "@" not in spec:
+        return None
+    kind, _, rest = spec.partition("@")
+    at, _, param = rest.partition(":")
+    try:
+        return kind, int(at), float(param or 0.0)
+    except ValueError:
+        return None
 
 
 def _serve() -> int:
@@ -247,6 +281,8 @@ def _serve() -> int:
             hello["warm_error"] = f"{type(exc).__name__}: {exc}"[:500]
     write_frame(out, hello)
 
+    fault = _parse_fault(os.environ.get(CHAOS_FAULT_ENV))
+    req_no = 0
     while True:
         try:
             req = read_frame(inp)
@@ -254,6 +290,13 @@ def _serve() -> int:
             return 1
         if req is None or req.get("op") == "shutdown":
             return 0
+        req_no += 1
+        if fault is not None and req_no == fault[1]:
+            kind, _, param = fault
+            if kind == "crash":
+                os._exit(int(param) or 13)
+            if kind == "hang":
+                time.sleep(param or 3600.0)
         resp = {"id": req.get("id")}
         t0 = time.monotonic()
         try:
@@ -449,6 +492,11 @@ class WorkerPool:
         self.respawns = 0
         self._procs: List[Optional[_WorkerProc]] = []
         self._threads: List[threading.Thread] = []
+        # slots mid-job: the heartbeat must not touch them (their
+        # dispatcher owns crash handling for the in-flight request)
+        self._busy: List[bool] = [False] * size
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
         for slot in range(size):
             self._procs.append(_WorkerProc(self._env))
             thread = threading.Thread(
@@ -492,15 +540,76 @@ class WorkerPool:
                 break
         return info
 
+    # -- health -------------------------------------------------------
+
+    def check_health(self) -> List[dict]:
+        """One liveness row per slot: pid, alive, busy, uptime. The
+        heartbeat's observable; also the cheap pre-flight a caller
+        can make before a batch of submissions."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for slot, proc in enumerate(self._procs):
+                out.append({
+                    "slot": slot,
+                    "pid": proc.pid if proc is not None else None,
+                    "alive": bool(proc is not None and proc.alive()),
+                    "busy": self._busy[slot],
+                    "uptime_s": (round(now - proc.spawned_at, 3)
+                                 if proc is not None else None),
+                })
+        return out
+
+    def start_heartbeat(self, interval_s: float = 5.0) -> None:
+        """Background liveness sweep: a dead IDLE worker is respawned
+        proactively (instead of lazily at its next job), so a pool
+        that sat through a chaos kill is warm again before the next
+        submission. Busy slots are left to their dispatcher — its
+        crash path owns the in-flight request."""
+        if self._hb_thread is not None:
+            return
+        self._hb_stop.clear()
+
+        def sweep() -> None:
+            while not self._hb_stop.wait(interval_s):
+                for slot in range(len(self._procs)):
+                    with self._lock:
+                        if self._busy[slot] or self._closed:
+                            continue
+                        proc = self._procs[slot]
+                        if proc is not None and proc.alive():
+                            continue
+                        self._respawn(slot, reason="heartbeat")
+
+        self._hb_thread = threading.Thread(
+            target=sweep, name="tpu-sim-pool-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_thread is None:
+            return
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=5)
+        self._hb_thread = None
+
     # -- dispatch -----------------------------------------------------
 
-    def _respawn(self, slot: int) -> _WorkerProc:
+    def _respawn(self, slot: int, reason: str = "crash") -> _WorkerProc:
+        from kind_tpu_sim import metrics
+
         old = self._procs[slot]
         if old is not None:
             old.kill()
         self.respawns += 1
-        proc = _WorkerProc(self._env)
+        # A respawn heals: the injected chaos fault (if any) applies
+        # to the ORIGINAL worker only, so recovery converges instead
+        # of re-crashing forever.
+        env = dict(self._env)
+        env.pop(CHAOS_FAULT_ENV, None)
+        proc = _WorkerProc(env)
         self._procs[slot] = proc
+        metrics.recovery_log().record(
+            "worker_respawn", slot=slot, reason=reason, pid=proc.pid)
         return proc
 
     def _dispatch(self, slot: int) -> None:
@@ -511,11 +620,13 @@ class WorkerPool:
             fut, req, timeout = item
             if not fut.set_running_or_notify_cancel():
                 continue
+            self._busy[slot] = True
             attempts_left = 1  # one respawn+retry per job
             while True:
-                proc = self._procs[slot]
-                if proc is None or not proc.alive():
-                    proc = self._respawn(slot)
+                with self._lock:
+                    proc = self._procs[slot]
+                    if proc is None or not proc.alive():
+                        proc = self._respawn(slot)
                 deadline = time.monotonic() + timeout
                 try:
                     resp = proc.request(req, deadline)
@@ -533,8 +644,13 @@ class WorkerPool:
                 except TimeoutError as exc:
                     # A wedged worker is useless — kill it; but do
                     # NOT rerun the job (doubling a 300s wait).
+                    from kind_tpu_sim import metrics
+
                     self._procs[slot] = None
                     proc.kill()
+                    metrics.recovery_log().record(
+                        "worker_hang_killed", slot=slot,
+                        job=req.get("job"))
                     fut.set_exception(exc)
                     break
                 if resp.get("ok"):
@@ -544,6 +660,7 @@ class WorkerPool:
                         resp.get("error", "job failed"),
                         resp.get("traceback", "")))
                 break
+            self._busy[slot] = False
 
     # -- lifecycle ----------------------------------------------------
 
@@ -551,6 +668,7 @@ class WorkerPool:
         if self._closed:
             return
         self._closed = True
+        self.stop_heartbeat()
         for _ in self._threads:
             self._queue.put(_SHUTDOWN)
         for thread in self._threads:
@@ -572,7 +690,8 @@ class WorkerPool:
 
 def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
              timeout: float,
-             kwargs_list: Optional[Sequence[dict]] = None) -> List:
+             kwargs_list: Optional[Sequence[dict]] = None,
+             max_respawns: int = 0) -> List:
     """Spawn one COLD protocol worker per env dict, run ``target``
     (a ``module:attr`` callable) in each, and return the results in
     spawn order.
@@ -583,7 +702,28 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
     workers. Semantics match the old file-based launcher: a crashed
     worker raises RuntimeError with its stderr tail (killing the
     rest), workers still pending at the deadline raise TimeoutError.
-    """
+
+    ``max_respawns`` > 0 turns on the self-healing path: a worker
+    that dies before answering is respawned (same identity env, the
+    injected CHAOS_FAULT_ENV stripped — a respawn heals) and its job
+    resent, up to that many times PER worker; results are identical
+    to a fault-free run because each job is a pure function of its
+    env + kwargs. Rendezvous launchers keep 0: one dead member wedges
+    the whole jax.distributed world, so the recovery unit there is
+    the launch attempt (multihost._with_launch_retry), not the
+    worker."""
+    from kind_tpu_sim import metrics
+
+    def send_job(proc: _WorkerProc, worker: int) -> None:
+        write_frame(proc.proc.stdin, {
+            "id": worker, "job": "call",
+            "kwargs": {
+                "target": target,
+                "kwargs": (kwargs_list[worker]
+                           if kwargs_list else {}),
+            },
+        })
+
     procs: List[_WorkerProc] = []
     with tempfile.TemporaryDirectory() as logdir:
         logs = pathlib.Path(logdir)
@@ -595,14 +735,7 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
             deadline = time.monotonic() + timeout
             for worker, proc in enumerate(procs):
                 try:
-                    write_frame(proc.proc.stdin, {
-                        "id": worker, "job": "call",
-                        "kwargs": {
-                            "target": target,
-                            "kwargs": (kwargs_list[worker]
-                                       if kwargs_list else {}),
-                        },
-                    })
+                    send_job(proc, worker)
                 except (BrokenPipeError, OSError):
                     raise RuntimeError(
                         f"slice worker {worker} crashed at spawn "
@@ -610,6 +743,7 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
                         f"{proc.stderr_tail()}")
             results: List = [None] * len(procs)
             pending = set(range(len(procs)))
+            respawns_left = [max_respawns] * len(procs)
             while pending:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
@@ -625,9 +759,35 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
                         continue
                     except WorkerCrash:
                         rc = proc.proc.poll()
-                        raise RuntimeError(
-                            f"slice worker {worker} crashed "
-                            f"(rc={rc}):\n{proc.stderr_tail()}")
+                        if respawns_left[worker] <= 0:
+                            raise RuntimeError(
+                                f"slice worker {worker} crashed "
+                                f"(rc={rc}):\n{proc.stderr_tail()}")
+                        respawns_left[worker] -= 1
+                        proc.kill()
+                        env = _pool_child_env(
+                            worker_envs[worker], warm=False)
+                        env.pop(CHAOS_FAULT_ENV, None)
+                        retry_no = max_respawns - respawns_left[worker]
+                        fresh = _WorkerProc(
+                            env, stderr_path=logs
+                            / f"worker-{worker}-r{retry_no}.err")
+                        procs[worker] = fresh
+                        metrics.recovery_log().record(
+                            "grid_worker_respawn", worker=worker,
+                            rc=rc, retry=retry_no)
+                        log.warning(
+                            "grid worker %d died (rc=%s); respawning "
+                            "and resending its job (%d/%d)", worker,
+                            rc, retry_no, max_respawns)
+                        try:
+                            send_job(fresh, worker)
+                        except (BrokenPipeError, OSError):
+                            raise RuntimeError(
+                                f"slice worker {worker} crashed at "
+                                f"respawn (rc={fresh.proc.poll()}):\n"
+                                f"{fresh.stderr_tail()}")
+                        continue
                     if frame.get("hello"):
                         continue  # cold hello precedes the result
                     if not frame.get("ok"):
@@ -641,6 +801,162 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
         finally:
             for proc in procs:
                 proc.kill()
+
+
+def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
+              cells: Sequence[dict], timeout: float,
+              cell_timeout: Optional[float] = None,
+              max_respawns: int = 1,
+              fault: Optional[tuple] = None):
+    """Dynamic grid-cell scheduler over COLD protocol workers: every
+    worker pulls the next unclaimed cell, so the grid drains at the
+    speed of the survivors even when a worker dies.
+
+    Recovery contract (docs/CHAOS.md): a worker that crashes or hangs
+    mid-cell has that cell REQUEUED — picked up by a survivor, or by
+    the worker's own respawn when it still has budget (the injected
+    CHAOS_FAULT_ENV is stripped on respawn, so a chaos fault is
+    transient by construction). A hang is detected by
+    ``cell_timeout`` and the wedged worker killed. Results are
+    indexed by cell, so a faulted run returns EXACTLY what the
+    fault-free run returns — each cell is a pure function of its
+    kwargs. A cell whose job RAISES is deterministic and fails the
+    whole run (retrying it would just re-raise slower).
+
+    ``fault`` = ("crash"|"hang", cell_index[, seconds]) is the
+    DETERMINISTIC chaos lever: the FIRST dispatch of that cell sends
+    a genuine crash/hang job in its place (whichever worker drew it
+    dies/wedges mid-cell), consumed exactly once — so a seeded fault
+    plan replays identically regardless of which worker the dynamic
+    scheduler hands the cell to. Hang faults need ``cell_timeout``
+    to be detected before the global deadline.
+
+    Returns ``(results, stats)``: results in cell order, stats with
+    requeue/respawn counts (also recorded in metrics.recovery_log).
+    """
+    from kind_tpu_sim import metrics
+
+    deadline = time.monotonic() + timeout
+    cond = threading.Condition()
+    todo: List[int] = list(range(len(cells)))
+    inflight: set = set()
+    fatal: List[BaseException] = []
+    results: List = [None] * len(cells)
+    ok: List[bool] = [False] * len(cells)
+    stats = {"workers": len(worker_envs), "requeues": 0,
+             "respawns": 0, "faults_injected": 0}
+    fault_budget = [1 if fault else 0]
+
+    def next_cell() -> Optional[int]:
+        with cond:
+            while True:
+                if fatal or time.monotonic() > deadline:
+                    return None
+                if todo:
+                    idx = todo.pop(0)
+                    inflight.add(idx)
+                    return idx
+                if not inflight:
+                    return None
+                cond.wait(0.05)
+
+    def finish(idx: int, success: bool) -> None:
+        with cond:
+            inflight.discard(idx)
+            if success:
+                ok[idx] = True
+            else:
+                todo.insert(0, idx)
+                stats["requeues"] += 1
+            cond.notify_all()
+
+    def drive(worker: int) -> None:
+        env = _pool_child_env(worker_envs[worker], warm=False)
+        proc = _WorkerProc(env)
+        respawns_left = max_respawns
+        try:
+            while True:
+                idx = next_cell()
+                if idx is None:
+                    return
+                cell_deadline = deadline
+                if cell_timeout is not None:
+                    cell_deadline = min(
+                        deadline, time.monotonic() + cell_timeout)
+                req = {"id": idx, "job": "call",
+                       "kwargs": {"target": target,
+                                  "kwargs": dict(cells[idx])}}
+                if fault is not None and idx == fault[1]:
+                    with cond:
+                        inject = fault_budget[0] > 0
+                        if inject:
+                            fault_budget[0] -= 1
+                            stats["faults_injected"] += 1
+                    if inject:
+                        if fault[0] == "crash":
+                            req = {"id": idx, "job": "crash",
+                                   "kwargs": {}}
+                        elif fault[0] == "hang":
+                            req = {"id": idx, "job": "hang",
+                                   "kwargs": {"seconds": float(
+                                       fault[2] if len(fault) > 2
+                                       else 3600.0)}}
+                        metrics.recovery_log().record(
+                            "fault_injected", kind=fault[0],
+                            cell=idx, worker=worker)
+                try:
+                    resp = proc.request(req, cell_deadline)
+                except (WorkerCrash, TimeoutError) as exc:
+                    finish(idx, False)
+                    metrics.recovery_log().record(
+                        "cell_requeued", cell=idx, worker=worker,
+                        cause=type(exc).__name__)
+                    proc.kill()
+                    if respawns_left <= 0:
+                        return  # survivors drain the requeued cell
+                    respawns_left -= 1
+                    with cond:
+                        stats["respawns"] += 1
+                    env = dict(env)
+                    env.pop(CHAOS_FAULT_ENV, None)
+                    proc = _WorkerProc(env)
+                    metrics.recovery_log().record(
+                        "cell_worker_respawn", worker=worker,
+                        pid=proc.pid)
+                    continue
+                if not resp.get("ok"):
+                    with cond:
+                        fatal.append(RuntimeError(
+                            f"cell {idx} failed on worker {worker}: "
+                            f"{resp.get('error')}\n"
+                            f"{resp.get('traceback', '')[-1000:]}"))
+                        cond.notify_all()
+                    return
+                results[idx] = resp.get("result")
+                finish(idx, True)
+        finally:
+            proc.kill()
+            with cond:
+                cond.notify_all()
+
+    threads = [threading.Thread(target=drive, args=(w,),
+                                name=f"tpu-sim-cells-{w}",
+                                daemon=True)
+               for w in range(len(worker_envs))]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=max(0.0, deadline - time.monotonic())
+                    + 10.0)
+    if fatal:
+        raise fatal[0]
+    missing = [i for i, done in enumerate(ok) if not done]
+    if missing:
+        raise TimeoutError(
+            f"cells {missing} unfinished after {timeout}s "
+            f"(requeues={stats['requeues']}, "
+            f"respawns={stats['respawns']})")
+    return results, stats
 
 
 def main(argv: Optional[List[str]] = None) -> int:

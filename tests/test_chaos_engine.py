@@ -1,0 +1,330 @@
+"""Deterministic chaos engine + the recovery invariants it proves.
+
+Tier-1 (CPU-only, no cluster) coverage of the acceptance criteria:
+(a) a grid sweep with one injected worker crash returns results
+identical to the fault-free run, (b) an injected mid-step preemption
+resumes from checkpoint with a continuous loss trajectory, (c) serving
+with an injected slot failure completes all accepted requests with no
+corrupted streams — plus the seeded-determinism contract (same seed =>
+identical fault schedule) and the scenario/soak/CLI surface.
+"""
+
+import signal
+
+import pytest
+
+from kind_tpu_sim import chaos, metrics
+
+pytestmark = pytest.mark.chaos
+
+
+# -- seeded determinism -----------------------------------------------
+
+
+def test_same_seed_identical_fault_schedule():
+    kwargs = dict(kinds=("worker_crash", "worker_hang",
+                         "device_flap"),
+                  n_faults=5, horizon=16, targets=4)
+    a = chaos.ChaosSchedule(42).plan(**kwargs)
+    b = chaos.ChaosSchedule(42).plan(**kwargs)
+    assert a == b
+    assert a.events == b.events
+
+
+def test_different_seed_different_schedule():
+    kwargs = dict(kinds=("worker_crash", "worker_hang"),
+                  n_faults=6, horizon=32, targets=4)
+    plans = {chaos.ChaosSchedule(s).plan(**kwargs).events
+             for s in range(8)}
+    assert len(plans) > 1
+
+
+def test_plan_shape_isolated_per_arguments():
+    # different plan shapes from the SAME seed draw from independent
+    # streams — adding a fault to one plan must not perturb another
+    a = chaos.ChaosSchedule(1).plan(kinds=("worker_crash",),
+                                    n_faults=2, horizon=8)
+    b = chaos.ChaosSchedule(1).plan(kinds=("worker_crash",),
+                                    n_faults=3, horizon=8)
+    assert a.events == chaos.ChaosSchedule(1).plan(
+        kinds=("worker_crash",), n_faults=2, horizon=8).events
+    assert len(b.events) == 3
+
+
+def test_seed_resolution_env(monkeypatch):
+    monkeypatch.setenv(chaos.CHAOS_SEED_ENV, "1234")
+    assert chaos.resolve_seed() == 1234
+    assert chaos.resolve_seed(7) == 7  # explicit wins
+    monkeypatch.delenv(chaos.CHAOS_SEED_ENV)
+    assert chaos.resolve_seed() == 0
+
+
+def test_unknown_fault_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        chaos.ChaosSchedule(0).plan(kinds=("meteor_strike",))
+
+
+# -- (a) grid-cell recovery under worker crash/hang -------------------
+
+
+def test_run_cells_crash_requeues_and_matches_fault_free():
+    from kind_tpu_sim.parallel import multihost
+
+    cells = [{"cell": i, "payload": 3} for i in range(6)]
+    clean, clean_stats = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0)
+    assert clean_stats["requeues"] == 0
+    before = metrics.recovery_log().counts().get("cell_requeued", 0)
+    faulted, stats = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0, fault=("crash", 2))
+    assert faulted == clean  # the recovery invariant
+    assert stats["faults_injected"] == 1
+    assert stats["requeues"] >= 1
+    assert metrics.recovery_log().counts()["cell_requeued"] > before
+
+
+def test_run_cells_requeues_on_survivor_without_respawn():
+    from kind_tpu_sim.parallel import multihost
+
+    cells = [{"cell": i, "payload": 5} for i in range(5)]
+    clean, _ = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0)
+    # no respawn budget: the dead worker's cell MUST drain through
+    # the survivor
+    faulted, stats = multihost.scatter_grid_cells(
+        cells, workers=2, timeout=120.0, fault=("crash", 0),
+        max_respawns=0)
+    assert faulted == clean
+    assert stats["requeues"] >= 1
+    assert stats["respawns"] == 0
+
+
+def test_run_grid_crash_respawn_matches_fault_free():
+    from kind_tpu_sim.utils import worker_pool as wp
+
+    target = "kind_tpu_sim.parallel.multihost:grid_cell_probe"
+    kwargs = [{"cell": i} for i in range(3)]
+    envs = [{"W": str(i)} for i in range(3)]
+    clean = wp.run_grid(envs, target, 60, kwargs_list=kwargs)
+    envs_f = [dict(e) for e in envs]
+    envs_f[1][wp.CHAOS_FAULT_ENV] = "crash@1"
+    faulted = wp.run_grid(envs_f, target, 60, kwargs_list=kwargs,
+                          max_respawns=1)
+    assert faulted == clean
+
+
+def test_run_grid_crash_without_budget_still_raises():
+    from kind_tpu_sim.utils import worker_pool as wp
+
+    envs = [{}, {wp.CHAOS_FAULT_ENV: "crash@1"}]
+    with pytest.raises(RuntimeError, match="crashed"):
+        wp.run_grid(envs,
+                    "kind_tpu_sim.parallel.multihost:grid_cell_probe",
+                    60, kwargs_list=[{"cell": 0}, {"cell": 1}])
+
+
+def test_run_cells_deterministic_job_failure_is_fatal():
+    from kind_tpu_sim.utils import worker_pool as wp
+
+    with pytest.raises(RuntimeError, match="cell 0 failed"):
+        wp.run_cells([{}], "kind_tpu_sim.topology:make_slice",
+                     [{"topology": "nonsense"}], timeout=60)
+
+
+# -- (b) preemption-safe checkpoint/resume ----------------------------
+
+
+@pytest.fixture(scope="module")
+def train_cfg():
+    tf = pytest.importorskip("kind_tpu_sim.models.transformer")
+    return tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                          n_layers=2, d_ff=64, max_seq=16)
+
+
+def test_preemption_mid_run_resumes_continuous_trajectory(
+        tmp_path, train_cfg):
+    """SIGTERM after step 2: the loop finishes the step, checkpoints
+    at step 3, raises Preempted; the resumed run completes 3..7 and
+    the combined losses match the uninterrupted run bit-for-bit."""
+    import os
+
+    ckpt = pytest.importorskip("kind_tpu_sim.models.checkpoint")
+    total = 8
+    _, straight = ckpt.train_with_checkpointing(
+        train_cfg, tmp_path / "straight", total_steps=total,
+        checkpoint_every=total)
+
+    def preempt(step):
+        if step == 2:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    before = metrics.recovery_log().counts().get(
+        "preemption_checkpoint", 0)
+    with pytest.raises(ckpt.Preempted) as err:
+        ckpt.train_with_checkpointing(
+            train_cfg, tmp_path / "chaos", total_steps=total,
+            checkpoint_every=total, on_step=preempt)
+    assert err.value.step == 3
+    assert sorted(err.value.losses) == [0, 1, 2]
+    assert ckpt.latest_step(tmp_path / "chaos") == 3
+    assert metrics.recovery_log().counts()[
+        "preemption_checkpoint"] > before
+
+    _, resumed = ckpt.train_with_checkpointing(
+        train_cfg, tmp_path / "chaos", total_steps=total,
+        checkpoint_every=total)
+    combined = {**err.value.losses, **resumed}
+    assert sorted(combined) == list(range(total))
+    assert all(combined[i] == straight[i] for i in range(total))
+
+
+def test_preemption_guard_restores_handler():
+    prior = signal.getsignal(signal.SIGTERM)
+    ckpt = pytest.importorskip("kind_tpu_sim.models.checkpoint")
+    with ckpt.preemption_guard() as guard:
+        assert not guard.preempted
+        guard.trip()
+        assert guard.preempted
+    assert signal.getsignal(signal.SIGTERM) is prior
+
+
+# -- (c) serving slot failure -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def serve_params(train_cfg):
+    jax = pytest.importorskip("jax")
+    from kind_tpu_sim.models import transformer as tf
+
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=64)
+    return cfg, tf.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def test_serving_slot_failure_requeues_and_streams_identical(
+        serve_params):
+    import numpy as np
+
+    from kind_tpu_sim.models import serving
+
+    cfg, params = serve_params
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, size=4 + 3 * i).tolist()
+               for i in range(4)]
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8)
+
+    def run(inject):
+        eng = serving.ServingEngine(params, cfg, sc)
+        for i, p in enumerate(prompts):
+            eng.submit(serving.Request(f"r{i}", p, max_new=20,
+                                       seed=100 + i))
+        if inject:
+            eng.step_round()
+            assert eng.inject_slot_failure(0)  # mid-stream: displaced
+            eng.restore_slot(0)
+        comps = eng.poll() + eng.run()
+        return {c.request_id: tuple(c.tokens) for c in comps}, eng
+
+    clean, _ = run(False)
+    faulted, eng = run(True)
+    assert faulted == clean  # no corrupted streams, all complete
+    assert len(faulted) == len(prompts)
+    assert eng.slot_failures == 1 and eng.requeues == 1
+    assert eng.report()["chaos"]["slot_failures"] == 1
+
+
+def test_serving_quarantine_blocks_admission_until_restore(
+        serve_params):
+    from kind_tpu_sim.models import serving
+
+    cfg, params = serve_params
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    eng.inject_slot_failure(0)
+    eng.submit(serving.Request("q", [1, 2, 3], max_new=4, seed=1))
+    eng._admit()
+    assert eng.slot_req[0] is None  # quarantined slot skipped
+    assert eng.slot_req[1] is not None
+    eng.inject_slot_failure(1)  # displaces q back to the queue
+    with pytest.raises(RuntimeError, match="quarantined"):
+        eng.run()
+    eng.restore_slot(0)
+    eng.restore_slot(1)
+    done = eng.run()
+    assert [c.request_id for c in done] == ["q"]
+
+
+def test_serving_load_shedding_max_queue(serve_params):
+    from kind_tpu_sim.models import serving
+
+    cfg, params = serve_params
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                               max_queue=2)
+    eng = serving.ServingEngine(params, cfg, sc)
+    for i in range(2):
+        eng.submit(serving.Request(f"s{i}", [1, 2], max_new=3,
+                                   seed=i))
+    with pytest.raises(serving.EngineSaturated):
+        eng.submit(serving.Request("s2", [1, 2], max_new=3, seed=9))
+    assert eng.shed == 1
+    # accepted requests still complete — shedding never corrupts
+    done = eng.run()
+    assert sorted(c.request_id for c in done) == ["s0", "s1"]
+    assert eng.report()["chaos"]["shed"] == 1
+
+
+# -- scenarios, soak, CLI ---------------------------------------------
+
+
+def test_scenarios_fast_tier_all_pass():
+    for name, scen in sorted(chaos.SCENARIOS.items()):
+        if scen.slow:
+            continue
+        report = chaos.run_scenario(name, seed=13)
+        assert report["ok"], (name, report)
+        assert report["seed"] == 13
+        assert "recovery_events" in report
+
+
+def test_scenario_reports_are_replayable():
+    a = chaos.run_scenario("flaky-exec", seed=21)
+    b = chaos.run_scenario("flaky-exec", seed=21)
+    assert a["plan"] == b["plan"]
+    assert a["injected_failures"] == b["injected_failures"]
+
+
+def test_unknown_scenario_rejected():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        chaos.run_scenario("no-such-thing")
+
+
+def test_soak_deterministic_and_green():
+    a = chaos.soak(iterations=3, seed=5)
+    b = chaos.soak(iterations=3, seed=5)
+    assert a["ok"] and b["ok"]
+    assert [(r["scenario"], r["seed"]) for r in a["runs"]] == \
+           [(r["scenario"], r["seed"]) for r in b["runs"]]
+
+
+def test_chaos_cli_run_and_soak():
+    from kind_tpu_sim.cli import main
+
+    assert main(["chaos", "run", "--runtime=fake"]) == 0  # listing
+    assert main(["chaos", "run", "--runtime=fake",
+                 "--scenario=flaky-exec", "--seed=3",
+                 "--json"]) == 0
+    assert main(["chaos", "run", "--runtime=fake",
+                 "--scenario=device-flap"]) == 0
+    assert main(["chaos", "soak", "--runtime=fake",
+                 "--iterations=2", "--seed=1"]) == 0
+
+
+def test_chaos_cli_help_covers_engine(capsys):
+    from kind_tpu_sim.cli import build_parser
+
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["chaos", "--help"])
+    text = capsys.readouterr().out
+    for token in ("run", "soak", "--scenario", "--seed",
+                  "--iterations", "KIND_TPU_SIM_CHAOS_SEED"):
+        assert token in text

@@ -69,6 +69,34 @@ def test_resume_matches_uninterrupted(tmp_path, cfg):
     assert merged == straight, (merged, straight)
 
 
+@pytest.mark.chaos
+def test_sigterm_preemption_checkpoints_and_resumes(tmp_path, cfg):
+    """The TPU maintenance-event drill: SIGTERM mid-run finishes the
+    in-flight step, writes a checkpoint at that exact step, raises
+    Preempted; resuming completes the run with the uninterrupted
+    trajectory (docs/CHAOS.md recovery invariant)."""
+    import os
+    import signal
+
+    straight_dir = tmp_path / "straight"
+    chaos_dir = tmp_path / "chaos"
+    _, straight = ckpt.train_with_checkpointing(
+        cfg, straight_dir, total_steps=4, checkpoint_every=4)
+
+    with pytest.raises(ckpt.Preempted) as err:
+        ckpt.train_with_checkpointing(
+            cfg, chaos_dir, total_steps=4, checkpoint_every=4,
+            on_step=lambda i: (i == 1 and os.kill(
+                os.getpid(), signal.SIGTERM)))
+    assert err.value.step == 2
+    assert ckpt.latest_step(chaos_dir) == 2
+
+    _, resumed = ckpt.train_with_checkpointing(
+        cfg, chaos_dir, total_steps=4, checkpoint_every=4)
+    merged = {**err.value.losses, **resumed}
+    assert merged == straight, (merged, straight)
+
+
 def test_retention_max_to_keep(tmp_path, cfg):
     import jax
 

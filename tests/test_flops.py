@@ -124,6 +124,28 @@ def test_step_peak_bytes_gate_calibration():
                 optimizer=False)
 
 
+def test_step_peak_bytes_remat_aware():
+    """With cfg.remat the backward keeps only block-boundary
+    residuals (plus one block's transient recompute), so the OOM
+    gate must charge strictly less than the non-remat estimate — a
+    remat variant that fits must not be skipped by non-remat
+    arithmetic (ADVICE r5)."""
+    import dataclasses
+
+    from kind_tpu_sim.models import flops as F
+    from kind_tpu_sim.models import transformer as tf
+
+    cfg = tf.bench_config_large()
+    remat = dataclasses.replace(cfg, remat=True)
+    for flash in (False, True):
+        plain = F.step_peak_bytes(cfg, 8, 1024, flash=flash)
+        saved = F.step_peak_bytes(remat, 8, 1024, flash=flash)
+        assert saved < plain
+    # forward-only estimates are remat-independent (nothing saved)
+    assert F.step_peak_bytes(remat, 8, 1024, backward=False) == \
+        F.step_peak_bytes(cfg, 8, 1024, backward=False)
+
+
 def test_attention_flops_formula():
     from kind_tpu_sim.models import flops as F
 

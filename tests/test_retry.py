@@ -1,0 +1,211 @@
+"""Classified retry policy: the error taxonomy, the backoff math,
+and the run_with_retry loop every kubectl/runtime command now rides.
+
+The contract under test (docs/CHAOS.md "Retry policy"): transient
+failures retry with exponential backoff + jitter up to the env-tunable
+budget; fatal failures surface immediately (retrying a typo just
+doubles the latency to the real error); and every retry is observable
+in metrics.recovery_log().
+"""
+
+import random
+
+import pytest
+
+from kind_tpu_sim import metrics
+from kind_tpu_sim.chaos import FlakyExecutor
+from kind_tpu_sim.utils.shell import (
+    CommandError,
+    ExecResult,
+    FakeExecutor,
+    RetryPolicy,
+    classify_failure,
+    run_with_retry,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# -- taxonomy ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("stderr", [
+    "Unable to connect to the server: dial tcp 1.2.3.4:6443: "
+    "connect: connection refused",
+    "Error from server: etcdserver: request timed out",
+    "error: the object has been modified; please apply your changes",
+    "net/http: TLS handshake timeout",
+    "rpc error: code = Unavailable desc = transport is closing",
+])
+def test_transient_errors_classified_transient(stderr):
+    assert classify_failure(ExecResult(1, "", stderr)) == "transient"
+
+
+@pytest.mark.parametrize("stderr", [
+    'Error from server (NotFound): pods "x" not found',
+    "error: unknown flag: --bogus",
+    "error: error validating data: invalid field",
+    'Error from server (Forbidden): nodes is forbidden',
+    "docker: no such container: kind-tpu-sim-worker9",
+])
+def test_fatal_errors_classified_fatal(stderr):
+    assert classify_failure(ExecResult(1, "", stderr)) == "fatal"
+
+
+def test_fatal_patterns_win_over_transient():
+    # a NotFound wrapped in connection noise must not retry
+    assert classify_failure(ExecResult(
+        1, "", "pod not found (after connection reset)")) == "fatal"
+
+
+def test_timeout_returncodes_are_transient():
+    assert classify_failure(ExecResult(124, "", "")) == "transient"
+    assert classify_failure(ExecResult(137, "", "")) == "transient"
+
+
+def test_unrecognized_error_is_fatal():
+    assert classify_failure(
+        ExecResult(1, "", "segfault in plugin")) == "fatal"
+
+
+# -- backoff ----------------------------------------------------------
+
+
+def test_backoff_doubles_and_caps():
+    policy = RetryPolicy(max_retries=5, base_ms=100.0, max_ms=500.0,
+                         seed=0)
+    rng = random.Random(0)
+    delays = [policy.backoff_s(a, rng) for a in range(5)]
+    # exponential base under the jitter: 100, 200, 400, 500, 500 ms
+    assert 0.1 <= delays[0] <= 0.2
+    assert 0.2 <= delays[1] <= 0.3
+    assert 0.4 <= delays[2] <= 0.5
+    assert 0.5 <= delays[3] <= 0.6  # capped at max_ms + jitter
+    assert 0.5 <= delays[4] <= 0.6
+
+
+def test_backoff_jitter_deterministic_per_seed():
+    policy = RetryPolicy(seed=7)
+    a = [policy.backoff_s(i, random.Random(7)) for i in range(3)]
+    b = [policy.backoff_s(i, random.Random(7)) for i in range(3)]
+    assert a == b
+
+
+def test_policy_env_knobs(monkeypatch):
+    monkeypatch.setenv("KIND_TPU_SIM_MAX_RETRIES", "7")
+    monkeypatch.setenv("KIND_TPU_SIM_RETRY_BASE_MS", "5")
+    monkeypatch.setenv("KIND_TPU_SIM_CMD_TIMEOUT_S", "30")
+    monkeypatch.setenv("KIND_TPU_SIM_CHAOS_SEED", "11")
+    policy = RetryPolicy.from_env()
+    assert policy.max_retries == 7
+    assert policy.base_ms == 5.0
+    assert policy.deadline_s == 30.0
+    assert policy.seed == 11
+
+
+def test_policy_env_defaults(monkeypatch):
+    for key in ("KIND_TPU_SIM_MAX_RETRIES",
+                "KIND_TPU_SIM_RETRY_BASE_MS",
+                "KIND_TPU_SIM_CMD_TIMEOUT_S"):
+        monkeypatch.delenv(key, raising=False)
+    policy = RetryPolicy.from_env()
+    assert policy.max_retries == 3
+    assert policy.base_ms == 50.0
+    assert policy.deadline_s is None
+
+
+# -- run_with_retry ---------------------------------------------------
+
+FAST = RetryPolicy(max_retries=3, base_ms=1.0, seed=0)
+
+
+def test_transient_failure_recovers():
+    fake = FlakyExecutor(fail_attempts=2)
+    before = metrics.recovery_log().counts().get("exec_retry", 0)
+    result = run_with_retry(fake, ["kubectl", "get", "nodes"],
+                            policy=FAST)
+    assert result.ok
+    assert fake.injected_failures == 2
+    assert len(fake.calls) == 3  # 2 failures + the success
+    after = metrics.recovery_log().counts()["exec_retry"]
+    assert after - before == 2  # recovery is observable, not silent
+
+
+def test_fatal_failure_never_retries():
+    fake = FakeExecutor(rules={
+        "kubectl delete": ExecResult(1, "", "pods 'x' not found"),
+    })
+    with pytest.raises(CommandError) as err:
+        run_with_retry(fake, ["kubectl", "delete", "pod", "x"],
+                       policy=FAST)
+    assert len(fake.calls) == 1
+    assert err.value.attempts == 1
+
+
+def test_exhaustion_raises_with_attempt_count():
+    fake = FlakyExecutor(fail_attempts=99)
+    with pytest.raises(CommandError, match="after 4 attempts"):
+        run_with_retry(fake, ["kubectl", "get", "nodes"],
+                       policy=FAST)
+    assert len(fake.calls) == 4  # 1 + max_retries
+
+
+def test_check_false_returns_last_result():
+    fake = FlakyExecutor(fail_attempts=99)
+    result = run_with_retry(fake, ["kubectl", "get", "nodes"],
+                            policy=FAST, check=False)
+    assert not result.ok
+    assert "connection refused" in result.stderr
+
+
+def test_runtime_and_kubectl_ride_the_policy():
+    """The wiring: ContainerRuntime.run and runtime.kubectl recover a
+    transient daemon/apiserver blip without the caller noticing."""
+    from kind_tpu_sim.runtime import ContainerRuntime, kubectl
+
+    fake = FlakyExecutor(flaky_prefix="docker ps", fail_attempts=1)
+    rt = ContainerRuntime("docker", fake, retry=FAST)
+    assert rt.run("ps").ok
+    assert fake.injected_failures == 1
+
+    fake2 = FlakyExecutor(fail_attempts=1)
+    assert kubectl(fake2, "get", "nodes", retry=FAST).ok
+    assert fake2.injected_failures == 1
+
+
+def test_system_executor_deadline_reports_timeout_code():
+    """A per-command deadline kills the child and reports rc=124 —
+    classified transient, so a wedged command is retried instead of
+    hanging the pipeline."""
+    from kind_tpu_sim.utils.shell import SystemExecutor
+
+    result = SystemExecutor().run(
+        ["sleep", "5"], check=False, timeout=0.2)
+    assert result.returncode == 124
+    assert classify_failure(result) == "transient"
+
+
+def test_launch_retry_classification():
+    """multihost._with_launch_retry: worker crashes and rendezvous
+    timeouts relaunch; a deterministic job failure does not."""
+    from kind_tpu_sim.parallel.multihost import _with_launch_retry
+
+    calls = {"n": 0}
+
+    def crash_once():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("slice worker 1 crashed (rc=9):\n...")
+        return "ok"
+
+    assert _with_launch_retry(crash_once, attempts=2) == "ok"
+    assert calls["n"] == 2
+
+    def job_failed():
+        calls["n"] += 1
+        raise RuntimeError("slice worker 0 job failed: ValueError")
+
+    calls["n"] = 0
+    with pytest.raises(RuntimeError, match="job failed"):
+        _with_launch_retry(job_failed, attempts=3)
+    assert calls["n"] == 1  # deterministic: never retried

@@ -1348,6 +1348,66 @@ def test_admission_waves_proportional_to_wave_not_grid(cfg, params):
                                   admission_wave_sizes=(2, 4)))
 
 
+def test_warm_admission_rejects_live_engine(cfg, params):
+    """warm_admission's dummy prefills scribble on slot KV rows, so
+    calling it with live slots or pending chunked prefills must fail
+    loudly instead of silently corrupting in-flight streams."""
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    eng.submit(serving.Request("live", make_prompt(3, 6,
+                                                  cfg.vocab_size),
+                               max_new=20))
+    eng.step_round()
+    with pytest.raises(RuntimeError, match="idle engine"):
+        eng.warm_admission((6,))
+    # drains cleanly afterwards — the guard touched nothing
+    done = {c.request_id: c for c in [*eng.poll(), *eng.run()]}
+    assert len(done["live"].tokens) == 20
+
+    sc_c = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                                 prefill_chunk=4)
+    eng_c = serving.ServingEngine(params, cfg, sc_c)
+    eng_c.submit(serving.Request("pend", make_prompt(4, 12,
+                                                     cfg.vocab_size),
+                                 max_new=4))
+    eng_c._admit()  # claims a pending chunked-prefill slot
+    assert eng_c._pending
+    with pytest.raises(RuntimeError, match="idle engine"):
+        eng_c.warm_admission((6,))
+
+
+@pytest.mark.chaos
+def test_paged_slot_failure_frees_blocks_and_replays(cfg, params):
+    """The paged engine's slot-failure path releases the dead slot's
+    blocks back to the pool (no leak under chaos) and the requeued
+    request replays its exact stream."""
+    sc = serving.ServingConfig(max_slots=2, max_len=48, chunk=8,
+                               paged_blocks=24, block_size=8)
+    prompts = [make_prompt(11 + i, 5 + 3 * i, cfg.vocab_size)
+               for i in range(3)]
+
+    def run(inject):
+        eng = serving.PagedServingEngine(params, cfg, sc)
+        for i, p in enumerate(prompts):
+            eng.submit(serving.Request(f"p{i}", p, max_new=20,
+                                       seed=50 + i))
+        if inject:
+            eng.step_round()
+            in_use = eng.report()["paged"]["blocks_in_use"]
+            assert in_use > 0
+            assert eng.inject_slot_failure(0)
+            assert (eng.report()["paged"]["blocks_in_use"]
+                    < in_use)  # the dead slot's blocks came back
+            eng.restore_slot(0)
+        comps = eng.poll() + eng.run()
+        return ({c.request_id: tuple(c.tokens) for c in comps}, eng)
+
+    clean, _ = run(False)
+    faulted, eng = run(True)
+    assert faulted == clean
+    assert eng.report()["paged"]["blocks_in_use"] == 0
+
+
 def test_warm_admission_precompiles_without_state_damage(cfg, params):
     """warm_admission drives the stacked prefill/sample traces with
     dummy groups, touching no scheduler or allocator state — streams

@@ -100,6 +100,65 @@ def test_unknown_job_is_a_job_error():
             pool.submit("no-such-job", timeout=60)
 
 
+# -- chaos hooks + self-healing (docs/CHAOS.md) -----------------------
+
+
+@pytest.mark.chaos
+def test_hang_job_deadline_kills_worker_and_pool_recovers():
+    """A wedged worker (hang job) is killed at the job deadline —
+    TimeoutError, never a wait-out — and the pool serves the next
+    job on a fresh worker."""
+    with wp.WorkerPool(size=1, warm=False) as pool:
+        pid1 = pool.submit("ping", timeout=60)["pid"]
+        with pytest.raises(TimeoutError):
+            pool.submit("hang", timeout=2, seconds=60)
+        pid2 = pool.submit("ping", timeout=60)["pid"]
+        assert pid2 != pid1
+        assert pool.respawns >= 1
+
+
+@pytest.mark.chaos
+def test_injected_fault_env_heals_on_respawn():
+    """A CHAOS_FAULT_ENV crash applies to the original worker only:
+    the respawn strips it, so the retried job succeeds instead of
+    crash-looping."""
+    with wp.WorkerPool(size=1, warm=False,
+                       extra_env={wp.CHAOS_FAULT_ENV: "crash@1"}
+                       ) as pool:
+        # first job hits the fault, rides respawn+retry, succeeds
+        assert pool.submit("ping", timeout=60)["pid"] > 0
+        assert pool.respawns >= 1
+
+
+@pytest.mark.chaos
+def test_check_health_and_heartbeat_respawn():
+    """check_health reports per-slot liveness; the heartbeat sweep
+    respawns a dead idle worker proactively (before any job is
+    submitted against it)."""
+    with wp.WorkerPool(size=2, warm=False) as pool:
+        pids = [pool.submit("ping", timeout=60)["pid"]
+                for _ in range(2)]
+        rows = pool.check_health()
+        assert [r["alive"] for r in rows] == [True, True]
+        victim = pool.worker_pids()[0]
+        os.kill(victim, signal.SIGKILL)
+        pool.start_heartbeat(interval_s=0.1)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            rows = pool.check_health()
+            if (all(r["alive"] for r in rows)
+                    and victim not in pool.worker_pids()):
+                break
+            time.sleep(0.05)
+        pool.stop_heartbeat()
+        assert all(r["alive"] for r in pool.check_health())
+        assert victim not in pool.worker_pids()
+        assert pool.respawns >= 1
+        # and the healed pool still serves
+        assert pool.submit("ping", timeout=60)["pid"] > 0
+        del pids
+
+
 # -- warm path with the persistent XLA compilation cache --------------
 
 

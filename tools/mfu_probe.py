@@ -201,8 +201,11 @@ OP_FAMILIES = (
 # to the model op that emitted it — this is what turns round 4's
 # "other 78.4%" bucket into named families (VERDICT r4 #2).
 _OPNAME_FAMILIES = (
-    ("flash-attention-bwd", ("flash",), ("transpose", "jvp",
-                                         "bwd")),  # grad-of-flash
+    # grad-of-flash: 'transpose(...)' is the actual backward marker.
+    # 'jvp' alone is NOT — XLA stamps forward ops under a grad trace
+    # with 'jvp(...)' too, so matching it attributed forward flash
+    # kernels inside the train step to the backward family.
+    ("flash-attention-bwd", ("flash",), ("transpose", "bwd")),
     ("flash-attention", ("flash",), ()),
     ("attention-softmax", (), ("softmax", "attention")),
     ("optimizer-adamw", (), ("adamw", "scale_by_adam", "adam",
