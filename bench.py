@@ -2188,6 +2188,67 @@ def ring_attention_bench(pool=None) -> dict | None:
         return {"error": str(exc)[:200]}
 
 
+def fleet_smoke() -> dict | None:
+    """Fleet-tier extras: one seeded multi-replica run per routing
+    policy over the SAME shared-prefix trace (the analytic replicas —
+    milliseconds, no jax), publishing SLO attainment, tail latency,
+    goodput, and the router/autoscaler counter board
+    (metrics.fleet_board) alongside the RecoveryLog. The policy
+    spread (prefix-affinity vs round-robin TTFT) is the fleet layer's
+    headline observable; docs/FLEET.md explains the model."""
+    try:
+        from kind_tpu_sim import fleet
+        from kind_tpu_sim import metrics as _metrics
+
+        spec = fleet.WorkloadSpec(
+            process="bursty", rps=400.0, n_requests=300,
+            prompt_len=(24, 32), max_new=(4, 8),
+            shared_prefix_frac=0.8, prefix_groups=6, prefix_len=16)
+        trace = fleet.generate_trace(spec, seed=7)
+        sim_cfg = fleet.SimReplicaConfig(
+            max_slots=4, prefill_per_tok_s=0.004, tpot_s=0.002,
+            prefix_cache_entries=2)
+        board_before = _metrics.fleet_board().counts()
+        t0 = time.monotonic()
+        policies = {}
+        for policy in fleet.POLICIES:
+            rep = fleet.FleetSim(
+                fleet.FleetConfig(replicas=3, policy=policy,
+                                  sim=sim_cfg),
+                trace).run()
+            policies[policy] = {
+                "ok": rep["ok"],
+                "attainment": rep["slo"]["attainment"],
+                "ttft_p50_s": rep["slo"]["ttft"].get("p50_s"),
+                "ttft_p99_s": rep["slo"]["ttft"].get("p99_s"),
+                "goodput_tok_s": rep["slo"].get("goodput_tok_s"),
+            }
+        auto = fleet.FleetSim(
+            fleet.FleetConfig(
+                replicas=1, policy="least-outstanding",
+                sim=sim_cfg, autoscale=True,
+                autoscaler=fleet.AutoscalerConfig(
+                    max_replicas=4, warmup_s=0.2)),
+            trace).run()
+        return {
+            "ok": all(p["ok"] for p in policies.values())
+            and auto["ok"],
+            "requests": len(trace),
+            "seconds": round(time.monotonic() - t0, 3),
+            "policies": policies,
+            "autoscaler": {
+                "ok": auto["ok"],
+                "scale_ups": auto["autoscaler"]["scale_ups"],
+                "scale_downs": auto["autoscaler"]["scale_downs"],
+                "attainment": auto["slo"]["attainment"],
+            },
+            "counters": _metrics.fleet_board().snapshot_since(
+                board_before),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def multihost_smoke() -> dict | None:
     """DCN-tier proof: a 2-host simulated slice (one process per host,
     gloo collectives over loopback) comes up and passes cross-host
@@ -2347,6 +2408,10 @@ def main(argv=None) -> int:
             ring = ring_attention_bench(pool)
         if ring:
             phases["ring_attention"] = ring
+        with stopwatch("fleet"):
+            fleet_rep = fleet_smoke()
+        if fleet_rep:
+            phases["fleet"] = fleet_rep
     finally:
         if pool is not None:
             pool.close()
@@ -2395,6 +2460,9 @@ def main(argv=None) -> int:
     mh = phases.get("multihost")
     if isinstance(mh, dict):
         compact_extra["multihost_ok"] = mh.get("ok")
+    fl = phases.get("fleet")
+    if isinstance(fl, dict):
+        compact_extra["fleet_ok"] = fl.get("ok")
     emit_result(out, out_path, compact_extra)
     return 0
 

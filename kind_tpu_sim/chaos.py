@@ -63,6 +63,8 @@ FAULT_KINDS = (
     "preempt_sigterm",   # SIGTERM mid-train-step (TPU maintenance)
     "cmd_transient",     # kubectl/runtime command fails transiently
     "slot_failure",      # serving slot/engine dies mid-stream
+    "replica_preempt",   # fleet: a whole serving replica preempted
+    "replica_flap",      # fleet: a replica fails/heals repeatedly
 )
 
 
@@ -545,6 +547,147 @@ def _scenario_serving_slot_failure(seed: int) -> dict:
         "streams_identical": faulted == clean,
         "ok": bool(faulted == clean and eng.slot_failures == 1
                    and eng.requeues >= 1),
+    }
+
+
+@_scenario("fleet-flaky-replica",
+           "a fleet replica fails and heals repeatedly under seeded "
+           "open-loop traffic; every request still completes and "
+           "post-recovery SLO attainment matches the fault-free run")
+def _scenario_fleet_flaky_replica(seed: int) -> dict:
+    from kind_tpu_sim import fleet
+
+    plan = ChaosSchedule(seed).plan(kinds=("replica_flap",),
+                                    n_faults=2, horizon=8, targets=3)
+    spec = fleet.WorkloadSpec(process="poisson", rps=300.0,
+                              n_requests=120, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    sim_cfg = fleet.SimReplicaConfig(max_slots=4,
+                                     prefill_per_tok_s=0.002,
+                                     tpot_s=0.002)
+    fc = fleet.FleetConfig(replicas=3, policy="least-outstanding",
+                           tick_s=0.01, sim=sim_cfg,
+                           slo=fleet.SloPolicy(ttft_s=1.0,
+                                               e2e_s=5.0))
+    clean = fleet.FleetSim(fc, trace).run()
+    span = clean["virtual_s"]
+    events = []
+    last_restore = 0.0
+    for ev in plan.events:
+        # flaps land in the first 60% of the clean makespan so
+        # arrivals keep coming after the final heal (the recovery
+        # window the invariant is judged over)
+        at = round((ev.at + 1) / 9.0 * span * 0.6, 6)
+        heal = round(at + 0.05 * span, 6)
+        events.append(fleet.ChaosEvent(at_s=at, action="preempt",
+                                       target=ev.target % 3))
+        events.append(fleet.ChaosEvent(at_s=heal, action="restore",
+                                       target=ev.target % 3))
+        last_restore = max(last_restore, heal)
+    faulted = fleet.FleetSim(fc, trace, chaos_events=events).run()
+    tail_clean = fleet.attainment_over(clean["completions"],
+                                       last_restore)
+    tail_faulted = fleet.attainment_over(faulted["completions"],
+                                         last_restore)
+    tokens = lambda rep: sum(e["tokens"] for e in rep["completions"])  # noqa: E731
+    recovered = (tail_clean is None or tail_faulted is None
+                 or tail_faulted >= tail_clean)
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(trace),
+        "flaps": len(plan.events),
+        "requeues": faulted["router"]["requeues"],
+        "tail_attainment_clean": tail_clean,
+        "tail_attainment_faulted": tail_faulted,
+        "ok": bool(faulted["ok"] and clean["ok"]
+                   and tokens(faulted) == tokens(clean)
+                   and recovered),
+    }
+
+
+@_scenario("fleet-preemption",
+           "a serving replica (real engines) preempted mid-traffic; "
+           "the router drains + requeues via the slot-failure "
+           "machinery, streams stay identical to fault-free, and "
+           "SLO attainment recovers to baseline", needs_jax=True,
+           slow=True)
+def _scenario_fleet_preemption(seed: int) -> dict:
+    import jax
+
+    from kind_tpu_sim import fleet
+    from kind_tpu_sim.models import transformer as tf
+    from kind_tpu_sim.models.serving import (
+        ServingConfig,
+        ServingEngine,
+    )
+
+    plan = ChaosSchedule(seed).plan(kinds=("replica_preempt",),
+                                    n_faults=1, horizon=4, targets=2)
+    target = plan.events[0].target % 2
+    cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                         n_layers=2, d_ff=64, max_seq=64)
+    params = tf.init_params(jax.random.PRNGKey(0), cfg)
+    spec = fleet.WorkloadSpec(process="poisson", rps=150.0,
+                              n_requests=14, prompt_len=(3, 8),
+                              max_new=(6, 12), vocab=cfg.vocab_size)
+    trace = fleet.generate_trace(spec, seed)
+    tick = 0.05
+
+    def run(events):
+        clock = fleet.VirtualClock()
+
+        def factory(rid):
+            return fleet.EngineReplica(rid, ServingEngine(
+                params, cfg,
+                ServingConfig(max_slots=2, max_len=48, chunk=4),
+                clock=clock.now))
+
+        fc = fleet.FleetConfig(replicas=2, policy="round-robin",
+                               tick_s=tick,
+                               slo=fleet.SloPolicy(ttft_s=1.0,
+                                                   e2e_s=5.0))
+        return fleet.FleetSim(fc, trace, replica_factory=factory,
+                              chaos_events=events,
+                              clock=clock).run()
+
+    clean = run([])
+    # preempt just after a mid-trace dispatch onto the target
+    # replica: the runs are identical up to that instant, so the
+    # victim provably holds in-flight work — the displacement (and
+    # its requeue) is guaranteed, not seed-lucky
+    victim_disp = sorted(
+        e["dispatch_s"] for e in clean["completions"]
+        if e["replica"] == target)
+    at = (victim_disp[len(victim_disp) // 4] + tick / 2
+          if victim_disp else tick)
+    restore = at + 4 * tick
+    faulted = run([
+        fleet.ChaosEvent(at_s=round(at, 6), action="preempt",
+                         target=target),
+        fleet.ChaosEvent(at_s=round(restore, 6), action="restore",
+                         target=target),
+    ])
+    crc = lambda rep: {e["request_id"]: e["tokens_crc"]  # noqa: E731
+                       for e in rep["completions"]}
+    tail_clean = fleet.attainment_over(clean["completions"], restore)
+    tail_faulted = fleet.attainment_over(faulted["completions"],
+                                         restore)
+    recovered = (tail_clean is None or tail_faulted is None
+                 or tail_faulted >= tail_clean)
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(trace),
+        "preempted_replica": target,
+        "preempt_at_s": round(at, 6),
+        "requeues": faulted["router"]["requeues"],
+        "streams_identical": crc(faulted) == crc(clean),
+        "tail_attainment_clean": tail_clean,
+        "tail_attainment_faulted": tail_faulted,
+        "ok": bool(faulted["ok"] and clean["ok"]
+                   and crc(faulted) == crc(clean)
+                   and faulted["router"]["requeues"] >= 1
+                   and recovered),
     }
 
 

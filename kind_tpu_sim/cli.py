@@ -236,6 +236,70 @@ def build_parser() -> argparse.ArgumentParser:
     )
     jsmoke.add_argument("--json", action="store_true", dest="as_json")
 
+    fl = sub.add_parser(
+        "fleet",
+        help=(
+            "deterministic multi-replica serving fleet simulator: "
+            "seeded open-loop traffic over N replicas with SLO-aware "
+            "routing and optional autoscaling, on a virtual clock — "
+            "same seed, byte-identical report (docs/FLEET.md)"
+        ),
+    )
+    fl.add_argument("action", choices=["run", "trace"])
+    fl.add_argument(
+        "--seed", type=int, default=None,
+        help="workload seed (default: KIND_TPU_SIM_FLEET_SEED or 0)")
+    fl.add_argument("--replicas", type=int, default=2)
+    fl.add_argument(
+        "--policy", default="round-robin",
+        choices=["round-robin", "least-outstanding",
+                 "prefix-affinity"])
+    fl.add_argument(
+        "--rps", type=float, default=100.0,
+        help="mean arrival rate (requests per virtual second)")
+    fl.add_argument("--requests", type=int, default=200)
+    fl.add_argument(
+        "--process", default="poisson",
+        choices=["poisson", "bursty", "diurnal"])
+    fl.add_argument(
+        "--engine", default="sim", choices=["sim", "serving"],
+        help=(
+            "sim: analytic replicas (instant, no jax); serving: real "
+            "ServingEngine replicas on the virtual clock (real token "
+            "streams, needs jax)"
+        ),
+    )
+    fl.add_argument(
+        "--deadline-s", type=float, default=None,
+        help="per-request e2e budget (virtual s); expired requests "
+             "finish as deadline_exceeded")
+    fl.add_argument("--ttft-slo", type=float, default=0.5)
+    fl.add_argument("--e2e-slo", type=float, default=2.0)
+    fl.add_argument(
+        "--shared-prefix-frac", type=float, default=0.0,
+        help="fraction of requests in shared-prefix groups (the "
+             "prefix-affinity policy's hit population)")
+    fl.add_argument("--prefix-groups", type=int, default=4)
+    fl.add_argument(
+        "--autoscale", action="store_true",
+        help="enable the queue/SLO-driven autoscaler "
+             "(--replicas becomes the floor)")
+    fl.add_argument("--max-replicas", type=int, default=8)
+    fl.add_argument(
+        "--tick-s", type=float, default=None,
+        help="virtual scheduling quantum "
+             "(default: KIND_TPU_SIM_FLEET_TICK_S or 0.01)")
+    fl.add_argument(
+        "--trace-file", default=None,
+        help="replay this JSONL trace instead of generating one")
+    fl.add_argument(
+        "--save-trace", default=None,
+        help="also write the generated trace to this JSONL file")
+    fl.add_argument(
+        "--out", default=None,
+        help="write the full JSON report to this file")
+    fl.add_argument("--json", action="store_true", dest="as_json")
+
     man = sub.add_parser(
         "manifests",
         help=(
@@ -461,6 +525,110 @@ def run_chaos_engine(args: argparse.Namespace) -> int:
                   f"{'OK' if rep['ok'] else 'FAILED'}  [{events}]")
         print("CHAOS RUN " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
+
+
+def run_fleet(args: argparse.Namespace) -> int:
+    """`fleet run` / `fleet trace`: the deterministic multi-replica
+    serving simulator (docs/FLEET.md). Everything advances on a
+    virtual clock; the JSON report (sorted keys) is byte-identical
+    across runs of the same seed+config — the reproducibility
+    contract `--seed` promises."""
+    from kind_tpu_sim import fleet
+
+    seed = fleet.resolve_seed(args.seed)
+    spec = fleet.WorkloadSpec(
+        process=args.process, rps=args.rps,
+        n_requests=args.requests,
+        shared_prefix_frac=args.shared_prefix_frac,
+        prefix_groups=args.prefix_groups,
+        deadline_s=args.deadline_s)
+    if args.trace_file:
+        trace = fleet.load_trace(args.trace_file)
+    else:
+        trace = fleet.generate_trace(spec, seed)
+    if args.save_trace:
+        fleet.save_trace(args.save_trace, trace)
+    if args.action == "trace":
+        if not args.save_trace:
+            for req in trace:
+                print(json.dumps(req.as_dict(), sort_keys=True))
+        else:
+            print(f"wrote {len(trace)} requests to "
+                  f"{args.save_trace}")
+        return 0
+
+    fc = fleet.FleetConfig(
+        replicas=args.replicas, policy=args.policy,
+        tick_s=args.tick_s, autoscale=args.autoscale,
+        slo=fleet.SloPolicy(ttft_s=args.ttft_slo,
+                            e2e_s=args.e2e_slo),
+        autoscaler=fleet.AutoscalerConfig(
+            min_replicas=args.replicas,
+            max_replicas=args.max_replicas))
+    clock = fleet.VirtualClock()
+    factory = None
+    if args.engine == "serving":
+        import jax
+
+        from kind_tpu_sim.models import transformer as tf
+        from kind_tpu_sim.models.serving import (
+            ServingConfig,
+            ServingEngine,
+        )
+
+        cfg = tf.ModelConfig(vocab_size=64, d_model=32, n_heads=2,
+                             n_layers=2, d_ff=64, max_seq=128)
+        params = tf.init_params(jax.random.PRNGKey(0), cfg)
+        sc = ServingConfig(max_slots=4, max_len=128, chunk=8,
+                           max_queue=64)
+        vocab = cfg.vocab_size
+
+        def factory(rid):
+            return fleet.EngineReplica(rid, ServingEngine(
+                params, cfg, sc, clock=clock.now))
+
+        bad = [r for r in trace
+               if max(r.prompt) >= vocab
+               or len(r.prompt) + r.max_new > sc.max_len]
+        if bad:
+            raise SystemExit(
+                f"{len(bad)} trace request(s) exceed the serving "
+                f"engine's vocab={vocab}/max_len={sc.max_len} "
+                "envelope; regenerate the trace within it")
+    report = fleet.FleetSim(fc, trace, replica_factory=factory,
+                            clock=clock).run()
+    report["seed"] = seed
+    report["engine"] = args.engine
+    text = json.dumps(report, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    if args.as_json:
+        print(text)
+    else:
+        slo = report["slo"]
+        print(f"fleet: {report['requests']} requests, "
+              f"{args.policy} over {args.replicas} replica(s), "
+              f"seed {seed}, engine {args.engine}")
+        print(f"  attainment {slo['attainment']}  "
+              f"goodput {slo.get('goodput_tok_s')} tok/s  "
+              f"throughput {slo.get('throughput_tok_s')} tok/s")
+        ttft, e2e = slo["ttft"], slo["e2e"]
+        if ttft.get("count"):
+            print(f"  ttft p50/p90/p99 {ttft['p50_s']}/"
+                  f"{ttft['p90_s']}/{ttft['p99_s']} s  "
+                  f"e2e p99 {e2e['p99_s']} s")
+        print(f"  shed {slo['shed']}  deadline_exceeded "
+              f"{slo['deadline_exceeded']}  requeues "
+              f"{report['router']['requeues']}")
+        if "autoscaler" in report:
+            a = report["autoscaler"]
+            print(f"  autoscaler: +{a['scale_ups']}/-"
+                  f"{a['scale_downs']} (warmup {a['warmup_s']}s)")
+        if args.out:
+            print(f"  report -> {args.out}")
+        print("FLEET RUN " + ("OK" if report["ok"] else "FAILED"))
+    return 0 if report["ok"] else 1
 
 
 def run_manifests(args: argparse.Namespace) -> int:
@@ -760,6 +928,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_train_smoke(args)
         if args.command == "manifests":
             return run_manifests(args)
+        if args.command == "fleet":
+            return run_fleet(args)
         if args.command == "profile":
             return run_profile(args)
         if args.command == "chaos" and args.action in ("run", "soak"):

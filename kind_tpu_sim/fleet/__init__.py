@@ -1,0 +1,51 @@
+"""Deterministic multi-replica serving fleet simulator (docs/FLEET.md).
+
+The layer above ``models/serving.py``: seeded open-loop load
+generation (loadgen), SLO-aware routing over N replicas (router),
+streaming percentile/attainment/goodput accounting (slo), and
+queue/SLO-driven autoscaling with modeled warm-up (autoscaler), all
+advanced by one virtual-clock tick loop (sim). Same seed, same
+config => byte-identical completion logs and SLO reports.
+
+Knobs: KIND_TPU_SIM_FLEET_SEED (loadgen.resolve_seed),
+KIND_TPU_SIM_FLEET_TICK_S (sim.resolve_tick_s),
+KIND_TPU_SIM_FLEET_WARMUP_S (autoscaler.resolve_warmup_s).
+"""
+
+from kind_tpu_sim.fleet.autoscaler import (  # noqa: F401
+    Autoscaler,
+    AutoscalerConfig,
+    ScaleEvent,
+    resolve_warmup_s,
+)
+from kind_tpu_sim.fleet.loadgen import (  # noqa: F401
+    FLEET_SEED_ENV,
+    TraceRequest,
+    VirtualClock,
+    WorkloadSpec,
+    generate_trace,
+    load_trace,
+    resolve_seed,
+    save_trace,
+)
+from kind_tpu_sim.fleet.router import (  # noqa: F401
+    POLICIES,
+    EngineReplica,
+    ReplicaCompletion,
+    Router,
+    SimReplica,
+    SimReplicaConfig,
+)
+from kind_tpu_sim.fleet.sim import (  # noqa: F401
+    ChaosEvent,
+    FleetConfig,
+    FleetSim,
+    attainment_over,
+    resolve_tick_s,
+)
+from kind_tpu_sim.fleet.slo import (  # noqa: F401
+    FixedBucketHistogram,
+    SloPolicy,
+    SloTracker,
+    brute_force_percentile,
+)

@@ -1,0 +1,157 @@
+"""Queue-depth / SLO-driven replica autoscaling on the virtual clock.
+
+The control loop the fleet simulator evaluates: scale up when backlog
+per healthy replica stays above a threshold, scale down when the
+fleet is comfortably attaining its SLO with spare capacity — with the
+two classic guards against flapping baked in as explicit knobs:
+
+* **breach persistence** — a threshold must be breached for
+  ``breach_evals`` CONSECUTIVE evaluations before any action (one
+  bursty tick is noise, not a trend);
+* **cooldown** — after any action, no further action for
+  ``cooldown_s`` of virtual time (the system must be allowed to
+  absorb the capacity change it just made before being judged again).
+
+Scale-up is not free: a new replica only becomes routable after
+``warmup_s`` of virtual time — modeled from the measured warm-path
+bring-up numbers (docs/PERFORMANCE.md: ~0.55 s stack-ready on the
+persistent worker pool; override with ``KIND_TPU_SIM_FLEET_WARMUP_S``
+to model cold starts). Scale-down drains: the victim replica stops
+receiving traffic immediately but is only removed once idle, so no
+request is ever displaced by a scale decision (only chaos does that).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+from kind_tpu_sim import metrics
+
+WARMUP_ENV = "KIND_TPU_SIM_FLEET_WARMUP_S"
+DEFAULT_WARMUP_S = 0.55  # measured warm bring-up (docs/PERFORMANCE.md)
+
+
+def resolve_warmup_s(value: Optional[float] = None) -> float:
+    """Explicit value > env (KIND_TPU_SIM_FLEET_WARMUP_S) > the
+    measured default."""
+    if value is not None:
+        return float(value)
+    try:
+        return float(os.environ.get(WARMUP_ENV, DEFAULT_WARMUP_S))
+    except ValueError:
+        return DEFAULT_WARMUP_S
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalerConfig:
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # backlog per healthy replica (router queue + replica
+    # outstanding, averaged) that triggers scale-up
+    up_backlog: float = 8.0
+    # ... and the comfort level below which scale-down is considered
+    down_backlog: float = 1.0
+    # recent SLO attainment below this also argues for scale-up
+    # (None = queue-depth only)
+    min_attainment: Optional[float] = 0.9
+    breach_evals: int = 3
+    cooldown_s: float = 1.0
+    warmup_s: Optional[float] = None  # None -> resolve_warmup_s()
+
+
+@dataclasses.dataclass(frozen=True)
+class ScaleEvent:
+    at_s: float
+    action: str        # scale_up | scale_down | replica_ready
+    replicas: int      # routable replicas AFTER the action
+    reason: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Autoscaler:
+    """Pure decision logic: the fleet driver feeds it one observation
+    per evaluation interval and enacts whatever it returns. Keeping
+    it side-effect-free (no replica construction in here) is what
+    makes the hysteresis testable without a fleet."""
+
+    def __init__(self, cfg: AutoscalerConfig = AutoscalerConfig()):
+        self.cfg = cfg
+        self.warmup_s = resolve_warmup_s(cfg.warmup_s)
+        self.events: List[ScaleEvent] = []
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_action_s = -1e18
+        self._warming = 0  # replicas paid for but not yet routable
+
+    def note_ready(self, at_s: float, replicas: int) -> None:
+        """The driver reports a warming replica became routable."""
+        self._warming = max(0, self._warming - 1)
+        self.events.append(ScaleEvent(
+            at_s=round(at_s, 6), action="replica_ready",
+            replicas=replicas, reason="warmup complete"))
+        metrics.fleet_board().incr("replicas_ready")
+
+    def evaluate(self, now: float, *, routable: int,
+                 backlog: float,
+                 attainment: Optional[float]) -> Optional[str]:
+        """One control-loop step. ``routable`` counts healthy,
+        non-draining replicas; ``backlog`` is total waiting+running
+        requests; ``attainment`` is the recent SLO attainment (None
+        before any completion). Returns 'scale_up' / 'scale_down' /
+        None; the driver enacts it and the warming replica is
+        counted here so repeated evaluations during warm-up don't
+        pile on more scale-ups."""
+        cfg = self.cfg
+        per = backlog / max(1, routable + self._warming)
+        slo_breach = (cfg.min_attainment is not None
+                      and attainment is not None
+                      and attainment < cfg.min_attainment)
+        if per > cfg.up_backlog or slo_breach:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif per < cfg.down_backlog and not slo_breach:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = 0
+            self._down_streak = 0
+        if now - self._last_action_s < cfg.cooldown_s:
+            return None
+        total = routable + self._warming
+        if (self._up_streak >= cfg.breach_evals
+                and total < cfg.max_replicas):
+            self._up_streak = 0
+            self._last_action_s = now
+            self._warming += 1
+            reason = ("slo_attainment" if slo_breach
+                      else "queue_backlog")
+            self.events.append(ScaleEvent(
+                at_s=round(now, 6), action="scale_up",
+                replicas=total + 1, reason=reason))
+            metrics.fleet_board().incr("scale_up")
+            return "scale_up"
+        if (self._down_streak >= cfg.breach_evals
+                and total > cfg.min_replicas and routable > 1):
+            self._down_streak = 0
+            self._last_action_s = now
+            self.events.append(ScaleEvent(
+                at_s=round(now, 6), action="scale_down",
+                replicas=total - 1, reason="idle_capacity"))
+            metrics.fleet_board().incr("scale_down")
+            return "scale_down"
+        return None
+
+    def report(self) -> Dict[str, object]:
+        ups = sum(1 for e in self.events if e.action == "scale_up")
+        downs = sum(1 for e in self.events
+                    if e.action == "scale_down")
+        return {
+            "warmup_s": self.warmup_s,
+            "scale_ups": ups,
+            "scale_downs": downs,
+            "events": [e.as_dict() for e in self.events],
+        }

@@ -1,0 +1,230 @@
+"""Seeded open-loop workload generation on a virtual clock.
+
+The fleet simulator's traffic source. Everything here is a pure
+function of (spec, seed): the arrival process, the sampled prompt and
+output lengths, the shared-prefix structure, and the per-request
+sampling seeds are all drawn from one ``random.Random`` stream keyed
+by the canonical argument repr (the ChaosSchedule recipe), so the same
+seed yields BIT-IDENTICAL traffic — the determinism contract every
+fleet report and chaos invariant rests on.
+
+Open-loop means arrivals do not wait for completions (the
+Poisson-arrival serving-benchmark shape): a saturated fleet keeps
+receiving requests, which is exactly the regime SLO attainment,
+shedding, and autoscaling are about. Three arrival processes:
+
+* ``poisson``  — exponential inter-arrivals at ``rps``.
+* ``bursty``   — on/off modulation: bursts of ``burst_factor * rps``
+                 alternating with quiet valleys (mean rate ~ rps).
+* ``diurnal``  — a sinusoidal rate profile over the trace duration
+                 (the compressed day/night cycle autoscalers chase).
+
+Traces round-trip through JSON lines (:func:`save_trace` /
+:func:`load_trace`) so a generated workload can be replayed against a
+different policy/replica count — same requests, different fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import random
+import zlib
+from typing import List, Optional, Sequence
+
+FLEET_SEED_ENV = "KIND_TPU_SIM_FLEET_SEED"
+
+
+def resolve_seed(seed: Optional[int] = None) -> int:
+    """Explicit seed > env (KIND_TPU_SIM_FLEET_SEED) > 0."""
+    if seed is not None:
+        return int(seed)
+    try:
+        return int(os.environ.get(FLEET_SEED_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+class VirtualClock:
+    """The fleet's notion of time: starts at 0.0, only moves when the
+    simulator advances it. Every latency the fleet reports (TTFT,
+    TPOT, e2e, deadline expiry, autoscaler warm-up) is measured on
+    THIS clock, never the wall — which is what makes two runs of the
+    same seed byte-identical regardless of host load."""
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"virtual time cannot rewind (dt={dt})")
+        self._now += dt
+        return self._now
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceRequest:
+    """One generated request: ``arrival_s`` is virtual time;
+    ``prefix_group`` >= 0 marks membership in a shared-prompt-prefix
+    cohort (the prefix-affinity router's routing key and the
+    PrefixCache's hit population); ``deadline_s`` is the per-request
+    e2e budget relative to arrival (None = no deadline)."""
+
+    request_id: str
+    arrival_s: float
+    prompt: tuple
+    max_new: int
+    seed: int
+    prefix_group: int = -1
+    deadline_s: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["prompt"] = list(self.prompt)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceRequest":
+        d = dict(d)
+        d["prompt"] = tuple(d["prompt"])
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadSpec:
+    """Knobs of one generated workload. ``process`` is the arrival
+    model; lengths are drawn uniform in [lo, hi] (closed); a
+    ``shared_prefix_frac`` of requests get a group-common prompt
+    prefix of ``prefix_len`` tokens drawn per group."""
+
+    process: str = "poisson"        # poisson | bursty | diurnal
+    rps: float = 50.0               # mean arrival rate (requests/s)
+    n_requests: int = 100
+    prompt_len: Sequence[int] = (4, 24)
+    max_new: Sequence[int] = (4, 16)
+    vocab: int = 64
+    shared_prefix_frac: float = 0.0  # fraction of requests in groups
+    prefix_groups: int = 4
+    prefix_len: int = 8
+    deadline_s: Optional[float] = None  # uniform per-request budget
+    burst_factor: float = 4.0       # bursty: peak rate multiplier
+    burst_period_s: float = 2.0     # bursty: one on+off cycle
+    diurnal_period_s: float = 20.0  # diurnal: one day (compressed)
+
+    PROCESSES = ("poisson", "bursty", "diurnal")
+
+
+def _spec_rng(spec: WorkloadSpec, seed: int) -> random.Random:
+    key = repr((seed, spec.process, spec.rps, spec.n_requests,
+                tuple(spec.prompt_len), tuple(spec.max_new),
+                spec.vocab, spec.shared_prefix_frac,
+                spec.prefix_groups, spec.prefix_len, spec.deadline_s,
+                spec.burst_factor, spec.burst_period_s,
+                spec.diurnal_period_s))
+    return random.Random(zlib.crc32(key.encode("utf-8")))
+
+
+def _rate_at(spec: WorkloadSpec, t: float) -> float:
+    """Instantaneous arrival rate of the modulated processes (the
+    thinning envelope); constant for poisson."""
+    if spec.process == "poisson":
+        return spec.rps
+    if spec.process == "bursty":
+        # on/off with duty cycle 1/burst_factor: bursts run at
+        # burst_factor * rps, valleys are silent, mean is EXACTLY rps
+        phase = (t % spec.burst_period_s) / spec.burst_period_s
+        duty = 1.0 / max(1.0, spec.burst_factor)
+        return (spec.rps * max(1.0, spec.burst_factor)
+                if phase < duty else 0.0)
+    if spec.process == "diurnal":
+        # raised cosine: peaks at mid-period, valleys at the edges,
+        # mean exactly rps
+        phase = (t % spec.diurnal_period_s) / spec.diurnal_period_s
+        return spec.rps * (1.0 - math.cos(2 * math.pi * phase))
+    raise ValueError(
+        f"unknown arrival process {spec.process!r}; known: "
+        f"{', '.join(WorkloadSpec.PROCESSES)}")
+
+
+def generate_trace(spec: WorkloadSpec,
+                   seed: Optional[int] = None) -> List[TraceRequest]:
+    """The seeded trace: ``n_requests`` arrivals via Lewis thinning
+    against the process's peak rate (exact for poisson, and the one
+    algorithm that serves all three processes from one stream), each
+    with sampled prompt/output lengths, an explicit per-request
+    sampling seed (replayable through a real engine), and optional
+    shared-prefix group membership."""
+    if spec.process not in WorkloadSpec.PROCESSES:
+        raise ValueError(
+            f"unknown arrival process {spec.process!r}; known: "
+            f"{', '.join(WorkloadSpec.PROCESSES)}")
+    if spec.rps <= 0:
+        raise ValueError(f"rps must be > 0 (got {spec.rps})")
+    seed = resolve_seed(seed)
+    rng = _spec_rng(spec, seed)
+    # thinning envelope: each process's peak instantaneous rate
+    if spec.process == "bursty":
+        peak = spec.rps * max(1.0, spec.burst_factor)
+    elif spec.process == "diurnal":
+        peak = 2.0 * spec.rps  # raised-cosine max
+    else:
+        peak = spec.rps
+    group_prefixes = [
+        tuple(rng.randrange(spec.vocab)
+              for _ in range(spec.prefix_len))
+        for _ in range(max(1, spec.prefix_groups))]
+    out: List[TraceRequest] = []
+    t = 0.0
+    i = 0
+    while len(out) < spec.n_requests:
+        t += rng.expovariate(peak)
+        if rng.random() * peak > _rate_at(spec, t):
+            continue  # thinned: outside this instant's rate envelope
+        p_len = rng.randint(*spec.prompt_len)
+        grouped = (spec.shared_prefix_frac > 0
+                   and rng.random() < spec.shared_prefix_frac)
+        group = (rng.randrange(max(1, spec.prefix_groups))
+                 if grouped else -1)
+        if grouped:
+            prefix = group_prefixes[group]
+            body_len = max(1, p_len - len(prefix))
+            prompt = prefix + tuple(
+                rng.randrange(spec.vocab) for _ in range(body_len))
+        else:
+            prompt = tuple(rng.randrange(spec.vocab)
+                           for _ in range(max(1, p_len)))
+        out.append(TraceRequest(
+            request_id=f"f{i:05d}",
+            arrival_s=round(t, 6),
+            prompt=prompt,
+            max_new=rng.randint(*spec.max_new),
+            seed=rng.randrange(2 ** 31),
+            prefix_group=group,
+            deadline_s=spec.deadline_s,
+        ))
+        i += 1
+    return out
+
+
+def save_trace(path: str, trace: Sequence[TraceRequest]) -> None:
+    """One JSON object per line, keys sorted — a byte-stable artifact
+    (diffable across runs of the same seed)."""
+    with open(path, "w", encoding="utf-8") as fh:
+        for req in trace:
+            fh.write(json.dumps(req.as_dict(), sort_keys=True))
+            fh.write("\n")
+
+
+def load_trace(path: str) -> List[TraceRequest]:
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(TraceRequest.from_dict(json.loads(line)))
+    return out

@@ -1,0 +1,502 @@
+"""SLO-aware routing over N serving replicas (the fleet data plane).
+
+Two replica flavors behind one interface:
+
+* :class:`SimReplica` — an analytic continuous-batching model on the
+  virtual clock (prefill = base + per-token, decode = TPOT per token,
+  ``max_slots`` concurrency, admission at tick boundaries — the same
+  scheduling shape as ``ServingEngine`` without the matmuls). This is
+  the SCALE-Sim move: fleet questions (replicas vs tail latency,
+  policy vs goodput) become testable in milliseconds on any host.
+* :class:`EngineReplica` — a real ``models/serving.ServingEngine``
+  driven one ``step_round()`` per tick with its latency clocks bound
+  to the virtual clock, so real token streams flow under fleet
+  traffic and the chaos scenarios exercise the true slot-failure
+  recovery machinery.
+
+:class:`Router` implements the balancing policies (round-robin,
+least-outstanding, prefix-affinity over the shared-prefix cohorts),
+per-request deadlines while queued, and admission control: a bounded
+central queue sheds loudly (the fleet face of the engine's
+``EngineSaturated``), and a replica that refuses a submit (its own
+``max_queue``) just falls back to the next candidate. A failed
+replica's displaced requests requeue at the FRONT of the central
+queue — recovery preserves FCFS as seen by the survivors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+from kind_tpu_sim import metrics
+from kind_tpu_sim.fleet.loadgen import TraceRequest
+
+POLICIES = ("round-robin", "least-outstanding", "prefix-affinity")
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaCompletion:
+    """One request's terminal outcome at a replica, on the virtual
+    clock. ``tokens_crc`` fingerprints the emitted stream (crc32 of
+    the token list for engine replicas; of (request_id, seed, tokens)
+    for sim replicas) so stream-identity invariants don't require
+    dumping every token into the log."""
+
+    request: TraceRequest
+    dispatch_s: float
+    first_s: Optional[float]
+    finish_s: float
+    tokens: int
+    tokens_crc: int
+    finish_reason: str  # length | stop | deadline_exceeded
+
+
+@dataclasses.dataclass(frozen=True)
+class SimReplicaConfig:
+    """The analytic replica's service model. Defaults approximate the
+    repo's measured small-model serving numbers (docs/PERFORMANCE.md)
+    scaled to round figures; fleet conclusions should come from
+    RELATIVE comparisons at fixed config, not these absolutes."""
+
+    max_slots: int = 4
+    prefill_base_s: float = 0.010
+    prefill_per_tok_s: float = 0.001
+    tpot_s: float = 0.005
+    max_queue: int = 64          # submit() refuses beyond this
+    prefix_cache_entries: int = 8  # prefix groups remembered (0=off)
+
+
+class SimReplica:
+    """Deterministic service-time model of one continuous-batching
+    engine. Slots run independent (prefill -> decode) timelines inside
+    each tick; admission and queue-deadline reaping happen at tick
+    boundaries, like the engine's chunk-boundary scheduling."""
+
+    def __init__(self, replica_id: int,
+                 cfg: SimReplicaConfig = SimReplicaConfig()):
+        self.replica_id = replica_id
+        self.cfg = cfg
+        self.healthy = True
+        self.queue: List[TraceRequest] = []
+        self._slots: List[Optional[dict]] = [None] * cfg.max_slots
+        # group id -> True, LRU-bounded: the PrefixCache stand-in
+        # (a hit skips the group prefix's share of prefill time)
+        self._prefix_seen: Dict[int, bool] = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
+    # -- replica interface -------------------------------------------
+
+    def outstanding(self) -> int:
+        return (len(self.queue)
+                + sum(1 for s in self._slots if s is not None))
+
+    def idle(self) -> bool:
+        return self.outstanding() == 0
+
+    def submit(self, req: TraceRequest, now: float) -> bool:
+        if not self.healthy:
+            return False
+        if (self.cfg.max_queue
+                and len(self.queue) >= self.cfg.max_queue):
+            return False
+        self.queue.append(req)
+        return True
+
+    def _prefill_cost(self, req: TraceRequest) -> float:
+        """Full-prompt prefill time, minus the cached prefix share on
+        a group hit (the PrefixCache analog, group-granular)."""
+        toks = len(req.prompt)
+        if (self.cfg.prefix_cache_entries > 0
+                and req.prefix_group >= 0):
+            if req.prefix_group in self._prefix_seen:
+                self.prefix_hits += 1
+                # LRU refresh, like PrefixCache.lookup's move_to_end
+                self._prefix_seen.pop(req.prefix_group)
+                self._prefix_seen[req.prefix_group] = True
+                # suffix-only prefill: the group prefix's tokens are
+                # already cached rows (serving._suffix_into_slot)
+                toks = max(1, toks - self._group_prefix_len(req))
+            else:
+                self.prefix_misses += 1
+                self._prefix_seen[req.prefix_group] = True
+                while (len(self._prefix_seen)
+                       > self.cfg.prefix_cache_entries):
+                    self._prefix_seen.pop(
+                        next(iter(self._prefix_seen)))
+        return (self.cfg.prefill_base_s
+                + self.cfg.prefill_per_tok_s * toks)
+
+    @staticmethod
+    def _group_prefix_len(req: TraceRequest) -> int:
+        """Shared-prefix length: the loadgen contract says grouped
+        prompts share their leading segment; we credit at most half
+        the prompt so a hit never zeroes prefill entirely."""
+        return min(len(req.prompt) // 2, 16)
+
+    def tick(self, now: float, dt: float) -> List[ReplicaCompletion]:
+        """Advance this replica's slots through [now, now + dt)."""
+        if not self.healthy:
+            return []
+        done: List[ReplicaCompletion] = []
+        # reap queued requests whose deadline passed while waiting
+        still: List[TraceRequest] = []
+        for req in self.queue:
+            if (req.deadline_s is not None
+                    and now >= req.arrival_s + req.deadline_s):
+                done.append(ReplicaCompletion(
+                    request=req, dispatch_s=now, first_s=None,
+                    finish_s=round(req.arrival_s + req.deadline_s, 9),
+                    tokens=0, tokens_crc=0,
+                    finish_reason="deadline_exceeded"))
+            else:
+                still.append(req)
+        self.queue = still
+        # admit into free slots (tick boundary = chunk boundary)
+        for i, slot in enumerate(self._slots):
+            if slot is None and self.queue:
+                req = self.queue.pop(0)
+                self._slots[i] = {
+                    "req": req,
+                    "dispatch_s": now,
+                    "prefill_left": self._prefill_cost(req),
+                    "first_s": None,
+                    "tokens": 0,
+                    "t": now,  # slot-local timeline cursor
+                }
+        # advance each slot's local timeline to now + dt
+        end = now + dt
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            req = slot["req"]
+            deadline = (req.arrival_s + req.deadline_s
+                        if req.deadline_s is not None else None)
+            while slot["t"] < end:
+                if slot["prefill_left"] > 0:
+                    step = min(slot["prefill_left"],
+                               end - slot["t"])
+                    slot["prefill_left"] -= step
+                    slot["t"] += step
+                    if slot["prefill_left"] <= 1e-12:
+                        slot["prefill_left"] = 0.0
+                        slot["first_s"] = slot["t"]
+                        slot["tokens"] = 1
+                    continue
+                nxt = slot["t"] + self.cfg.tpot_s
+                if deadline is not None and nxt > deadline:
+                    done.append(self._complete(
+                        slot, finish_s=deadline,
+                        reason="deadline_exceeded"))
+                    self._slots[i] = None
+                    break
+                if nxt > end:
+                    slot["t"] = end
+                    break
+                slot["t"] = nxt
+                slot["tokens"] += 1
+                if slot["tokens"] >= req.max_new:
+                    done.append(self._complete(
+                        slot, finish_s=slot["t"], reason="length"))
+                    self._slots[i] = None
+                    break
+            else:
+                continue
+        # a slot that finished mid-tick stays empty until the next
+        # tick's admission pass — the chunk-boundary contract
+        return done
+
+    def _complete(self, slot: dict, finish_s: float,
+                  reason: str) -> ReplicaCompletion:
+        req = slot["req"]
+        crc = zlib.crc32(repr((req.request_id, req.seed,
+                               slot["tokens"])).encode("utf-8"))
+        return ReplicaCompletion(
+            request=req,
+            dispatch_s=round(slot["dispatch_s"], 9),
+            first_s=(round(slot["first_s"], 9)
+                     if slot["first_s"] is not None else None),
+            finish_s=round(finish_s, 9),
+            tokens=slot["tokens"],
+            tokens_crc=crc,
+            finish_reason=reason)
+
+    def fail(self, now: float) -> List[TraceRequest]:
+        """Preempt this replica: every queued and in-flight request
+        is displaced (returned for the router to requeue), the
+        prefix cache is lost, and the replica refuses traffic until
+        :meth:`restore`."""
+        displaced = list(self.queue)
+        displaced.extend(s["req"] for s in self._slots
+                         if s is not None)
+        self.queue = []
+        self._slots = [None] * self.cfg.max_slots
+        self._prefix_seen.clear()
+        self.healthy = False
+        return displaced
+
+    def restore(self, now: float) -> None:
+        self.healthy = True
+
+    def report(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "kind": "sim",
+            "healthy": self.healthy,
+            "outstanding": self.outstanding(),
+        }
+        if self.prefix_hits or self.prefix_misses:
+            out["prefix"] = {"hits": self.prefix_hits,
+                             "misses": self.prefix_misses}
+        return out
+
+
+class EngineReplica:
+    """A real ``ServingEngine`` as a fleet replica: one
+    ``step_round()`` per tick, completions mapped back to virtual
+    time via the engine's (virtual-clock-bound) latency stamps, and
+    ``fail()`` driving the engine's slot-failure machinery so the
+    chaos scenarios exercise the REAL recovery path."""
+
+    def __init__(self, replica_id: int, engine):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.healthy = True
+        self._dispatched: Dict[str, TraceRequest] = {}
+        self._dispatch_s: Dict[str, float] = {}
+
+    def outstanding(self) -> int:
+        return self.engine.outstanding()
+
+    def idle(self) -> bool:
+        return self.outstanding() == 0
+
+    def submit(self, req: TraceRequest, now: float) -> bool:
+        from kind_tpu_sim.models.serving import (
+            EngineSaturated,
+            Request,
+        )
+
+        if not self.healthy:
+            return False
+        try:
+            self.engine.submit(Request(
+                request_id=req.request_id,
+                prompt=list(req.prompt),
+                max_new=req.max_new,
+                seed=req.seed,
+                deadline_s=req.deadline_s,
+                cache_prefix=req.prefix_group >= 0,
+            ))
+        except EngineSaturated:
+            return False
+        self._dispatched[req.request_id] = req
+        self._dispatch_s[req.request_id] = now
+        return True
+
+    def tick(self, now: float, dt: float) -> List[ReplicaCompletion]:
+        if not self.healthy:
+            return []
+        if not self.idle():
+            self.engine.step_round()
+        out = []
+        for c in self.engine.poll():
+            req = self._dispatched.pop(c.request_id)
+            disp = self._dispatch_s.pop(c.request_id)
+            crc = zlib.crc32(repr(tuple(c.tokens)).encode("utf-8"))
+            first = (disp + c.ttft_s if c.ttft_s is not None
+                     and c.tokens else None)
+            out.append(ReplicaCompletion(
+                request=req,
+                dispatch_s=round(disp, 9),
+                first_s=(round(first, 9)
+                         if first is not None else None),
+                finish_s=round(disp + (c.e2e_s or 0.0), 9),
+                tokens=len(c.tokens),
+                tokens_crc=crc,
+                finish_reason=c.finish_reason))
+        return out
+
+    def fail(self, now: float) -> List[TraceRequest]:
+        """The real recovery lever: every slot takes
+        ``inject_slot_failure`` (mid-stream requests requeue inside
+        the engine, uncorrupted by construction), then the engine's
+        whole queue is drained back to the router. Quarantine stays
+        on until :meth:`restore` lifts it slot by slot."""
+        eng = self.engine
+        for slot in range(eng.serving.max_slots):
+            eng.inject_slot_failure(slot, quarantine=True)
+        displaced = []
+        for r in eng.queue:
+            displaced.append(self._dispatched.pop(r.request_id))
+            self._dispatch_s.pop(r.request_id, None)
+            # the engine keyed latency clocks by id at submit; drop
+            # them so a post-recovery resubmit isn't a duplicate
+            eng._req_clock.pop(r.request_id, None)
+        eng.queue = []
+        self.healthy = False
+        return displaced
+
+    def restore(self, now: float) -> None:
+        for slot in range(self.engine.serving.max_slots):
+            self.engine.restore_slot(slot)
+        self.healthy = True
+
+    def report(self) -> Dict[str, object]:
+        return {
+            "kind": "engine",
+            "healthy": self.healthy,
+            "outstanding": self.outstanding(),
+            "engine": self.engine.report(),
+        }
+
+
+class Router:
+    """The fleet's balancing + admission layer.
+
+    Requests land in a bounded central queue; each ``dispatch()``
+    pass drains it head-first onto replicas by policy. A request the
+    head cannot place (every candidate refuses) blocks the pass —
+    FCFS, no overtaking, same as the engine's admission. Expired
+    queued requests complete as ``deadline_exceeded`` without ever
+    touching a replica; a full central queue sheds on arrival."""
+
+    def __init__(self, replicas: Sequence, policy: str = "round-robin",
+                 max_queue: int = 0, affinity_spill: int = 8):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r}; known: "
+                f"{', '.join(POLICIES)}")
+        self.replicas: List = list(replicas)
+        self.policy = policy
+        self.max_queue = max_queue
+        # prefix-affinity: preferred replica may be this many
+        # requests MORE loaded than the least-loaded one before the
+        # router spills the request elsewhere (cache locality is
+        # worth a bounded queue imbalance, not an unbounded one)
+        self.affinity_spill = affinity_spill
+        self.queue: List[TraceRequest] = []
+        self._rr = 0
+        self.routed = 0
+        self.shed = 0
+        self.expired_queued = 0
+        self.requeues = 0
+        self.per_replica: Dict[int, int] = {}
+        self.affinity_hits = 0
+        self.affinity_spills = 0
+
+    # -- policy ------------------------------------------------------
+
+    def _healthy(self) -> List:
+        return [r for r in self.replicas if r.healthy]
+
+    def _pick_order(self, req: TraceRequest) -> List:
+        """Candidate replicas, best first, per policy. Ties break on
+        replica_id — determinism over cleverness."""
+        healthy = self._healthy()
+        if not healthy:
+            return []
+        if self.policy == "round-robin":
+            start = self._rr % len(healthy)
+            return healthy[start:] + healthy[:start]
+        by_load = sorted(
+            healthy, key=lambda r: (r.outstanding(), r.replica_id))
+        if self.policy == "least-outstanding":
+            return by_load
+        # prefix-affinity: grouped requests stick to a stable home
+        # replica (crc of the group id over the FULL replica list, so
+        # the mapping survives scale events for existing groups);
+        # ungrouped traffic falls back to least-outstanding
+        if req.prefix_group < 0:
+            return by_load
+        key = zlib.crc32(f"group:{req.prefix_group}".encode("utf-8"))
+        home = self.replicas[key % len(self.replicas)]
+        if not home.healthy:
+            return by_load
+        floor = by_load[0].outstanding()
+        if home.outstanding() - floor > self.affinity_spill:
+            self.affinity_spills += 1
+            return by_load
+        self.affinity_hits += 1
+        return [home] + [r for r in by_load if r is not home]
+
+    # -- surface -----------------------------------------------------
+
+    def offer(self, req: TraceRequest,
+              now: float) -> Optional[ReplicaCompletion]:
+        """Admit one arrival into the central queue; returns a shed
+        completion when admission control refuses it."""
+        if self.max_queue and len(self.queue) >= self.max_queue:
+            self.shed += 1
+            metrics.fleet_board().incr("requests_shed")
+            metrics.recovery_log().record(
+                "fleet_shed", request=req.request_id)
+            return ReplicaCompletion(
+                request=req, dispatch_s=now, first_s=None,
+                finish_s=now, tokens=0, tokens_crc=0,
+                finish_reason="shed")
+        self.queue.append(req)
+        return None
+
+    def requeue_front(self, displaced: Sequence[TraceRequest]) -> None:
+        """Displaced requests (a failed replica's) go back to the
+        queue HEAD in their original arrival order."""
+        ordered = sorted(displaced,
+                         key=lambda r: (r.arrival_s, r.request_id))
+        self.queue[:0] = ordered
+        self.requeues += len(ordered)
+        metrics.fleet_board().incr("fleet_requeues", len(ordered))
+
+    def dispatch(self, now: float) -> List[ReplicaCompletion]:
+        """One placement pass; returns terminal outcomes decided AT
+        THE ROUTER (queue-deadline expiries)."""
+        out: List[ReplicaCompletion] = []
+        still: List[TraceRequest] = []
+        for req in self.queue:
+            if (req.deadline_s is not None
+                    and now >= req.arrival_s + req.deadline_s):
+                self.expired_queued += 1
+                metrics.fleet_board().incr("deadline_expired_queued")
+                out.append(ReplicaCompletion(
+                    request=req, dispatch_s=now, first_s=None,
+                    finish_s=round(req.arrival_s + req.deadline_s, 9),
+                    tokens=0, tokens_crc=0,
+                    finish_reason="deadline_exceeded"))
+            else:
+                still.append(req)
+        self.queue = still
+        while self.queue:
+            req = self.queue[0]
+            placed = False
+            for replica in self._pick_order(req):
+                if replica.submit(req, now):
+                    self.queue.pop(0)
+                    self.routed += 1
+                    self.per_replica[replica.replica_id] = (
+                        self.per_replica.get(replica.replica_id, 0)
+                        + 1)
+                    metrics.fleet_board().incr("requests_routed")
+                    if self.policy == "round-robin":
+                        self._rr += 1
+                    placed = True
+                    break
+            if not placed:
+                break  # head blocks: FCFS, retry next pass
+        return out
+
+    def report(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "policy": self.policy,
+            "routed": self.routed,
+            "shed": self.shed,
+            "expired_queued": self.expired_queued,
+            "requeues": self.requeues,
+            "queued": len(self.queue),
+            "per_replica": {str(k): v for k, v in
+                            sorted(self.per_replica.items())},
+        }
+        if self.policy == "prefix-affinity":
+            out["affinity"] = {"hits": self.affinity_hits,
+                               "spills": self.affinity_spills}
+        return out

@@ -1,0 +1,286 @@
+"""The fleet driver: trace -> router -> replicas -> SLO report.
+
+One tick loop on the virtual clock glues the pieces together:
+arrivals due this tick enter the router (or shed), the router places
+its queue by policy, every replica advances one tick, completions
+stream into the SLO tracker and the per-request completion log, and
+the autoscaler gets one observation per evaluation interval. Chaos
+events (replica preemption / restore) fire at planned virtual times
+and displaced requests requeue at the router — the same loop the
+`fleet run` CLI, the bench fleet section, and the chaos fleet
+scenarios all drive.
+
+Determinism: the loop consumes no wall time, no entropy, and iterates
+replicas in id order; the completion log is emitted sorted by
+(finish_s, request_id). Two runs of the same (trace, config) are
+byte-identical — `fleet run --seed 7` twice diffs clean.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from kind_tpu_sim import metrics
+from kind_tpu_sim.fleet.autoscaler import Autoscaler, AutoscalerConfig
+from kind_tpu_sim.fleet.loadgen import TraceRequest, VirtualClock
+from kind_tpu_sim.fleet.router import (
+    ReplicaCompletion,
+    Router,
+    SimReplica,
+    SimReplicaConfig,
+)
+from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
+
+TICK_ENV = "KIND_TPU_SIM_FLEET_TICK_S"
+DEFAULT_TICK_S = 0.01
+
+
+def resolve_tick_s(value: Optional[float] = None) -> float:
+    """Explicit value > env (KIND_TPU_SIM_FLEET_TICK_S) > 0.01."""
+    if value is not None:
+        return float(value)
+    try:
+        return float(os.environ.get(TICK_ENV, DEFAULT_TICK_S))
+    except ValueError:
+        return DEFAULT_TICK_S
+
+
+@dataclasses.dataclass(frozen=True)
+class ChaosEvent:
+    """A fleet-level fault: ``preempt`` displaces a replica's whole
+    load (chaos.py derives these from a seeded FaultPlan); ``restore``
+    heals it."""
+
+    at_s: float
+    action: str   # preempt | restore
+    target: int   # replica id
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    replicas: int = 2
+    policy: str = "round-robin"
+    tick_s: Optional[float] = None     # None -> resolve_tick_s()
+    max_queue: int = 1024              # router admission bound
+    max_virtual_s: float = 600.0       # runaway-loop backstop
+    autoscale: bool = False
+    eval_every_ticks: int = 10         # autoscaler cadence
+    slo: SloPolicy = SloPolicy(ttft_s=0.5, e2e_s=2.0)
+    sim: SimReplicaConfig = SimReplicaConfig()
+    autoscaler: AutoscalerConfig = AutoscalerConfig()
+
+    def as_dict(self) -> dict:
+        return {
+            "replicas": self.replicas,
+            "policy": self.policy,
+            "tick_s": resolve_tick_s(self.tick_s),
+            "max_queue": self.max_queue,
+            "autoscale": self.autoscale,
+            "slo": {k: v for k, v in
+                    dataclasses.asdict(self.slo).items()
+                    if v is not None},
+            "sim": dataclasses.asdict(self.sim),
+        }
+
+
+class FleetSim:
+    """One fleet run. ``replica_factory(replica_id)`` builds a
+    replica (default: a SimReplica with ``cfg.sim``); engine-backed
+    fleets pass a factory closing over shared params — constructing
+    extra ServingEngines is cheap because the jitted kernels are
+    module-cached per ModelConfig."""
+
+    def __init__(self, cfg: FleetConfig,
+                 trace: Sequence[TraceRequest],
+                 replica_factory: Optional[Callable[[int], object]]
+                 = None,
+                 chaos_events: Sequence[ChaosEvent] = (),
+                 clock: Optional[VirtualClock] = None):
+        self.cfg = cfg
+        self.clock = clock or VirtualClock()
+        self.trace = sorted(trace,
+                            key=lambda r: (r.arrival_s, r.request_id))
+        self.factory = replica_factory or (
+            lambda rid: SimReplica(rid, cfg.sim))
+        self.replicas = [self.factory(i)
+                         for i in range(cfg.replicas)]
+        self.router = Router(self.replicas, policy=cfg.policy,
+                             max_queue=cfg.max_queue)
+        self.chaos_events = sorted(chaos_events,
+                                   key=lambda e: (e.at_s, e.target))
+        self.tracker = SloTracker(cfg.slo)
+        self.autoscaler = (Autoscaler(cfg.autoscaler)
+                           if cfg.autoscale else None)
+        self.log: List[dict] = []
+        # recent attained-flags window: the autoscaler's SLO signal
+        self._recent = deque(maxlen=64)
+        self._next_replica_id = cfg.replicas
+        self._warming: List[tuple] = []   # (ready_at_s, replica)
+        self._draining: List = []
+        self.preemptions = 0
+
+    # -- bookkeeping ---------------------------------------------------
+
+    def _record(self, comp: ReplicaCompletion,
+                replica_id: int) -> None:
+        req = comp.request
+        ok = self.tracker.observe(
+            arrival_s=req.arrival_s, first_s=comp.first_s,
+            finish_s=comp.finish_s, tokens=comp.tokens,
+            shed=comp.finish_reason == "shed",
+            deadline_exceeded=comp.finish_reason
+            == "deadline_exceeded")
+        self._recent.append(ok)
+        self.log.append({
+            "request_id": req.request_id,
+            "replica": replica_id,
+            "prefix_group": req.prefix_group,
+            "arrival_s": round(req.arrival_s, 6),
+            "dispatch_s": round(comp.dispatch_s, 6),
+            "first_s": (round(comp.first_s, 6)
+                        if comp.first_s is not None else None),
+            "finish_s": round(comp.finish_s, 6),
+            "tokens": comp.tokens,
+            "tokens_crc": comp.tokens_crc,
+            "finish_reason": comp.finish_reason,
+            "slo_ok": ok,
+        })
+
+    def _backlog(self) -> int:
+        return (len(self.router.queue)
+                + sum(r.outstanding() for r in self.replicas
+                      if r.healthy))
+
+    def _apply_chaos(self, now: float) -> None:
+        while self.chaos_events and self.chaos_events[0].at_s <= now:
+            ev = self.chaos_events.pop(0)
+            victim = next((r for r in self.replicas
+                           if r.replica_id == ev.target), None)
+            if victim is None:
+                continue
+            if ev.action == "preempt" and victim.healthy:
+                displaced = victim.fail(now)
+                self.router.requeue_front(displaced)
+                self.preemptions += 1
+                metrics.fleet_board().incr("replica_preemptions")
+                metrics.recovery_log().record(
+                    "fleet_replica_preempt", replica=ev.target,
+                    displaced=len(displaced),
+                    at_s=round(now, 6))
+            elif ev.action == "restore" and not victim.healthy:
+                victim.restore(now)
+                metrics.recovery_log().record(
+                    "fleet_replica_restore", replica=ev.target,
+                    at_s=round(now, 6))
+
+    def _autoscale(self, now: float) -> None:
+        scaler = self.autoscaler
+        # warming replicas come online first
+        ready = [w for w in self._warming if w[0] <= now]
+        self._warming = [w for w in self._warming if w[0] > now]
+        for _, replica in ready:
+            self.replicas.append(replica)
+            self.router.replicas.append(replica)
+            scaler.note_ready(now, len(self.router.replicas))
+        routable = sum(1 for r in self.router.replicas if r.healthy)
+        recent = list(self._recent)
+        attainment = (sum(recent) / len(recent)
+                      if recent else None)
+        action = scaler.evaluate(
+            now, routable=routable, backlog=self._backlog(),
+            attainment=attainment)
+        if action == "scale_up":
+            rid = self._next_replica_id
+            self._next_replica_id += 1
+            self._warming.append(
+                (now + scaler.warmup_s, self.factory(rid)))
+        elif action == "scale_down":
+            # drain the highest-id healthy replica: no new traffic,
+            # removed once idle — scale-down never displaces work
+            victim = max((r for r in self.router.replicas
+                          if r.healthy),
+                         key=lambda r: r.replica_id)
+            self.router.replicas.remove(victim)
+            self.replicas.remove(victim)
+            self._draining.append(victim)
+
+    # -- the loop ------------------------------------------------------
+
+    def run(self) -> Dict[str, object]:
+        board_before = metrics.fleet_board().counts()
+        tick = resolve_tick_s(self.cfg.tick_s)
+        pending = deque(self.trace)
+        ticks = 0
+        while True:
+            now = self.clock.now()
+            if now > self.cfg.max_virtual_s:
+                break
+            self._apply_chaos(now)
+            while pending and pending[0].arrival_s <= now:
+                shed = self.router.offer(pending.popleft(), now)
+                if shed is not None:
+                    self._record(shed, -1)
+            for comp in self.router.dispatch(now):
+                self._record(comp, -1)
+            for replica in list(self.replicas):
+                for comp in replica.tick(now, tick):
+                    self._record(comp, replica.replica_id)
+            for replica in list(self._draining):
+                for comp in replica.tick(now, tick):
+                    self._record(comp, replica.replica_id)
+                if replica.idle():
+                    self._draining.remove(replica)
+            if (self.autoscaler is not None
+                    and ticks % self.cfg.eval_every_ticks == 0):
+                self._autoscale(now)
+            ticks += 1
+            if (not pending and not self.router.queue
+                    and not self._warming
+                    and all(r.idle() for r in self.replicas
+                            if r.healthy)
+                    and not self._draining
+                    and not self.chaos_events):
+                break
+            self.clock.advance(tick)
+        self.log.sort(key=lambda e: (e["finish_s"],
+                                     e["request_id"]))
+        report: Dict[str, object] = {
+            "config": self.cfg.as_dict(),
+            "requests": len(self.trace),
+            "completed": len(self.log),
+            "virtual_s": round(self.clock.now(), 6),
+            "slo": self.tracker.report(span_s=self.clock.now()),
+            "router": self.router.report(),
+            "replicas": {
+                str(r.replica_id): r.report()
+                for r in sorted(self.replicas + self._draining,
+                                key=lambda r: r.replica_id)},
+            "completions": self.log,
+            "fleet_counters": metrics.fleet_board().snapshot_since(
+                board_before),
+            "ok": len(self.log) == len(self.trace),
+        }
+        if self.preemptions:
+            report["preemptions"] = self.preemptions
+        if self.autoscaler is not None:
+            report["autoscaler"] = self.autoscaler.report()
+        return report
+
+
+def attainment_over(log: Sequence[dict], t_from: float,
+                    t_to: float = float("inf")) -> Optional[float]:
+    """SLO attainment restricted to requests ARRIVING in a window —
+    how the chaos scenarios compare post-recovery service against the
+    fault-free baseline without the backlog-drain period polluting
+    the number."""
+    window = [e for e in log
+              if t_from <= e["arrival_s"] < t_to]
+    if not window:
+        return None
+    return sum(1 for e in window if e["slo_ok"]) / len(window)

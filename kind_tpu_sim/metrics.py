@@ -174,6 +174,64 @@ def recovery_log() -> RecoveryLog:
     return _RECOVERY_LOG
 
 
+class CounterBoard:
+    """Thread-safe named counters + gauges — the fleet layer's
+    observability surface (requests routed/shed, requeues, scale
+    events), published in fleet reports and bench extras alongside
+    the RecoveryLog. Counters are monotonic; gauges are
+    last-write-wins snapshots (e.g. current replica count)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = collections.Counter()
+        self._gauges: Dict[str, float] = {}
+
+    def incr(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += by
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def snapshot_since(self, before: Dict[str, int]) -> Dict[str, int]:
+        """Counter delta vs an earlier ``counts()`` snapshot — how
+        one fleet run attributes exactly ITS traffic on the shared
+        process-global board."""
+        now = self.counts()
+        return {k: now[k] - before.get(k, 0) for k in now
+                if now[k] - before.get(k, 0)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._gauges.clear()
+
+    def as_dict(self) -> Dict[str, object]:
+        out: Dict[str, object] = {"counts": self.counts()}
+        gauges = self.gauges()
+        if gauges:
+            out["gauges"] = gauges
+        return out
+
+
+_FLEET_BOARD = CounterBoard()
+
+
+def fleet_board() -> CounterBoard:
+    """The process-global fleet counter board (router/autoscaler
+    record into it; fleet reports and bench extras snapshot it)."""
+    return _FLEET_BOARD
+
+
 def parse_k8s_time(stamp: str) -> float:
     """RFC3339 (kubernetes) timestamp -> unix seconds."""
     import datetime

@@ -169,6 +169,13 @@ class Request:
     seed: Optional[int] = None
     cache_prefix: bool = False   # store this prompt's KV for reuse
     #                              by later prefix-sharing requests
+    deadline_s: Optional[float] = None  # e2e budget in clock seconds
+    #                              from submit(); checked at decode-
+    #                              step (scheduling-round) granularity
+    #                              — an expired request completes with
+    #                              finish_reason "deadline_exceeded"
+    #                              and frees its slot (the fleet
+    #                              router's per-request SLO lever)
     logprobs: bool = False       # return each generated token's
     #                              log-probability under the RAW
     #                              model distribution (log_softmax
@@ -184,7 +191,11 @@ class Completion:
     request_id: str
     prompt: List[int]
     tokens: List[int]          # generated tokens (eos included if hit)
-    finish_reason: str         # "stop" (eos) or "length"
+    finish_reason: str         # "stop" (eos), "length", or
+    #                            "deadline_exceeded" (budget expired
+    #                            mid-stream; tokens emitted so far
+    #                            are still returned, uncorrupted)
+    deadline_exceeded: bool = False
     # host-side request metrics (the vLLM observability analog),
     # set by the engine on every completion:
     # ttft_s = submit -> first token (queue wait + prefill);
@@ -946,11 +957,19 @@ class ServingEngine:
 
     def __init__(self, params: Params, cfg: ModelConfig,
                  serving: ServingConfig = ServingConfig(),
-                 mesh=None):
+                 mesh=None, clock=None):
         import functools
+        import time as _time
 
         import jax
         import jax.numpy as jnp
+
+        # All host-side latency stamps (submit/first/finish clocks,
+        # deadline checks) read THIS callable. The default is wall
+        # time; the fleet simulator binds its virtual clock here so
+        # engine-backed fleet runs are deterministic and deadlines
+        # are evaluated in simulated time.
+        self._clock = clock if clock is not None else _time.monotonic
 
         self.mesh = mesh
         n = serving.max_slots
@@ -1117,10 +1136,8 @@ class ServingEngine:
             raise ValueError(
                 f"request id {request.request_id!r} is already "
                 "queued or in flight")
-        import time as _time
-
         self._req_clock[request.request_id] = {
-            "submit": _time.monotonic()}
+            "submit": self._clock()}
         self.queue.append(request)
 
     def step_round(self) -> None:
@@ -1158,6 +1175,55 @@ class ServingEngine:
     def _round_retire(self, handles) -> None:
         (emitted, lps), owners = handles
         self._retire(emitted, lps, owners)
+        self._expire_deadlines()
+
+    def _expire_deadlines(self) -> None:
+        """Deadline enforcement at decode-step (scheduling-round)
+        granularity: every live or mid-prefill slot whose request's
+        budget has run out completes NOW with finish_reason
+        "deadline_exceeded" — tokens already emitted are returned
+        (they streamed in time), the slot frees for the next tenant.
+        Runs after every round's retire, on both the sequential and
+        pipelined schedulers."""
+        now = self._clock()
+
+        def expired(req) -> bool:
+            if req is None or req.deadline_s is None:
+                return False
+            clock = self._req_clock.get(req.request_id)
+            return (clock is not None
+                    and now - clock["submit"] >= req.deadline_s)
+
+        for slot, req in enumerate(self.slot_req):
+            if expired(req):
+                self._finish(slot, reason="deadline_exceeded")
+        for slot in [s for s, st in self._pending.items()
+                     if expired(st["req"])]:
+            req = self._pending.pop(slot)["req"]
+            self._release_storage(slot)
+            self._complete_unserved(req)
+
+    def _complete_unserved(self, req: Request) -> None:
+        """Emit a deadline_exceeded Completion for a request that
+        never reached (or never finished reaching) a slot — expired
+        in the queue or mid-chunked-prefill. No tokens, clocks
+        closed out."""
+        now = self._clock()
+        clock = self._req_clock.pop(req.request_id, None)
+        e2e = (round(now - clock["submit"], 6)
+               if clock and "submit" in clock else None)
+        self.finished.append(Completion(
+            request_id=req.request_id, prompt=list(req.prompt),
+            tokens=[], finish_reason="deadline_exceeded",
+            deadline_exceeded=True, ttft_s=None, e2e_s=e2e,
+            logprobs=None))
+
+    def outstanding(self) -> int:
+        """Accepted-but-unfinished request count (queued + streaming
+        prefills + live slots) — the cheap load probe the fleet
+        router's least-outstanding policy polls every tick."""
+        return (len(self.queue) + len(self._pending)
+                + sum(1 for r in self.slot_req if r is not None))
 
     def _sampling_state(self):
         """The per-slot sampling-parameter tuple every decode/verify
@@ -1329,6 +1395,19 @@ class ServingEngine:
         })
 
     def _admit(self) -> None:
+        # a queued request whose budget already ran out must not pay
+        # a prefill it can't use: reap it here, before slot claims
+        if any(r.deadline_s is not None for r in self.queue):
+            now = self._clock()
+            keep = []
+            for req in self.queue:
+                clock = self._req_clock.get(req.request_id)
+                if (req.deadline_s is not None and clock is not None
+                        and now - clock["submit"] >= req.deadline_s):
+                    self._complete_unserved(req)
+                else:
+                    keep.append(req)
+            self.queue = keep
         claims = []
         # Blocks promised to this round's deferred claims: the paged
         # allocator only moves when _admit_claims runs _claim_pending,
@@ -1716,11 +1795,9 @@ class ServingEngine:
                 float(_jitted_first_lp()(logits, first)))
         # TTFT clock: the EARLIEST first-token time survives a
         # recompute preemption (the user saw that token then)
-        import time as _time
-
         clock = self._req_clock.get(req.request_id)
         if clock is not None and "first" not in clock:
-            clock["first"] = _time.monotonic()
+            clock["first"] = self._clock()
         self.slot_req[slot] = req
         self._slot_gen[slot] += 1
         self.slot_emitted[slot] = [first]
@@ -1773,14 +1850,14 @@ class ServingEngine:
                      have[-1] == req.eos_id)):
                 self._finish(slot)
 
-    def _finish(self, slot: int) -> None:
-        import time as _time
-
+    def _finish(self, slot: int,
+                reason: Optional[str] = None) -> None:
         req = self.slot_req[slot]
         toks = self.slot_emitted[slot]
-        reason = ("stop" if req.eos_id is not None and toks and
-                  toks[-1] == req.eos_id else "length")
-        now = _time.monotonic()
+        if reason is None:
+            reason = ("stop" if req.eos_id is not None and toks and
+                      toks[-1] == req.eos_id else "length")
+        now = self._clock()
         clock = self._req_clock.pop(req.request_id, None)
         ttft = e2e = None
         if clock is not None and "submit" in clock:
@@ -1803,6 +1880,7 @@ class ServingEngine:
         self.finished.append(Completion(
             request_id=req.request_id, prompt=list(req.prompt),
             tokens=list(toks), finish_reason=reason,
+            deadline_exceeded=reason == "deadline_exceeded",
             ttft_s=ttft, e2e_s=e2e,
             logprobs=(list(self.slot_lps[slot][:len(toks)])
                       if req.logprobs else None)))
@@ -2437,9 +2515,9 @@ class SpeculativeServingEngine(ServingEngine):
 
     def __init__(self, params: Params, cfg: ModelConfig,
                  serving: ServingConfig = ServingConfig(),
-                 draft=None, mesh=None):
+                 draft=None, mesh=None, clock=None):
         self._draft = draft
-        super().__init__(params, cfg, serving, mesh)
+        super().__init__(params, cfg, serving, mesh, clock=clock)
 
     def _init_storage(self) -> None:
         import functools
@@ -2572,6 +2650,7 @@ class SpeculativeServingEngine(ServingEngine):
     def _round_retire(self, handles) -> None:
         (emits, ms, lps), owners = handles
         self._spec_retire(emits, ms, lps, owners)
+        self._expire_deadlines()
 
     def _spec_retire(self, emits, ms, lps, owners=None) -> None:
         """Ragged per-slot retirement after a scanned verify

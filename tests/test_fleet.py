@@ -1,0 +1,435 @@
+"""Fleet layer: determinism, routing policies, SLO math, autoscaling.
+
+The load-bearing properties (ISSUE 3 acceptance): same seed =>
+byte-identical completion logs and SLO reports; prefix-affinity beats
+round-robin on a shared-prefix trace; fixed-bucket percentiles track
+a brute-force reference; the autoscaler doesn't flap on steady load;
+and the seeded fleet chaos scenarios hold their recovery invariants.
+Everything in this file runs on the analytic (no-jax) replicas —
+engine-backed coverage lives with the slow serving tests and the
+slow `fleet-preemption` scenario test at the bottom.
+"""
+
+import json
+
+import pytest
+
+from kind_tpu_sim import chaos, fleet
+
+pytestmark = pytest.mark.fleet
+
+
+# -- loadgen -----------------------------------------------------------
+
+
+def test_same_seed_identical_trace():
+    spec = fleet.WorkloadSpec(process="poisson", rps=80.0,
+                              n_requests=50, shared_prefix_frac=0.5)
+    assert fleet.generate_trace(spec, 7) == fleet.generate_trace(
+        spec, 7)
+
+
+def test_different_seed_different_trace():
+    spec = fleet.WorkloadSpec(n_requests=30)
+    traces = {tuple(fleet.generate_trace(spec, s)) for s in range(6)}
+    assert len(traces) > 1
+
+
+def test_arrival_processes_shape():
+    n = 400
+    for process in fleet.WorkloadSpec.PROCESSES:
+        spec = fleet.WorkloadSpec(process=process, rps=100.0,
+                                  n_requests=n)
+        trace = fleet.generate_trace(spec, 3)
+        assert len(trace) == n
+        arrivals = [r.arrival_s for r in trace]
+        assert arrivals == sorted(arrivals)
+        # thinning preserves the mean rate within a loose factor
+        mean_rate = n / arrivals[-1]
+        assert 50.0 < mean_rate < 200.0, (process, mean_rate)
+
+
+def test_bursty_is_burstier_than_poisson():
+    """The on/off modulation must show up as higher variance of
+    per-window arrival counts than the flat process."""
+    def window_var(process):
+        spec = fleet.WorkloadSpec(process=process, rps=100.0,
+                                  n_requests=500,
+                                  burst_period_s=1.0)
+        trace = fleet.generate_trace(spec, 5)
+        span = trace[-1].arrival_s
+        bins = [0] * 20
+        for r in trace:
+            bins[min(19, int(r.arrival_s / span * 20))] += 1
+        mean = sum(bins) / len(bins)
+        return sum((b - mean) ** 2 for b in bins) / len(bins)
+
+    assert window_var("bursty") > 2.0 * window_var("poisson")
+
+
+def test_trace_roundtrip(tmp_path):
+    spec = fleet.WorkloadSpec(n_requests=20, shared_prefix_frac=0.4,
+                              deadline_s=1.5)
+    trace = fleet.generate_trace(spec, 11)
+    path = tmp_path / "trace.jsonl"
+    fleet.save_trace(str(path), trace)
+    assert fleet.load_trace(str(path)) == trace
+
+
+def test_unknown_process_rejected():
+    with pytest.raises(ValueError, match="unknown arrival process"):
+        fleet.generate_trace(
+            fleet.WorkloadSpec(process="tidal", n_requests=1))
+
+
+def test_fleet_seed_env(monkeypatch):
+    monkeypatch.setenv(fleet.FLEET_SEED_ENV, "77")
+    assert fleet.resolve_seed() == 77
+    assert fleet.resolve_seed(3) == 3
+    monkeypatch.delenv(fleet.FLEET_SEED_ENV)
+    assert fleet.resolve_seed() == 0
+
+
+# -- determinism of a whole fleet run ---------------------------------
+
+
+def _run(policy="round-robin", seed=7, **cfg_kw):
+    spec = fleet.WorkloadSpec(process="poisson", rps=150.0,
+                              n_requests=80, shared_prefix_frac=0.5,
+                              deadline_s=3.0)
+    trace = fleet.generate_trace(spec, seed)
+    cfg = fleet.FleetConfig(replicas=3, policy=policy, **cfg_kw)
+    return fleet.FleetSim(cfg, trace).run()
+
+
+def test_same_seed_byte_identical_report():
+    a = json.dumps(_run(), sort_keys=True)
+    b = json.dumps(_run(), sort_keys=True)
+    assert a == b
+
+
+def test_completion_log_accounts_every_request():
+    rep = _run(policy="least-outstanding")
+    assert rep["ok"]
+    assert rep["completed"] == rep["requests"]
+    ids = [e["request_id"] for e in rep["completions"]]
+    assert len(set(ids)) == len(ids)
+
+
+def test_policies_produce_different_routings():
+    reps = {p: _run(policy=p) for p in fleet.POLICIES}
+    per = {p: reps[p]["router"]["per_replica"]
+           for p in fleet.POLICIES}
+    # same totals, different placement fingerprints
+    for p, rep in reps.items():
+        assert rep["router"]["routed"] == rep["requests"], p
+    assert per["prefix-affinity"] != per["round-robin"]
+
+
+# -- router policy differentiation ------------------------------------
+
+
+def _policy_report(policy):
+    """Saturated shared-prefix workload where cache locality matters:
+    6 groups over 3 replicas with a 2-entry per-replica prefix cache
+    — affinity keeps each home cache resident, round-robin thrashes
+    it (the PrefixCache LRU analog)."""
+    spec = fleet.WorkloadSpec(process="poisson", rps=400.0,
+                              n_requests=200, prompt_len=(24, 32),
+                              max_new=(4, 8),
+                              shared_prefix_frac=1.0,
+                              prefix_groups=6, prefix_len=16)
+    trace = fleet.generate_trace(spec, 11)
+    sim = fleet.SimReplicaConfig(max_slots=4,
+                                 prefill_per_tok_s=0.004,
+                                 tpot_s=0.002,
+                                 prefix_cache_entries=2)
+    cfg = fleet.FleetConfig(replicas=3, policy=policy, sim=sim)
+    return fleet.FleetSim(cfg, trace).run()
+
+
+def test_prefix_affinity_beats_round_robin_on_shared_prefixes():
+    aff = _policy_report("prefix-affinity")
+    rr = _policy_report("round-robin")
+    hits = lambda rep: sum(  # noqa: E731
+        r.get("prefix", {}).get("hits", 0)
+        for r in rep["replicas"].values())
+    assert hits(aff) > hits(rr)
+    assert (aff["slo"]["ttft"]["p50_s"]
+            < rr["slo"]["ttft"]["p50_s"])
+    assert (aff["slo"]["e2e"]["p90_s"]
+            < rr["slo"]["e2e"]["p90_s"])
+
+
+# -- admission control + deadlines ------------------------------------
+
+
+def test_router_sheds_when_central_queue_full():
+    spec = fleet.WorkloadSpec(process="bursty", rps=500.0,
+                              n_requests=120)
+    trace = fleet.generate_trace(spec, 3)
+    sim = fleet.SimReplicaConfig(max_slots=2, tpot_s=0.01,
+                                 max_queue=4)
+    cfg = fleet.FleetConfig(replicas=2, policy="least-outstanding",
+                            max_queue=8, sim=sim)
+    rep = fleet.FleetSim(cfg, trace).run()
+    assert rep["ok"]  # shed requests still appear in the log
+    assert rep["router"]["shed"] > 0
+    assert rep["slo"]["shed"] == rep["router"]["shed"]
+    shed = [e for e in rep["completions"]
+            if e["finish_reason"] == "shed"]
+    assert all(e["tokens"] == 0 for e in shed)
+
+
+def test_deadlines_expire_in_queue_and_in_flight():
+    spec = fleet.WorkloadSpec(process="poisson", rps=400.0,
+                              n_requests=100, prompt_len=(24, 32),
+                              max_new=(16, 24), deadline_s=0.3)
+    trace = fleet.generate_trace(spec, 9)
+    sim = fleet.SimReplicaConfig(max_slots=2,
+                                 prefill_per_tok_s=0.004,
+                                 tpot_s=0.004)
+    cfg = fleet.FleetConfig(replicas=2, policy="least-outstanding",
+                            sim=sim)
+    rep = fleet.FleetSim(cfg, trace).run()
+    assert rep["ok"]
+    expired = [e for e in rep["completions"]
+               if e["finish_reason"] == "deadline_exceeded"]
+    assert expired, "saturated run must expire some deadlines"
+    for e in expired:
+        assert e["finish_s"] <= e["arrival_s"] + 0.3 + 1e-6
+    assert rep["slo"]["deadline_exceeded"] == len(expired)
+
+
+# -- histogram / SLO math ---------------------------------------------
+
+
+def test_histogram_percentiles_match_brute_force():
+    import random
+
+    rng = random.Random(13)
+    hist = fleet.FixedBucketHistogram(lo=1e-4, hi=100.0,
+                                      growth=1.12)
+    samples = [rng.expovariate(2.0) + 1e-4 for _ in range(5000)]
+    for s in samples:
+        hist.observe(s)
+    for p in (0.5, 0.9, 0.99):
+        ref = fleet.brute_force_percentile(samples, p)
+        est = hist.percentile(p)
+        # upper-bound estimate: >= truth, within one bucket's growth
+        assert ref <= est <= ref * 1.12 * 1.0001, (p, ref, est)
+
+
+def test_histogram_overflow_reports_max_seen():
+    hist = fleet.FixedBucketHistogram(lo=0.01, hi=1.0)
+    for v in (0.5, 3.0, 7.5):
+        hist.observe(v)
+    assert hist.percentile(0.99) == 7.5
+    assert hist.max == 7.5
+
+
+def test_histogram_empty_and_bad_input():
+    hist = fleet.FixedBucketHistogram()
+    assert hist.percentile(0.5) is None
+    assert hist.report() == {"count": 0}
+    with pytest.raises(ValueError):
+        hist.observe(-1.0)
+
+
+def test_slo_tracker_goodput_vs_throughput():
+    tracker = fleet.SloTracker(fleet.SloPolicy(ttft_s=0.1,
+                                               e2e_s=1.0))
+    # attained: fast request
+    assert tracker.observe(arrival_s=0.0, first_s=0.05,
+                           finish_s=0.5, tokens=10)
+    # ttft miss
+    assert not tracker.observe(arrival_s=0.0, first_s=0.5,
+                               finish_s=0.9, tokens=10)
+    # shed counts in the denominator with zero tokens
+    assert not tracker.observe(arrival_s=1.0, first_s=None,
+                               finish_s=1.0, tokens=0, shed=True)
+    rep = tracker.report(span_s=2.0)
+    assert rep["completed"] == 3 and rep["attained"] == 1
+    assert rep["attainment"] == pytest.approx(1 / 3)
+    assert rep["throughput_tok_s"] == pytest.approx(10.0)
+    assert rep["goodput_tok_s"] == pytest.approx(5.0)
+
+
+# -- autoscaler --------------------------------------------------------
+
+
+def test_autoscaler_no_flapping_on_steady_load():
+    """Backlog steady between the thresholds: ZERO scale events over
+    a long horizon — the hysteresis contract."""
+    scaler = fleet.Autoscaler(fleet.AutoscalerConfig(
+        up_backlog=8.0, down_backlog=1.0, breach_evals=3,
+        cooldown_s=1.0, warmup_s=0.5))
+    for i in range(200):
+        action = scaler.evaluate(i * 0.1, routable=2,
+                                 backlog=8.0, attainment=0.95)
+        assert action is None
+    assert scaler.events == []
+
+
+def test_autoscaler_breach_persistence_and_cooldown():
+    scaler = fleet.Autoscaler(fleet.AutoscalerConfig(
+        up_backlog=4.0, breach_evals=3, cooldown_s=5.0,
+        warmup_s=0.1, max_replicas=4))
+    actions = [scaler.evaluate(t * 0.1, routable=1, backlog=100.0,
+                               attainment=None)
+               for t in range(12)]
+    # one breach or two is noise; the third consecutive eval acts
+    assert actions[:2] == [None, None]
+    assert "scale_up" in actions
+    # cooldown: exactly one action inside the 5 s window
+    assert actions.count("scale_up") == 1
+
+
+def test_autoscaler_scales_down_when_idle():
+    scaler = fleet.Autoscaler(fleet.AutoscalerConfig(
+        min_replicas=1, down_backlog=1.0, breach_evals=2,
+        cooldown_s=0.1, warmup_s=0.1))
+    actions = [scaler.evaluate(t * 1.0, routable=3, backlog=0.0,
+                               attainment=1.0)
+               for t in range(4)]
+    assert "scale_down" in actions
+
+
+def test_fleet_autoscales_under_burst_then_settles():
+    spec = fleet.WorkloadSpec(process="bursty", rps=300.0,
+                              n_requests=200)
+    trace = fleet.generate_trace(spec, 3)
+    cfg = fleet.FleetConfig(
+        replicas=1, policy="least-outstanding", autoscale=True,
+        sim=fleet.SimReplicaConfig(max_slots=2, tpot_s=0.004),
+        autoscaler=fleet.AutoscalerConfig(
+            min_replicas=1, max_replicas=4, warmup_s=0.2,
+            cooldown_s=0.5))
+    rep = fleet.FleetSim(cfg, trace).run()
+    assert rep["ok"]
+    auto = rep["autoscaler"]
+    assert auto["scale_ups"] >= 1
+    # warm-up is modeled: every scale_up is followed by its
+    # replica_ready exactly warmup_s later
+    ups = [e for e in auto["events"] if e["action"] == "scale_up"]
+    readies = [e for e in auto["events"]
+               if e["action"] == "replica_ready"]
+    assert len(readies) == len(ups)
+    for up, ready in zip(ups, readies):
+        assert ready["at_s"] >= up["at_s"] + 0.2 - 1e-9
+
+
+def test_fleet_scale_down_drains_without_displacement():
+    """Two bursts with a quiet gap: the fleet scales up in burst 1,
+    down in the valley (draining — no request displaced), and still
+    completes EVERYTHING, deterministically."""
+    import dataclasses
+
+    spec = fleet.WorkloadSpec(process="poisson", rps=300.0,
+                              n_requests=120)
+    burst = fleet.generate_trace(spec, 5)
+    second = [dataclasses.replace(r, request_id="g" + r.request_id,
+                                  arrival_s=round(r.arrival_s + 4.0,
+                                                  6))
+              for r in burst]
+    trace = burst + second
+    cfg = fleet.FleetConfig(
+        replicas=1, policy="least-outstanding", autoscale=True,
+        sim=fleet.SimReplicaConfig(max_slots=2, tpot_s=0.004),
+        autoscaler=fleet.AutoscalerConfig(
+            min_replicas=1, max_replicas=4, warmup_s=0.2,
+            cooldown_s=0.3, breach_evals=2, up_backlog=6.0,
+            down_backlog=0.5, min_attainment=None))
+    rep = fleet.FleetSim(cfg, trace).run()
+    assert rep["ok"] and rep["completed"] == len(trace)
+    auto = rep["autoscaler"]
+    assert auto["scale_ups"] >= 1 and auto["scale_downs"] >= 1
+    rep2 = fleet.FleetSim(cfg, trace).run()
+    assert (json.dumps(rep, sort_keys=True)
+            == json.dumps(rep2, sort_keys=True))
+
+
+# -- chaos scenarios ---------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_fleet_flaky_replica_scenario_recovers():
+    for seed in (0, 7, 1234):
+        rep = chaos.run_scenario("fleet-flaky-replica", seed=seed)
+        assert rep["ok"], rep
+        assert rep["recovery_events"].get(
+            "fleet_replica_preempt", 0) >= 1
+
+
+@pytest.mark.chaos
+def test_fleet_preemption_requeues_preserve_streams():
+    """SimReplica tier of the preemption invariant (the real-engine
+    tier is the slow scenario below): displaced work requeues and
+    the fleet still completes everything."""
+    spec = fleet.WorkloadSpec(process="poisson", rps=300.0,
+                              n_requests=100, prompt_len=(16, 24),
+                              max_new=(8, 16))
+    trace = fleet.generate_trace(spec, 7)
+    cfg = fleet.FleetConfig(replicas=2, policy="round-robin",
+                            sim=fleet.SimReplicaConfig(
+                                max_slots=4, tpot_s=0.002))
+    clean = fleet.FleetSim(cfg, trace).run()
+    mid = clean["virtual_s"] / 3
+    faulted = fleet.FleetSim(cfg, trace, chaos_events=[
+        fleet.ChaosEvent(at_s=mid, action="preempt", target=0),
+        fleet.ChaosEvent(at_s=mid * 2, action="restore", target=0),
+    ]).run()
+    assert faulted["ok"]
+    assert faulted.get("preemptions") == 1
+    assert faulted["router"]["requeues"] >= 1
+    # every displaced request still completes with full output
+    crc = lambda rep: {e["request_id"]: e["tokens_crc"]  # noqa: E731
+                       for e in rep["completions"]}
+    assert crc(faulted) == crc(clean)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_fleet_preemption_engine_scenario():
+    """The acceptance invariant: real engines, seeded preemption,
+    streams identical to fault-free and attainment recovered."""
+    pytest.importorskip("jax")
+    rep = chaos.run_scenario("fleet-preemption", seed=7)
+    assert rep["ok"], rep
+    assert rep["streams_identical"]
+    assert rep["requeues"] >= 1
+    assert rep["recovery_events"].get("slot_failure", 0) >= 1
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def test_fleet_cli_byte_identical_reports(capsys):
+    from kind_tpu_sim import cli
+
+    argv = ["fleet", "run", "--seed", "7", "--requests", "40",
+            "--rps", "200", "--json"]
+    assert cli.main(argv) == 0
+    first = capsys.readouterr().out
+    assert cli.main(argv) == 0
+    second = capsys.readouterr().out
+    assert first == second
+    report = json.loads(first)
+    assert report["ok"] and report["seed"] == 7
+    assert len(report["completions"]) == 40
+
+
+def test_fleet_cli_trace_replay(tmp_path, capsys):
+    from kind_tpu_sim import cli
+
+    path = tmp_path / "t.jsonl"
+    assert cli.main(["fleet", "trace", "--seed", "3", "--requests",
+                     "15", "--save-trace", str(path)]) == 0
+    capsys.readouterr()
+    argv = ["fleet", "run", "--trace-file", str(path), "--json"]
+    assert cli.main(argv) == 0
+    replayed = json.loads(capsys.readouterr().out)
+    assert cli.main(["fleet", "run", "--seed", "3", "--requests",
+                     "15", "--json"]) == 0
+    direct = json.loads(capsys.readouterr().out)
+    assert (replayed["completions"] == direct["completions"])

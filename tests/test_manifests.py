@@ -102,3 +102,35 @@ def test_jax_multihost_manifest_matches_committed_default():
     with open("pods/jax-multihost.yaml", encoding="utf-8") as fh:
         committed = fh.read()
     assert committed == text
+
+
+def test_tpu_serving_deployment_manifest():
+    """pods/tpu-serving-deployment.yaml: the fleet layer's cluster
+    face — a multi-replica Deployment + Service requesting
+    google.com/tpu, clean under manifest_lint and with the service
+    selector actually matching the replica pods."""
+    from kind_tpu_sim import manifest_lint
+
+    with open("pods/tpu-serving-deployment.yaml",
+              encoding="utf-8") as fh:
+        text = fh.read()
+    assert manifest_lint.validate_yaml(text) == []
+    deploy, service = list(yaml.safe_load_all(text))
+    assert deploy["kind"] == "Deployment"
+    assert deploy["spec"]["replicas"] >= 2  # a fleet, not a pod
+    spec = deploy["spec"]["template"]["spec"]
+    ctr = spec["containers"][0]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == "1"
+    assert spec["nodeSelector"] == {"hardware-type": "tpu"}
+    # failover shape: shortened not-ready/unreachable tolerations
+    # (the cluster-side fleet_preemption story)
+    tols = {t.get("key"): t for t in spec["tolerations"]}
+    for key in ("node.kubernetes.io/not-ready",
+                "node.kubernetes.io/unreachable"):
+        assert tols[key]["tolerationSeconds"] <= 30
+    assert service["kind"] == "Service"
+    labels = deploy["spec"]["template"]["metadata"]["labels"]
+    sel = service["spec"]["selector"]
+    assert all(labels.get(k) == v for k, v in sel.items())
+    port = service["spec"]["ports"][0]
+    assert port["port"] == ctr["ports"][0]["containerPort"]

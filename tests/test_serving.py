@@ -1429,3 +1429,96 @@ def test_warm_admission_precompiles_without_state_damage(cfg, params):
     eng_p.submit(serving.Request("b", p, max_new=5))
     done_p = {c.request_id: c for c in eng_p.run()}
     assert done_p["b"].tokens == oracle(params, cfg, p, 5, 8)
+
+
+# -- fleet-facing replica hooks (ISSUE 3): deadlines, load probe,
+# injectable clock --------------------------------------------------
+
+
+def test_outstanding_counts_queue_and_slots(cfg, params):
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    assert eng.outstanding() == 0
+    for i in range(4):
+        eng.submit(serving.Request(
+            f"o{i}", make_prompt(60 + i, 4, cfg.vocab_size),
+            max_new=10, seed=i))
+    assert eng.outstanding() == 4
+    eng.step_round()  # two admitted into slots, two queued
+    assert eng.outstanding() == 4
+    done = eng.run()
+    assert len(done) == 4 and eng.outstanding() == 0
+
+
+def test_deadline_expires_mid_stream_and_frees_slot(cfg, params):
+    """A request whose budget runs out mid-decode completes with
+    finish_reason deadline_exceeded (partial tokens returned, a
+    PREFIX of its unconstrained stream) and its slot frees for the
+    next tenant; co-tenants are untouched."""
+    from kind_tpu_sim.fleet import VirtualClock
+
+    clk = VirtualClock()
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=4)
+    eng = serving.ServingEngine(params, cfg, sc, clock=clk.now)
+    p_dead = make_prompt(70, 5, cfg.vocab_size)
+    p_live = make_prompt(71, 5, cfg.vocab_size)
+    eng.submit(serving.Request("dead", p_dead, max_new=40, seed=0,
+                               deadline_s=0.5))
+    eng.submit(serving.Request("live", p_live, max_new=8, seed=0))
+    eng.submit(serving.Request("next", p_live, max_new=4, seed=0))
+    done = []
+    while eng.outstanding():
+        eng.step_round()
+        clk.advance(0.2)
+        done.extend(eng.poll())
+    by_id = {c.request_id: c for c in done}
+    dead = by_id["dead"]
+    assert dead.finish_reason == "deadline_exceeded"
+    assert dead.deadline_exceeded
+    assert 0 < len(dead.tokens) < 40
+    # partial output is uncorrupted: a prefix of the solo stream
+    solo = oracle(params, cfg, p_dead, 40, sc.chunk)
+    assert dead.tokens == solo[:len(dead.tokens)]
+    assert by_id["live"].finish_reason == "length"
+    assert by_id["live"].tokens == oracle(params, cfg, p_live, 8,
+                                          sc.chunk)
+    assert by_id["next"].finish_reason == "length"
+
+
+def test_deadline_expires_while_queued(cfg, params):
+    """A queued request past its budget completes with zero tokens
+    and never pays a prefill."""
+    from kind_tpu_sim.fleet import VirtualClock
+
+    clk = VirtualClock()
+    sc = serving.ServingConfig(max_slots=1, max_len=64, chunk=4)
+    eng = serving.ServingEngine(params, cfg, sc, clock=clk.now)
+    eng.submit(serving.Request(
+        "head", make_prompt(72, 4, cfg.vocab_size), max_new=16,
+        seed=0))
+    eng.submit(serving.Request(
+        "tail", make_prompt(73, 4, cfg.vocab_size), max_new=4,
+        seed=0, deadline_s=0.1))
+    done = []
+    while eng.outstanding():
+        eng.step_round()
+        clk.advance(0.2)
+        done.extend(eng.poll())
+    by_id = {c.request_id: c for c in done}
+    assert by_id["tail"].finish_reason == "deadline_exceeded"
+    assert by_id["tail"].tokens == []
+    assert by_id["tail"].e2e_s is not None
+    assert by_id["head"].finish_reason == "length"
+
+
+def test_no_deadline_single_engine_behavior_unchanged(cfg, params):
+    """The replica hooks must not perturb default single-engine
+    streams: no deadline, wall clock — same tokens as ever."""
+    sc = serving.ServingConfig(max_slots=2, max_len=64, chunk=8)
+    eng = serving.ServingEngine(params, cfg, sc)
+    p = make_prompt(74, 6, cfg.vocab_size)
+    eng.submit(serving.Request("r", p, max_new=9))
+    done = eng.run()
+    assert done[0].tokens == oracle(params, cfg, p, 9, sc.chunk)
+    assert done[0].finish_reason == "length"
+    assert not done[0].deadline_exceeded
