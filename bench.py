@@ -2249,6 +2249,70 @@ def fleet_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def sched_smoke() -> dict | None:
+    """Scheduler-tier extras: the seeded gang workload run once per
+    placement policy (pure virtual clock — milliseconds, no jax),
+    publishing time-to-routable and preemption/migration counts per
+    policy, plus one scheduler-backed autoscale fleet run whose
+    time-to-routable is compared against the flat-warmup constant it
+    replaced (docs/SCHED.md)."""
+    try:
+        from kind_tpu_sim import fleet, sched
+        from kind_tpu_sim import metrics as _metrics
+
+        t0 = time.monotonic()
+        board_before = _metrics.sched_board().counts()
+        policies = {}
+        for policy in sched.POLICIES:
+            rep = sched.run_sched_sim(
+                sched.SchedSimConfig(
+                    sched=sched.SchedConfig(policy=policy)),
+                seed=7)
+            policies[policy] = {
+                "ok": rep["ok"],
+                "scheduled": rep["scheduled"],
+                "ttr_mean_s": rep["time_to_routable"]["mean_s"],
+                "ttr_max_s": rep["time_to_routable"]["max_s"],
+                "preemptions":
+                    rep["event_counts"].get("Preempted", 0),
+                "migrations":
+                    rep["event_counts"].get("Migrated", 0),
+            }
+        spec = fleet.WorkloadSpec(
+            process="bursty", rps=400.0, n_requests=300,
+            prompt_len=(24, 32), max_new=(4, 8))
+        trace = fleet.generate_trace(spec, seed=7)
+        auto = fleet.FleetSim(
+            fleet.FleetConfig(
+                replicas=1, policy="least-outstanding",
+                sim=fleet.SimReplicaConfig(
+                    max_slots=4, prefill_per_tok_s=0.004,
+                    tpot_s=0.002),
+                autoscale=True,
+                autoscaler=fleet.AutoscalerConfig(
+                    max_replicas=4, warmup_s=0.2),
+                sched=fleet.FleetSchedConfig()),
+            trace).run()
+        s = auto["scheduler"]
+        return {
+            "ok": all(p["ok"] for p in policies.values())
+            and auto["ok"],
+            "seconds": round(time.monotonic() - t0, 3),
+            "policies": policies,
+            "fleet_autoscale": {
+                "ok": auto["ok"],
+                "scale_ups": auto["autoscaler"]["scale_ups"],
+                "flat_warmup_s": s["flat_warmup_s"],
+                "ttr_mean_s": s["time_to_routable"]["mean_s"],
+                "ttr_max_s": s["time_to_routable"]["max_s"],
+            },
+            "counters": _metrics.sched_board().snapshot_since(
+                board_before),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def multihost_smoke() -> dict | None:
     """DCN-tier proof: a 2-host simulated slice (one process per host,
     gloo collectives over loopback) comes up and passes cross-host
@@ -2412,6 +2476,10 @@ def main(argv=None) -> int:
             fleet_rep = fleet_smoke()
         if fleet_rep:
             phases["fleet"] = fleet_rep
+        with stopwatch("sched"):
+            sched_rep = sched_smoke()
+        if sched_rep:
+            phases["sched"] = sched_rep
     finally:
         if pool is not None:
             pool.close()
@@ -2463,6 +2531,9 @@ def main(argv=None) -> int:
     fl = phases.get("fleet")
     if isinstance(fl, dict):
         compact_extra["fleet_ok"] = fl.get("ok")
+    sd = phases.get("sched")
+    if isinstance(sd, dict):
+        compact_extra["sched_ok"] = sd.get("ok")
     emit_result(out, out_path, compact_extra)
     return 0
 

@@ -65,6 +65,8 @@ FAULT_KINDS = (
     "slot_failure",      # serving slot/engine dies mid-stream
     "replica_preempt",   # fleet: a whole serving replica preempted
     "replica_flap",      # fleet: a replica fails/heals repeatedly
+    "node_drain",        # sched: node cordoned, gangs evicted+rescheduled
+    "node_fail",         # sched: node breaks outright (capacity gone)
 )
 
 
@@ -688,6 +690,166 @@ def _scenario_fleet_preemption(seed: int) -> dict:
                    and crc(faulted) == crc(clean)
                    and faulted["router"]["requeues"] >= 1
                    and recovered),
+    }
+
+
+@_scenario("sched-node-drain",
+           "a TPU node drained mid-traffic under the scheduler-"
+           "backed fleet: its replica's gang evicts, reschedules "
+           "onto surviving nodes, warms up, and post-recovery SLO "
+           "attainment matches the fault-free run")
+def _scenario_sched_node_drain(seed: int) -> dict:
+    from kind_tpu_sim import fleet
+
+    plan = ChaosSchedule(seed).plan(kinds=("node_drain",),
+                                    n_faults=1, horizon=4, targets=4)
+    ev = plan.events[0]
+    # arrivals span ~4 virtual seconds — long enough that the
+    # evicted gang's rebind + bind latency + 0.55s warm-up all land
+    # WELL before the last third of the trace (the judged window)
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=240, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    sim_cfg = fleet.SimReplicaConfig(max_slots=4,
+                                     prefill_per_tok_s=0.002,
+                                     tpot_s=0.002)
+    fc = fleet.FleetConfig(replicas=2, policy="least-outstanding",
+                           tick_s=0.01, sim=sim_cfg,
+                           slo=fleet.SloPolicy(ttft_s=1.0,
+                                               e2e_s=5.0),
+                           sched=fleet.FleetSchedConfig())
+    clean = fleet.FleetSim(fc, trace).run()
+    # drain a node that PROVABLY hosts a replica gang (the runs are
+    # identical up to the drain instant, so the clean run's t=0
+    # placement names the victim) — displacement is guaranteed, not
+    # seed-lucky; ChaosEvent.target is the node's index in the
+    # sorted inventory, the same resolution FleetSim applies
+    victim_replica = ev.target % fc.replicas
+    placed = next(
+        e for e in clean["scheduler"]["events"]
+        if e["type"] == "Scheduled"
+        and e["gang"] == f"replica-{victim_replica}")
+    node_names = sorted(
+        n["name"]
+        for d in fleet.FleetSim(fc, []).sched.inv.as_dict()[
+            "domains"].values()
+        for n in d["nodes"])
+    target = node_names.index(placed["nodes"][0])
+    # the drain lands a third into the arrival window and the node
+    # restores at two thirds — a full third of the trace arrives
+    # post-restore, so the recovery window has real traffic to judge
+    arr_max = max(r.arrival_s for r in trace)
+    at = round(arr_max / 3.0, 6)
+    restore = round(2.0 * arr_max / 3.0, 6)
+    events = [
+        fleet.ChaosEvent(at_s=at, action="node_drain",
+                         target=target),
+        fleet.ChaosEvent(at_s=restore, action="node_restore",
+                         target=target),
+    ]
+    faulted = fleet.FleetSim(fc, trace, chaos_events=events).run()
+    tail_clean = fleet.attainment_over(clean["completions"],
+                                       restore)
+    tail_faulted = fleet.attainment_over(faulted["completions"],
+                                         restore)
+    tokens = lambda rep: sum(e["tokens"] for e in rep["completions"])  # noqa: E731
+    recovered = (tail_clean is None or tail_faulted is None
+                 or tail_faulted >= tail_clean)
+    sched_counts = faulted["scheduler"]["event_counts"]
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(trace),
+        "drain_at_s": at,
+        "restore_at_s": restore,
+        "sched_events": sched_counts,
+        "requeues": faulted["router"]["requeues"],
+        "tail_attainment_clean": tail_clean,
+        "tail_attainment_faulted": tail_faulted,
+        "ok": bool(faulted["ok"] and clean["ok"]
+                   and tokens(faulted) == tokens(clean)
+                   and sched_counts.get("NodeDrained", 0) == 1
+                   and recovered),
+    }
+
+
+@_scenario("sched-preemption-priority",
+           "a full cluster meets a high-priority gang: the "
+           "scheduler evicts strictly-lower-priority victims "
+           "(never equals), reschedules them when capacity frees, "
+           "and the seeded event log replays byte-identically")
+def _scenario_sched_preemption(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import sched as sched_mod
+
+    plan = ChaosSchedule(seed).plan(kinds=("node_fail",),
+                                    n_faults=1, horizon=8, targets=4)
+    ev = plan.events[0]
+    # one v5e 4x8 pod: 4 hosts. Fill with 4 low-priority single-host
+    # batch gangs that release in a few virtual seconds, then land a
+    # high-priority 2-host slice on the full cluster.
+    def run():
+        inv = sched_mod.build_inventory(
+            [("tpu-v5-lite-podslice", "4x8")])
+        sched = sched_mod.ClusterScheduler(
+            inv, sched_mod.SchedConfig(policy="ici"))
+        for i in range(4):
+            # hold times vary with the seed so different soak draws
+            # exercise different release orders
+            sched.submit(sched_mod.SliceRequest(
+                name=f"batch-{i}", topology="2x4", priority=-10,
+                hold_s=round(3.0 + ((seed >> i) + i) % 4, 6)),
+                0.0)
+        sched.step(0.0)
+        sched.submit(sched_mod.SliceRequest(
+            name="serving-hi", topology="4x4", priority=10), 1.0)
+        sched.step(1.0)
+        # batch victims rescheduled as their preemptor's capacity
+        # frees (hold expiry releases both tiers over time)
+        now = 1.0
+        while (sched.pending or any(
+                g.release_s is not None
+                for g in sched.bound.values())):
+            now = round(now + 0.5, 6)
+            if now > 60.0:
+                break
+            sched.step(now)
+        return sched
+
+    s1 = run()
+    s2 = run()
+    evicted = [e for e in s1.events if e["type"] == "Preempted"]
+    hi_bound = [e for e in s1.events
+                if e["type"] == "Scheduled"
+                and e["gang"] == "serving-hi"]
+    sched_counts: Dict[str, int] = {}
+    for e in s1.events:
+        if e["type"] == "Scheduled":
+            sched_counts[e["gang"]] = (
+                sched_counts.get(e["gang"], 0) + 1)
+    victims = {e["gang"] for e in evicted}
+    # a victim was RE-scheduled iff it has a second Scheduled event
+    batch_resched = {g for g, n in sched_counts.items()
+                     if g.startswith("batch") and n >= 2}
+    # strictly-by-priority invariant: only priority -10 batch gangs
+    # may ever be displaced by the priority-10 preemptor
+    strict = all(g.startswith("batch-") for g in victims)
+    identical = (_json.dumps(s1.events, sort_keys=True)
+                 == _json.dumps(s2.events, sort_keys=True))
+    metrics.recovery_log().record(
+        "sched_preemption_scenario", victims=len(victims),
+        fault_target=ev.target)
+    return {
+        "plan": plan.as_dict(),
+        "evictions": len(evicted),
+        "victims": sorted(victims),
+        "high_priority_bound": bool(hi_bound),
+        "victims_rescheduled": sorted(
+            batch_resched & victims),
+        "events_identical": identical,
+        "ok": bool(hi_bound and evicted and strict and identical
+                   and victims <= batch_resched),
     }
 
 

@@ -286,6 +286,17 @@ def build_parser() -> argparse.ArgumentParser:
              "(--replicas becomes the floor)")
     fl.add_argument("--max-replicas", type=int, default=8)
     fl.add_argument(
+        "--sched", action="store_true",
+        help="place replicas through the topology-aware cluster "
+             "scheduler (docs/SCHED.md): scale-up time-to-routable "
+             "= queue wait + placement + warm-up instead of the "
+             "flat warm-up constant; enables node_drain/node_fail "
+             "chaos")
+    fl.add_argument(
+        "--sched-policy", default="ici",
+        choices=["binpack", "spread", "ici"],
+        help="placement scoring policy when --sched is set")
+    fl.add_argument(
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
              "(default: KIND_TPU_SIM_FLEET_TICK_S or 0.01)")
@@ -299,6 +310,52 @@ def build_parser() -> argparse.ArgumentParser:
         "--out", default=None,
         help="write the full JSON report to this file")
     fl.add_argument("--json", action="store_true", dest="as_json")
+
+    sd = sub.add_parser(
+        "sched",
+        help=(
+            "deterministic topology-aware TPU slice scheduler sim: "
+            "gang placement of a seeded slice-request workload onto "
+            "a simulated node inventory, with binpack/spread/ICI "
+            "scoring, priority preemption, and defrag — same seed, "
+            "byte-identical event log (docs/SCHED.md)"
+        ),
+    )
+    sd.add_argument("action", choices=["run", "trace"])
+    sd.add_argument(
+        "--seed", type=int, default=None,
+        help="workload seed (default: KIND_TPU_SIM_SCHED_SEED or 0)")
+    sd.add_argument(
+        "--policy", default="binpack,spread,ici",
+        help="comma-separated placement policies to run "
+             "(binpack, spread, ici); one report section each")
+    sd.add_argument(
+        "--gangs", type=int, default=24,
+        help="slice requests in the seeded workload")
+    sd.add_argument(
+        "--pods", default="tpu-v5-lite-podslice:4x8,"
+                          "tpu-v5-lite-podslice:4x8",
+        help="inventory as comma-separated accelerator:topology "
+             "pairs, one ICI domain each")
+    sd.add_argument(
+        "--no-preemption", action="store_true",
+        help="disable priority preemption")
+    sd.add_argument(
+        "--no-defrag", action="store_true",
+        help="disable the defragmentation pass")
+    sd.add_argument(
+        "--manifest", default=None,
+        help="also schedule the TPU workloads parsed from this "
+             "kubernetes manifest (e.g. "
+             "pods/tpu-serving-deployment.yaml) at t=0")
+    sd.add_argument(
+        "--events", action="store_true",
+        help="run: print the full event log as JSON lines "
+             "(kubernetes Event objects)")
+    sd.add_argument(
+        "--out", default=None,
+        help="write the full JSON report to this file")
+    sd.add_argument("--json", action="store_true", dest="as_json")
 
     man = sub.add_parser(
         "manifests",
@@ -564,7 +621,9 @@ def run_fleet(args: argparse.Namespace) -> int:
                             e2e_s=args.e2e_slo),
         autoscaler=fleet.AutoscalerConfig(
             min_replicas=args.replicas,
-            max_replicas=args.max_replicas))
+            max_replicas=args.max_replicas),
+        sched=(fleet.FleetSchedConfig(policy=args.sched_policy)
+               if args.sched else None))
     clock = fleet.VirtualClock()
     factory = None
     if args.engine == "serving":
@@ -625,10 +684,111 @@ def run_fleet(args: argparse.Namespace) -> int:
             a = report["autoscaler"]
             print(f"  autoscaler: +{a['scale_ups']}/-"
                   f"{a['scale_downs']} (warmup {a['warmup_s']}s)")
+        if "scheduler" in report:
+            s = report["scheduler"]
+            ttr = s["time_to_routable"]
+            print(f"  scheduler ({s['policy']}): "
+                  f"time-to-routable mean/max "
+                  f"{ttr['mean_s']}/{ttr['max_s']} s over "
+                  f"{ttr['count']} placement(s) "
+                  f"(flat warmup {s['flat_warmup_s']}s)")
         if args.out:
             print(f"  report -> {args.out}")
         print("FLEET RUN " + ("OK" if report["ok"] else "FAILED"))
     return 0 if report["ok"] else 1
+
+
+def run_sched(args: argparse.Namespace) -> int:
+    """`sched run` / `sched trace`: the deterministic scheduler sim
+    (docs/SCHED.md). The report is sorted-keys JSON of pure
+    virtual-clock state — two runs of the same seed+config are
+    byte-identical, the reproducibility contract `--seed` promises."""
+    from kind_tpu_sim import sched as sched_mod
+
+    seed = sched_mod.resolve_seed(args.seed)
+    pods = []
+    for part in args.pods.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        acc, _, topology = part.partition(":")
+        if not topology:
+            raise ValueError(
+                f"malformed --pods entry {part!r} "
+                "(want accelerator:topology)")
+        pods.append((acc, topology))
+    workload = sched_mod.SchedWorkloadSpec(n_gangs=args.gangs)
+    if args.action == "trace":
+        for req in sched_mod.generate_gangs(workload, seed):
+            print(json.dumps(req.as_dict(), sort_keys=True))
+        return 0
+    policies = [p.strip() for p in args.policy.split(",")
+                if p.strip()]
+    manifest_gangs = []
+    if args.manifest:
+        with open(args.manifest, "r", encoding="utf-8") as fh:
+            manifest_gangs = sched_mod.slice_requests_from_yaml(
+                fh.read())
+    sections = {}
+    for policy in policies:
+        cfg = sched_mod.SchedSimConfig(
+            pods=tuple(pods),
+            sched=sched_mod.SchedConfig(
+                policy=policy,
+                preemption=not args.no_preemption,
+                defrag=not args.no_defrag),
+            workload=workload)
+        if manifest_gangs:
+            # manifest workloads submit at t=0, ahead of the seeded
+            # stream — the kube manifests drive the same sim
+            inv = sched_mod.build_inventory(list(cfg.pods))
+            pre = sched_mod.ClusterScheduler(inv, cfg.sched)
+            for req in manifest_gangs:
+                pre.submit(req, 0.0)
+            pre.step(0.0)
+            sections[f"{policy}:manifest"] = pre.report()
+        sections[policy] = sched_mod.run_sched_sim(cfg, seed)
+    ok = all(s.get("ok", True) for s in sections.values())
+    report = {"seed": seed, "pods": [list(p) for p in pods],
+              "policies": sections, "ok": ok}
+    text = json.dumps(report, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    if args.events:
+        for policy in policies:
+            for ev in sections[policy]["events"]:
+                print(json.dumps(sched_mod.k8s_event(ev),
+                                 sort_keys=True))
+        return 0 if ok else 1
+    if args.as_json:
+        print(text)
+    else:
+        for policy in policies:
+            sec = sections[policy]
+            ttr = sec["time_to_routable"]
+            counts = sec["event_counts"]
+            print(f"  {policy:<10} gangs {sec['scheduled']}/"
+                  f"{sec['gangs']}  ttr mean/max "
+                  f"{ttr['mean_s']}/{ttr['max_s']} s  "
+                  f"preemptions {counts.get('Preempted', 0)}  "
+                  f"migrations {counts.get('Migrated', 0)}  "
+                  f"failed-attempts "
+                  f"{sec['sched_counters'].get('failed_scheduling', 0)}")
+            man = sections.get(f"{policy}:manifest")
+            if man is not None:
+                mcounts = man["event_counts"]
+                total = len(man["bound"]) + len(man["pending"])
+                print(f"  {policy:<10} manifest gangs "
+                      f"{len(man['bound'])}/{total} bound at t=0  "
+                      f"scheduled {mcounts.get('Scheduled', 0)}  "
+                      f"failed-attempts "
+                      f"{mcounts.get('FailedScheduling', 0)}")
+        if args.out:
+            print(f"  report -> {args.out}")
+        print(f"SCHED RUN (seed {seed}) "
+              + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
 
 
 def run_manifests(args: argparse.Namespace) -> int:
@@ -930,6 +1090,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_manifests(args)
         if args.command == "fleet":
             return run_fleet(args)
+        if args.command == "sched":
+            return run_sched(args)
         if args.command == "profile":
             return run_profile(args)
         if args.command == "chaos" and args.action in ("run", "soak"):

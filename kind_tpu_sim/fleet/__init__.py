@@ -39,6 +39,7 @@ from kind_tpu_sim.fleet.router import (  # noqa: F401
 from kind_tpu_sim.fleet.sim import (  # noqa: F401
     ChaosEvent,
     FleetConfig,
+    FleetSchedConfig,
     FleetSim,
     attainment_over,
     resolve_tick_s,

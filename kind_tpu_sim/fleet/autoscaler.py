@@ -87,12 +87,16 @@ class Autoscaler:
         self._last_action_s = -1e18
         self._warming = 0  # replicas paid for but not yet routable
 
-    def note_ready(self, at_s: float, replicas: int) -> None:
-        """The driver reports a warming replica became routable."""
+    def note_ready(self, at_s: float, replicas: int,
+                   reason: str = "warmup complete") -> None:
+        """The driver reports a warming replica became routable.
+        Scheduler-backed fleets pass a reason naming the measured
+        time-to-routable (queue wait + placement + warm-up) so the
+        event log shows what the capacity actually cost."""
         self._warming = max(0, self._warming - 1)
         self.events.append(ScaleEvent(
             at_s=round(at_s, 6), action="replica_ready",
-            replicas=replicas, reason="warmup complete"))
+            replicas=replicas, reason=reason))
         metrics.fleet_board().incr("replicas_ready")
 
     def evaluate(self, now: float, *, routable: int,

@@ -24,7 +24,11 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 from kind_tpu_sim import metrics
-from kind_tpu_sim.fleet.autoscaler import Autoscaler, AutoscalerConfig
+from kind_tpu_sim.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    resolve_warmup_s,
+)
 from kind_tpu_sim.fleet.loadgen import TraceRequest, VirtualClock
 from kind_tpu_sim.fleet.router import (
     ReplicaCompletion,
@@ -52,14 +56,50 @@ def resolve_tick_s(value: Optional[float] = None) -> float:
 class ChaosEvent:
     """A fleet-level fault: ``preempt`` displaces a replica's whole
     load (chaos.py derives these from a seeded FaultPlan); ``restore``
-    heals it."""
+    heals it. With a scheduler-backed fleet (``FleetConfig.sched``)
+    three node-level actions join: ``node_drain`` cordons node index
+    ``target`` and evicts its gangs (replicas preempt, reschedule,
+    and warm back up elsewhere), ``node_fail`` breaks the node
+    outright, ``node_restore`` heals it."""
 
     at_s: float
-    action: str   # preempt | restore
-    target: int   # replica id
+    action: str   # preempt | restore | node_drain | node_fail | node_restore
+    target: int   # replica id, or node index for node_* actions
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSchedConfig:
+    """Scheduler-backed placement for the fleet (docs/SCHED.md).
+
+    When set on :class:`FleetConfig`, replicas stop materializing
+    out of thin air: every replica is a gang placed by
+    :class:`kind_tpu_sim.sched.ClusterScheduler` on an inventory of
+    ``pods``, and a scale-up's time-to-routable becomes queue wait +
+    placement (``bind_s``) + warm-up instead of the flat constant —
+    never less than the flat-warmup baseline, by construction.
+    ``replica_topology`` is the slice each serving replica occupies
+    (default: one whole v5e host); serving replicas run at
+    ``priority`` (above batch fill so chaos/batch experiments can
+    exercise preemption)."""
+
+    pods: tuple = (("tpu-v5-lite-podslice", "4x8"),)
+    policy: str = "ici"
+    bind_s: float = 0.05
+    replica_accelerator: str = "tpu-v5-lite-podslice"
+    replica_topology: str = "2x4"
+    priority: int = 10
+
+    def as_dict(self) -> dict:
+        return {
+            "pods": [list(p) for p in self.pods],
+            "policy": self.policy,
+            "bind_s": self.bind_s,
+            "replica_topology": self.replica_topology,
+            "priority": self.priority,
+        }
 
 
 @dataclasses.dataclass(frozen=True)
@@ -74,9 +114,10 @@ class FleetConfig:
     slo: SloPolicy = SloPolicy(ttft_s=0.5, e2e_s=2.0)
     sim: SimReplicaConfig = SimReplicaConfig()
     autoscaler: AutoscalerConfig = AutoscalerConfig()
+    sched: Optional[FleetSchedConfig] = None
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "replicas": self.replicas,
             "policy": self.policy,
             "tick_s": resolve_tick_s(self.tick_s),
@@ -87,6 +128,9 @@ class FleetConfig:
                     if v is not None},
             "sim": dataclasses.asdict(self.sim),
         }
+        if self.sched is not None:
+            out["sched"] = self.sched.as_dict()
+        return out
 
 
 class FleetSim:
@@ -124,6 +168,116 @@ class FleetSim:
         self._warming: List[tuple] = []   # (ready_at_s, replica)
         self._draining: List = []
         self.preemptions = 0
+        self.sched = None
+        self._now = 0.0
+        if cfg.sched is not None:
+            self._init_scheduler(cfg.sched)
+
+    # -- scheduler-backed placement (docs/SCHED.md) -------------------
+
+    def _init_scheduler(self, sc: "FleetSchedConfig") -> None:
+        """Replicas become gangs on a real inventory: the initial
+        fleet binds at t=0 (a fleet that cannot place its floor is a
+        config error, not a simulation), scale-ups queue through the
+        scheduler, and node chaos evicts through the same
+        ``replica_preempt`` displacement machinery."""
+        from kind_tpu_sim import sched as sched_mod
+
+        self.sched = sched_mod.ClusterScheduler(
+            sched_mod.build_inventory(list(sc.pods)),
+            sched_mod.SchedConfig(policy=sc.policy,
+                                  bind_s=sc.bind_s),
+            on_evict=self._on_gang_evict)
+        self._sched_cfg = sc
+        self._gang_replica: Dict[str, int] = {}
+        # gangs whose bind we are waiting on: name -> requested_at
+        self._gang_requested: Dict[str, float] = {}
+        # replicas evicted by node chaos, awaiting rebind+warmup
+        self._rebinding: List[tuple] = []  # (ready_at_s, replica)
+        self.time_to_routable: List[float] = []
+        for replica in self.replicas:
+            name = f"replica-{replica.replica_id}"
+            self.sched.submit(self._gang_request(name), 0.0)
+            self._gang_replica[name] = replica.replica_id
+        bound = self.sched.step(0.0)
+        if len(bound) < len(self.replicas):
+            raise ValueError(
+                f"inventory cannot place the initial "
+                f"{len(self.replicas)} replica(s); "
+                f"{len(bound)} bound")
+
+    def _gang_request(self, name: str):
+        from kind_tpu_sim import sched as sched_mod
+
+        sc = self._sched_cfg
+        return sched_mod.SliceRequest(
+            name=name, accelerator=sc.replica_accelerator,
+            topology=sc.replica_topology, priority=sc.priority)
+
+    def _replica_by_id(self, rid: int):
+        for r in self.replicas + self._draining:
+            if r.replica_id == rid:
+                return r
+        return None
+
+    def _on_gang_evict(self, request) -> None:
+        """Scheduler eviction -> fleet displacement: the victim
+        replica preempts through the existing chaos machinery (its
+        load requeues at the router FRONT) and the gang rejoins the
+        pending queue; the replica heals only after rebind+warmup."""
+        rid = self._gang_replica.get(request.name)
+        if rid is None:
+            return
+        victim = self._replica_by_id(rid)
+        now = self._now
+        if victim is not None and victim.healthy:
+            displaced = victim.fail(now)
+            self.router.requeue_front(displaced)
+            self.preemptions += 1
+            metrics.fleet_board().incr("replica_preemptions")
+            metrics.recovery_log().record(
+                "fleet_gang_evict", gang=request.name,
+                displaced=len(displaced), at_s=round(now, 6))
+        self._gang_requested[request.name] = now
+
+    def _sched_step(self, now: float) -> None:
+        """Advance the scheduler: bind pending gangs; a bound gang's
+        replica becomes routable after bind latency + warm-up (the
+        measured queue-wait + placement + warm-up path that replaces
+        the flat constant)."""
+        if not self.sched.pending:
+            return
+        warmup = (self.autoscaler.warmup_s
+                  if self.autoscaler is not None
+                  else resolve_warmup_s())
+        for gang in self.sched.step(now):
+            name = gang.request.name
+            requested = self._gang_requested.pop(name, now)
+            ready_at = now + self._sched_cfg.bind_s + warmup
+            ttr = round(ready_at - requested, 6)
+            self.time_to_routable.append(ttr)
+            rid = self._gang_replica[name]
+            existing = self._replica_by_id(rid)
+            if existing is not None:
+                # evicted replica rebound: heals at ready_at
+                self._rebinding.append((ready_at, existing))
+            else:
+                # autoscaler scale-up: new replica warms up
+                self._warming.append((
+                    ready_at, self.factory(rid),
+                    f"bound+warm (time_to_routable={ttr}s)"))
+
+    def _apply_node_chaos(self, ev: "ChaosEvent",
+                          now: float) -> None:
+        from kind_tpu_sim import sched as sched_mod
+
+        names = sorted(self.sched.inv.nodes)
+        node = names[ev.target % len(names)]
+        sched_mod.apply_node_event(self.sched, ev.action, node, now)
+        if ev.action in ("node_drain", "node_fail"):
+            metrics.recovery_log().record(
+                f"fleet_{ev.action}", node=node,
+                at_s=round(now, 6))
 
     # -- bookkeeping ---------------------------------------------------
 
@@ -160,6 +314,13 @@ class FleetSim:
     def _apply_chaos(self, now: float) -> None:
         while self.chaos_events and self.chaos_events[0].at_s <= now:
             ev = self.chaos_events.pop(0)
+            if ev.action.startswith("node_"):
+                if self.sched is None:
+                    raise ValueError(
+                        f"{ev.action} chaos needs a scheduler-"
+                        "backed fleet (FleetConfig.sched)")
+                self._apply_node_chaos(ev, now)
+                continue
             victim = next((r for r in self.replicas
                            if r.replica_id == ev.target), None)
             if victim is None:
@@ -184,10 +345,14 @@ class FleetSim:
         # warming replicas come online first
         ready = [w for w in self._warming if w[0] <= now]
         self._warming = [w for w in self._warming if w[0] > now]
-        for _, replica in ready:
+        for entry in ready:
+            replica = entry[1]
+            reason = (entry[2] if len(entry) > 2
+                      else "warmup complete")
             self.replicas.append(replica)
             self.router.replicas.append(replica)
-            scaler.note_ready(now, len(self.router.replicas))
+            scaler.note_ready(now, len(self.router.replicas),
+                              reason=reason)
         routable = sum(1 for r in self.router.replicas if r.healthy)
         recent = list(self._recent)
         attainment = (sum(recent) / len(recent)
@@ -198,8 +363,16 @@ class FleetSim:
         if action == "scale_up":
             rid = self._next_replica_id
             self._next_replica_id += 1
-            self._warming.append(
-                (now + scaler.warmup_s, self.factory(rid)))
+            if self.sched is not None:
+                # routable only after queue wait + placement +
+                # warm-up — the scheduler path (docs/SCHED.md)
+                name = f"replica-{rid}"
+                self.sched.submit(self._gang_request(name), now)
+                self._gang_replica[name] = rid
+                self._gang_requested[name] = now
+            else:
+                self._warming.append(
+                    (now + scaler.warmup_s, self.factory(rid)))
         elif action == "scale_down":
             # drain the highest-id healthy replica: no new traffic,
             # removed once idle — scale-down never displaces work
@@ -219,9 +392,22 @@ class FleetSim:
         ticks = 0
         while True:
             now = self.clock.now()
+            self._now = now
             if now > self.cfg.max_virtual_s:
                 break
             self._apply_chaos(now)
+            if self.sched is not None:
+                self._sched_step(now)
+                healed = [w for w in self._rebinding
+                          if w[0] <= now]
+                self._rebinding = [w for w in self._rebinding
+                                   if w[0] > now]
+                for _, replica in healed:
+                    replica.restore(now)
+                    metrics.recovery_log().record(
+                        "fleet_gang_rebound",
+                        replica=replica.replica_id,
+                        at_s=round(now, 6))
             while pending and pending[0].arrival_s <= now:
                 shed = self.router.offer(pending.popleft(), now)
                 if shed is not None:
@@ -236,6 +422,10 @@ class FleetSim:
                     self._record(comp, replica.replica_id)
                 if replica.idle():
                     self._draining.remove(replica)
+                    if self.sched is not None:
+                        self.sched.release(
+                            f"replica-{replica.replica_id}", now,
+                            reason="scale-down drained")
             if (self.autoscaler is not None
                     and ticks % self.cfg.eval_every_ticks == 0):
                 self._autoscale(now)
@@ -245,7 +435,10 @@ class FleetSim:
                     and all(r.idle() for r in self.replicas
                             if r.healthy)
                     and not self._draining
-                    and not self.chaos_events):
+                    and not self.chaos_events
+                    and not (self.sched is not None
+                             and (self.sched.pending
+                                  or self._rebinding))):
                 break
             self.clock.advance(tick)
         self.log.sort(key=lambda e: (e["finish_s"],
@@ -270,6 +463,26 @@ class FleetSim:
             report["preemptions"] = self.preemptions
         if self.autoscaler is not None:
             report["autoscaler"] = self.autoscaler.report()
+        if self.sched is not None:
+            ttrs = self.time_to_routable
+            warmup = (self.autoscaler.warmup_s
+                      if self.autoscaler is not None
+                      else resolve_warmup_s())
+            report["scheduler"] = {
+                "policy": self._sched_cfg.policy,
+                "flat_warmup_s": round(warmup, 6),
+                "bind_s": self._sched_cfg.bind_s,
+                "time_to_routable": {
+                    "count": len(ttrs),
+                    "mean_s": (round(sum(ttrs) / len(ttrs), 6)
+                               if ttrs else None),
+                    "max_s": (round(max(ttrs), 6)
+                              if ttrs else None),
+                },
+                "events": self.sched.events,
+                "event_counts":
+                    self.sched.report()["event_counts"],
+            }
         return report
 
 

@@ -232,6 +232,18 @@ def fleet_board() -> CounterBoard:
     return _FLEET_BOARD
 
 
+_SCHED_BOARD = CounterBoard()
+
+
+def sched_board() -> CounterBoard:
+    """The process-global scheduler counter board (gangs submitted/
+    scheduled/released, failed-scheduling decisions, preemptions,
+    defrag migrations, node drains/fails — kind_tpu_sim.sched
+    records into it; sched/fleet reports and bench extras snapshot
+    it)."""
+    return _SCHED_BOARD
+
+
 def parse_k8s_time(stamp: str) -> float:
     """RFC3339 (kubernetes) timestamp -> unix seconds."""
     import datetime

@@ -1,0 +1,38 @@
+"""Deterministic topology-aware TPU slice scheduler sim (docs/SCHED.md).
+
+The placement layer between the kube manifests in ``pods/`` and the
+fleet simulator: a virtual-clock cluster scheduler that places TPU
+slice requests (gangs) onto a node inventory derived from
+:mod:`kind_tpu_sim.topology` — gang all-or-nothing admission,
+binpack / spread / ICI-contiguity scoring, priority preemption, and
+defragmentation, with a byte-identical seeded event log.
+
+Knobs: KIND_TPU_SIM_SCHED_SEED (scheduler.resolve_seed).
+"""
+
+from kind_tpu_sim.sched.inventory import (  # noqa: F401
+    IciDomain,
+    Inventory,
+    Node,
+    Placement,
+    build_inventory,
+)
+from kind_tpu_sim.sched.kubeface import (  # noqa: F401
+    PRIORITY_CLASSES,
+    k8s_event,
+    slice_requests_from_yaml,
+    to_pod_manifest,
+)
+from kind_tpu_sim.sched.scheduler import (  # noqa: F401
+    POLICIES,
+    BoundGang,
+    ClusterScheduler,
+    SchedConfig,
+    SchedSimConfig,
+    SchedWorkloadSpec,
+    SliceRequest,
+    apply_node_event,
+    generate_gangs,
+    resolve_seed,
+    run_sched_sim,
+)

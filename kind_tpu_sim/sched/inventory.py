@@ -1,0 +1,316 @@
+"""Simulated node inventory for the cluster scheduler.
+
+The scheduler's world model, derived from the same
+:mod:`kind_tpu_sim.topology` source of truth the orchestrator and
+device plugin use: every simulated TPU pool is one or more **ICI
+domains** (physical pods/slices), each a grid of hosts; every host is
+a :class:`Node` carrying ``google.com/tpu`` chip capacity, its GKE
+label set (accelerator, topology, worker id, host coordinate), and a
+pool/zone assignment.
+
+Placement granularity mirrors Cloud TPU:
+
+* a **multi-host** slice request binds an axis-aligned contiguous
+  block of WHOLE hosts inside one ICI domain (ICI only wires grid
+  neighbors — see :func:`kind_tpu_sim.topology.enumerate_block_anchors`);
+* a **single-host** request (``chips <= chips_per_host``) binds chips
+  on one node and may share the host with other single-host slices —
+  the v5e sub-host shapes (1x1, 2x2, 2x4) are chip-granular.
+
+The inventory is pure bookkeeping: feasibility enumeration and
+free-capacity accounting live here, *choosing* among feasible
+placements (binpack / spread / ICI-contiguity scoring, preemption,
+defrag) is :mod:`kind_tpu_sim.sched.scheduler`'s job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from kind_tpu_sim import topology as topo
+
+LABEL_POOL = "kind-tpu-sim.dev/pool"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+
+
+@dataclasses.dataclass
+class Node:
+    """One simulated host: a kind worker owning a block of chips."""
+
+    name: str
+    domain: str                    # owning ICI domain id
+    coord: Tuple[int, ...]         # host coordinate in the domain grid
+    capacity: int                  # google.com/tpu allocatable
+    pool: str
+    zone: str
+    labels: Dict[str, str]
+    free: int = -1                 # -1 -> set to capacity in __post_init__
+    cordoned: bool = False         # drained: no new bindings
+    broken: bool = False           # failed: capacity gone entirely
+
+    def __post_init__(self) -> None:
+        if self.free < 0:
+            self.free = self.capacity
+
+    @property
+    def schedulable(self) -> bool:
+        return not self.cordoned and not self.broken
+
+    @property
+    def whole_free(self) -> bool:
+        """Free for a multi-host gang: the ENTIRE host is unused."""
+        return self.schedulable and self.free == self.capacity
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "domain": self.domain,
+            "coord": list(self.coord),
+            "capacity": self.capacity,
+            "free": self.free,
+            "pool": self.pool,
+            "zone": self.zone,
+            "cordoned": self.cordoned,
+            "broken": self.broken,
+        }
+
+
+@dataclasses.dataclass
+class IciDomain:
+    """One physical pod/slice: a host grid wired by ICI."""
+
+    domain_id: str
+    accelerator: str               # topo.ACCELERATORS key
+    host_grid: Tuple[int, ...]
+    nodes: Dict[Tuple[int, ...], Node]
+
+    @property
+    def spec(self) -> topo.AcceleratorSpec:
+        return topo.ACCELERATORS[self.accelerator]
+
+    def free_chips(self) -> int:
+        return sum(n.free for n in self.nodes.values()
+                   if n.schedulable)
+
+    def whole_free_coords(self) -> set:
+        return {c for c, n in self.nodes.items() if n.whole_free}
+
+    def largest_free_block(self) -> int:
+        """Host count of the largest axis-aligned box of whole-free
+        hosts — the fragmentation metric ICI-contiguity scoring
+        maximizes. Brute force over all box shapes/anchors; domain
+        grids are tens of hosts, not thousands."""
+        free = self.whole_free_coords()
+        if not free:
+            return 0
+        best = 1
+        shapes = _box_shapes(self.host_grid)
+        for shape in shapes:
+            size = 1
+            for d in shape:
+                size *= d
+            if size <= best:
+                continue
+            for anchor in topo.enumerate_block_anchors(
+                    self.host_grid, shape):
+                if all(c in free
+                       for c in topo.block_coords(anchor, shape)):
+                    best = size
+                    break
+        return best
+
+
+def _box_shapes(grid: Tuple[int, ...]) -> List[Tuple[int, ...]]:
+    """All axis-aligned box shapes that could fit in ``grid``,
+    largest volume first (so largest_free_block can early-exit)."""
+    ranges = [range(1, d + 1) for d in grid]
+    shapes: List[Tuple[int, ...]] = []
+
+    def rec(prefix: Tuple[int, ...], rest) -> None:
+        if not rest:
+            shapes.append(prefix)
+            return
+        for v in rest[0]:
+            rec(prefix + (v,), rest[1:])
+
+    rec((), ranges)
+    shapes.sort(key=lambda s: (-_prod(s), s))
+    return shapes
+
+
+def _prod(t: Tuple[int, ...]) -> int:
+    out = 1
+    for v in t:
+        out *= v
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """A concrete feasible binding for one gang: which nodes, how
+    many chips on each. Multi-host placements carry the anchor of
+    their contiguous block; single-host ones anchor at the node."""
+
+    domain: str
+    anchor: Tuple[int, ...]
+    node_names: Tuple[str, ...]
+    chips_per_node: int
+
+    def as_dict(self) -> dict:
+        return {
+            "domain": self.domain,
+            "anchor": list(self.anchor),
+            "nodes": list(self.node_names),
+            "chips_per_node": self.chips_per_node,
+        }
+
+
+class Inventory:
+    """All schedulable nodes, grouped into ICI domains."""
+
+    def __init__(self, domains: List[IciDomain]):
+        self.domains: Dict[str, IciDomain] = {
+            d.domain_id: d for d in domains}
+        self.nodes: Dict[str, Node] = {}
+        for d in domains:
+            for node in d.nodes.values():
+                if node.name in self.nodes:
+                    raise ValueError(
+                        f"duplicate node name {node.name!r}")
+                self.nodes[node.name] = node
+
+    # -- feasibility -------------------------------------------------
+
+    def candidate_placements(
+        self, *, accelerator: str, host_block: Tuple[int, ...],
+        chips_per_node: int, pool: Optional[str] = None,
+    ) -> List[Placement]:
+        """Every feasible placement, deterministic order (domain id,
+        then anchor lexicographic). ``host_block`` is the request's
+        host grid — ``(1,) * ndims`` means single-host and admits
+        chip-granular sharing; anything larger requires whole-free
+        hosts in a contiguous block."""
+        out: List[Placement] = []
+        single = all(b == 1 for b in host_block)
+        for did in sorted(self.domains):
+            dom = self.domains[did]
+            if dom.accelerator != accelerator:
+                continue
+            if pool is not None and any(
+                    n.pool != pool for n in dom.nodes.values()):
+                continue
+            if len(host_block) != len(dom.host_grid):
+                continue
+            if single:
+                for coord in sorted(dom.nodes):
+                    node = dom.nodes[coord]
+                    if (node.schedulable
+                            and node.free >= chips_per_node):
+                        out.append(Placement(
+                            domain=did, anchor=coord,
+                            node_names=(node.name,),
+                            chips_per_node=chips_per_node))
+                continue
+            free = dom.whole_free_coords()
+            for anchor in topo.enumerate_block_anchors(
+                    dom.host_grid, host_block):
+                coords = topo.block_coords(anchor, host_block)
+                if all(c in free for c in coords):
+                    out.append(Placement(
+                        domain=did, anchor=anchor,
+                        node_names=tuple(
+                            dom.nodes[c].name for c in coords),
+                        chips_per_node=chips_per_node))
+        return out
+
+    # -- accounting --------------------------------------------------
+
+    def bind(self, placement: Placement) -> None:
+        for name in placement.node_names:
+            node = self.nodes[name]
+            if node.free < placement.chips_per_node:
+                raise RuntimeError(
+                    f"bind over capacity on {name}")
+            node.free -= placement.chips_per_node
+
+    def release(self, placement: Placement) -> None:
+        for name in placement.node_names:
+            node = self.nodes[name]
+            node.free = min(node.capacity,
+                            node.free + placement.chips_per_node)
+
+    def cordon(self, node_name: str) -> None:
+        self.nodes[node_name].cordoned = True
+
+    def uncordon(self, node_name: str) -> None:
+        self.nodes[node_name].cordoned = False
+
+    def fail_node(self, node_name: str) -> None:
+        self.nodes[node_name].broken = True
+
+    def restore_node(self, node_name: str) -> None:
+        self.nodes[node_name].broken = False
+
+    # -- reporting ---------------------------------------------------
+
+    def free_chips(self) -> int:
+        return sum(d.free_chips() for d in self.domains.values())
+
+    def capacity_chips(self) -> int:
+        return sum(n.capacity for n in self.nodes.values()
+                   if not n.broken)
+
+    def as_dict(self) -> dict:
+        return {
+            "domains": {
+                did: {
+                    "accelerator": d.accelerator,
+                    "host_grid": list(d.host_grid),
+                    "free_chips": d.free_chips(),
+                    "largest_free_block_hosts":
+                        d.largest_free_block(),
+                    "nodes": [d.nodes[c].as_dict()
+                              for c in sorted(d.nodes)],
+                }
+                for did, d in sorted(self.domains.items())
+            },
+            "free_chips": self.free_chips(),
+            "capacity_chips": self.capacity_chips(),
+        }
+
+
+def build_inventory(
+    pods: List[Tuple[str, str]],
+    *, pool: str = "default", zone: str = "zone-a",
+    name_prefix: str = "tpu-node",
+) -> Inventory:
+    """Inventory from physical pod shapes: ``pods`` is a list of
+    (accelerator, topology) — each entry one ICI domain whose host
+    grid comes from :class:`~kind_tpu_sim.topology.SliceTopology`
+    (so a v4-style ``2x2xN`` chip grid yields contiguous-placeable
+    host sub-blocks). Node names/labels mirror what the orchestrator
+    applies to kind workers."""
+    domains: List[IciDomain] = []
+    for idx, (accelerator, topology) in enumerate(pods):
+        s = topo.make_slice(accelerator, topology)
+        did = f"pod-{idx}"
+        nodes: Dict[Tuple[int, ...], Node] = {}
+        coords = s.host_coords()
+        for worker_id, coord in enumerate(coords):
+            labels = dict(s.node_labels(worker_id))
+            labels[LABEL_POOL] = pool
+            labels[LABEL_ZONE] = zone
+            nodes[coord] = Node(
+                name=f"{name_prefix}-{idx}-{worker_id}",
+                domain=did,
+                coord=coord,
+                capacity=s.chips_per_host,
+                pool=pool,
+                zone=zone,
+                labels=labels,
+            )
+        domains.append(IciDomain(
+            domain_id=did, accelerator=accelerator,
+            host_grid=s.host_grid, nodes=nodes))
+    return Inventory(domains)

@@ -252,6 +252,64 @@ def _suffix_products(grid: Tuple[int, ...]) -> List[int]:
     return list(reversed(out))
 
 
+# ---------------------------------------------------------------------
+# contiguous sub-block geometry (the scheduler's ICI-fit primitive)
+
+
+def enumerate_block_anchors(
+    outer: Tuple[int, ...], block: Tuple[int, ...]
+) -> List[Tuple[int, ...]]:
+    """Every anchor (minimum corner) at which an axis-aligned
+    ``block`` fits inside the ``outer`` grid, in lexicographic order.
+
+    This is the geometric core of ICI-contiguous placement
+    (:mod:`kind_tpu_sim.sched`): a multi-host slice request occupies
+    a contiguous axis-aligned box of hosts inside one ICI domain's
+    host grid — TPU ICI links only connect grid neighbors, so a
+    non-contiguous gang would have no wired path between its hosts.
+    No rotation: slice topologies are requested in pod orientation
+    (GKE does not rotate slices either).
+    """
+    if len(outer) != len(block):
+        raise ValueError(
+            f"rank mismatch: outer {outer} vs block {block}")
+    if any(b < 1 for b in block):
+        raise ValueError(f"malformed block {block}")
+    if any(b > o for o, b in zip(outer, block)):
+        return []
+    ranges = [range(o - b + 1) for o, b in zip(outer, block)]
+    anchors: List[Tuple[int, ...]] = []
+
+    def rec(prefix: Tuple[int, ...], rest) -> None:
+        if not rest:
+            anchors.append(prefix)
+            return
+        for v in rest[0]:
+            rec(prefix + (v,), rest[1:])
+
+    rec((), ranges)
+    return anchors
+
+
+def block_coords(
+    anchor: Tuple[int, ...], block: Tuple[int, ...]
+) -> List[Tuple[int, ...]]:
+    """Row-major coordinates of every cell in the axis-aligned box
+    ``block`` anchored at ``anchor``."""
+    coords: List[Tuple[int, ...]] = []
+
+    def rec(prefix: Tuple[int, ...], dims) -> None:
+        if not dims:
+            coords.append(prefix)
+            return
+        a, b = dims[0]
+        for v in range(a, a + b):
+            rec(prefix + (v,), dims[1:])
+
+    rec((), list(zip(anchor, block)))
+    return coords
+
+
 def default_hostnames(num_hosts: int) -> List[str]:
     """Stable in-cluster DNS names for the multi-host JAX StatefulSet.
 

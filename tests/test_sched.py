@@ -1,0 +1,545 @@
+"""Scheduler-subsystem tests (kind_tpu_sim/sched, docs/SCHED.md).
+
+Everything here runs on the virtual clock — no jax, no cluster, no
+wall-clock dependence — so the whole file is tier-1 fast. The
+invariants covered are the ISSUE-4 acceptance list: seeded
+determinism (byte-identical event logs), gang all-or-nothing under
+fragmentation, ICI-contiguity beating spread on a multi-host
+workload, strictly-by-priority preemption, defrag convergence
+without displacing equal-or-higher priority, node-drain recovery,
+and the kubeface round-trip of the real serving manifest.
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from kind_tpu_sim import chaos, fleet, sched
+from kind_tpu_sim import topology as topo
+from kind_tpu_sim.sched.inventory import Placement
+
+pytestmark = pytest.mark.sched
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+# -- geometry ----------------------------------------------------------
+
+
+def test_enumerate_block_anchors_and_coords():
+    anchors = topo.enumerate_block_anchors((2, 2), (2, 1))
+    assert anchors == [(0, 0), (0, 1)]
+    assert topo.block_coords((0, 1), (2, 1)) == [(0, 1), (1, 1)]
+    # block larger than the grid: nothing fits
+    assert topo.enumerate_block_anchors((2, 2), (3, 1)) == []
+    with pytest.raises(ValueError):
+        topo.enumerate_block_anchors((2, 2), (1,))
+
+
+def test_inventory_largest_free_block_tracks_bindings():
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    dom = inv.domains["pod-0"]
+    assert dom.host_grid == (2, 2)
+    assert dom.largest_free_block() == 4
+    # occupy one corner: the best free box drops to a 2-host strip
+    node = dom.nodes[(0, 0)]
+    node.free -= 1
+    assert dom.largest_free_block() == 2
+
+
+# -- determinism -------------------------------------------------------
+
+
+def test_sched_sim_seeded_determinism():
+    cfg = sched.SchedSimConfig()
+    r1 = sched.run_sched_sim(cfg, seed=7)
+    r2 = sched.run_sched_sim(cfg, seed=7)
+    strip = lambda r: {k: v for k, v in r.items()  # noqa: E731
+                       if k != "sched_counters"}
+    assert (json.dumps(strip(r1), sort_keys=True)
+            == json.dumps(strip(r2), sort_keys=True))
+    assert r1["ok"]
+    r3 = sched.run_sched_sim(cfg, seed=8)
+    assert r3["events"] != r1["events"]
+
+
+def test_generate_gangs_pure_function_of_spec_and_seed():
+    spec = sched.SchedWorkloadSpec(n_gangs=6)
+    assert (sched.generate_gangs(spec, 3)
+            == sched.generate_gangs(spec, 3))
+    assert (sched.generate_gangs(spec, 3)
+            != sched.generate_gangs(spec, 4))
+
+
+# -- gang all-or-nothing ----------------------------------------------
+
+
+def test_gang_all_or_nothing_under_fragmentation():
+    """A 2-host gang facing one free host must bind NOTHING: no
+    partial allocation, free capacity untouched, request pending."""
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(preemption=False, defrag=False))
+    for i in range(3):
+        s.submit(sched.SliceRequest(name=f"fill-{i}",
+                                    topology="2x4"), 0.0)
+    s.step(0.0)
+    assert len(s.bound) == 3
+    free_before = inv.free_chips()
+    assert free_before == 8  # exactly one whole host left
+    s.submit(sched.SliceRequest(name="gang", topology="4x4"), 1.0)
+    s.step(1.0)
+    assert "gang" not in s.bound
+    assert inv.free_chips() == free_before
+    assert [r.name for r in s.pending] == ["gang"]
+    fails = [e for e in s.events
+             if e["type"] == "FailedScheduling"
+             and e["gang"] == "gang"]
+    assert fails and "contiguous" in fails[0]["message"]
+
+
+def test_failed_scheduling_events_dedup_not_spam():
+    """A stuck gang emits ONE FailedScheduling per distinct message
+    (kube-scheduler event dedup), while every attempt still counts."""
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(preemption=False, defrag=False))
+    s.submit(sched.SliceRequest(name="too-big", topology="8x8"), 0.0)
+    for tick in range(5):
+        s.step(float(tick))
+    fails = [e for e in s.events
+             if e["type"] == "FailedScheduling"]
+    assert len(fails) == 1
+    assert s.failed_attempts == 5
+
+
+# -- policy: ICI contiguity beats spread ------------------------------
+
+
+def _frag_then_gang(policy: str) -> sched.ClusterScheduler:
+    """Two sub-host (4-chip) slices, then a 2-host 4x4 gang, on one
+    2x2-host domain. No preemption/defrag: pure placement quality."""
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(policy=policy, preemption=False,
+                               defrag=False))
+    for i in range(2):
+        s.submit(sched.SliceRequest(name=f"small-{i}",
+                                    topology="2x2"), 0.0)
+        s.step(0.0)
+    s.submit(sched.SliceRequest(name="gang", topology="4x4"), 1.0)
+    s.step(1.0)
+    return s
+
+
+def test_ici_contiguity_beats_spread_on_multihost_gang():
+    """spread scatters the sub-host slices across two whole hosts —
+    no contiguous 2-host column survives and the gang starves; ici
+    co-locates them on one host and the gang binds immediately."""
+    spread = _frag_then_gang("spread")
+    assert "gang" not in spread.bound
+    assert any(e["type"] == "FailedScheduling"
+               and e["gang"] == "gang" for e in spread.events)
+    ici = _frag_then_gang("ici")
+    assert "gang" in ici.bound
+    # and the two sub-host slices share one node under ici
+    small_nodes = {ici.bound[f"small-{i}"].placement.node_names
+                   for i in range(2)}
+    assert len(small_nodes) == 1
+
+
+def test_binpack_consolidates_versus_spread():
+    inv_b = sched.build_inventory([("tpu-v5-lite-podslice", "4x8"),
+                                   ("tpu-v5-lite-podslice", "4x8")])
+    s_b = sched.ClusterScheduler(
+        inv_b, sched.SchedConfig(policy="binpack"))
+    inv_s = sched.build_inventory([("tpu-v5-lite-podslice", "4x8"),
+                                   ("tpu-v5-lite-podslice", "4x8")])
+    s_s = sched.ClusterScheduler(
+        inv_s, sched.SchedConfig(policy="spread"))
+    for s in (s_b, s_s):
+        for i in range(2):
+            s.submit(sched.SliceRequest(name=f"g{i}",
+                                        topology="2x4"), 0.0)
+        s.step(0.0)
+    doms_b = {s_b.bound[f"g{i}"].placement.domain
+              for i in range(2)}
+    doms_s = {s_s.bound[f"g{i}"].placement.domain
+              for i in range(2)}
+    assert len(doms_b) == 1    # binpack: same domain
+    assert len(doms_s) == 2    # spread: one per domain
+
+
+# -- preemption --------------------------------------------------------
+
+
+def test_preemption_evicts_strictly_by_priority():
+    """Four full hosts at priorities [-10, -5, 0, 5]; a priority-10
+    2-host gang evicts the LOWEST priorities first, never touches
+    an equal-or-higher gang, and the victims requeue."""
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(policy="ici", defrag=False))
+    prios = {"a": -10, "b": -5, "c": 0, "d": 5}
+    for name, prio in prios.items():
+        s.submit(sched.SliceRequest(name=name, topology="2x4",
+                                    priority=prio), 0.0)
+    s.step(0.0)
+    assert len(s.bound) == 4
+    s.submit(sched.SliceRequest(name="hi", topology="4x4",
+                                priority=10), 1.0)
+    s.step(1.0)
+    assert "hi" in s.bound
+    victims = [e["gang"] for e in s.events
+               if e["type"] == "Preempted"]
+    assert victims  # something was displaced
+    # strictly lower priority, lowest first
+    assert all(prios[v] < 10 for v in victims)
+    assert victims == sorted(victims, key=lambda v: prios[v])
+    assert "d" in s.bound  # the priority-5 gang survived
+    # displaced gangs are pending again
+    assert {r.name for r in s.pending} == set(victims)
+
+
+def test_preemption_never_evicts_equal_priority():
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(policy="ici", defrag=False))
+    for i in range(4):
+        s.submit(sched.SliceRequest(name=f"peer-{i}",
+                                    topology="2x4", priority=5),
+                 0.0)
+    s.step(0.0)
+    s.submit(sched.SliceRequest(name="rival", topology="4x4",
+                                priority=5), 1.0)
+    s.step(1.0)
+    assert "rival" not in s.bound
+    assert not [e for e in s.events if e["type"] == "Preempted"]
+    assert len(s.bound) == 4
+
+
+def test_preemption_rolls_back_when_eviction_cannot_help():
+    """Evicting every lower-priority gang still would not fit the
+    request (wrong accelerator family in the domain): nothing is
+    evicted — the trial releases roll back completely."""
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(policy="ici", defrag=False))
+    s.submit(sched.SliceRequest(name="low", topology="2x4",
+                                priority=-10), 0.0)
+    s.step(0.0)
+    free_before = inv.free_chips()
+    s.submit(sched.SliceRequest(
+        name="v4-gang", accelerator="tpu-v4-podslice",
+        topology="2x2x4", priority=10), 1.0)
+    s.step(1.0)
+    assert "v4-gang" not in s.bound
+    assert "low" in s.bound
+    assert inv.free_chips() == free_before
+    assert not [e for e in s.events if e["type"] == "Preempted"]
+
+
+# -- defragmentation ---------------------------------------------------
+
+
+def _diagonal_layout(low_priority: int):
+    """Two 4-chip slices pinned to DIAGONAL corners of the 2x2 host
+    grid — every 2-host column is blocked, yet half the capacity is
+    free. The canonical defrag-able state."""
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(policy="ici", preemption=False))
+    s.submit(sched.SliceRequest(name="low-a", topology="2x2",
+                                priority=low_priority), 0.0)
+    s.step(0.0)
+    assert s.bound["low-a"].placement.anchor == (0, 0)
+    req_b = sched.SliceRequest(name="low-b", topology="2x2",
+                               priority=low_priority)
+    s._arrival_seq[req_b.name] = s._seq
+    s._seq += 1
+    dom = inv.domains["pod-0"]
+    s._bind(req_b, Placement(
+        domain="pod-0", anchor=(1, 1),
+        node_names=(dom.nodes[(1, 1)].name,),
+        chips_per_node=4), 0.0)
+    return inv, s
+
+
+def test_defrag_migrates_lower_priority_to_open_hole():
+    inv, s = _diagonal_layout(low_priority=-10)
+    s.submit(sched.SliceRequest(name="hi", topology="4x4",
+                                priority=5), 1.0)
+    s.step(1.0)
+    assert "hi" in s.bound
+    moves = [e for e in s.events if e["type"] == "Migrated"]
+    assert moves and all(e["gang"].startswith("low-")
+                         for e in moves)
+    # migration displaced no capacity: both low gangs still bound
+    assert "low-a" in s.bound and "low-b" in s.bound
+    sched_ev = next(e for e in s.events
+                    if e["type"] == "Scheduled"
+                    and e["gang"] == "hi")
+    assert sched_ev["via"] == "defrag"
+
+
+def test_defrag_never_displaces_equal_or_higher_priority():
+    inv, s = _diagonal_layout(low_priority=5)
+    free_before = inv.free_chips()
+    placements_before = {n: g.placement
+                         for n, g in s.bound.items()}
+    s.submit(sched.SliceRequest(name="hi", topology="4x4",
+                                priority=5), 1.0)
+    s.step(1.0)
+    assert "hi" not in s.bound
+    assert not [e for e in s.events if e["type"] == "Migrated"]
+    assert inv.free_chips() == free_before
+    assert {n: g.placement for n, g in s.bound.items()
+            if n != "hi"} == placements_before
+
+
+def test_defrag_converges_within_move_budget():
+    """defrag_pass terminates (bounded by max_defrag_moves) and is
+    idempotent once no useful move exists."""
+    inv, s = _diagonal_layout(low_priority=-10)
+    req = sched.SliceRequest(name="hi", topology="4x4", priority=5)
+    assert s.defrag_pass(req, 1.0) is True
+    moves = len([e for e in s.events if e["type"] == "Migrated"])
+    assert moves <= s.cfg.max_defrag_moves
+    # a second pass finds the request already placeable: no new moves
+    assert s.defrag_pass(req, 2.0) is True
+    assert len([e for e in s.events
+                if e["type"] == "Migrated"]) == moves
+
+
+# -- node chaos through the fleet -------------------------------------
+
+
+def _fleet_cfg(**kw):
+    return fleet.FleetConfig(
+        replicas=2, policy="least-outstanding", tick_s=0.01,
+        sim=fleet.SimReplicaConfig(max_slots=4,
+                                   prefill_per_tok_s=0.002,
+                                   tpot_s=0.002),
+        slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+        sched=fleet.FleetSchedConfig(), **kw)
+
+
+@pytest.mark.chaos
+def test_node_drain_scenario_recovers_attainment():
+    report = chaos.run_scenario("sched-node-drain", seed=7)
+    assert report["ok"], report
+    assert report["sched_events"]["NodeDrained"] == 1
+    assert (report["tail_attainment_faulted"]
+            >= report["tail_attainment_clean"])
+
+
+@pytest.mark.chaos
+def test_sched_preemption_priority_scenario():
+    report = chaos.run_scenario("sched-preemption-priority", seed=7)
+    assert report["ok"], report
+    assert report["events_identical"]
+    assert all(v.startswith("batch-") for v in report["victims"])
+
+
+def test_fleet_node_fail_evicts_and_recovers():
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=120, prompt_len=(8, 16),
+                              max_new=(4, 8))
+    trace = fleet.generate_trace(spec, 3)
+    clean = fleet.FleetSim(_fleet_cfg(), trace).run()
+    # fail the node hosting replica-0's gang (placement is
+    # deterministic, so the victim is known a priori)
+    placed = next(e for e in clean["scheduler"]["events"]
+                  if e["type"] == "Scheduled"
+                  and e["gang"] == "replica-0")
+    probe = fleet.FleetSim(_fleet_cfg(), [])
+    names = sorted(probe.sched.inv.nodes)
+    target = names.index(placed["nodes"][0])
+    arr_max = max(r.arrival_s for r in trace)
+    events = [fleet.ChaosEvent(at_s=round(arr_max / 3, 6),
+                               action="node_fail", target=target),
+              fleet.ChaosEvent(at_s=round(2 * arr_max / 3, 6),
+                               action="node_restore",
+                               target=target)]
+    faulted = fleet.FleetSim(_fleet_cfg(), trace,
+                             chaos_events=events).run()
+    assert faulted["ok"]
+    counts = faulted["scheduler"]["event_counts"]
+    assert counts["NodeFailed"] == 1
+    assert counts["Preempted"] >= 1
+    tokens = lambda rep: sum(  # noqa: E731
+        e["tokens"] for e in rep["completions"])
+    assert tokens(faulted) == tokens(clean)
+
+
+def test_node_chaos_requires_scheduler_backed_fleet():
+    trace = fleet.generate_trace(
+        fleet.WorkloadSpec(n_requests=5), 0)
+    cfg = fleet.FleetConfig(replicas=1)
+    events = [fleet.ChaosEvent(at_s=0.0, action="node_drain",
+                               target=0)]
+    with pytest.raises(ValueError, match="scheduler-backed"):
+        fleet.FleetSim(cfg, trace, chaos_events=events).run()
+
+
+# -- scheduler-backed autoscaler --------------------------------------
+
+
+def test_scheduled_autoscaler_ttr_at_least_flat_warmup():
+    spec = fleet.WorkloadSpec(process="bursty", rps=400.0,
+                              n_requests=250, prompt_len=(16, 32),
+                              max_new=(4, 8))
+    trace = fleet.generate_trace(spec, 7)
+    cfg = fleet.FleetConfig(
+        replicas=1, policy="least-outstanding",
+        sim=fleet.SimReplicaConfig(max_slots=4,
+                                   prefill_per_tok_s=0.004,
+                                   tpot_s=0.002),
+        autoscale=True,
+        autoscaler=fleet.AutoscalerConfig(max_replicas=4,
+                                          warmup_s=0.2),
+        sched=fleet.FleetSchedConfig())
+    report = fleet.FleetSim(cfg, trace).run()
+    assert report["ok"]
+    s = report["scheduler"]
+    assert s["time_to_routable"]["count"] >= 1
+    # queue wait + placement + warm-up can never beat flat warm-up
+    assert (s["time_to_routable"]["mean_s"]
+            >= s["flat_warmup_s"])
+    ready = [e for e in report["autoscaler"]["events"]
+             if e["action"] == "replica_ready"]
+    assert any("time_to_routable" in e["reason"] for e in ready)
+
+
+def test_scheduled_fleet_report_byte_identical():
+    spec = fleet.WorkloadSpec(process="poisson", rps=100.0,
+                              n_requests=80)
+    trace = fleet.generate_trace(spec, 5)
+
+    def run():
+        rep = fleet.FleetSim(_fleet_cfg(), trace).run()
+        return json.dumps(
+            {k: v for k, v in rep.items()
+             if k != "fleet_counters"}, sort_keys=True)
+
+    assert run() == run()
+
+
+def test_initial_replicas_must_fit_inventory():
+    cfg = fleet.FleetConfig(
+        replicas=5,  # 5 whole-host replicas on a 4-host inventory
+        sched=fleet.FleetSchedConfig())
+    with pytest.raises(ValueError, match="cannot place"):
+        fleet.FleetSim(cfg, [])
+
+
+# -- kubeface ----------------------------------------------------------
+
+
+def test_kubeface_round_trips_serving_deployment():
+    text = (REPO / "pods" / "tpu-serving-deployment.yaml").read_text()
+    reqs = sched.slice_requests_from_yaml(text)
+    assert [r.name for r in reqs] == [
+        f"tpu-sim-serving-{i}" for i in range(3)]
+    assert all(r.priority == 10 for r in reqs)
+    assert all(r.num_hosts == 1 and r.num_chips == 1 for r in reqs)
+    # the emitted pod manifest parses back to the identical request
+    for req in reqs:
+        back = sched.slice_requests_from_yaml(
+            sched.to_pod_manifest(req))
+        assert back == [req]
+
+
+def test_kubeface_statefulset_is_one_gang():
+    text = (REPO / "pods" / "jax-multihost.yaml").read_text()
+    reqs = sched.slice_requests_from_yaml(text)
+    assert len(reqs) == 1
+    (req,) = reqs
+    assert req.name == "jax-tpu"
+    assert req.topology == "4x4"
+    assert req.num_hosts == 2  # all-or-nothing pair
+
+
+def test_kubeface_batch_job_priority_and_gang():
+    text = (REPO / "pods" / "tpu-batch-train-job.yaml").read_text()
+    reqs = sched.slice_requests_from_yaml(text)
+    assert len(reqs) == 1
+    (req,) = reqs
+    assert req.priority == -10
+    assert req.hold_s == 30.0
+    assert req.num_hosts == 2
+    # the batch gang is schedulable on the default inventory and is
+    # evicted by the serving tier, never the reverse
+    assert req.priority < 10
+
+
+def test_kubeface_failed_scheduling_event_shape():
+    inv = sched.build_inventory([("tpu-v5-lite-podslice", "4x8")])
+    s = sched.ClusterScheduler(
+        inv, sched.SchedConfig(preemption=False, defrag=False))
+    s.submit(sched.SliceRequest(name="huge", topology="8x8"), 0.0)
+    s.step(0.0)
+    fail = next(e for e in s.events
+                if e["type"] == "FailedScheduling")
+    ev = sched.k8s_event(fail)
+    assert ev["kind"] == "Event"
+    assert ev["type"] == "Warning"
+    assert ev["reason"] == "FailedScheduling"
+    assert ev["involvedObject"]["name"] == "huge"
+    assert "google.com/tpu" in ev["message"]
+
+
+def test_kubeface_rejects_unknown_priority_class():
+    bad = """
+apiVersion: v1
+kind: Pod
+metadata: {name: p}
+spec:
+  priorityClassName: platinum
+  containers:
+    - name: c
+      image: busybox
+      resources: {limits: {google.com/tpu: "1"}}
+"""
+    with pytest.raises(ValueError, match="platinum"):
+        sched.slice_requests_from_yaml(bad)
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def test_cli_sched_run_byte_identical(capsys):
+    from kind_tpu_sim import cli
+
+    assert cli.main(["sched", "run", "--seed", "7", "--json"]) == 0
+    first = capsys.readouterr().out
+    assert cli.main(["sched", "run", "--seed", "7", "--json"]) == 0
+    second = capsys.readouterr().out
+    assert first == second
+    report = json.loads(first)
+    assert report["ok"]
+    assert set(report["policies"]) == {"binpack", "spread", "ici"}
+
+
+def test_cli_sched_trace_lists_seeded_workload(capsys):
+    from kind_tpu_sim import cli
+
+    assert cli.main(["sched", "trace", "--seed", "7",
+                     "--gangs", "5"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 5
+    assert json.loads(lines[0])["name"] == "gang-000"
+
+
+def test_cli_sched_run_with_manifest(capsys):
+    from kind_tpu_sim import cli
+
+    manifest = str(REPO / "pods" / "tpu-serving-deployment.yaml")
+    assert cli.main(["sched", "run", "--seed", "7", "--json",
+                     "--policy", "ici", "--manifest",
+                     manifest]) == 0
+    report = json.loads(capsys.readouterr().out)
+    pre = report["policies"]["ici:manifest"]
+    assert set(pre["bound"]) == {
+        f"tpu-sim-serving-{i}" for i in range(3)}
