@@ -2313,6 +2313,83 @@ def sched_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def gray_smoke() -> dict | None:
+    """Gray-failure-tier extras: the same seeded trace run three ways
+    through a fleet whose replica 1 silently slows 4x mid-run —
+    fault-free, faulted with the phi-accrual detector ON
+    (latency-aware routing + quarantine + probe restore), and faulted
+    with detection OFF (analytic replicas — milliseconds, no jax).
+    The headline observable is the p99 TTFT spread: detection-on must
+    sit near fault-free while detection-off shows what the gray fault
+    costs an undefended fleet; the health counter board
+    (metrics.health_board) rides along. docs/HEALTH.md explains the
+    detector math and knobs."""
+    try:
+        from kind_tpu_sim import fleet, health
+        from kind_tpu_sim import metrics as _metrics
+
+        t0 = time.monotonic()
+        board_before = _metrics.health_board().counts()
+        spec = fleet.WorkloadSpec(
+            process="poisson", rps=60.0, n_requests=500,
+            prompt_len=(8, 24), max_new=(4, 12))
+        trace = fleet.generate_trace(spec, seed=7)
+        span = max(r.arrival_s for r in trace)
+        sim_cfg = fleet.SimReplicaConfig(
+            max_slots=4, prefill_per_tok_s=0.002, tpot_s=0.002)
+        events = [
+            fleet.ChaosEvent(at_s=round(span * 0.25, 6),
+                             action="slow", target=1, param=4.0),
+            fleet.ChaosEvent(at_s=round(span * 0.65, 6),
+                             action="unslow", target=1),
+        ]
+        hcfg = health.DetectorConfig.from_env()
+
+        def run(detect: bool, evs) -> dict:
+            rep = fleet.FleetSim(
+                fleet.FleetConfig(
+                    replicas=3, policy="least-outstanding",
+                    tick_s=0.01, sim=sim_cfg,
+                    slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+                    health=(hcfg if detect else None)),
+                trace, chaos_events=list(evs)).run()
+            out = {
+                "ok": rep["ok"],
+                "attainment": rep["slo"]["attainment"],
+                "ttft_p50_s": rep["slo"]["ttft"].get("p50_s"),
+                "ttft_p99_s": rep["slo"]["ttft"].get("p99_s"),
+            }
+            if "health" in rep:
+                out["quarantines"] = rep["health"]["counters"].get(
+                    "quarantines", 0)
+            return out
+
+        fault_free = run(True, [])
+        detect_on = run(True, events)
+        detect_off = run(False, events)
+        p99_free = fault_free["ttft_p99_s"]
+        return {
+            "ok": (fault_free["ok"] and detect_on["ok"]
+                   and detect_off["ok"]
+                   and fault_free.get("quarantines", 0) == 0),
+            "requests": len(trace),
+            "seconds": round(time.monotonic() - t0, 3),
+            "fault_free": fault_free,
+            "detect_on": detect_on,
+            "detect_off": detect_off,
+            "p99_ttft_ratio_on": (
+                round(detect_on["ttft_p99_s"] / p99_free, 3)
+                if p99_free else None),
+            "p99_ttft_ratio_off": (
+                round(detect_off["ttft_p99_s"] / p99_free, 3)
+                if p99_free else None),
+            "counters": _metrics.health_board().snapshot_since(
+                board_before),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def multihost_smoke() -> dict | None:
     """DCN-tier proof: a 2-host simulated slice (one process per host,
     gloo collectives over loopback) comes up and passes cross-host
@@ -2480,6 +2557,10 @@ def main(argv=None) -> int:
             sched_rep = sched_smoke()
         if sched_rep:
             phases["sched"] = sched_rep
+        with stopwatch("gray"):
+            gray_rep = gray_smoke()
+        if gray_rep:
+            phases["gray"] = gray_rep
     finally:
         if pool is not None:
             pool.close()

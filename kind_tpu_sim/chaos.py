@@ -67,6 +67,12 @@ FAULT_KINDS = (
     "replica_flap",      # fleet: a replica fails/heals repeatedly
     "node_drain",        # sched: node cordoned, gangs evicted+rescheduled
     "node_fail",         # sched: node breaks outright (capacity gone)
+    # gray failures (docs/HEALTH.md): alive but slow — nothing
+    # crashes, so only the failure detector can catch them
+    "straggler_worker",  # grid: one worker stalls every job (param: s)
+    "degraded_link",     # ICI link at param x nominal bandwidth
+    "slow_replica",      # fleet: replica service times x param
+    "flaky_node",        # intermittent sub-crash stalls (param: s)
 )
 
 
@@ -129,7 +135,9 @@ class ChaosSchedule:
         """``n_faults`` events drawn over ``horizon`` schedule slots
         and ``targets`` possible victims, kinds cycled through the
         seeded stream. ``param`` is drawn per kind: hang seconds in
-        [1, 5], transient counts in [1, 3], else 0."""
+        [1, 5], transient counts in [1, 3], straggler/flaky stall
+        seconds, slow-replica service factors, degraded-link
+        bandwidth factors — else 0."""
         for kind in kinds:
             if kind not in FAULT_KINDS:
                 raise ValueError(
@@ -145,6 +153,14 @@ class ChaosSchedule:
                 param = float(rng.randint(1, 5))
             elif kind == "cmd_transient":
                 param = float(rng.randint(1, 3))
+            elif kind == "straggler_worker":
+                param = round(rng.uniform(1.6, 2.4), 3)
+            elif kind == "flaky_node":
+                param = round(rng.uniform(0.5, 1.5), 3)
+            elif kind == "slow_replica":
+                param = round(rng.uniform(3.0, 6.0), 3)
+            elif kind == "degraded_link":
+                param = round(rng.uniform(0.08, 0.25), 3)
             else:
                 param = 0.0
             events.append(FaultEvent(
@@ -850,6 +866,288 @@ def _scenario_sched_preemption(seed: int) -> dict:
         "events_identical": identical,
         "ok": bool(hi_bound and evicted and strict and identical
                    and victims <= batch_resched),
+    }
+
+
+@_scenario("gray-straggler-grid",
+           "a gray straggler worker (alive but slow) is probed out "
+           "and quarantined; the grid rebalances and its wall "
+           "recovers to within tolerance of fault-free, results "
+           "bit-identical — detection-off provably does not recover")
+def _scenario_gray_straggler_grid(seed: int) -> dict:
+    import dataclasses as _dc
+
+    from kind_tpu_sim import health
+    from kind_tpu_sim.parallel import multihost
+
+    plan = ChaosSchedule(seed).plan(kinds=("straggler_worker",),
+                                    n_faults=1, horizon=8, targets=6)
+    ev = plan.events[0]
+    workers = 6
+    stall = min(2.4, max(1.6, ev.param))
+    cells = [{"cell": i, "payload": seed % 997, "sleep_s": 0.08}
+             for i in range(36)]
+    hcfg = _dc.replace(health.DetectorConfig.from_env(),
+                       probe_timeout_s=0.8)
+    clean, clean_stats = multihost.scatter_grid_cells(
+        cells, workers=workers, timeout=180.0,
+        detect=True, health_cfg=hcfg)
+    fault = ("straggler", ev.target % workers, stall)
+    on, on_stats = multihost.scatter_grid_cells(
+        cells, workers=workers, timeout=180.0,
+        detect=True, health_cfg=hcfg, fault=fault, max_respawns=1)
+    off, off_stats = multihost.scatter_grid_cells(
+        cells, workers=workers, timeout=240.0,
+        fault=fault, max_respawns=0)
+    on_ratio = on_stats["makespan_s"] / clean_stats["makespan_s"]
+    off_ratio = off_stats["makespan_s"] / clean_stats["makespan_s"]
+    detected = (on_stats["quarantines"]
+                + on_stats["speculative"]) >= 1
+    # only the hard transitions go in the report: the shape stays
+    # byte-stable across replays (no wall-clock values)
+    detection = [d for d in on_stats.get("detection", [])
+                 if d["transition"] in ("quarantined", "restored")]
+    return {
+        "plan": plan.as_dict(),
+        "workers": workers,
+        "cells": len(cells),
+        "faulted_worker": ev.target % workers,
+        "results_identical": bool(on == clean and off == clean),
+        "fault_free_quarantines": clean_stats["quarantines"],
+        "detected": bool(detected),
+        "detection": detection,
+        "recovered_within_tolerance": bool(on_ratio <= 1.25),
+        "off_degraded": bool(off_ratio >= 1.3),
+        "ok": bool(on == clean and off == clean
+                   and clean_stats["quarantines"] == 0
+                   and detected
+                   and on_ratio <= 1.25
+                   and off_ratio >= 1.3),
+    }
+
+
+def _window_p99_ttft(completions, t_from: float,
+                     t_to: float) -> Optional[float]:
+    """p99 TTFT over requests ARRIVING in [t_from, t_to) — the
+    post-detection recovery window the gray fleet scenarios are
+    judged over."""
+    from kind_tpu_sim.fleet.slo import brute_force_percentile
+
+    vals = [(e["first_s"] if e["first_s"] is not None
+             else e["finish_s"]) - e["arrival_s"]
+            for e in completions
+            if t_from <= e["arrival_s"] < t_to]
+    return brute_force_percentile(vals, 0.99)
+
+
+@_scenario("gray-slow-replica",
+           "one fleet replica silently slows under seeded traffic; "
+           "the detector quarantines it, the router routes around, "
+           "probes restore it after the fault lifts, and windowed "
+           "p99 TTFT recovers to within tolerance of fault-free — "
+           "detection-off provably does not")
+def _scenario_gray_slow_replica(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import fleet, health
+
+    plan = ChaosSchedule(seed).plan(kinds=("slow_replica",),
+                                    n_faults=1, horizon=8, targets=3)
+    ev = plan.events[0]
+    target = ev.target % 3
+    factor = max(3.0, ev.param)
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=500, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    span = max(r.arrival_s for r in trace)
+    t1, t2 = round(span * 0.25, 6), round(span * 0.65, 6)
+    sim_cfg = fleet.SimReplicaConfig(max_slots=4,
+                                     prefill_per_tok_s=0.002,
+                                     tpot_s=0.002)
+    events = [fleet.ChaosEvent(at_s=t1, action="slow",
+                               target=target, param=factor),
+              fleet.ChaosEvent(at_s=t2, action="unslow",
+                               target=target)]
+    hcfg = health.DetectorConfig.from_env()
+
+    def run(detect: bool, ev_list):
+        fc = fleet.FleetConfig(
+            replicas=3, policy="least-outstanding", tick_s=0.01,
+            sim=sim_cfg, slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+            health=(hcfg if detect else None))
+        return fleet.FleetSim(fc, trace,
+                              chaos_events=list(ev_list)).run()
+
+    clean = run(True, [])
+    on = run(True, events)
+    replay = run(True, events)
+    off = run(False, events)
+    counters = on["health"]["counters"]
+    q_events = [e for e in on["health"]["detector"]["events"]
+                if e["transition"] == "quarantined"]
+    t_q = q_events[0]["at_s"] if q_events else t1 + 0.5
+    p99_clean = _window_p99_ttft(clean["completions"], t_q, t2)
+    p99_on = _window_p99_ttft(on["completions"], t_q, t2)
+    p99_off = _window_p99_ttft(off["completions"], t_q, t2)
+    tokens = lambda rep: sum(e["tokens"]  # noqa: E731
+                             for e in rep["completions"])
+    recovered = (p99_clean is not None and p99_on is not None
+                 and p99_on <= 1.25 * p99_clean)
+    off_degraded = (p99_clean is not None and p99_off is not None
+                    and p99_off > 1.25 * p99_clean)
+    identical = (_json.dumps(on["completions"], sort_keys=True)
+                 == _json.dumps(replay["completions"],
+                                sort_keys=True)
+                 and _json.dumps(on["health"]["detector"]["events"],
+                                 sort_keys=True)
+                 == _json.dumps(
+                     replay["health"]["detector"]["events"],
+                     sort_keys=True))
+    restored = any(e["transition"] == "restored"
+                   and e["component"] == f"replica-{target}"
+                   for e in on["health"]["detector"]["events"])
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(trace),
+        "slow_replica": target,
+        "factor": round(factor, 3),
+        "fault_free_quarantines":
+            clean["health"]["counters"].get("quarantines", 0),
+        "quarantines": counters.get("quarantines", 0),
+        "false_positives": counters.get("false_positives", 0),
+        "restored_via_probes": bool(restored),
+        "p99_recovered": bool(recovered),
+        "p99_off_degraded": bool(off_degraded),
+        "replay_identical": bool(identical),
+        "ok": bool(clean["ok"] and on["ok"] and off["ok"]
+                   and clean["health"]["counters"].get(
+                       "quarantines", 0) == 0
+                   and counters.get("quarantines", 0) >= 1
+                   and counters.get("false_positives", 0) == 0
+                   and restored
+                   and tokens(on) == tokens(clean) == tokens(off)
+                   and recovered and off_degraded and identical),
+    }
+
+
+@_scenario("gray-degraded-ici",
+           "an ICI link degrades under a scheduler-backed fleet: "
+           "the replicas on that domain are quarantined and their "
+           "gangs migrate (one at a time) onto the healthy domain, "
+           "the scheduler scores the degraded domain last, and "
+           "windowed p99 TTFT recovers to fault-free levels — "
+           "detection-off stays degraded until the link heals")
+def _scenario_gray_degraded_ici(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import fleet, health
+
+    plan = ChaosSchedule(seed).plan(kinds=("degraded_link",),
+                                    n_faults=1, horizon=8, targets=2)
+    ev = plan.events[0]
+    factor = min(0.25, max(0.08, ev.param))
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=500, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    span = max(r.arrival_s for r in trace)
+    t1, t2 = round(span * 0.25, 6), round(span * 0.7, 6)
+    sim_cfg = fleet.SimReplicaConfig(max_slots=4,
+                                     prefill_per_tok_s=0.002,
+                                     tpot_s=0.002)
+    # spread placement: one replica per ICI domain, so degrading one
+    # domain grays out ONE replica — ici/binpack would co-locate both
+    # gangs and a single bad link would migrate the whole fleet
+    sc = fleet.FleetSchedConfig(
+        pods=(("tpu-v5-lite-podslice", "4x8"),
+              ("tpu-v5-lite-podslice", "4x8")),
+        policy="spread")
+    hcfg = health.DetectorConfig.from_env()
+
+    def run(detect: bool, ev_list):
+        fc = fleet.FleetConfig(
+            replicas=2, policy="least-outstanding", tick_s=0.01,
+            sim=sim_cfg, slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+            sched=sc, health=(hcfg if detect else None))
+        return fleet.FleetSim(fc, trace,
+                              chaos_events=list(ev_list)).run()
+
+    clean = run(True, [])
+    # degrade the domain that PROVABLY hosts a replica gang (the
+    # runs are identical up to the degrade instant, so the clean
+    # run's t=0 placement names the victim domain)
+    placed = next(
+        e for e in clean["scheduler"]["events"]
+        if e["type"] == "Scheduled"
+        and e["gang"] == f"replica-{ev.target % 2}")
+    victim_domain = int(placed["nodes"][0].split("-")[2])
+    events = [fleet.ChaosEvent(at_s=t1, action="link_degrade",
+                               target=victim_domain, param=factor),
+              fleet.ChaosEvent(at_s=t2, action="link_restore",
+                               target=victim_domain)]
+    on = run(True, events)
+    replay = run(True, events)
+    off = run(False, events)
+    counters = on["health"]["counters"]
+    sched_counts = on["scheduler"]["event_counts"]
+    restored_events = [
+        e for e in on["health"]["detector"]["events"]
+        if e["transition"] == "restored"]
+    ready = (max(e["at_s"] for e in restored_events) + 0.3
+             if restored_events else t1 + 1.0)
+    p99_clean = _window_p99_ttft(clean["completions"], ready, t2)
+    p99_on = _window_p99_ttft(on["completions"], ready, t2)
+    p99_off = _window_p99_ttft(off["completions"], ready, t2)
+    # every post-migration Scheduled event must land OFF the
+    # degraded domain (the scoring + avoid-mark contract)
+    migrated_clean = all(
+        int(e["nodes"][0].split("-")[2]) != victim_domain
+        for e in on["scheduler"]["events"]
+        if e["type"] == "Scheduled" and e["at_s"] > t1)
+    tokens = lambda rep: sum(e["tokens"]  # noqa: E731
+                             for e in rep["completions"])
+    recovered = (p99_clean is not None and p99_on is not None
+                 and p99_on <= 1.25 * p99_clean)
+    off_degraded = (p99_clean is not None and p99_off is not None
+                    and p99_off > 1.25 * p99_clean)
+    identical = (
+        _json.dumps(on["completions"], sort_keys=True)
+        == _json.dumps(replay["completions"], sort_keys=True)
+        and _json.dumps(on["scheduler"]["events"], sort_keys=True)
+        == _json.dumps(replay["scheduler"]["events"],
+                       sort_keys=True)
+        and _json.dumps(on["health"]["detector"]["events"],
+                        sort_keys=True)
+        == _json.dumps(replay["health"]["detector"]["events"],
+                       sort_keys=True))
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(trace),
+        "degraded_domain": victim_domain,
+        "link_factor": round(factor, 3),
+        "fault_free_quarantines":
+            clean["health"]["counters"].get("quarantines", 0),
+        "quarantines": counters.get("quarantines", 0),
+        "false_positives": counters.get("false_positives", 0),
+        "gray_migrations": counters.get("gray_migrations", 0),
+        "link_events": {
+            "degraded": sched_counts.get("LinkDegraded", 0),
+            "restored": sched_counts.get("LinkRestored", 0)},
+        "migrations_avoid_degraded_domain": bool(migrated_clean),
+        "p99_recovered": bool(recovered),
+        "p99_off_degraded": bool(off_degraded),
+        "replay_identical": bool(identical),
+        "ok": bool(clean["ok"] and on["ok"] and off["ok"]
+                   and clean["health"]["counters"].get(
+                       "quarantines", 0) == 0
+                   and counters.get("quarantines", 0) >= 1
+                   and counters.get("false_positives", 0) == 0
+                   and counters.get("gray_migrations", 0) >= 1
+                   and sched_counts.get("LinkDegraded", 0) == 1
+                   and migrated_clean
+                   and tokens(on) == tokens(clean) == tokens(off)
+                   and recovered and off_degraded and identical),
     }
 
 

@@ -297,6 +297,13 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["binpack", "spread", "ici"],
         help="placement scoring policy when --sched is set")
     fl.add_argument(
+        "--health", action="store_true",
+        help="enable the gray-failure detector (docs/HEALTH.md): "
+             "latency-aware routing, slow-replica quarantine + "
+             "probe restore, gang migration off suspect hardware "
+             "when --sched is set; knobs KIND_TPU_SIM_HEALTH_*; "
+             "report gains a 'health' section")
+    fl.add_argument(
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
              "(default: KIND_TPU_SIM_FLEET_TICK_S or 0.01)")
@@ -356,6 +363,25 @@ def build_parser() -> argparse.ArgumentParser:
         "--out", default=None,
         help="write the full JSON report to this file")
     sd.add_argument("--json", action="store_true", dest="as_json")
+
+    he = sub.add_parser(
+        "health",
+        help=(
+            "gray-failure detection layer (docs/HEALTH.md): print "
+            "the resolved detector knobs, or run a seeded synthetic "
+            "straggler through the phi-accrual detector "
+            "(quarantine -> probe -> restore) — deterministic, no "
+            "cluster needed"
+        ),
+    )
+    he.add_argument("action", choices=["knobs", "demo"])
+    he.add_argument(
+        "--seed", type=int, default=None,
+        help="fault-plan seed for 'demo' (default: "
+             "KIND_TPU_SIM_CHAOS_SEED or 0)")
+    he.add_argument("--components", type=int, default=4)
+    he.add_argument("--samples", type=int, default=120)
+    he.add_argument("--json", action="store_true", dest="as_json")
 
     man = sub.add_parser(
         "manifests",
@@ -623,7 +649,9 @@ def run_fleet(args: argparse.Namespace) -> int:
             min_replicas=args.replicas,
             max_replicas=args.max_replicas),
         sched=(fleet.FleetSchedConfig(policy=args.sched_policy)
-               if args.sched else None))
+               if args.sched else None),
+        health=(fleet.DetectorConfig.from_env()
+                if args.health else None))
     clock = fleet.VirtualClock()
     factory = None
     if args.engine == "serving":
@@ -789,6 +817,44 @@ def run_sched(args: argparse.Namespace) -> int:
         print(f"SCHED RUN (seed {seed}) "
               + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
+
+
+def run_health(args: argparse.Namespace) -> int:
+    """`health knobs` / `health demo`: the gray-failure detector
+    surface (docs/HEALTH.md). knobs prints the resolved
+    KIND_TPU_SIM_HEALTH_* configuration; demo runs a seeded
+    synthetic straggler through the phi-accrual detector and asserts
+    the full quarantine -> probe -> restore round-trip — same seed,
+    byte-identical report."""
+    from kind_tpu_sim import health
+
+    if args.action == "knobs":
+        cfg = health.DetectorConfig.from_env()
+        if args.as_json:
+            print(json.dumps(cfg.as_dict(), sort_keys=True))
+        else:
+            for key, value in sorted(cfg.as_dict().items()):
+                print(f"  {key:<20} {value}")
+        return 0
+    from kind_tpu_sim.chaos import resolve_seed
+
+    report = health.detection_demo(
+        seed=resolve_seed(args.seed), components=args.components,
+        samples=args.samples)
+    if args.as_json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(f"health demo: {args.components} components, "
+              f"{args.samples} samples, straggler "
+              f"{report['straggler']} x{report['factor']}")
+        for ev in report["events"]:
+            extra = ""
+            if "phi" in ev:
+                extra = f" (phi {ev['phi']})"
+            print(f"  t={ev['at_s']:<6} {ev['component']:<10} "
+                  f"{ev['transition']}{extra}")
+        print("HEALTH DEMO " + ("OK" if report["ok"] else "FAILED"))
+    return 0 if report["ok"] else 1
 
 
 def run_manifests(args: argparse.Namespace) -> int:
@@ -1092,6 +1158,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_fleet(args)
         if args.command == "sched":
             return run_sched(args)
+        if args.command == "health":
+            return run_health(args)
         if args.command == "profile":
             return run_profile(args)
         if args.command == "chaos" and args.action in ("run", "soak"):

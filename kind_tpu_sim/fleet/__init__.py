@@ -9,8 +9,15 @@ config => byte-identical completion logs and SLO reports.
 
 Knobs: KIND_TPU_SIM_FLEET_SEED (loadgen.resolve_seed),
 KIND_TPU_SIM_FLEET_TICK_S (sim.resolve_tick_s),
-KIND_TPU_SIM_FLEET_WARMUP_S (autoscaler.resolve_warmup_s).
+KIND_TPU_SIM_FLEET_WARMUP_S (autoscaler.resolve_warmup_s),
+KIND_TPU_SIM_HEALTH_* (health.DetectorConfig — the gray-failure
+detection layer, docs/HEALTH.md).
 """
+
+from kind_tpu_sim.health import (  # noqa: F401
+    DetectorConfig,
+    FailureDetector,
+)
 
 from kind_tpu_sim.fleet.autoscaler import (  # noqa: F401
     Autoscaler,

@@ -79,6 +79,10 @@ class SimReplica:
         self.replica_id = replica_id
         self.cfg = cfg
         self.healthy = True
+        # gray-failure lever (docs/HEALTH.md): a multiplicative
+        # service-time inflation — 1.0 is nominal; the slow_replica
+        # chaos kind and degraded-ICI-domain placement both set it
+        self.slowdown = 1.0
         self.queue: List[TraceRequest] = []
         self._slots: List[Optional[dict]] = [None] * cfg.max_slots
         # group id -> True, LRU-bounded: the PrefixCache stand-in
@@ -86,6 +90,13 @@ class SimReplica:
         self._prefix_seen: Dict[int, bool] = {}
         self.prefix_hits = 0
         self.prefix_misses = 0
+
+    def set_slowdown(self, factor: float) -> None:
+        """Inflate (or restore, factor=1) this replica's service
+        times: prefill and TPOT both scale. Applies to work admitted
+        OR advancing after the call — the gray fault is a property
+        of the hardware, not of individual requests."""
+        self.slowdown = max(1.0, float(factor))
 
     # -- replica interface -------------------------------------------
 
@@ -127,7 +138,7 @@ class SimReplica:
                     self._prefix_seen.pop(
                         next(iter(self._prefix_seen)))
         return (self.cfg.prefill_base_s
-                + self.cfg.prefill_per_tok_s * toks)
+                + self.cfg.prefill_per_tok_s * toks) * self.slowdown
 
     @staticmethod
     def _group_prefix_len(req: TraceRequest) -> int:
@@ -162,11 +173,16 @@ class SimReplica:
                     "req": req,
                     "dispatch_s": now,
                     "prefill_left": self._prefill_cost(req),
+                    "decode_left": 0.0,  # current token's remainder
                     "first_s": None,
                     "tokens": 0,
                     "t": now,  # slot-local timeline cursor
                 }
-        # advance each slot's local timeline to now + dt
+        # advance each slot's local timeline to now + dt. Partial
+        # progress on the current token carries ACROSS ticks
+        # (decode_left) — truncating it at tick boundaries would
+        # stall decode outright whenever the (possibly gray-
+        # inflated) TPOT exceeds the tick quantum
         end = now + dt
         for i, slot in enumerate(self._slots):
             if slot is None:
@@ -185,7 +201,10 @@ class SimReplica:
                         slot["first_s"] = slot["t"]
                         slot["tokens"] = 1
                     continue
-                nxt = slot["t"] + self.cfg.tpot_s
+                if slot["decode_left"] <= 0.0:
+                    slot["decode_left"] = (self.cfg.tpot_s
+                                           * self.slowdown)
+                nxt = slot["t"] + slot["decode_left"]
                 if deadline is not None and nxt > deadline:
                     done.append(self._complete(
                         slot, finish_s=deadline,
@@ -193,9 +212,11 @@ class SimReplica:
                     self._slots[i] = None
                     break
                 if nxt > end:
+                    slot["decode_left"] = nxt - end
                     slot["t"] = end
                     break
                 slot["t"] = nxt
+                slot["decode_left"] = 0.0
                 slot["tokens"] += 1
                 if slot["tokens"] >= req.max_new:
                     done.append(self._complete(
@@ -246,6 +267,8 @@ class SimReplica:
             "healthy": self.healthy,
             "outstanding": self.outstanding(),
         }
+        if self.slowdown != 1.0:
+            out["slowdown"] = round(self.slowdown, 6)
         if self.prefix_hits or self.prefix_misses:
             out["prefix"] = {"hits": self.prefix_hits,
                              "misses": self.prefix_misses}
@@ -263,8 +286,20 @@ class EngineReplica:
         self.replica_id = replica_id
         self.engine = engine
         self.healthy = True
+        # gray slowdown for a REAL engine: we cannot slow the math,
+        # so a slowdown of k steps the engine every k-th tick only —
+        # the same virtual-time inflation the analytic replica models
+        self._stride = 1
+        self._tick_no = 0
         self._dispatched: Dict[str, TraceRequest] = {}
         self._dispatch_s: Dict[str, float] = {}
+
+    @property
+    def slowdown(self) -> float:
+        return float(self._stride)
+
+    def set_slowdown(self, factor: float) -> None:
+        self._stride = max(1, int(round(factor)))
 
     def outstanding(self) -> int:
         return self.engine.outstanding()
@@ -298,7 +333,8 @@ class EngineReplica:
     def tick(self, now: float, dt: float) -> List[ReplicaCompletion]:
         if not self.healthy:
             return []
-        if not self.idle():
+        self._tick_no += 1
+        if not self.idle() and self._tick_no % self._stride == 0:
             self.engine.step_round()
         out = []
         for c in self.engine.poll():
@@ -363,7 +399,8 @@ class Router:
     touching a replica; a full central queue sheds on arrival."""
 
     def __init__(self, replicas: Sequence, policy: str = "round-robin",
-                 max_queue: int = 0, affinity_spill: int = 8):
+                 max_queue: int = 0, affinity_spill: int = 8,
+                 health=None):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r}; known: "
@@ -371,6 +408,13 @@ class Router:
         self.replicas: List = list(replicas)
         self.policy = policy
         self.max_queue = max_queue
+        # optional kind_tpu_sim.health.FailureDetector: quarantined
+        # replicas leave the candidate set entirely, and the load
+        # orderings become LATENCY-AWARE — a replica's queue depth is
+        # weighted by its service-time EWMA relative to the fleet
+        # baseline, so a slow-but-not-yet-quarantined replica is
+        # down-weighted instead of treated as equal capacity
+        self.health = health
         # prefix-affinity: preferred replica may be this many
         # requests MORE loaded than the least-loaded one before the
         # router spills the request elsewhere (cache locality is
@@ -389,7 +433,26 @@ class Router:
     # -- policy ------------------------------------------------------
 
     def _healthy(self) -> List:
-        return [r for r in self.replicas if r.healthy]
+        out = [r for r in self.replicas if r.healthy]
+        if self.health is not None:
+            unquarantined = [r for r in out
+                             if not self.health.quarantined(
+                                 f"replica-{r.replica_id}")]
+            # never quarantine the whole fleet out of service: with
+            # no clean replica left, degraded capacity beats none
+            if unquarantined:
+                return unquarantined
+        return out
+
+    def _load_key(self, r) -> float:
+        """Effective load for the latency-aware orderings: queue
+        depth weighted by the replica's relative service time (1.0
+        without a detector or before it has a baseline)."""
+        if self.health is None:
+            return float(r.outstanding())
+        rel = self.health.relative_latency(
+            f"replica-{r.replica_id}")
+        return (r.outstanding() + 1) * rel
 
     def _pick_order(self, req: TraceRequest) -> List:
         """Candidate replicas, best first, per policy. Ties break on
@@ -401,7 +464,8 @@ class Router:
             start = self._rr % len(healthy)
             return healthy[start:] + healthy[:start]
         by_load = sorted(
-            healthy, key=lambda r: (r.outstanding(), r.replica_id))
+            healthy, key=lambda r: (self._load_key(r),
+                                    r.replica_id))
         if self.policy == "least-outstanding":
             return by_load
         # prefix-affinity: grouped requests stick to a stable home
@@ -412,7 +476,10 @@ class Router:
             return by_load
         key = zlib.crc32(f"group:{req.prefix_group}".encode("utf-8"))
         home = self.replicas[key % len(self.replicas)]
-        if not home.healthy:
+        if not home.healthy or (
+                self.health is not None
+                and self.health.quarantined(
+                    f"replica-{home.replica_id}")):
             return by_load
         floor = by_load[0].outstanding()
         if home.outstanding() - floor > self.affinity_spill:

@@ -24,6 +24,8 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 from kind_tpu_sim import metrics
+from kind_tpu_sim.health import DetectorConfig, FailureDetector
+from kind_tpu_sim.parallel import collectives
 from kind_tpu_sim.fleet.autoscaler import (
     Autoscaler,
     AutoscalerConfig,
@@ -60,11 +62,21 @@ class ChaosEvent:
     three node-level actions join: ``node_drain`` cordons node index
     ``target`` and evicts its gangs (replicas preempt, reschedule,
     and warm back up elsewhere), ``node_fail`` breaks the node
-    outright, ``node_restore`` heals it."""
+    outright, ``node_restore`` heals it.
+
+    GRAY actions (docs/HEALTH.md) degrade without killing: ``slow``
+    inflates replica ``target``'s service times by factor ``param``
+    (the slow_replica fault kind), ``unslow`` restores it;
+    ``link_degrade`` sets ICI domain index ``target``'s slowest-link
+    bandwidth factor to ``param`` (scheduler-backed fleets only —
+    every replica placed there inflates by the modeled collective
+    share, parallel/collectives.ici_slowdown), ``link_restore``
+    heals the domain."""
 
     at_s: float
-    action: str   # preempt | restore | node_drain | node_fail | node_restore
-    target: int   # replica id, or node index for node_* actions
+    action: str   # preempt | restore | node_* | slow | unslow | link_*
+    target: int   # replica id, node index, or ICI domain index
+    param: float = 0.0  # slow factor / link bandwidth factor
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -91,6 +103,11 @@ class FleetSchedConfig:
     replica_accelerator: str = "tpu-v5-lite-podslice"
     replica_topology: str = "2x4"
     priority: int = 10
+    # share of a replica's service time spent in ICI collectives —
+    # the Amdahl knob the degraded-link slowdown model
+    # (parallel/collectives.ici_slowdown) applies to replicas placed
+    # on a degraded domain, and to their warm-up on rebind
+    ici_fraction: float = 0.35
 
     def as_dict(self) -> dict:
         return {
@@ -99,6 +116,7 @@ class FleetSchedConfig:
             "bind_s": self.bind_s,
             "replica_topology": self.replica_topology,
             "priority": self.priority,
+            "ici_fraction": self.ici_fraction,
         }
 
 
@@ -115,6 +133,11 @@ class FleetConfig:
     sim: SimReplicaConfig = SimReplicaConfig()
     autoscaler: AutoscalerConfig = AutoscalerConfig()
     sched: Optional[FleetSchedConfig] = None
+    # gray-failure detection (docs/HEALTH.md): a DetectorConfig turns
+    # on the per-replica service-time detector — quarantined replicas
+    # leave the routing set, get probed, and (scheduler-backed) have
+    # their gang migrated off the suspect hardware
+    health: Optional[DetectorConfig] = None
 
     def as_dict(self) -> dict:
         out = {
@@ -130,6 +153,8 @@ class FleetConfig:
         }
         if self.sched is not None:
             out["sched"] = self.sched.as_dict()
+        if self.health is not None:
+            out["health"] = self.health.as_dict()
         return out
 
 
@@ -154,8 +179,11 @@ class FleetSim:
             lambda rid: SimReplica(rid, cfg.sim))
         self.replicas = [self.factory(i)
                          for i in range(cfg.replicas)]
+        self.health = (FailureDetector(cfg.health)
+                       if cfg.health is not None else None)
         self.router = Router(self.replicas, policy=cfg.policy,
-                             max_queue=cfg.max_queue)
+                             max_queue=cfg.max_queue,
+                             health=self.health)
         self.chaos_events = sorted(chaos_events,
                                    key=lambda e: (e.at_s, e.target))
         self.tracker = SloTracker(cfg.slo)
@@ -170,6 +198,15 @@ class FleetSim:
         self.preemptions = 0
         self.sched = None
         self._now = 0.0
+        # gray-failure bookkeeping: replicas currently slowed by an
+        # explicit chaos `slow` (rid -> factor) or by a degraded ICI
+        # domain — the ground truth false-positive accounting is
+        # judged against
+        self._slow_factor: Dict[int, float] = {}
+        self._link_slow: set = set()
+        self._probe_last: Dict[str, float] = {}
+        self._probe_n: Dict[str, int] = {}
+        self._migrate_pending: List[int] = []
         if cfg.sched is not None:
             self._init_scheduler(cfg.sched)
 
@@ -253,7 +290,14 @@ class FleetSim:
         for gang in self.sched.step(now):
             name = gang.request.name
             requested = self._gang_requested.pop(name, now)
-            ready_at = now + self._sched_cfg.bind_s + warmup
+            # warm-up is collective-heavy (compile + init all-reduce
+            # smokes), so a degraded-link domain inflates it by the
+            # same modeled share as steady-state service
+            dom = self.sched.inv.domains[gang.placement.domain]
+            warm_mult = collectives.ici_slowdown(
+                dom.link_factor, self._sched_cfg.ici_fraction)
+            ready_at = (now + self._sched_cfg.bind_s
+                        + warmup * warm_mult)
             ttr = round(ready_at - requested, 6)
             self.time_to_routable.append(ttr)
             rid = self._gang_replica[name]
@@ -278,6 +322,150 @@ class FleetSim:
             metrics.recovery_log().record(
                 f"fleet_{ev.action}", node=node,
                 at_s=round(now, 6))
+
+    # -- gray failures (docs/HEALTH.md) -------------------------------
+
+    def _apply_link_chaos(self, ev: "ChaosEvent",
+                          now: float) -> None:
+        from kind_tpu_sim import sched as sched_mod
+
+        domains = sorted(self.sched.inv.domains)
+        domain = domains[ev.target % len(domains)]
+        if ev.action == "link_degrade":
+            sched_mod.apply_link_event(
+                self.sched, "link_degrade", domain,
+                max(1e-3, ev.param), now)
+            metrics.recovery_log().record(
+                "fleet_link_degrade", domain=domain,
+                factor=ev.param, at_s=round(now, 6))
+        else:
+            sched_mod.apply_link_event(
+                self.sched, "link_restore", domain, 1.0, now)
+            # the fault is gone: lift the avoid marks quarantine-
+            # driven migrations left on the domain's nodes
+            for node in self.sched.inv.domains[domain].nodes.values():
+                self.sched.inv.mark_avoid(node.name, False)
+        self._refresh_link_slowdowns(now)
+
+    def _refresh_link_slowdowns(self, now: float) -> None:
+        """Recompute every placed replica's service-time inflation
+        from its ICI domain's link state (plus any explicit `slow`
+        chaos), and the ground-truth set of link-slowed replicas."""
+        self._link_slow = set()
+        sc = self._sched_cfg
+        for name, gang in sorted(self.sched.bound.items()):
+            rid = self._gang_replica.get(name)
+            if rid is None:
+                continue
+            replica = self._replica_by_id(rid)
+            if replica is None or not hasattr(replica,
+                                              "set_slowdown"):
+                continue
+            mult = collectives.ici_slowdown(
+                self.sched.inv.domains[gang.placement.domain]
+                .link_factor, sc.ici_fraction)
+            if mult > 1.0:
+                self._link_slow.add(rid)
+            replica.set_slowdown(
+                max(mult, self._slow_factor.get(rid, 1.0)))
+
+    def _gray_truth(self) -> set:
+        return set(self._slow_factor) | self._link_slow
+
+    def _on_health_transition(self, rid: int, transition: str,
+                              now: float) -> None:
+        if transition != "quarantined":
+            return
+        metrics.recovery_log().record(
+            "fleet_replica_quarantine", replica=rid,
+            at_s=round(now, 6))
+        if rid not in self._gray_truth():
+            # detection fired on a replica nothing is degrading —
+            # the no-churn acceptance bound counts these
+            metrics.health_board().incr("false_positives")
+        if self.sched is not None:
+            self._migrate_pending.append(rid)
+
+    def _drain_migrations(self, now: float) -> None:
+        """At most ONE gray migration in flight at a time (the
+        maxUnavailable=1 discipline): evicting every quarantined
+        gang at once would trade a gray slowdown for a total outage.
+        A quarantined replica waiting its turn keeps serving its
+        in-flight work (slowly) and remains the router's
+        last-resort fallback — graceful degradation, not a cliff."""
+        if not self._migrate_pending:
+            return
+        if self._rebinding or self._gang_requested:
+            return  # a migration/rebind is already in flight
+        rid = self._migrate_pending.pop(0)
+        if (self.health is not None
+                and not self.health.quarantined(f"replica-{rid}")):
+            return  # restored in the meantime; nothing to move
+        self._migrate_gang(rid, now)
+
+    def _migrate_gang(self, rid: int, now: float) -> None:
+        """Quarantined replica on a scheduler-backed fleet: migrate
+        its gang off the suspect hardware — evict (displaced load
+        requeues at the router FRONT via the existing preemption
+        machinery), mark the vacated nodes avoid, and let the next
+        scheduling pass rebind it; degraded-domain scoring plus the
+        avoid marks steer it onto healthy hardware."""
+        name = f"replica-{rid}"
+        gang = self.sched.bound.get(name)
+        if gang is None:
+            return
+        for node in gang.placement.node_names:
+            self.sched.inv.mark_avoid(node, True)
+        self.sched.evict_gang(
+            name, now,
+            reason="gray: replica quarantined by the failure "
+                   "detector; migrating off suspect hardware")
+        metrics.health_board().incr("gray_migrations")
+
+    def _probe_quarantined(self, now: float) -> None:
+        """Inject one SYNTHETIC probe request per suspect-or-
+        quarantined (but alive) replica per probe interval. Probing
+        SUSPECTS matters as much as probing quarantined replicas:
+        the latency-aware router starves a suspect of user traffic,
+        which would otherwise starve the detector of the very
+        samples it needs to confirm or clear the suspicion. Probes
+        never enter the SLO log — user traffic is not sacrificed to
+        find out whether the hardware recovered."""
+        from kind_tpu_sim.fleet.loadgen import TraceRequest
+
+        for replica in self.replicas:
+            comp = f"replica-{replica.replica_id}"
+            if (not replica.healthy
+                    or self.health.state(comp) == "healthy"):
+                continue
+            last = self._probe_last.get(comp)
+            if (last is not None and
+                    now - last < self.health.cfg.probe_interval_s):
+                continue
+            self._probe_last[comp] = now
+            n = self._probe_n.get(comp, 0)
+            self._probe_n[comp] = n + 1
+            probe = TraceRequest(
+                request_id=f"__probe-{replica.replica_id}-{n}",
+                arrival_s=round(now, 6), prompt=(1,) * 8,
+                max_new=4, seed=0)
+            if replica.submit(probe, now):
+                metrics.health_board().incr("probe_dispatches")
+
+    def _observe_health(self, rid: int, comp: ReplicaCompletion,
+                        now: float) -> None:
+        # the detector's one channel is TPOT (decode time per post-
+        # first token): a pure service-time signal, uncontaminated
+        # by queueing or prompt-length spread — exactly what a gray
+        # slowdown inflates and a healthy replica holds constant
+        if comp.tokens < 2 or comp.first_s is None:
+            return
+        sample = ((comp.finish_s - comp.first_s)
+                  / (comp.tokens - 1))
+        transition = self.health.observe(
+            f"replica-{rid}", sample, now=now)
+        if transition is not None:
+            self._on_health_transition(rid, transition, now)
 
     # -- bookkeeping ---------------------------------------------------
 
@@ -305,6 +493,10 @@ class FleetSim:
             "finish_reason": comp.finish_reason,
             "slo_ok": ok,
         })
+        if (self.health is not None and replica_id >= 0
+                and comp.finish_reason not in
+                ("shed", "deadline_exceeded")):
+            self._observe_health(replica_id, comp, self._now)
 
     def _backlog(self) -> int:
         return (len(self.router.queue)
@@ -321,11 +513,36 @@ class FleetSim:
                         "backed fleet (FleetConfig.sched)")
                 self._apply_node_chaos(ev, now)
                 continue
+            if ev.action.startswith("link_"):
+                if self.sched is None:
+                    raise ValueError(
+                        f"{ev.action} chaos needs a scheduler-"
+                        "backed fleet (FleetConfig.sched)")
+                self._apply_link_chaos(ev, now)
+                continue
             victim = next((r for r in self.replicas
                            if r.replica_id == ev.target), None)
             if victim is None:
                 continue
-            if ev.action == "preempt" and victim.healthy:
+            if ev.action == "slow":
+                factor = max(1.0, ev.param)
+                self._slow_factor[ev.target] = factor
+                if hasattr(victim, "set_slowdown"):
+                    victim.set_slowdown(factor)
+                metrics.recovery_log().record(
+                    "fleet_replica_slow", replica=ev.target,
+                    factor=factor, at_s=round(now, 6))
+            elif ev.action == "unslow":
+                self._slow_factor.pop(ev.target, None)
+                if hasattr(victim, "set_slowdown"):
+                    victim.set_slowdown(1.0)
+                if self.sched is not None:
+                    # re-apply any remaining link-induced inflation
+                    self._refresh_link_slowdowns(now)
+                metrics.recovery_log().record(
+                    "fleet_replica_unslow", replica=ev.target,
+                    at_s=round(now, 6))
+            elif ev.action == "preempt" and victim.healthy:
                 displaced = victim.fail(now)
                 self.router.requeue_front(displaced)
                 self.preemptions += 1
@@ -353,7 +570,13 @@ class FleetSim:
             self.router.replicas.append(replica)
             scaler.note_ready(now, len(self.router.replicas),
                               reason=reason)
-        routable = sum(1 for r in self.router.replicas if r.healthy)
+        # quarantined capacity is MISSING capacity: the autoscaler
+        # must not count a replica the router refuses to route to
+        routable = sum(
+            1 for r in self.router.replicas
+            if r.healthy and (self.health is None
+                              or not self.health.quarantined(
+                                  f"replica-{r.replica_id}")))
         recent = list(self._recent)
         attainment = (sum(recent) / len(recent)
                       if recent else None)
@@ -387,6 +610,7 @@ class FleetSim:
 
     def run(self) -> Dict[str, object]:
         board_before = metrics.fleet_board().counts()
+        health_before = metrics.health_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
         pending = deque(self.trace)
         ticks = 0
@@ -397,6 +621,7 @@ class FleetSim:
                 break
             self._apply_chaos(now)
             if self.sched is not None:
+                self._drain_migrations(now)
                 self._sched_step(now)
                 healed = [w for w in self._rebinding
                           if w[0] <= now]
@@ -408,14 +633,38 @@ class FleetSim:
                         "fleet_gang_rebound",
                         replica=replica.replica_id,
                         at_s=round(now, 6))
+                if healed:
+                    self._refresh_link_slowdowns(now)
+                for _, replica in healed:
+                    comp = f"replica-{replica.replica_id}"
+                    if (self.health is not None
+                            and self.health.quarantined(comp)):
+                        # the gang rebound onto healthy hardware —
+                        # the replacement is a new individual
+                        self.health.restore(comp, now,
+                                            reason="rebound")
             while pending and pending[0].arrival_s <= now:
                 shed = self.router.offer(pending.popleft(), now)
                 if shed is not None:
                     self._record(shed, -1)
+            if self.health is not None and (pending
+                                            or self.router.queue):
+                # probe only while user traffic still flows — an
+                # endless probe loop must never keep a drained sim
+                # alive
+                self._probe_quarantined(now)
             for comp in self.router.dispatch(now):
                 self._record(comp, -1)
             for replica in list(self.replicas):
                 for comp in replica.tick(now, tick):
+                    if comp.request.request_id.startswith(
+                            "__probe-"):
+                        # synthetic health probe: feeds the detector
+                        # (its quarantined-component probe path),
+                        # never the SLO log
+                        self._observe_health(
+                            replica.replica_id, comp, now)
+                        continue
                     self._record(comp, replica.replica_id)
             for replica in list(self._draining):
                 for comp in replica.tick(now, tick):
@@ -461,6 +710,12 @@ class FleetSim:
         }
         if self.preemptions:
             report["preemptions"] = self.preemptions
+        if self.health is not None:
+            report["health"] = {
+                "detector": self.health.report(),
+                "counters": metrics.health_board().snapshot_since(
+                    health_before),
+            }
         if self.autoscaler is not None:
             report["autoscaler"] = self.autoscaler.report()
         if self.sched is not None:

@@ -1,0 +1,431 @@
+"""Gray-failure detection: phi-accrual suspicion + hysteresis.
+
+Fail-stop faults (crashes, hangs, preemptions — PR 2's chaos engine)
+are the EASY failure mode: the component goes silent and every layer
+notices. The dominant mode in real accelerator fleets is the **gray
+failure** — a chip, host, or ICI link that stays alive but slow,
+silently stretching every collective and inflating tail latency.
+Nothing crashes, so nothing recovers, and the p99 quietly doubles.
+
+This module is the shared failure detector every execution layer
+feeds and consults (docs/HEALTH.md):
+
+* the cold grid (``worker_pool.run_cells``) feeds per-cell service
+  times and probe round-trips per worker;
+* the fleet (``fleet/sim.py``) feeds per-replica per-token service
+  times on the virtual clock;
+* the scheduler reacts to verdicts by scoring degraded ICI domains
+  last and migrating gangs off them (``sched/scheduler.py``).
+
+Detection is **phi-accrual-style** (Hayashibara et al.): a latency
+sample's suspicion is phi = -log10 P(X >= x) under a normal model of
+the GLOBAL sample stream (EWMA mean/variance, sigma floored so a
+near-constant baseline cannot make ordinary jitter look
+catastrophic). Cross-component comparison is deliberate: a
+straggler's own history is all-slow, so judging it against itself
+would never fire — stragglers are defined relative to their peers.
+
+State machine, with hysteresis so one noisy sample cannot flap a
+component out of service::
+
+    healthy --(phi >= suspect_phi)--> suspect
+    suspect --(clean sample)-------> healthy           ("cleared")
+    suspect --(streak >= quarantine_evals)--> quarantined
+    any     --(phi >= quarantine_phi, or failed probe)--> quarantined
+    quarantined --(probe ok x probe_ok_required)--> healthy ("restored")
+
+Every threshold is an env knob (``KIND_TPU_SIM_HEALTH_*``, see
+:class:`DetectorConfig`), every transition is recorded in
+:attr:`FailureDetector.events` and counted on
+``metrics.health_board()`` — so a chaos scenario can assert both that
+detection fired and that a fault-free run stayed silent. The detector
+consumes whatever clock its caller passes (virtual for fleet/sched,
+monotonic for the worker grid) and draws no entropy: the same sample
+stream yields a byte-identical event log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Dict, List, Optional
+
+from kind_tpu_sim import metrics
+
+# component states
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+
+# phi is capped here: erfc underflows around z ~ 38 and "suspicion
+# beyond astronomical" carries no extra information
+PHI_CAP = 300.0
+
+_ENV_PREFIX = "KIND_TPU_SIM_HEALTH_"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(_ENV_PREFIX + name, default))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(_ENV_PREFIX + name, default))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectorConfig:
+    """Every detection threshold, resolvable from env knobs.
+
+    ``suspect_phi`` / ``quarantine_phi`` are phi-accrual suspicion
+    levels (phi = 2 means "this slow happens < 1% of the time");
+    ``quarantine_evals`` is how many CONSECUTIVE suspicious samples
+    escalate suspect -> quarantined (the no-flap hysteresis);
+    ``probe_ok_required`` clean probes lift a quarantine. The sigma
+    floor (``max(sigma_floor_frac * mean, sigma_floor_abs)``) keeps a
+    near-constant baseline from turning scheduler jitter into
+    suspicion. ``probe_timeout_s`` and ``spec_age_ratio`` belong to
+    the worker-grid consumer: a probe slower than the timeout is a
+    failed probe, and an in-flight cell older than
+    ``spec_age_ratio x`` the expected service time is speculatively
+    re-dispatched."""
+
+    ewma_alpha: float = 0.25        # KIND_TPU_SIM_HEALTH_ALPHA
+    suspect_phi: float = 2.0        # ..._SUSPECT_PHI
+    quarantine_phi: float = 8.0     # ..._QUARANTINE_PHI
+    quarantine_evals: int = 3       # ..._QUARANTINE_EVALS
+    probe_ok_required: int = 2      # ..._PROBE_OK
+    probe_interval_s: float = 0.25  # ..._PROBE_INTERVAL_S
+    min_samples: int = 4            # ..._MIN_SAMPLES
+    sigma_floor_frac: float = 0.1   # ..._SIGMA_FRAC
+    sigma_floor_abs: float = 1e-4   # ..._SIGMA_ABS
+    probe_timeout_s: float = 2.0    # ..._PROBE_TIMEOUT_S
+    spec_age_ratio: float = 3.0     # ..._SPEC_RATIO
+
+    @classmethod
+    def from_env(cls) -> "DetectorConfig":
+        return cls(
+            ewma_alpha=_env_float("ALPHA", cls.ewma_alpha),
+            suspect_phi=_env_float("SUSPECT_PHI", cls.suspect_phi),
+            quarantine_phi=_env_float("QUARANTINE_PHI",
+                                      cls.quarantine_phi),
+            quarantine_evals=_env_int("QUARANTINE_EVALS",
+                                      cls.quarantine_evals),
+            probe_ok_required=_env_int("PROBE_OK",
+                                       cls.probe_ok_required),
+            probe_interval_s=_env_float("PROBE_INTERVAL_S",
+                                        cls.probe_interval_s),
+            min_samples=_env_int("MIN_SAMPLES", cls.min_samples),
+            sigma_floor_frac=_env_float("SIGMA_FRAC",
+                                        cls.sigma_floor_frac),
+            sigma_floor_abs=_env_float("SIGMA_ABS",
+                                       cls.sigma_floor_abs),
+            probe_timeout_s=_env_float("PROBE_TIMEOUT_S",
+                                       cls.probe_timeout_s),
+            spec_age_ratio=_env_float("SPEC_RATIO",
+                                      cls.spec_age_ratio),
+        )
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Ewma:
+    """Streaming mean/variance (exponentially weighted)."""
+
+    __slots__ = ("alpha", "mean", "var", "count")
+
+    def __init__(self, alpha: float):
+        self.alpha = alpha
+        self.mean = 0.0
+        self.var = 0.0
+        self.count = 0
+
+    def update(self, value: float) -> None:
+        if self.count == 0:
+            self.mean = value
+            self.var = 0.0
+        else:
+            d = value - self.mean
+            self.mean += self.alpha * d
+            self.var = (1.0 - self.alpha) * (
+                self.var + self.alpha * d * d)
+        self.count += 1
+
+
+@dataclasses.dataclass
+class _Component:
+    state: str = HEALTHY
+    streak: int = 0            # consecutive suspicious samples
+    good_probes: int = 0
+    ewma: Optional[_Ewma] = None
+
+
+class FailureDetector:
+    """Per-component gray-failure detection over one sample stream.
+
+    ``observe(component, sample_s, now)`` ingests one latency sample
+    (per-cell service time, per-token replica service time, probe
+    RTT — ONE channel per detector; mixing distributions breaks the
+    baseline) and returns the transition it caused, if any:
+    ``"suspected" | "cleared" | "quarantined" | "probe_ok" |
+    "restored" | None``. Samples from a quarantined component count
+    as probes. All state is deterministic in the sample stream; the
+    caller supplies ``now`` (virtual or monotonic), which is only
+    recorded, never branched on.
+    """
+
+    def __init__(self, cfg: Optional[DetectorConfig] = None):
+        self.cfg = cfg or DetectorConfig.from_env()
+        self._global = _Ewma(self.cfg.ewma_alpha)
+        self._comps: Dict[str, _Component] = {}
+        self.events: List[dict] = []
+
+    # -- model --------------------------------------------------------
+
+    def _sigma(self) -> float:
+        return max(math.sqrt(max(self._global.var, 0.0)),
+                   self.cfg.sigma_floor_frac * self._global.mean,
+                   self.cfg.sigma_floor_abs)
+
+    def phi(self, value: float) -> float:
+        """Suspicion of ``value`` against the global baseline:
+        -log10 of the survival probability under Normal(mean, sigma).
+        0.0 while the baseline has fewer than ``min_samples``
+        samples (no model, no suspicion — never quarantine on an
+        empty prior)."""
+        if self._global.count < self.cfg.min_samples:
+            return 0.0
+        z = (value - self._global.mean) / self._sigma()
+        if z <= 0:
+            return 0.0
+        sf = 0.5 * math.erfc(z / math.sqrt(2.0))
+        if sf <= 1e-300:
+            return PHI_CAP
+        return min(PHI_CAP, -math.log10(sf))
+
+    def expected_s(self) -> Optional[float]:
+        """The baseline's current expected service time (None before
+        min_samples) — the speculative re-dispatch threshold's
+        anchor."""
+        if self._global.count < self.cfg.min_samples:
+            return None
+        return self._global.mean
+
+    def relative_latency(self, component: str) -> float:
+        """This component's EWMA service time relative to the global
+        baseline, clipped to [0.25, 8] — the latency-aware router's
+        down-weighting factor (1.0 when either side lacks samples)."""
+        comp = self._comps.get(component)
+        if (comp is None or comp.ewma is None
+                or comp.ewma.count < self.cfg.min_samples
+                or self._global.count < self.cfg.min_samples
+                or self._global.mean <= 0):
+            return 1.0
+        return min(8.0, max(0.25,
+                            comp.ewma.mean / self._global.mean))
+
+    # -- introspection ------------------------------------------------
+
+    def _comp(self, component: str) -> _Component:
+        comp = self._comps.get(component)
+        if comp is None:
+            comp = _Component(ewma=_Ewma(self.cfg.ewma_alpha))
+            self._comps[component] = comp
+        return comp
+
+    def state(self, component: str) -> str:
+        comp = self._comps.get(component)
+        return comp.state if comp is not None else HEALTHY
+
+    def quarantined(self, component: str) -> bool:
+        return self.state(component) == QUARANTINED
+
+    def mean(self, component: str) -> Optional[float]:
+        comp = self._comps.get(component)
+        if comp is None or comp.ewma is None or not comp.ewma.count:
+            return None
+        return comp.ewma.mean
+
+    # -- transitions --------------------------------------------------
+
+    def _transition(self, component: str, transition: str,
+                    now: float, **info) -> str:
+        ev = {"at_s": round(now, 6), "component": component,
+              "transition": transition}
+        ev.update(info)
+        self.events.append(ev)
+        board = metrics.health_board()
+        if transition == "suspected":
+            board.incr("suspicions")
+        elif transition == "quarantined":
+            board.incr("quarantines")
+        elif transition == "restored":
+            board.incr("restores")
+        elif transition == "probe_ok":
+            board.incr("probes_ok")
+        return transition
+
+    def _quarantine(self, component: str, now: float,
+                    phi: float, cause: str) -> str:
+        comp = self._comp(component)
+        comp.state = QUARANTINED
+        comp.streak = 0
+        comp.good_probes = 0
+        metrics.recovery_log().record(
+            "health_quarantine", component=component, cause=cause)
+        return self._transition(component, "quarantined", now,
+                                phi=round(phi, 3), cause=cause)
+
+    def observe(self, component: str, sample_s: float,
+                now: float) -> Optional[str]:
+        comp = self._comp(component)
+        if comp.state == QUARANTINED:
+            ok = self.phi(sample_s) < self.cfg.suspect_phi
+            return self.record_probe(component, ok, now)
+        phi = self.phi(sample_s)
+        comp.ewma.update(sample_s)
+        transition = None
+        if phi >= self.cfg.quarantine_phi:
+            transition = self._quarantine(component, now, phi,
+                                          cause="phi_hard")
+        elif phi >= self.cfg.suspect_phi:
+            comp.streak += 1
+            if comp.streak >= self.cfg.quarantine_evals:
+                transition = self._quarantine(component, now, phi,
+                                              cause="phi_streak")
+            elif comp.state == HEALTHY:
+                comp.state = SUSPECT
+                transition = self._transition(
+                    component, "suspected", now, phi=round(phi, 3))
+        else:
+            comp.streak = 0
+            if comp.state == SUSPECT:
+                comp.state = HEALTHY
+                transition = self._transition(component, "cleared",
+                                              now)
+        # suspicious samples stay out of the baseline — a straggler
+        # must not drag the fleet's notion of normal toward itself
+        if phi < self.cfg.suspect_phi:
+            self._global.update(sample_s)
+        return transition
+
+    def record_probe(self, component: str, ok: bool,
+                     now: float) -> Optional[str]:
+        """One probe outcome. A failed probe is hard evidence (the
+        component wedged past its deadline): immediate quarantine
+        from any state. Clean probes lift a quarantine after
+        ``probe_ok_required`` in a row."""
+        comp = self._comp(component)
+        metrics.health_board().incr("probes")
+        if not ok:
+            comp.good_probes = 0
+            metrics.health_board().incr("probe_failures")
+            if comp.state != QUARANTINED:
+                return self._quarantine(component, now, PHI_CAP,
+                                        cause="probe_failure")
+            return None
+        if comp.state != QUARANTINED:
+            return None
+        comp.good_probes += 1
+        if comp.good_probes >= self.cfg.probe_ok_required:
+            return self.restore(component, now, reason="probes")
+        return self._transition(component, "probe_ok", now)
+
+    def restore(self, component: str, now: float,
+                reason: str = "probes") -> str:
+        """Lift a quarantine (clean probes, or the component was
+        replaced outright — a respawned worker, a gang rebound onto
+        healthy hardware). Per-component history resets: the
+        replacement is a new individual, not the straggler with a
+        clean shirt."""
+        comp = self._comp(component)
+        comp.state = HEALTHY
+        comp.streak = 0
+        comp.good_probes = 0
+        comp.ewma = _Ewma(self.cfg.ewma_alpha)
+        metrics.recovery_log().record(
+            "health_restore", component=component, reason=reason)
+        return self._transition(component, "restored", now,
+                                reason=reason)
+
+    # -- reporting ----------------------------------------------------
+
+    def quarantined_components(self) -> List[str]:
+        return sorted(c for c, s in self._comps.items()
+                      if s.state == QUARANTINED)
+
+    def report(self) -> dict:
+        states: Dict[str, str] = {
+            c: comp.state for c, comp in sorted(self._comps.items())}
+        counts: Dict[str, int] = {}
+        for ev in self.events:
+            counts[ev["transition"]] = (
+                counts.get(ev["transition"], 0) + 1)
+        return {
+            "config": self.cfg.as_dict(),
+            "components": states,
+            "transition_counts": dict(sorted(counts.items())),
+            "events": self.events,
+            "baseline_mean_s": (round(self._global.mean, 6)
+                                if self._global.count else None),
+            "samples": self._global.count,
+        }
+
+
+def detection_demo(seed: int = 0, components: int = 4,
+                   samples: int = 120) -> dict:
+    """Seeded synthetic detection run (the `health demo` CLI): one
+    component drawn from the chaos fault plan turns straggler for the
+    middle third of the stream, then recovers; the detector must
+    quarantine it, restore it through probes, and never touch the
+    healthy components. Pure function of (seed, components, samples)
+    — same seed, byte-identical report."""
+    import random
+    import zlib
+
+    from kind_tpu_sim import chaos
+
+    plan = chaos.ChaosSchedule(seed).plan(
+        kinds=("straggler_worker",), n_faults=1, horizon=8,
+        targets=max(1, components))
+    ev = plan.events[0]
+    straggler = f"comp-{ev.target % max(1, components)}"
+    factor = max(3.0, ev.param)
+    rng = random.Random(zlib.crc32(
+        f"health-demo:{seed}:{components}:{samples}".encode("utf-8")))
+    det = FailureDetector(DetectorConfig.from_env())
+    base = 0.05
+    lo, hi = samples // 3, 2 * samples // 3
+    for i in range(samples):
+        comp = f"comp-{i % max(1, components)}"
+        value = base * rng.uniform(0.9, 1.1)
+        if comp == straggler and lo <= i < hi:
+            value *= factor
+        now = round(i * 0.1, 6)
+        if det.quarantined(comp):
+            det.record_probe(comp, ok=value < 2.0 * base, now=now)
+        else:
+            det.observe(comp, value, now)
+    report = det.report()
+    report.update({
+        "seed": seed,
+        "plan": plan.as_dict(),
+        "straggler": straggler,
+        "factor": round(factor, 3),
+        "ok": bool(
+            det.state(straggler) == HEALTHY
+            and any(e["transition"] == "quarantined"
+                    and e["component"] == straggler
+                    for e in det.events)
+            and not any(e["transition"] == "quarantined"
+                        and e["component"] != straggler
+                        for e in det.events)),
+    })
+    return report

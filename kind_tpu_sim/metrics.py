@@ -232,6 +232,18 @@ def fleet_board() -> CounterBoard:
     return _FLEET_BOARD
 
 
+_HEALTH_BOARD = CounterBoard()
+
+
+def health_board() -> CounterBoard:
+    """The process-global gray-failure counter board (suspicions,
+    quarantines, restores, probes/probe failures, false positives,
+    speculative re-dispatches, gray migrations — kind_tpu_sim.health
+    and its consumers record into it; fleet/sched reports, chaos
+    scenario reports, and bench gray extras snapshot it)."""
+    return _HEALTH_BOARD
+
+
 _SCHED_BOARD = CounterBoard()
 
 

@@ -16,9 +16,66 @@ shapes, and inputs are sharded over every mesh axis they reduce over
 from __future__ import annotations
 
 import functools
-from typing import Dict
+from typing import Dict, Optional, Sequence
 
 import numpy as np
+
+# Nominal per-direction ICI link bandwidth used by the ANALYTIC cost
+# model below (v5e-class ballpark). Fleet/sched conclusions come from
+# RELATIVE comparisons at fixed config, not this absolute.
+DEFAULT_ICI_GBPS = 90.0
+
+
+def ring_allreduce_s(size_bytes: float, participants: int,
+                     link_gbps: float = DEFAULT_ICI_GBPS,
+                     link_factors: Optional[Sequence[float]] = None
+                     ) -> float:
+    """Modeled wall time of a bandwidth-optimal ring all-reduce.
+
+    The standard 2(n-1)/n-transits model: each participant moves
+    ``2 * (n-1)/n * size_bytes`` over its ring links, and the ring
+    finishes at the pace of its SLOWEST link — which is exactly why a
+    single gray (degraded, not dead) ICI link inflates every
+    collective on the slice. ``link_factors`` are per-link bandwidth
+    multipliers in (0, 1]; the minimum governs. This is the cost
+    accounting the fleet/sched gray-failure tick math draws on
+    (docs/HEALTH.md); it models no latency term, so sub-KB transfers
+    are under-costed — fine for the relative comparisons it serves.
+    """
+    if participants <= 1:
+        return 0.0
+    if size_bytes < 0 or link_gbps <= 0:
+        raise ValueError(
+            f"need size_bytes >= 0 and link_gbps > 0; got "
+            f"{size_bytes}, {link_gbps}")
+    slowest = min(link_factors) if link_factors else 1.0
+    if not 0.0 < slowest <= 1.0:
+        raise ValueError(
+            f"link factors must be in (0, 1]; got {slowest}")
+    bytes_per_s = link_gbps * 1e9 / 8.0 * slowest
+    transits = 2.0 * (participants - 1) / participants
+    return transits * size_bytes / bytes_per_s
+
+
+def ici_slowdown(link_factor: float,
+                 ici_fraction: float = 0.35) -> float:
+    """Service-time multiplier for a workload whose step spends
+    ``ici_fraction`` of its time in ICI collectives when the slice's
+    slowest link runs at ``link_factor`` of nominal bandwidth.
+
+    Amdahl's law applied to the ring model above: the compute share
+    is unaffected, the collective share scales by ``1/link_factor``
+    (ring time is inverse in the slowest link). ``link_factor=1`` is
+    exactly 1.0 — a healthy fabric adds nothing. The fleet applies
+    this to replicas whose gang sits on a degraded ICI domain, and
+    the scheduler inflates warm-up the same way (docs/HEALTH.md)."""
+    if not 0.0 < link_factor <= 1.0:
+        raise ValueError(
+            f"link_factor must be in (0, 1]; got {link_factor}")
+    if not 0.0 <= ici_fraction <= 1.0:
+        raise ValueError(
+            f"ici_fraction must be in [0, 1]; got {ici_fraction}")
+    return 1.0 + ici_fraction * (1.0 / link_factor - 1.0)
 
 
 def psum_smoke(mesh=None) -> Dict[str, object]:

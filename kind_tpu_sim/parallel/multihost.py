@@ -473,16 +473,23 @@ def launch_local_multislice(num_slices: int = 2,
 
 
 def grid_cell_probe(cell: int = 0, payload: int = 0,
-                    spin: int = 0) -> dict:
+                    spin: int = 0, sleep_s: float = 0.0) -> dict:
     """One deterministic grid cell: a pure function of (cell,
     payload) — the work unit scatter_grid_cells' recovery contract
     is proven against (a faulted run must return exactly the
     fault-free results). ``spin`` burns a little CPU so chaos tests
-    can widen the crash window without sleeping."""
+    can widen the crash window without sleeping; ``sleep_s`` gives
+    the cell a known service time so the gray-failure scenarios can
+    compare makespans against a stable baseline (the sleep does not
+    affect the returned value)."""
     value = (cell * 2654435761 + payload * 97 + 12345) % (2 ** 32)
     for _ in range(max(0, spin)):
         value = (value * 6364136223846793005 + 1442695040888963407) \
             % (2 ** 64)
+    if sleep_s > 0:
+        import time
+
+        time.sleep(sleep_s)
     return {"cell": cell, "payload": payload, "value": value}
 
 
@@ -495,7 +502,9 @@ def scatter_grid_cells(cells: List[dict],
                        cell_timeout: Optional[float] = None,
                        chips: int = 1,
                        fault: Optional[tuple] = None,
-                       max_respawns: int = 1):
+                       max_respawns: int = 1,
+                       detect: bool = False,
+                       health_cfg=None):
     """Fan independent grid cells out over cold slice workers with
     dead-worker recovery: a worker that crashes or hangs mid-cell has
     that cell requeued on the survivors (or its own respawn), so one
@@ -505,8 +514,12 @@ def scatter_grid_cells(cells: List[dict],
 
     ``fault`` = ("crash"|"hang", cell_index[, seconds]) is the
     chaos engine's deterministic kill/hang lever: whichever worker
-    draws that cell dies (or wedges) mid-cell, exactly once — see
-    worker_pool.run_cells. Returns (results, stats); results are
+    draws that cell dies (or wedges) mid-cell, exactly once;
+    ("straggler"|"flaky", worker_index, stall_seconds) is the GRAY
+    lever — that worker answers correctly but slowly. ``detect=True``
+    enables the gray-failure layer (probe gating, straggler
+    quarantine, speculative tail re-dispatch — docs/HEALTH.md, knobs
+    via ``health_cfg``). Returns (results, stats); results are
     cell-indexed and identical to a fault-free run.
     """
     from kind_tpu_sim.utils import worker_pool
@@ -519,7 +532,7 @@ def scatter_grid_cells(cells: List[dict],
     return worker_pool.run_cells(
         envs, target, cells, timeout=timeout,
         cell_timeout=cell_timeout, max_respawns=max_respawns,
-        fault=fault)
+        fault=fault, detect=detect, health_cfg=health_cfg)
 
 
 if __name__ == "__main__":

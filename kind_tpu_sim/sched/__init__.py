@@ -11,6 +11,7 @@ Knobs: KIND_TPU_SIM_SCHED_SEED (scheduler.resolve_seed).
 """
 
 from kind_tpu_sim.sched.inventory import (  # noqa: F401
+    LABEL_AVOID,
     IciDomain,
     Inventory,
     Node,
@@ -31,6 +32,7 @@ from kind_tpu_sim.sched.scheduler import (  # noqa: F401
     SchedSimConfig,
     SchedWorkloadSpec,
     SliceRequest,
+    apply_link_event,
     apply_node_event,
     generate_gangs,
     resolve_seed,

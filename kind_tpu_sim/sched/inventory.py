@@ -32,6 +32,10 @@ from kind_tpu_sim import topology as topo
 
 LABEL_POOL = "kind-tpu-sim.dev/pool"
 LABEL_ZONE = "topology.kubernetes.io/zone"
+# soft anti-affinity: the gray-failure layer marks nodes a
+# quarantined gang vacated so its rebind (and later placements)
+# steer elsewhere while the hardware stays suspect (docs/HEALTH.md)
+LABEL_AVOID = "kind-tpu-sim.dev/avoid"
 
 
 @dataclasses.dataclass
@@ -48,6 +52,7 @@ class Node:
     free: int = -1                 # -1 -> set to capacity in __post_init__
     cordoned: bool = False         # drained: no new bindings
     broken: bool = False           # failed: capacity gone entirely
+    avoid: bool = False            # gray-suspect: schedulable, scored last
 
     def __post_init__(self) -> None:
         if self.free < 0:
@@ -73,21 +78,33 @@ class Node:
             "zone": self.zone,
             "cordoned": self.cordoned,
             "broken": self.broken,
+            "avoid": self.avoid,
         }
 
 
 @dataclasses.dataclass
 class IciDomain:
-    """One physical pod/slice: a host grid wired by ICI."""
+    """One physical pod/slice: a host grid wired by ICI.
+
+    ``link_factor`` models the domain's slowest ICI link as a
+    bandwidth multiplier in (0, 1]: 1.0 is a healthy fabric; below
+    that the domain is GRAY-degraded — still schedulable, but scored
+    last and inflating every collective on it
+    (parallel/collectives.ici_slowdown, docs/HEALTH.md)."""
 
     domain_id: str
     accelerator: str               # topo.ACCELERATORS key
     host_grid: Tuple[int, ...]
     nodes: Dict[Tuple[int, ...], Node]
+    link_factor: float = 1.0
 
     @property
     def spec(self) -> topo.AcceleratorSpec:
         return topo.ACCELERATORS[self.accelerator]
+
+    @property
+    def degraded(self) -> bool:
+        return self.link_factor < 1.0
 
     def free_chips(self) -> int:
         return sum(n.free for n in self.nodes.values()
@@ -252,6 +269,23 @@ class Inventory:
     def restore_node(self, node_name: str) -> None:
         self.nodes[node_name].broken = False
 
+    def mark_avoid(self, node_name: str, flag: bool = True) -> None:
+        """Soft anti-affinity: an avoid node stays schedulable but
+        the scheduler prefers any placement that skips it."""
+        node = self.nodes[node_name]
+        node.avoid = flag
+        if flag:
+            node.labels[LABEL_AVOID] = "true"
+        else:
+            node.labels.pop(LABEL_AVOID, None)
+
+    def set_link_factor(self, domain_id: str,
+                        factor: float) -> None:
+        if not 0.0 < factor <= 1.0:
+            raise ValueError(
+                f"link factor must be in (0, 1]; got {factor}")
+        self.domains[domain_id].link_factor = factor
+
     # -- reporting ---------------------------------------------------
 
     def free_chips(self) -> int:
@@ -267,6 +301,7 @@ class Inventory:
                 did: {
                     "accelerator": d.accelerator,
                     "host_grid": list(d.host_grid),
+                    "link_factor": d.link_factor,
                     "free_chips": d.free_chips(),
                     "largest_free_block_hosts":
                         d.largest_free_block(),

@@ -213,19 +213,27 @@ class ClusterScheduler:
     def _score(self, req: SliceRequest,
                p: Placement) -> Tuple:
         """Lower is better; ties break on (domain, anchor), so the
-        choice is a pure function of inventory state."""
+        choice is a pure function of inventory state. Two GRAY keys
+        lead every policy (docs/HEALTH.md): a degraded-link domain
+        scores after every healthy one, and a placement touching
+        `avoid`-marked (gray-suspect) nodes after every clean one —
+        degraded capacity is last-resort capacity, never a tie-break
+        winner."""
         dom = self.inv.domains[p.domain]
+        gray = (1 if dom.degraded else 0,
+                1 if any(self.inv.nodes[n].avoid
+                         for n in p.node_names) else 0)
         if self.cfg.policy == "binpack":
             # most-allocated feasible domain, then node, first
-            return (dom.free_chips(),
-                    sum(self.inv.nodes[n].free
-                        for n in p.node_names),
-                    p.domain, p.anchor)
+            return gray + (dom.free_chips(),
+                           sum(self.inv.nodes[n].free
+                               for n in p.node_names),
+                           p.domain, p.anchor)
         if self.cfg.policy == "spread":
-            return (-dom.free_chips(),
-                    -sum(self.inv.nodes[n].free
-                         for n in p.node_names),
-                    p.domain, p.anchor)
+            return gray + (-dom.free_chips(),
+                           -sum(self.inv.nodes[n].free
+                                for n in p.node_names),
+                           p.domain, p.anchor)
         # ici: simulate the bind, keep the placement that leaves the
         # largest contiguous free host block (least fragmentation)
         self.inv.bind(p)
@@ -233,7 +241,7 @@ class ClusterScheduler:
             frag = -dom.largest_free_block()
         finally:
             self.inv.release(p)
-        return (frag, dom.free_chips(), p.domain, p.anchor)
+        return gray + (frag, dom.free_chips(), p.domain, p.anchor)
 
     def _best_placement(
             self, req: SliceRequest) -> Optional[Placement]:
@@ -286,6 +294,21 @@ class ClusterScheduler:
         self.inv.release(gang.placement)
         self._event(now, "Released", name, reason)
         metrics.sched_board().incr("gangs_released")
+
+    def evict_gang(self, name: str, now: float,
+                   reason: str) -> bool:
+        """Evict one bound gang by name and requeue it — the gray-
+        failure migration entry point (docs/HEALTH.md): a fleet that
+        quarantined a replica evicts its gang here, and the next
+        scheduling pass rebinds it wherever the (degraded-last,
+        avoid-last) scoring sends it, through the same bounded
+        defrag/preemption machinery as any pending gang."""
+        gang = self.bound.get(name)
+        if gang is None:
+            return False
+        self._evict(gang, now, reason)
+        metrics.sched_board().incr("gray_evictions")
+        return True
 
     # -- preemption --------------------------------------------------
 
@@ -591,6 +614,29 @@ def run_sched_sim(cfg: SchedSimConfig,
         "ok": len(ttr) == len(gangs),
     }
     return report
+
+
+def apply_link_event(sched: ClusterScheduler, action: str,
+                     domain_id: str, factor: float,
+                     now: float) -> None:
+    """The gray face of the scheduler: ``link_degrade`` marks an ICI
+    domain's slowest link at ``factor`` of nominal bandwidth — the
+    domain stays schedulable but scores LAST and every consumer's
+    modeled collective time inflates (docs/HEALTH.md);
+    ``link_restore`` heals it."""
+    if domain_id not in sched.inv.domains:
+        raise ValueError(f"unknown ICI domain {domain_id!r}")
+    if action == "link_degrade":
+        sched.inv.set_link_factor(domain_id, factor)
+        sched._event(now, "LinkDegraded", "-",
+                     f"{domain_id} link_factor={factor}")
+        metrics.sched_board().incr("links_degraded")
+    elif action == "link_restore":
+        sched.inv.set_link_factor(domain_id, 1.0)
+        sched._event(now, "LinkRestored", "-", domain_id)
+        metrics.sched_board().incr("links_restored")
+    else:
+        raise ValueError(f"unknown link event {action!r}")
 
 
 def apply_node_event(sched: ClusterScheduler, action: str,

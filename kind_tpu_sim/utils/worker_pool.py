@@ -63,13 +63,18 @@ log = logging.getLogger("kind-tpu-sim")
 
 WARM_ENV = "KIND_TPU_SIM_POOL_WARM"
 
-# Injectable chaos fault for a protocol worker (docs/CHAOS.md):
-# "crash@N" kills the worker (os._exit) when it RECEIVES its Nth
-# request (1-based); "hang@N:S" sleeps S seconds before answering it.
-# The parent strips this variable when it respawns a worker, so an
-# injected fault is transient by construction — exactly the failure
-# the recovery paths (respawn+retry, cell requeue, deadline kill)
-# exist for.
+# Injectable chaos fault for a protocol worker (docs/CHAOS.md,
+# docs/HEALTH.md): "crash@N" kills the worker (os._exit) when it
+# RECEIVES its Nth request (1-based); "hang@N:S" sleeps S seconds
+# before answering it. Two GRAY (sub-crash) kinds join them:
+# "slow@N:S" stalls S seconds before answering EVERY request from the
+# Nth on (a persistent straggler — alive, correct, slow), and
+# "flaky@K:S" stalls S seconds before answering every Kth request (an
+# intermittently-stalling node). The parent strips this variable when
+# it respawns a worker, so an injected fault is transient by
+# construction — exactly the failure the recovery paths (respawn+
+# retry, cell requeue, deadline kill, straggler quarantine +
+# speculative re-dispatch) exist for.
 CHAOS_FAULT_ENV = "KIND_TPU_SIM_CHAOS_FAULT"
 
 # A frame bigger than this is protocol corruption, not data.
@@ -88,6 +93,12 @@ class JobError(RuntimeError):
 
 class WorkerCrash(RuntimeError):
     """The worker process died before answering."""
+
+
+class WorkerCancelled(RuntimeError):
+    """The caller cancelled a pending read (e.g. the grid finished
+    through a speculative copy while a straggler still held the
+    original dispatch) — not a worker failure."""
 
 
 # ---------------------------------------------------------------------
@@ -247,8 +258,11 @@ def _parse_fault(spec: Optional[str]):
     """CHAOS_FAULT_ENV spec -> (kind, request_no, param) or None.
 
     Formats: "crash@2" (exit on receiving request 2), "hang@1:30"
-    (sleep 30s before answering request 1). Malformed specs are
-    ignored — a chaos knob must never break a healthy worker."""
+    (sleep 30s before answering request 1), "slow@1:0.5" (stall 0.5s
+    before answering every request from the 1st on — a straggler),
+    "flaky@3:0.5" (stall 0.5s before answering every 3rd request).
+    Malformed specs are ignored — a chaos knob must never break a
+    healthy worker."""
     if not spec or "@" not in spec:
         return None
     kind, _, rest = spec.partition("@")
@@ -291,12 +305,20 @@ def _serve() -> int:
         if req is None or req.get("op") == "shutdown":
             return 0
         req_no += 1
-        if fault is not None and req_no == fault[1]:
-            kind, _, param = fault
-            if kind == "crash":
-                os._exit(int(param) or 13)
-            if kind == "hang":
-                time.sleep(param or 3600.0)
+        if fault is not None:
+            kind, at, param = fault
+            if req_no == at:
+                if kind == "crash":
+                    os._exit(int(param) or 13)
+                if kind == "hang":
+                    time.sleep(param or 3600.0)
+            if kind == "slow" and req_no >= at:
+                # gray straggler: every job from request `at` on is
+                # stalled — the worker stays alive and correct
+                time.sleep(param)
+            if kind == "flaky" and at > 0 and req_no % at == 0:
+                # intermittent sub-crash stall on every at-th request
+                time.sleep(param)
         resp = {"id": req.get("id")}
         t0 = time.monotonic()
         try:
@@ -377,22 +399,29 @@ class _WorkerProc:
         except OSError:
             return ""
 
-    def read_frame(self, deadline: float):
+    def read_frame(self, deadline: float, cancel=None):
         """One frame from the worker's stdout, or raise: WorkerCrash
-        on EOF/death, TimeoutError past ``deadline``."""
+        on EOF/death, TimeoutError past ``deadline``,
+        WorkerCancelled when ``cancel`` (a threading.Event) is set —
+        how a grid run stops waiting on a straggler whose cell a
+        speculative copy already finished."""
         fd = self.proc.stdout.fileno()
         sel = selectors.DefaultSelector()
         sel.register(self.proc.stdout, selectors.EVENT_READ)
+        poll_s = 1.0 if cancel is None else 0.05
         try:
             while True:
                 frame, self._buf = _try_parse(self._buf)
                 if frame is not None:
                     return frame
+                if cancel is not None and cancel.is_set():
+                    raise WorkerCancelled(
+                        f"read from worker {self.pid} cancelled")
                 remain = deadline - time.monotonic()
                 if remain <= 0:
                     raise TimeoutError(
                         f"worker {self.pid} gave no answer in time")
-                if not sel.select(timeout=min(remain, 1.0)):
+                if not sel.select(timeout=min(remain, poll_s)):
                     if not self.alive():
                         raise WorkerCrash(
                             f"worker {self.pid} exited "
@@ -414,7 +443,8 @@ class _WorkerProc:
             self.hello = self.read_frame(deadline)
         return self.hello
 
-    def request(self, req: dict, deadline: float) -> dict:
+    def request(self, req: dict, deadline: float,
+                cancel=None) -> dict:
         self.ensure_ready(deadline)
         try:
             write_frame(self.proc.stdin, req)
@@ -422,7 +452,7 @@ class _WorkerProc:
             raise WorkerCrash(
                 f"worker {self.pid} pipe closed: {exc}; "
                 f"{self.stderr_tail()}") from exc
-        return self.read_frame(deadline)
+        return self.read_frame(deadline, cancel=cancel)
 
     def kill(self) -> None:
         if self.alive():
@@ -482,7 +512,11 @@ class WorkerPool:
 
     def __init__(self, size: int = 1, warm: bool = True,
                  extra_env: Optional[Dict[str, str]] = None,
-                 job_timeout: float = 300.0):
+                 job_timeout: float = 300.0, health=None):
+        # optional kind_tpu_sim.health.FailureDetector: the heartbeat
+        # sweep reports per-slot liveness probes into it, and a dead
+        # slot's respawn restores it (docs/HEALTH.md)
+        self._health = health
         self._env = _pool_child_env(extra_env, warm=warm)
         self._timeout = job_timeout
         self._queue: "queue.Queue" = queue.Queue()
@@ -577,9 +611,18 @@ class WorkerPool:
                         if self._busy[slot] or self._closed:
                             continue
                         proc = self._procs[slot]
-                        if proc is not None and proc.alive():
+                        alive = proc is not None and proc.alive()
+                        if self._health is not None:
+                            self._health.record_probe(
+                                f"pool-{slot}", ok=alive,
+                                now=time.monotonic())
+                        if alive:
                             continue
                         self._respawn(slot, reason="heartbeat")
+                        if self._health is not None:
+                            self._health.restore(
+                                f"pool-{slot}", time.monotonic(),
+                                reason="respawned")
 
         self._hb_thread = threading.Thread(
             target=sweep, name="tpu-sim-pool-heartbeat", daemon=True)
@@ -691,7 +734,8 @@ class WorkerPool:
 def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
              timeout: float,
              kwargs_list: Optional[Sequence[dict]] = None,
-             max_respawns: int = 0) -> List:
+             max_respawns: int = 0,
+             detector=None) -> List:
     """Spawn one COLD protocol worker per env dict, run ``target``
     (a ``module:attr`` callable) in each, and return the results in
     spawn order.
@@ -711,7 +755,13 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
     env + kwargs. Rendezvous launchers keep 0: one dead member wedges
     the whole jax.distributed world, so the recovery unit there is
     the launch attempt (multihost._with_launch_retry), not the
-    worker."""
+    worker.
+
+    ``detector`` (a kind_tpu_sim.health.FailureDetector) observes
+    each worker's reported job time — gang members are identity-bound
+    so a straggler cannot be rebalanced mid-grid, but sustained
+    suspicion surfaces in the detector for the NEXT launch to act on
+    (docs/HEALTH.md)."""
     from kind_tpu_sim import metrics
 
     def send_job(proc: _WorkerProc, worker: int) -> None:
@@ -795,6 +845,12 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
                             f"slice worker {worker} job failed: "
                             f"{frame.get('error')}\n"
                             f"{frame.get('traceback', '')[-1000:]}")
+                    if (detector is not None
+                            and frame.get("elapsed_s") is not None):
+                        detector.observe(
+                            f"grid-worker-{worker}",
+                            float(frame["elapsed_s"]),
+                            now=time.monotonic())
                     results[worker] = frame.get("result")
                     pending.discard(worker)
             return results
@@ -807,7 +863,9 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
               cells: Sequence[dict], timeout: float,
               cell_timeout: Optional[float] = None,
               max_respawns: int = 1,
-              fault: Optional[tuple] = None):
+              fault: Optional[tuple] = None,
+              detect: bool = False,
+              health_cfg=None):
     """Dynamic grid-cell scheduler over COLD protocol workers: every
     worker pulls the next unclaimed cell, so the grid drains at the
     speed of the survivors even when a worker dies.
@@ -823,29 +881,66 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
     kwargs. A cell whose job RAISES is deterministic and fails the
     whole run (retrying it would just re-raise slower).
 
-    ``fault`` = ("crash"|"hang", cell_index[, seconds]) is the
-    DETERMINISTIC chaos lever: the FIRST dispatch of that cell sends
-    a genuine crash/hang job in its place (whichever worker drew it
-    dies/wedges mid-cell), consumed exactly once — so a seeded fault
-    plan replays identically regardless of which worker the dynamic
-    scheduler hands the cell to. Hang faults need ``cell_timeout``
-    to be detected before the global deadline.
+    ``fault`` is the DETERMINISTIC chaos lever. Fail-stop kinds
+    target a CELL: ("crash"|"hang", cell_index[, seconds]) sends a
+    genuine crash/hang job in that cell's place on its first
+    dispatch, consumed exactly once. Gray kinds target a WORKER:
+    ("straggler"|"flaky", worker_index, stall_seconds) plants a
+    "slow@1:S" / "flaky@2:S" CHAOS_FAULT_ENV in that worker's env —
+    alive, correct, slow (docs/HEALTH.md).
+
+    ``detect=True`` turns on the gray-failure layer
+    (kind_tpu_sim.health, knobs via ``health_cfg`` or the
+    KIND_TPU_SIM_HEALTH_* env):
+
+    * each worker is PROBED (a ping bounded by ``probe_timeout_s``)
+      before it may pull cells; a probe that misses its deadline
+      quarantines the worker, and a respawn (budget permitting)
+      replaces and restores it;
+    * per-cell service times feed the phi-accrual detector; a worker
+      whose samples go suspicious enough to quarantine stops pulling
+      cells (rebalanced away) and is respawned when budget remains;
+    * once the queue is empty, the slowest tail cell still in flight
+      on a suspect worker is SPECULATIVELY re-dispatched to an idle
+      worker — first result wins (cells are pure functions, so the
+      copies are identical by construction).
 
     Returns ``(results, stats)``: results in cell order, stats with
-    requeue/respawn counts (also recorded in metrics.recovery_log).
+    requeue/respawn/quarantine/speculation counts plus
+    ``makespan_s`` (first dispatch -> last completion) — also
+    recorded in metrics.recovery_log / metrics.health_board.
     """
     from kind_tpu_sim import metrics
 
+    detector = None
+    hcfg = None
+    if detect:
+        from kind_tpu_sim import health as health_mod
+
+        hcfg = health_cfg or health_mod.DetectorConfig.from_env()
+        detector = health_mod.FailureDetector(hcfg)
+
+    gray_fault = (fault if fault is not None
+                  and fault[0] in ("straggler", "flaky") else None)
+    cell_fault = fault if gray_fault is None else None
+
     deadline = time.monotonic() + timeout
     cond = threading.Condition()
+    all_done = threading.Event()
     todo: List[int] = list(range(len(cells)))
     inflight: set = set()
+    dispatch_t: Dict[int, float] = {}
+    spec_extra: Dict[int, int] = {}
     fatal: List[BaseException] = []
     results: List = [None] * len(cells)
     ok: List[bool] = [False] * len(cells)
+    done_count = [0]
+    span = [None, None]  # first dispatch, last completion
     stats = {"workers": len(worker_envs), "requeues": 0,
-             "respawns": 0, "faults_injected": 0}
-    fault_budget = [1 if fault else 0]
+             "respawns": 0, "faults_injected": 0,
+             "probes": 0, "probe_failures": 0,
+             "quarantines": 0, "speculative": 0}
+    fault_budget = [1 if cell_fault else 0]
 
     def next_cell() -> Optional[int]:
         with cond:
@@ -855,26 +950,114 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
                 if todo:
                     idx = todo.pop(0)
                     inflight.add(idx)
+                    now = time.monotonic()
+                    dispatch_t.setdefault(idx, now)
+                    if span[0] is None:
+                        span[0] = now
                     return idx
                 if not inflight:
                     return None
+                if detector is not None:
+                    idx = _pick_speculative()
+                    if idx is not None:
+                        return idx
                 cond.wait(0.05)
+
+    def _pick_speculative() -> Optional[int]:
+        # caller holds cond. The slowest (oldest) tail cell still in
+        # flight, once past spec_age_ratio x the expected service
+        # time, earns ONE speculative copy — first result wins.
+        expected = detector.expected_s()
+        if expected is None:
+            return None
+        now = time.monotonic()
+        for idx in sorted(inflight,
+                          key=lambda i: dispatch_t.get(i, now)):
+            if ok[idx] or spec_extra.get(idx, 0) >= 1:
+                continue
+            age = now - dispatch_t.get(idx, now)
+            if age > hcfg.spec_age_ratio * expected:
+                spec_extra[idx] = spec_extra.get(idx, 0) + 1
+                stats["speculative"] += 1
+                metrics.health_board().incr("speculative_redispatch")
+                metrics.recovery_log().record(
+                    "cell_speculated", cell=idx,
+                    age_s=round(age, 3))
+                return idx
+        return None
 
     def finish(idx: int, success: bool) -> None:
         with cond:
             inflight.discard(idx)
             if success:
-                ok[idx] = True
-            else:
+                if not ok[idx]:
+                    ok[idx] = True
+                    done_count[0] += 1
+                    span[1] = time.monotonic()
+                    if done_count[0] == len(cells):
+                        all_done.set()
+            elif not ok[idx] and idx not in todo:
                 todo.insert(0, idx)
                 stats["requeues"] += 1
             cond.notify_all()
 
+    def probe(proc: "_WorkerProc", comp: str) -> bool:
+        """Bounded ping before the worker may pull cells. RTTs are
+        NOT fed to the EWMA baseline (pings and cells are different
+        distributions); the probe verdict is binary."""
+        stats["probes"] += 1
+        try:
+            proc.request({"id": -1, "job": "ping"},
+                         time.monotonic() + hcfg.probe_timeout_s)
+        except (WorkerCrash, TimeoutError):
+            stats["probe_failures"] += 1
+            if detector.record_probe(
+                    comp, ok=False,
+                    now=time.monotonic()) == "quarantined":
+                stats["quarantines"] += 1
+            return False
+        detector.record_probe(comp, ok=True, now=time.monotonic())
+        return True
+
+    def respawn(env: Dict[str, str], proc: "_WorkerProc",
+                worker: int) -> "_WorkerProc":
+        proc.kill()
+        with cond:
+            stats["respawns"] += 1
+        env.pop(CHAOS_FAULT_ENV, None)
+        fresh = _WorkerProc(env)
+        metrics.recovery_log().record(
+            "cell_worker_respawn", worker=worker, pid=fresh.pid)
+        return fresh
+
     def drive(worker: int) -> None:
         env = _pool_child_env(worker_envs[worker], warm=False)
+        if (gray_fault is not None
+                and gray_fault[1] % len(worker_envs) == worker):
+            stall = float(gray_fault[2] if len(gray_fault) > 2
+                          else 1.0)
+            env[CHAOS_FAULT_ENV] = (
+                f"slow@1:{stall}" if gray_fault[0] == "straggler"
+                else f"flaky@2:{stall}")
+            with cond:
+                stats["faults_injected"] += 1
+            metrics.recovery_log().record(
+                "fault_injected", kind=gray_fault[0], worker=worker)
         proc = _WorkerProc(env)
+        comp = f"worker-{worker}"
         respawns_left = max_respawns
         try:
+            if detector is not None:
+                healthy = probe(proc, comp)
+                while not healthy:
+                    if respawns_left <= 0:
+                        return  # quarantined for good; peers drain
+                    respawns_left -= 1
+                    proc = respawn(dict(env), proc, worker)
+                    healthy = probe(proc, comp)
+                    if healthy:
+                        detector.restore(comp, time.monotonic(),
+                                         reason="respawned")
             while True:
                 idx = next_cell()
                 if idx is None:
@@ -886,26 +1069,34 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
                 req = {"id": idx, "job": "call",
                        "kwargs": {"target": target,
                                   "kwargs": dict(cells[idx])}}
-                if fault is not None and idx == fault[1]:
+                if cell_fault is not None and idx == cell_fault[1]:
                     with cond:
                         inject = fault_budget[0] > 0
                         if inject:
                             fault_budget[0] -= 1
                             stats["faults_injected"] += 1
                     if inject:
-                        if fault[0] == "crash":
+                        if cell_fault[0] == "crash":
                             req = {"id": idx, "job": "crash",
                                    "kwargs": {}}
-                        elif fault[0] == "hang":
+                        elif cell_fault[0] == "hang":
                             req = {"id": idx, "job": "hang",
                                    "kwargs": {"seconds": float(
-                                       fault[2] if len(fault) > 2
+                                       cell_fault[2]
+                                       if len(cell_fault) > 2
                                        else 3600.0)}}
                         metrics.recovery_log().record(
-                            "fault_injected", kind=fault[0],
+                            "fault_injected", kind=cell_fault[0],
                             cell=idx, worker=worker)
+                t0 = time.monotonic()
                 try:
-                    resp = proc.request(req, cell_deadline)
+                    resp = proc.request(req, cell_deadline,
+                                        cancel=all_done)
+                except WorkerCancelled:
+                    # the grid finished through a speculative copy
+                    # while this worker still chewed on its cell
+                    proc.kill()
+                    return
                 except (WorkerCrash, TimeoutError) as exc:
                     finish(idx, False)
                     metrics.recovery_log().record(
@@ -915,14 +1106,7 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
                     if respawns_left <= 0:
                         return  # survivors drain the requeued cell
                     respawns_left -= 1
-                    with cond:
-                        stats["respawns"] += 1
-                    env = dict(env)
-                    env.pop(CHAOS_FAULT_ENV, None)
-                    proc = _WorkerProc(env)
-                    metrics.recovery_log().record(
-                        "cell_worker_respawn", worker=worker,
-                        pid=proc.pid)
+                    proc = respawn(dict(env), proc, worker)
                     continue
                 if not resp.get("ok"):
                     with cond:
@@ -934,6 +1118,22 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
                     return
                 results[idx] = resp.get("result")
                 finish(idx, True)
+                if detector is not None:
+                    transition = detector.observe(
+                        comp, time.monotonic() - t0,
+                        now=time.monotonic())
+                    if transition == "quarantined":
+                        with cond:
+                            stats["quarantines"] += 1
+                        proc.kill()
+                        if respawns_left <= 0:
+                            return  # rebalanced away for good
+                        respawns_left -= 1
+                        proc = respawn(dict(env), proc, worker)
+                        if not probe(proc, comp):
+                            return
+                        detector.restore(comp, time.monotonic(),
+                                         reason="respawned")
         finally:
             proc.kill()
             with cond:
@@ -948,6 +1148,15 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
     for thread in threads:
         thread.join(timeout=max(0.0, deadline - time.monotonic())
                     + 10.0)
+    if span[0] is not None and span[1] is not None:
+        stats["makespan_s"] = round(span[1] - span[0], 6)
+    if detector is not None:
+        # transitions only (no wall times): the byte-stable shape
+        # chaos scenario reports embed (docs/HEALTH.md)
+        stats["detection"] = [
+            {"component": e["component"],
+             "transition": e["transition"]}
+            for e in detector.events]
     if fatal:
         raise fatal[0]
     missing = [i for i, done in enumerate(ok) if not done]

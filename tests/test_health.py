@@ -1,0 +1,459 @@
+"""Gray-failure layer tests (docs/HEALTH.md).
+
+The phi-accrual detector, its hysteresis state machine, and the
+graceful-degradation paths it drives: straggler re-dispatch in the
+cell grid, latency-aware routing + quarantine in the fleet,
+degraded-domain scoring + gang migration in the scheduler. Everything
+here is deterministic — seeded streams in, byte-identical event logs
+out — and the false-positive bound is asserted the same way the
+acceptance criteria state it: a fault-free run records ZERO
+quarantines.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from kind_tpu_sim import chaos, fleet, health, metrics
+from kind_tpu_sim.parallel import collectives, multihost
+
+pytestmark = pytest.mark.gray
+
+
+def _noisy_stream(seed: int, n: int, base: float = 0.05,
+                  jitter: float = 0.1):
+    """Seeded noise-only latency stream: base +/- jitter, no fault."""
+    import random
+    import zlib
+
+    rng = random.Random(zlib.crc32(f"noise:{seed}".encode("utf-8")))
+    return [base * rng.uniform(1.0 - jitter, 1.0 + jitter)
+            for _ in range(n)]
+
+
+# -- detector core -----------------------------------------------------
+
+
+def test_detector_deterministic_event_log():
+    """Same sample stream => byte-identical transition log."""
+    def run():
+        det = health.FailureDetector(health.DetectorConfig())
+        for i, v in enumerate(_noisy_stream(3, 60)):
+            comp = f"c-{i % 3}"
+            value = v * (4.0 if comp == "c-1" and 20 <= i < 40
+                         else 1.0)
+            det.observe(comp, value, now=round(i * 0.1, 6))
+        return det
+
+    a, b = run(), run()
+    assert json.dumps(a.events, sort_keys=True) == \
+        json.dumps(b.events, sort_keys=True)
+    assert any(e["transition"] == "quarantined" for e in a.events)
+
+
+def test_noise_only_stream_never_quarantines():
+    """The acceptance bound: fault-free => zero quarantines, across
+    several seeds and a healthy jitter band."""
+    for seed in range(8):
+        det = health.FailureDetector(health.DetectorConfig())
+        for i, v in enumerate(_noisy_stream(seed, 200)):
+            det.observe(f"c-{i % 4}", v, now=round(i * 0.1, 6))
+        assert not any(e["transition"] == "quarantined"
+                       for e in det.events), f"seed {seed}"
+
+
+def test_hysteresis_no_flap():
+    """One suspicious sample suspects but does NOT quarantine; a
+    clean sample clears the suspicion (suspect -> healthy), so a
+    single outlier can never flap a component out of service."""
+    cfg = health.DetectorConfig(quarantine_phi=1e9)
+    det = health.FailureDetector(cfg)
+    for i, v in enumerate(_noisy_stream(1, 30)):
+        det.observe("a", v, now=float(i))
+    base = det.expected_s()
+    assert base is not None
+    assert det.observe("a", base * 3.0, now=100.0) == "suspected"
+    assert det.state("a") == health.SUSPECT
+    assert det.observe("a", base, now=101.0) == "cleared"
+    assert det.state("a") == health.HEALTHY
+    assert not any(e["transition"] == "quarantined"
+                   for e in det.events)
+
+
+def test_streak_escalates_to_quarantine():
+    cfg = health.DetectorConfig(quarantine_phi=1e9,
+                                quarantine_evals=3)
+    det = health.FailureDetector(cfg)
+    for i, v in enumerate(_noisy_stream(2, 30)):
+        det.observe("a", v, now=float(i))
+    base = det.expected_s()
+    assert det.observe("a", base * 3.0, now=100.0) == "suspected"
+    assert det.observe("a", base * 3.0, now=101.0) is None
+    assert det.observe("a", base * 3.0, now=102.0) == "quarantined"
+    assert det.quarantined("a")
+
+
+def test_quarantine_restore_round_trip():
+    """quarantined -> probe_ok x probe_ok_required -> restored, and
+    the restored component starts with fresh per-component history."""
+    cfg = health.DetectorConfig(probe_ok_required=2)
+    det = health.FailureDetector(cfg)
+    for i, v in enumerate(_noisy_stream(4, 30)):
+        det.observe("a", v, now=float(i))
+        det.observe("b", v, now=float(i))
+    assert det.record_probe("a", ok=False, now=50.0) == "quarantined"
+    assert det.quarantined("a")
+    assert "a" in det.quarantined_components()
+    assert det.record_probe("a", ok=True, now=51.0) == "probe_ok"
+    assert det.record_probe("a", ok=True, now=52.0) == "restored"
+    assert det.state("a") == health.HEALTHY
+    assert det.mean("a") is None  # replacement = new individual
+    transitions = [e["transition"] for e in det.events
+                   if e["component"] == "a"]
+    assert transitions == ["quarantined", "probe_ok", "restored"]
+
+
+def test_failed_probe_resets_good_probe_progress():
+    cfg = health.DetectorConfig(probe_ok_required=2)
+    det = health.FailureDetector(cfg)
+    det.record_probe("a", ok=False, now=0.0)
+    assert det.record_probe("a", ok=True, now=1.0) == "probe_ok"
+    assert det.record_probe("a", ok=False, now=2.0) is None
+    assert det.record_probe("a", ok=True, now=3.0) == "probe_ok"
+    assert det.record_probe("a", ok=True, now=4.0) == "restored"
+
+
+def test_straggler_excluded_from_baseline():
+    """Suspicious samples must not drag the global baseline toward
+    the straggler — the mean stays near the healthy service time."""
+    det = health.FailureDetector(health.DetectorConfig())
+    for i, v in enumerate(_noisy_stream(5, 120)):
+        value = v * (5.0 if i % 4 == 1 and i >= 20 else 1.0)
+        det.observe(f"c-{i % 4}", value, now=float(i))
+    assert det.expected_s() < 0.1
+
+
+def test_relative_latency_down_weights_slow_component():
+    """One of four components turns slow after a healthy baseline
+    forms (the realistic minority-straggler shape): its EWMA-vs-
+    baseline factor rises well above 1 while its peers stay near 1."""
+    det = health.FailureDetector(
+        health.DetectorConfig(quarantine_phi=1e9,
+                              quarantine_evals=10 ** 6))
+    for i, v in enumerate(_noisy_stream(6, 160)):
+        comp = f"c-{i % 4}"
+        slow = comp == "c-1" and i >= 40
+        det.observe(comp, v * (3.0 if slow else 1.0), now=float(i))
+    assert det.relative_latency("c-0") == pytest.approx(1.0, rel=0.3)
+    assert det.relative_latency("c-1") > 1.5
+    assert det.relative_latency("never-seen") == 1.0
+
+
+def test_detector_config_env_knobs(monkeypatch):
+    monkeypatch.setenv("KIND_TPU_SIM_HEALTH_SUSPECT_PHI", "3.5")
+    monkeypatch.setenv("KIND_TPU_SIM_HEALTH_QUARANTINE_EVALS", "7")
+    monkeypatch.setenv("KIND_TPU_SIM_HEALTH_SPEC_RATIO", "bogus")
+    cfg = health.DetectorConfig.from_env()
+    assert cfg.suspect_phi == 3.5
+    assert cfg.quarantine_evals == 7
+    assert cfg.spec_age_ratio == health.DetectorConfig.spec_age_ratio
+
+
+def test_detection_demo_deterministic_and_ok():
+    a = health.detection_demo(seed=7)
+    b = health.detection_demo(seed=7)
+    assert json.dumps(a, sort_keys=True) == \
+        json.dumps(b, sort_keys=True)
+    assert a["ok"]
+
+
+# -- modeled collective cost -------------------------------------------
+
+
+def test_ring_allreduce_slowest_link_governs():
+    base = collectives.ring_allreduce_s(1 << 30, 8)
+    degraded = collectives.ring_allreduce_s(
+        1 << 30, 8, link_factors=[1.0, 1.0, 0.25, 1.0])
+    assert degraded == pytest.approx(base * 4.0)
+    assert collectives.ring_allreduce_s(1 << 30, 1) == 0.0
+    with pytest.raises(ValueError):
+        collectives.ring_allreduce_s(1 << 30, 8, link_factors=[0.0])
+
+
+def test_ici_slowdown_amdahl():
+    assert collectives.ici_slowdown(1.0) == 1.0
+    assert collectives.ici_slowdown(0.5, ici_fraction=0.4) == \
+        pytest.approx(1.4)
+    # fully-ICI workload scales inversely in the link factor
+    assert collectives.ici_slowdown(0.25, ici_fraction=1.0) == \
+        pytest.approx(4.0)
+    with pytest.raises(ValueError):
+        collectives.ici_slowdown(0.0)
+
+
+# -- straggler re-dispatch in the cell grid ----------------------------
+
+
+@pytest.mark.slow
+def test_straggler_grid_redispatch_result_identity():
+    """A gray straggler worker is detected and rebalanced away; the
+    results stay bit-identical to the fault-free run and nothing is
+    lost. (Real subprocesses — slow tier.)"""
+    cells = [{"cell": i, "payload": 11, "sleep_s": 0.05}
+             for i in range(18)]
+    hcfg = dataclasses.replace(health.DetectorConfig.from_env(),
+                               probe_timeout_s=0.8)
+    clean, clean_stats = multihost.scatter_grid_cells(
+        cells, workers=4, timeout=120.0, detect=True,
+        health_cfg=hcfg)
+    faulted, stats = multihost.scatter_grid_cells(
+        cells, workers=4, timeout=120.0, detect=True,
+        health_cfg=hcfg, fault=("straggler", 1, 1.5),
+        max_respawns=1)
+    assert faulted == clean
+    assert clean_stats["quarantines"] == 0
+    assert stats["quarantines"] + stats["speculative"] >= 1
+
+
+# -- fleet quarantine / restore / false-positive bound -----------------
+
+
+def _fleet_run(trace, detect: bool, events):
+    cfg = fleet.FleetConfig(
+        replicas=3, policy="least-outstanding", tick_s=0.01,
+        sim=fleet.SimReplicaConfig(max_slots=4,
+                                   prefill_per_tok_s=0.002,
+                                   tpot_s=0.002),
+        slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+        health=(health.DetectorConfig.from_env()
+                if detect else None))
+    return fleet.FleetSim(cfg, trace,
+                          chaos_events=list(events)).run()
+
+
+def _slow_trace(seed: int = 7):
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=400, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    return fleet.generate_trace(spec, seed)
+
+
+def test_fleet_fault_free_run_records_zero_quarantines():
+    rep = _fleet_run(_slow_trace(), detect=True, events=[])
+    assert rep["ok"]
+    assert rep["health"]["counters"].get("quarantines", 0) == 0
+    assert rep["health"]["counters"].get("false_positives", 0) == 0
+
+
+def test_fleet_slow_replica_quarantined_and_restored():
+    trace = _slow_trace()
+    span = max(r.arrival_s for r in trace)
+    events = [fleet.ChaosEvent(at_s=round(span * 0.25, 6),
+                               action="slow", target=1, param=4.0),
+              fleet.ChaosEvent(at_s=round(span * 0.65, 6),
+                               action="unslow", target=1)]
+    rep = _fleet_run(trace, detect=True, events=events)
+    assert rep["ok"]
+    detector = rep["health"]["detector"]
+    assert any(e["transition"] == "quarantined"
+               and e["component"] == "replica-1"
+               for e in detector["events"])
+    assert any(e["transition"] == "restored"
+               and e["component"] == "replica-1"
+               for e in detector["events"])
+    # no healthy replica was ever quarantined
+    assert not any(e["transition"] == "quarantined"
+                   and e["component"] != "replica-1"
+                   for e in detector["events"])
+    assert rep["health"]["counters"].get("false_positives", 0) == 0
+    # no request lost: same token volume as a detection-off run
+    off = _fleet_run(trace, detect=False, events=events)
+    assert sum(e["tokens"] for e in rep["completions"]) == \
+        sum(e["tokens"] for e in off["completions"])
+
+
+def test_fleet_health_report_replayable():
+    trace = _slow_trace()
+    span = max(r.arrival_s for r in trace)
+    events = [fleet.ChaosEvent(at_s=round(span * 0.3, 6),
+                               action="slow", target=0, param=5.0)]
+    a = _fleet_run(trace, detect=True, events=events)
+    b = _fleet_run(trace, detect=True, events=events)
+    assert json.dumps(a["completions"], sort_keys=True) == \
+        json.dumps(b["completions"], sort_keys=True)
+    assert json.dumps(a["health"]["detector"]["events"],
+                      sort_keys=True) == \
+        json.dumps(b["health"]["detector"]["events"], sort_keys=True)
+
+
+# -- scheduler: degraded domains + avoid marks -------------------------
+
+
+def _two_domain_sched(policy: str = "spread"):
+    from kind_tpu_sim import sched
+
+    inv = sched.build_inventory(
+        pods=(("tpu-v5-lite-podslice", "4x8"),
+              ("tpu-v5-lite-podslice", "4x8")))
+    return sched.ClusterScheduler(inv,
+                                  sched.SchedConfig(policy=policy))
+
+
+def test_degraded_domain_scored_last():
+    from kind_tpu_sim import sched
+
+    s = _two_domain_sched(policy="binpack")
+    domains = sorted(s.inv.domains)
+    sched.apply_link_event(s, "link_degrade", domains[0], 0.2, 0.0)
+    s.submit(sched.SliceRequest(name="g", accelerator
+             ="tpu-v5-lite-podslice", topology="2x4"), now=0.0)
+    bound = s.step(now=0.0)
+    assert len(bound) == 1
+    assert bound[0].placement.domain == domains[1]
+    sched.apply_link_event(s, "link_restore", domains[0], 1.0, 0.0)
+    assert not s.inv.domains[domains[0]].degraded
+
+
+def test_avoid_marked_nodes_scored_last():
+    s = _two_domain_sched(policy="binpack")
+    # binpack would otherwise prefer domain 0 deterministically
+    first = sorted(s.inv.domains)[0]
+    for node in s.inv.domains[first].nodes.values():
+        s.inv.mark_avoid(node.name)
+    from kind_tpu_sim import sched
+
+    s.submit(sched.SliceRequest(name="g", accelerator
+             ="tpu-v5-lite-podslice", topology="2x4"), now=0.0)
+    bound = s.step(now=0.0)
+    assert bound[0].placement.domain != first
+    node = next(iter(s.inv.domains[first].nodes.values()))
+    assert s.inv.nodes[node.name].labels.get(
+        "kind-tpu-sim.dev/avoid") == "true"
+    s.inv.mark_avoid(node.name, False)
+    assert "kind-tpu-sim.dev/avoid" not in s.inv.nodes[
+        node.name].labels
+
+
+def test_evict_gang_requeues_and_rebinds():
+    from kind_tpu_sim import sched
+
+    s = _two_domain_sched(policy="spread")
+    s.submit(sched.SliceRequest(name="g", accelerator
+             ="tpu-v5-lite-podslice", topology="2x4"), now=0.0)
+    bound = s.step(now=0.0)
+    vacated = set(bound[0].placement.node_names)
+    for node in vacated:
+        s.inv.mark_avoid(node, True)
+    assert s.evict_gang("g", 1.0, reason="gray test")
+    assert not s.evict_gang("no-such-gang", 1.0, reason="x")
+    rebound = s.step(now=1.0)
+    assert len(rebound) == 1
+    # the avoid marks steer the rebind off the vacated (suspect)
+    # hardware; the rest of that domain stays fair game
+    assert not vacated & set(rebound[0].placement.node_names)
+
+
+# -- gray chaos scenarios (the soak surface) ---------------------------
+
+
+@pytest.mark.chaos
+def test_gray_scenarios_registered_and_in_soak_pool():
+    for name in ("gray-straggler-grid", "gray-slow-replica",
+                 "gray-degraded-ici"):
+        assert name in chaos.SCENARIOS
+    # the grid scenario spawns real subprocesses but stays in the
+    # fast/soak pool: soak is the surface the acceptance criteria
+    # are asserted on
+    assert not chaos.SCENARIOS["gray-slow-replica"].slow
+    assert not chaos.SCENARIOS["gray-degraded-ici"].slow
+
+
+@pytest.mark.chaos
+def test_gray_slow_replica_scenario_green():
+    rep = chaos.run_scenario("gray-slow-replica", seed=13)
+    assert rep["ok"]
+    assert rep["fault_free_quarantines"] == 0
+    assert rep["quarantines"] >= 1
+    assert rep["false_positives"] == 0
+    assert rep["p99_recovered"] and rep["p99_off_degraded"]
+    assert rep["replay_identical"]
+
+
+@pytest.mark.chaos
+def test_gray_degraded_ici_scenario_green():
+    rep = chaos.run_scenario("gray-degraded-ici", seed=13)
+    assert rep["ok"]
+    assert rep["gray_migrations"] >= 1
+    assert rep["migrations_avoid_degraded_domain"]
+    assert rep["replay_identical"]
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_gray_straggler_grid_scenario_green():
+    rep = chaos.run_scenario("gray-straggler-grid", seed=13)
+    assert rep["ok"]
+    assert rep["results_identical"]
+    assert rep["detected"]
+
+
+def test_gray_fault_kinds_in_seeded_plan():
+    a = chaos.ChaosSchedule(21).plan(
+        kinds=("straggler_worker", "degraded_link", "slow_replica",
+               "flaky_node"),
+        n_faults=8, horizon=10, targets=4)
+    b = chaos.ChaosSchedule(21).plan(
+        kinds=("straggler_worker", "degraded_link", "slow_replica",
+               "flaky_node"),
+        n_faults=8, horizon=10, targets=4)
+    assert json.dumps(a.as_dict(), sort_keys=True) == \
+        json.dumps(b.as_dict(), sort_keys=True)
+    params = {e.kind: e.param for e in a.events}
+    assert 0.0 < params["degraded_link"] <= 0.25
+    assert params["slow_replica"] >= 3.0
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def test_health_cli_knobs_and_demo(capsys):
+    from kind_tpu_sim import cli
+
+    assert cli.main(["health", "knobs", "--json"]) == 0
+    knobs = json.loads(capsys.readouterr().out)
+    assert "suspect_phi" in knobs
+    assert cli.main(["health", "demo", "--seed", "7",
+                     "--json"]) == 0
+    a = capsys.readouterr().out
+    assert cli.main(["health", "demo", "--seed", "7",
+                     "--json"]) == 0
+    b = capsys.readouterr().out
+    assert a == b
+    assert json.loads(a)["ok"]
+
+
+def test_fleet_cli_health_flag_byte_identical(capsys):
+    from kind_tpu_sim import cli
+
+    argv = ["fleet", "run", "--seed", "7", "--replicas", "3",
+            "--requests", "80", "--policy", "least-outstanding",
+            "--health", "--json"]
+    assert cli.main(list(argv)) == 0
+    a = capsys.readouterr().out
+    assert cli.main(list(argv)) == 0
+    b = capsys.readouterr().out
+    assert a == b
+    rep = json.loads(a)
+    assert "health" in rep
+    assert rep["health"]["counters"].get("quarantines", 0) == 0
+
+
+def test_health_board_counters_flow():
+    board = metrics.health_board()
+    before = board.counts()
+    det = health.FailureDetector(health.DetectorConfig())
+    det.record_probe("x", ok=False, now=0.0)
+    delta = board.snapshot_since(before)
+    assert delta.get("quarantines") == 1
+    assert delta.get("probe_failures") == 1
