@@ -2390,6 +2390,89 @@ def gray_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def globe_smoke() -> dict | None:
+    """Globe-tier extras: one seeded multi-zone run fault-free and
+    one with a whole zone lost mid-trace (analytic cells —
+    milliseconds, no jax), publishing global attainment, cross-cell
+    spill counts, the post-restore p99 recovery ratio, and the
+    surviving zones' containment ratios (their per-zone boards vs
+    fault-free) alongside the globe counter board
+    (metrics.globe_board). The headline observable is containment:
+    a zone loss whose damage leaks into surviving zones' boards is
+    a front-door regression. docs/GLOBE.md explains the model."""
+    try:
+        from kind_tpu_sim import globe
+        from kind_tpu_sim import metrics as _metrics
+
+        t0 = time.monotonic()
+        board_before = _metrics.globe_board().counts()
+        cfg = globe.GlobeConfig(
+            zones=("zone-a", "zone-b", "zone-c"),
+            replicas_per_cell=2,
+            workload=globe.GlobeWorkloadSpec(
+                process="poisson", rps=30.0, n_per_zone=120))
+        traces = globe.generate_globe_traces(cfg, 7)
+        span = max(r.arrival_s for reqs in traces.values()
+                   for r in reqs)
+        restore = round(2.0 * span / 3.0, 6)
+        events = [
+            globe.GlobeChaosEvent(at_s=round(span / 3.0, 6),
+                                  action="zone_loss",
+                                  target="zone-a"),
+            globe.GlobeChaosEvent(at_s=restore,
+                                  action="zone_restore",
+                                  target="zone-a"),
+        ]
+        clean = globe.GlobeSim(cfg, traces=traces, seed=7).run()
+        faulted = globe.GlobeSim(cfg, traces=traces, seed=7,
+                                 chaos_events=events).run()
+
+        def window_p99(rep, t_from):
+            from kind_tpu_sim.fleet.slo import (
+                brute_force_percentile,
+            )
+
+            vals = [(e["first_s"] if e["first_s"] is not None
+                     else e["finish_s"]) - e["arrival_s"]
+                    for e in rep["completions"]
+                    if e["arrival_s"] >= t_from]
+            return brute_force_percentile(vals, 0.99)
+
+        p99_clean = window_p99(clean, restore)
+        p99_faulted = window_p99(faulted, restore)
+        containment = {}
+        for z in ("zone-b", "zone-c"):
+            pc = clean["zones"][z]["slo"]["ttft"].get("p99_s")
+            pf = faulted["zones"][z]["slo"]["ttft"].get("p99_s")
+            containment[z] = (round(pf / pc, 3)
+                              if pc and pf is not None else None)
+        return {
+            "ok": (clean["ok"] and faulted["ok"]
+                   and faulted["global_slo"]["shed"] == 0),
+            "requests": faulted["requests"],
+            "seconds": round(time.monotonic() - t0, 3),
+            "fault_free": {
+                "attainment": clean["global_slo"]["attainment"],
+                "served_in_origin_zone":
+                    clean["served_in_origin_zone"],
+            },
+            "zone_loss": {
+                "attainment": faulted["global_slo"]["attainment"],
+                "spilled": faulted["frontdoor"]["spilled"],
+                "readmitted": faulted["frontdoor"]["readmitted"],
+                "shed": faulted["global_slo"]["shed"],
+            },
+            "p99_post_restore_ratio": (
+                round(p99_faulted / p99_clean, 3)
+                if p99_clean and p99_faulted is not None else None),
+            "surviving_zone_p99_ratio": containment,
+            "counters": _metrics.globe_board().snapshot_since(
+                board_before),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def multihost_smoke() -> dict | None:
     """DCN-tier proof: a 2-host simulated slice (one process per host,
     gloo collectives over loopback) comes up and passes cross-host
@@ -2561,6 +2644,10 @@ def main(argv=None) -> int:
             gray_rep = gray_smoke()
         if gray_rep:
             phases["gray"] = gray_rep
+        with stopwatch("globe"):
+            globe_rep = globe_smoke()
+        if globe_rep:
+            phases["globe"] = globe_rep
     finally:
         if pool is not None:
             pool.close()

@@ -73,6 +73,12 @@ FAULT_KINDS = (
     "degraded_link",     # ICI link at param x nominal bandwidth
     "slow_replica",      # fleet: replica service times x param
     "flaky_node",        # intermittent sub-crash stalls (param: s)
+    # blast-radius tier (docs/GLOBE.md): the failures that page
+    # people — whole failure domains, not components
+    "zone_loss",         # globe: every cell in a zone goes dark
+    "dcn_degrade",       # inter-zone DCN link at param x nominal
+    "herd_failover",     # zone dies at peak: thundering-herd spill
+    "cell_drain",        # globe: cell drained for maintenance
 )
 
 
@@ -159,7 +165,7 @@ class ChaosSchedule:
                 param = round(rng.uniform(0.5, 1.5), 3)
             elif kind == "slow_replica":
                 param = round(rng.uniform(3.0, 6.0), 3)
-            elif kind == "degraded_link":
+            elif kind in ("degraded_link", "dcn_degrade"):
                 param = round(rng.uniform(0.08, 0.25), 3)
             else:
                 param = 0.0
@@ -1148,6 +1154,282 @@ def _scenario_gray_degraded_ici(seed: int) -> dict:
                    and migrated_clean
                    and tokens(on) == tokens(clean) == tokens(off)
                    and recovered and off_degraded and identical),
+    }
+
+
+@_scenario("globe-zone-loss",
+           "a whole zone goes dark under the globe's front door: "
+           "its cells' load spills cross-zone (nearest healthy "
+           "first), zero requests are lost, global p99 recovers "
+           "after the zone returns, and the surviving zones' boards "
+           "stay within noise of fault-free — the blast radius is "
+           "contained")
+def _scenario_globe_zone_loss(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import globe
+
+    plan = ChaosSchedule(seed).plan(kinds=("zone_loss",),
+                                    n_faults=1, horizon=6, targets=3)
+    ev = plan.events[0]
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=2,
+        workload=globe.GlobeWorkloadSpec(process="poisson",
+                                         rps=30.0, n_per_zone=120))
+    traces = globe.generate_globe_traces(cfg, seed)
+    span = max(r.arrival_s for reqs in traces.values()
+               for r in reqs)
+    lost_zone = cfg.zones[ev.target % len(cfg.zones)]
+    # the loss lands a third into the arrival window and the zone
+    # returns at two thirds — a full third of the trace arrives
+    # post-restore, so the recovery window has real traffic to judge
+    at = round(span / 3.0, 6)
+    restore = round(2.0 * span / 3.0, 6)
+    events = [
+        globe.GlobeChaosEvent(at_s=at, action="zone_loss",
+                              target=lost_zone),
+        globe.GlobeChaosEvent(at_s=restore, action="zone_restore",
+                              target=lost_zone),
+    ]
+    clean = globe.GlobeSim(cfg, traces=traces, seed=seed).run()
+    faulted = globe.GlobeSim(cfg, traces=traces, seed=seed,
+                             chaos_events=events).run()
+    replay = globe.GlobeSim(cfg, traces=traces, seed=seed,
+                            chaos_events=events).run()
+    # recovery: post-restore global p99 back to fault-free levels
+    p99_clean = _window_p99_ttft(clean["completions"], restore,
+                                 span + 1.0)
+    p99_faulted = _window_p99_ttft(faulted["completions"], restore,
+                                   span + 1.0)
+    recovered = (p99_clean is not None and p99_faulted is not None
+                 and p99_faulted <= 1.25 * p99_clean)
+    # containment: the surviving zones' per-zone boards (whole run,
+    # fault window included) must sit within noise of fault-free —
+    # a zone loss that degrades its neighbors was not contained.
+    # One histogram bucket is 1.12x, so 1.25 is ~2 buckets: the
+    # same fault-free tolerance every recovery invariant uses
+    survivors = [z for z in cfg.zones if z != lost_zone]
+    containment = {}
+    for z in survivors:
+        pc = clean["zones"][z]["slo"]["ttft"].get("p99_s")
+        pf = faulted["zones"][z]["slo"]["ttft"].get("p99_s")
+        containment[z] = (round(pf / pc, 3)
+                          if pc and pf is not None else None)
+    contained = all(r is not None and r <= 1.25
+                    for r in containment.values())
+    tokens = lambda rep: sum(e["tokens"] for e in rep["completions"])  # noqa: E731
+    identical = (_json.dumps(faulted["completions"],
+                             sort_keys=True)
+                 == _json.dumps(replay["completions"],
+                                sort_keys=True))
+    return {
+        "plan": plan.as_dict(),
+        "requests": faulted["requests"],
+        "lost_zone": lost_zone,
+        "loss_at_s": at,
+        "restore_at_s": restore,
+        "spilled": faulted["frontdoor"]["spilled"],
+        "readmitted": faulted["frontdoor"]["readmitted"],
+        "shed": faulted["global_slo"]["shed"],
+        "p99_post_restore_ratio": (
+            round(p99_faulted / p99_clean, 3)
+            if p99_clean and p99_faulted is not None else None),
+        "surviving_zone_p99_ratio": containment,
+        "replay_identical": bool(identical),
+        "ok": bool(clean["ok"] and faulted["ok"]
+                   and faulted["global_slo"]["shed"] == 0
+                   and tokens(faulted) == tokens(clean)
+                   and faulted["frontdoor"]["spilled"] >= 1
+                   and recovered and contained and identical),
+    }
+
+
+@_scenario("globe-herd-failover",
+           "a zone dies at peak burst: its whole load hits the "
+           "front door at once, and the spill bound spreads it "
+           "without cascade — no surviving cell is flooded past its "
+           "configured headroom, nothing sheds, and attainment "
+           "recovers once the zone returns")
+def _scenario_globe_herd_failover(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import globe
+
+    plan = ChaosSchedule(seed).plan(kinds=("herd_failover",),
+                                    n_faults=1, horizon=6, targets=3)
+    ev = plan.events[0]
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=2,
+        workload=globe.GlobeWorkloadSpec(process="bursty",
+                                         rps=40.0, n_per_zone=150))
+    traces = globe.generate_globe_traces(cfg, seed)
+    span = max(r.arrival_s for reqs in traces.values()
+               for r in reqs)
+    herd_zone = cfg.zones[ev.target % len(cfg.zones)]
+    clean = globe.GlobeSim(cfg, traces=traces, seed=seed).run()
+    # kill the zone just after a mid-trace dispatch INTO it: the
+    # runs are identical up to that instant, so the zone provably
+    # holds in-flight work — the herd (displacement + re-admission)
+    # is guaranteed, not seed-lucky (burst valleys are empty)
+    tick = 0.01
+    herd_disp = sorted(
+        e["dispatch_s"] for e in clean["completions"]
+        if e["serving_zone"] == herd_zone
+        and span / 4.0 <= e["dispatch_s"] <= 2.0 * span / 3.0)
+    at = round((herd_disp[len(herd_disp) // 2] + tick / 2
+                if herd_disp else span / 3.0), 6)
+    restore = round(max(2.0 * span / 3.0, at + 0.15 * span), 6)
+    events = [
+        globe.GlobeChaosEvent(at_s=at, action="herd_failover",
+                              target=herd_zone),
+        globe.GlobeChaosEvent(at_s=restore, action="zone_restore",
+                              target=herd_zone),
+    ]
+    faulted = globe.GlobeSim(cfg, traces=traces, seed=seed,
+                             chaos_events=events).run()
+    replay = globe.GlobeSim(cfg, traces=traces, seed=seed,
+                            chaos_events=events).run()
+    fd = faulted["frontdoor"]
+    # the cascade-prevention invariant: admission never floods any
+    # surviving cell past its hard limit (nominal x (1 + headroom)),
+    # and neither tier sheds — overflow waits at the front door
+    bounded = all(
+        fd["peak_outstanding"][name] <= fd["hard_limits"][name]
+        for name in fd["hard_limits"])
+    cell_sheds = sum(c["router"]["shed"]
+                     for c in faulted["cells"].values())
+    tail_clean = globe.attainment_over(clean["completions"],
+                                       restore)
+    tail_faulted = globe.attainment_over(faulted["completions"],
+                                         restore)
+    recovered = (tail_clean is None or tail_faulted is None
+                 or tail_faulted >= tail_clean)
+    tokens = lambda rep: sum(e["tokens"] for e in rep["completions"])  # noqa: E731
+    identical = (_json.dumps(faulted["completions"],
+                             sort_keys=True)
+                 == _json.dumps(replay["completions"],
+                                sort_keys=True))
+    return {
+        "plan": plan.as_dict(),
+        "requests": faulted["requests"],
+        "herd_zone": herd_zone,
+        "failover_at_s": at,
+        "readmitted": fd["readmitted"],
+        "spilled": fd["spilled"],
+        "peak_outstanding": fd["peak_outstanding"],
+        "hard_limits": fd["hard_limits"],
+        "spill_bound_held": bool(bounded),
+        "cell_sheds": cell_sheds,
+        "frontdoor_sheds": fd["shed"],
+        "tail_attainment_clean": tail_clean,
+        "tail_attainment_faulted": tail_faulted,
+        "replay_identical": bool(identical),
+        "ok": bool(clean["ok"] and faulted["ok"]
+                   and bounded and cell_sheds == 0
+                   and fd["shed"] == 0
+                   and fd["readmitted"] >= 1
+                   and tokens(faulted) == tokens(clean)
+                   and recovered and identical),
+    }
+
+
+@_scenario("globe-dcn-degrade",
+           "an inter-zone DCN link browns out under cross-zone "
+           "spill (one cell drained for maintenance forces the "
+           "spill): the latency-aware front door routes around the "
+           "degraded path, the untouched zone's board stays within "
+           "noise, and the spill path heals when the link does")
+def _scenario_globe_dcn_degrade(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import globe
+
+    plan = ChaosSchedule(seed).plan(kinds=("dcn_degrade",),
+                                    n_faults=1, horizon=8, targets=1)
+    factor = min(0.25, max(0.08, plan.events[0].param))
+    # 3 replicas/cell: zone-a must absorb zone-b's whole spill
+    # WITHOUT ever saturating — a saturated near cell would let the
+    # front door legitimately prefer the far (degraded) path, and
+    # this scenario is about latency steering, not overload
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=3,
+        workload=globe.GlobeWorkloadSpec(process="poisson",
+                                         rps=30.0, n_per_zone=120))
+    traces = globe.generate_globe_traces(cfg, seed)
+    span = max(r.arrival_s for reqs in traces.values()
+               for r in reqs)
+    t1 = round(span * 0.25, 6)
+    t2 = round(span * 0.65, 6)
+    # zone-b's cell is under maintenance the whole run (baseline
+    # includes the drain, so the faulted-vs-baseline delta is PURELY
+    # the browned-out link); its traffic must spill cross-zone,
+    # where zone-a and zone-c are equidistant candidates
+    drain = [globe.GlobeChaosEvent(at_s=0.0, action="cell_drain",
+                                   target="zone-b/c0")]
+    dcn = drain + [
+        globe.GlobeChaosEvent(at_s=t1, action="dcn_degrade",
+                              target="zone-c", param=factor),
+        globe.GlobeChaosEvent(at_s=t2, action="dcn_restore",
+                              target="zone-c"),
+    ]
+    base = globe.GlobeSim(cfg, traces=traces, seed=seed,
+                          chaos_events=list(drain)).run()
+    faulted = globe.GlobeSim(cfg, traces=traces, seed=seed,
+                             chaos_events=list(dcn)).run()
+    replay = globe.GlobeSim(cfg, traces=traces, seed=seed,
+                            chaos_events=list(dcn)).run()
+    # steering: while zone-c's DCN is browned out, NO zone-b
+    # request may be served through it — the front door's
+    # latency-aware scoring must prefer the healthy path to zone-a.
+    # The window edges back off a tick: an arrival 1ms before the
+    # restore is ADMITTED at the next tick, after the link healed
+    window = [e for e in faulted["completions"]
+              if e["origin"] == "zone-b"
+              and t1 + 0.1 <= e["arrival_s"] < t2 - 0.05]
+    routed_around = (all(e["serving_zone"] != "zone-c"
+                         for e in window)
+                     and any(e["serving_zone"] == "zone-a"
+                             for e in window))
+    # containment: zone-c's own (purely local) traffic must not
+    # notice its DCN links browning out (1.25 = ~2 histogram
+    # buckets, the repo-wide fault-free tolerance)
+    pc = base["zones"]["zone-c"]["slo"]["ttft"].get("p99_s")
+    pf = faulted["zones"]["zone-c"]["slo"]["ttft"].get("p99_s")
+    contained = bool(pc and pf is not None and pf <= 1.25 * pc)
+    # recovery: once the link heals, the spill path costs what it
+    # did under maintenance alone
+    p99_base = _window_p99_ttft(base["completions"], t2, span + 1.0)
+    p99_faulted = _window_p99_ttft(faulted["completions"], t2,
+                                   span + 1.0)
+    recovered = (p99_base is not None and p99_faulted is not None
+                 and p99_faulted <= 1.25 * p99_base)
+    tokens = lambda rep: sum(e["tokens"] for e in rep["completions"])  # noqa: E731
+    identical = (_json.dumps(faulted["completions"],
+                             sort_keys=True)
+                 == _json.dumps(replay["completions"],
+                                sort_keys=True))
+    return {
+        "plan": plan.as_dict(),
+        "requests": faulted["requests"],
+        "link_factor": round(factor, 3),
+        "degrade_window_s": [t1, t2],
+        "spill_window_requests": len(window),
+        "routed_around_degraded_link": bool(routed_around),
+        "zone_c_p99_ratio": (round(pf / pc, 3)
+                             if pc and pf is not None else None),
+        "p99_post_restore_ratio": (
+            round(p99_faulted / p99_base, 3)
+            if p99_base and p99_faulted is not None else None),
+        "dcn_degrades": faulted["globe_counters"].get(
+            "dcn_degrades", 0),
+        "replay_identical": bool(identical),
+        "ok": bool(base["ok"] and faulted["ok"]
+                   and len(window) >= 5
+                   and routed_around and contained and recovered
+                   and faulted["globe_counters"].get(
+                       "dcn_degrades", 0) == 1
+                   and tokens(faulted) == tokens(base)
+                   and identical),
     }
 
 

@@ -49,6 +49,7 @@ from kind_tpu_sim.fleet.sim import (  # noqa: F401
     FleetSchedConfig,
     FleetSim,
     attainment_over,
+    resolve_fast_forward,
     resolve_tick_s,
 )
 from kind_tpu_sim.fleet.slo import (  # noqa: F401

@@ -115,18 +115,28 @@ class WorkloadSpec:
     burst_factor: float = 4.0       # bursty: peak rate multiplier
     burst_period_s: float = 2.0     # bursty: one on+off cycle
     diurnal_period_s: float = 20.0  # diurnal: one day (compressed)
+    # phase offset of the modulated processes (virtual s): a zone at
+    # phase_s = period/3 peaks a third of a day later — the
+    # peak-follows-the-sun lever the globe layer staggers its
+    # per-zone diurnal demand with (docs/GLOBE.md)
+    phase_s: float = 0.0
 
     PROCESSES = ("poisson", "bursty", "diurnal")
 
 
 def _spec_rng(spec: WorkloadSpec, seed: int) -> random.Random:
-    key = repr((seed, spec.process, spec.rps, spec.n_requests,
-                tuple(spec.prompt_len), tuple(spec.max_new),
-                spec.vocab, spec.shared_prefix_frac,
-                spec.prefix_groups, spec.prefix_len, spec.deadline_s,
-                spec.burst_factor, spec.burst_period_s,
-                spec.diurnal_period_s))
-    return random.Random(zlib.crc32(key.encode("utf-8")))
+    sig = (seed, spec.process, spec.rps, spec.n_requests,
+           tuple(spec.prompt_len), tuple(spec.max_new),
+           spec.vocab, spec.shared_prefix_frac,
+           spec.prefix_groups, spec.prefix_len, spec.deadline_s,
+           spec.burst_factor, spec.burst_period_s,
+           spec.diurnal_period_s)
+    # phase_s joins the identity key only when set: every phase-0
+    # spec keeps its pre-globe stream (seed compatibility is the
+    # byte-identity contract every scenario report rests on)
+    if spec.phase_s:
+        sig = sig + (spec.phase_s,)
+    return random.Random(zlib.crc32(repr(sig).encode("utf-8")))
 
 
 def _rate_at(spec: WorkloadSpec, t: float) -> float:
@@ -137,14 +147,17 @@ def _rate_at(spec: WorkloadSpec, t: float) -> float:
     if spec.process == "bursty":
         # on/off with duty cycle 1/burst_factor: bursts run at
         # burst_factor * rps, valleys are silent, mean is EXACTLY rps
-        phase = (t % spec.burst_period_s) / spec.burst_period_s
+        phase = (((t + spec.phase_s) % spec.burst_period_s)
+                 / spec.burst_period_s)
         duty = 1.0 / max(1.0, spec.burst_factor)
         return (spec.rps * max(1.0, spec.burst_factor)
                 if phase < duty else 0.0)
     if spec.process == "diurnal":
         # raised cosine: peaks at mid-period, valleys at the edges,
-        # mean exactly rps
-        phase = (t % spec.diurnal_period_s) / spec.diurnal_period_s
+        # mean exactly rps; phase_s slides the peak (two zones a
+        # half-period apart peak in antiphase)
+        phase = (((t + spec.phase_s) % spec.diurnal_period_s)
+                 / spec.diurnal_period_s)
         return spec.rps * (1.0 - math.cos(2 * math.pi * phase))
     raise ValueError(
         f"unknown arrival process {spec.process!r}; known: "

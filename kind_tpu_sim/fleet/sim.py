@@ -42,6 +42,7 @@ from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
 
 TICK_ENV = "KIND_TPU_SIM_FLEET_TICK_S"
 DEFAULT_TICK_S = 0.01
+FF_ENV = "KIND_TPU_SIM_FLEET_FF"
 
 
 def resolve_tick_s(value: Optional[float] = None) -> float:
@@ -52,6 +53,21 @@ def resolve_tick_s(value: Optional[float] = None) -> float:
         return float(os.environ.get(TICK_ENV, DEFAULT_TICK_S))
     except ValueError:
         return DEFAULT_TICK_S
+
+
+def resolve_fast_forward(value: Optional[bool] = None) -> bool:
+    """Explicit value > env (KIND_TPU_SIM_FLEET_FF) > on.
+
+    Fast-forward skips the per-tick work across PROVABLY idle gaps
+    (nothing in flight, nothing due before the next arrival/chaos
+    event) while advancing the virtual clock through the identical
+    sequence of tick-sized float additions — so reports stay
+    byte-identical with it on or off, and multi-hour diurnal (or
+    N-cell globe) sims stop paying wall time per empty tick. Set
+    ``KIND_TPU_SIM_FLEET_FF=0`` to force the plain loop."""
+    if value is not None:
+        return bool(value)
+    return os.environ.get(FF_ENV, "1") not in ("0", "false", "no")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,6 +119,10 @@ class FleetSchedConfig:
     replica_accelerator: str = "tpu-v5-lite-podslice"
     replica_topology: str = "2x4"
     priority: int = 10
+    # the inventory's topology.kubernetes.io/zone label — one fleet
+    # is one cell in one zone; the globe layer stamps each cell's
+    # inventory with its owning zone (docs/GLOBE.md)
+    zone: str = "zone-a"
     # share of a replica's service time spent in ICI collectives —
     # the Amdahl knob the degraded-link slowdown model
     # (parallel/collectives.ici_slowdown) applies to replicas placed
@@ -117,6 +137,7 @@ class FleetSchedConfig:
             "replica_topology": self.replica_topology,
             "priority": self.priority,
             "ici_fraction": self.ici_fraction,
+            "zone": self.zone,
         }
 
 
@@ -138,6 +159,11 @@ class FleetConfig:
     # leave the routing set, get probed, and (scheduler-backed) have
     # their gang migrated off the suspect hardware
     health: Optional[DetectorConfig] = None
+    # idle-gap fast-forward (None -> resolve_fast_forward()). An
+    # execution strategy, not workload config: reports are
+    # byte-identical either way, so it deliberately stays OUT of
+    # as_dict() — an ff-on and an ff-off run must diff clean.
+    fast_forward: Optional[bool] = None
 
     def as_dict(self) -> dict:
         out = {
@@ -190,6 +216,11 @@ class FleetSim:
         self.autoscaler = (Autoscaler(cfg.autoscaler)
                            if cfg.autoscale else None)
         self.log: List[dict] = []
+        # cell-embedding hook (docs/GLOBE.md): the globe driver sets
+        # this to stream every completion entry out of the cell as it
+        # lands (per-zone boards, front-door SLO windows). Called
+        # with (entry_dict, ReplicaCompletion).
+        self.on_complete: Optional[Callable] = None
         # recent attained-flags window: the autoscaler's SLO signal
         self._recent = deque(maxlen=64)
         self._next_replica_id = cfg.replicas
@@ -198,6 +229,12 @@ class FleetSim:
         self.preemptions = 0
         self.sched = None
         self._now = 0.0
+        self._ticks = 0
+        self._pending = deque(self.trace)
+        self._fast_forward = resolve_fast_forward(cfg.fast_forward)
+        # empty ticks skipped by fast-forward — observability only,
+        # deliberately NOT in the report (ff on/off must diff clean)
+        self.ff_skipped = 0
         # gray-failure bookkeeping: replicas currently slowed by an
         # explicit chaos `slow` (rid -> factor) or by a degraded ICI
         # domain — the ground truth false-positive accounting is
@@ -221,7 +258,7 @@ class FleetSim:
         from kind_tpu_sim import sched as sched_mod
 
         self.sched = sched_mod.ClusterScheduler(
-            sched_mod.build_inventory(list(sc.pods)),
+            sched_mod.build_inventory(list(sc.pods), zone=sc.zone),
             sched_mod.SchedConfig(policy=sc.policy,
                                   bind_s=sc.bind_s),
             on_evict=self._on_gang_evict)
@@ -497,6 +534,8 @@ class FleetSim:
                 and comp.finish_reason not in
                 ("shed", "deadline_exceeded")):
             self._observe_health(replica_id, comp, self._now)
+        if self.on_complete is not None:
+            self.on_complete(self.log[-1], comp)
 
     def _backlog(self) -> int:
         return (len(self.router.queue)
@@ -608,88 +647,153 @@ class FleetSim:
 
     # -- the loop ------------------------------------------------------
 
+    def step(self, now: float, tick: float,
+             pending: Optional[deque] = None) -> None:
+        """One fleet tick at virtual time ``now`` — the body of
+        :meth:`run`'s loop, exposed so an outer driver (the globe
+        layer's cells, docs/GLOBE.md) can advance N fleets in
+        lockstep on one shared clock. ``pending`` is the
+        arrival-ordered deque still to be offered (default: this
+        sim's own trace); an external driver feeds its own deque and
+        owns the clock."""
+        if pending is None:
+            pending = self._pending
+        self._now = now
+        self._apply_chaos(now)
+        if self.sched is not None:
+            self._drain_migrations(now)
+            self._sched_step(now)
+            healed = [w for w in self._rebinding
+                      if w[0] <= now]
+            self._rebinding = [w for w in self._rebinding
+                               if w[0] > now]
+            for _, replica in healed:
+                replica.restore(now)
+                metrics.recovery_log().record(
+                    "fleet_gang_rebound",
+                    replica=replica.replica_id,
+                    at_s=round(now, 6))
+            if healed:
+                self._refresh_link_slowdowns(now)
+            for _, replica in healed:
+                comp = f"replica-{replica.replica_id}"
+                if (self.health is not None
+                        and self.health.quarantined(comp)):
+                    # the gang rebound onto healthy hardware —
+                    # the replacement is a new individual
+                    self.health.restore(comp, now,
+                                        reason="rebound")
+        while pending and pending[0].arrival_s <= now:
+            shed = self.router.offer(pending.popleft(), now)
+            if shed is not None:
+                self._record(shed, -1)
+        if self.health is not None and (pending
+                                        or self.router.queue):
+            # probe only while user traffic still flows — an
+            # endless probe loop must never keep a drained sim
+            # alive
+            self._probe_quarantined(now)
+        for comp in self.router.dispatch(now):
+            self._record(comp, -1)
+        for replica in list(self.replicas):
+            for comp in replica.tick(now, tick):
+                if comp.request.request_id.startswith(
+                        "__probe-"):
+                    # synthetic health probe: feeds the detector
+                    # (its quarantined-component probe path),
+                    # never the SLO log
+                    self._observe_health(
+                        replica.replica_id, comp, now)
+                    continue
+                self._record(comp, replica.replica_id)
+        for replica in list(self._draining):
+            for comp in replica.tick(now, tick):
+                self._record(comp, replica.replica_id)
+            if replica.idle():
+                self._draining.remove(replica)
+                if self.sched is not None:
+                    self.sched.release(
+                        f"replica-{replica.replica_id}", now,
+                        reason="scale-down drained")
+        if (self.autoscaler is not None
+                and self._ticks % self.cfg.eval_every_ticks == 0):
+            self._autoscale(now)
+        self._ticks += 1
+
+    def quiescent(self, pending: Optional[deque] = None) -> bool:
+        """Nothing pending, in flight, warming, draining, or left in
+        the chaos plan — the loop's (and the globe driver's)
+        termination test."""
+        if pending is None:
+            pending = self._pending
+        return bool(
+            not pending and not self.router.queue
+            and not self._warming
+            and all(r.idle() for r in self.replicas
+                    if r.healthy)
+            and not self._draining
+            and not self.chaos_events
+            and not (self.sched is not None
+                     and (self.sched.pending
+                          or self._rebinding)))
+
+    def _idle_gap(self, pending: deque) -> bool:
+        """True when NOTHING can happen before the next arrival or
+        chaos event: no queued/in-flight/warming/draining work, no
+        scheduler activity, and no per-tick decision makers
+        (autoscaler evaluations and health probes are tick-cadenced
+        events, so their presence disqualifies the gap)."""
+        if self.autoscaler is not None or self.health is not None:
+            return False
+        if (self.router.queue or self._warming or self._draining):
+            return False
+        # slowdown != 1 disqualifies even an idle replica: an
+        # EngineReplica's stride counter advances per tick() call,
+        # so skipping ticks would shift its stepping phase
+        if not all(r.idle()
+                   and getattr(r, "slowdown", 1.0) == 1.0
+                   for r in self.replicas):
+            return False
+        if self.sched is not None and (
+                self.sched.pending or self._rebinding
+                or self._gang_requested or self._migrate_pending):
+            return False
+        return True
+
+    def _advance(self, tick: float, pending: deque) -> None:
+        """Advance the clock one tick — or, on a provably idle gap
+        with fast-forward enabled, through every empty tick up to
+        the next arrival/chaos event in one tight loop. The clock
+        still takes the IDENTICAL sequence of tick-sized float
+        additions (a single jump of n*tick would land on a
+        different float), so replays diff clean with fast-forward
+        on or off; only the per-tick bookkeeping is skipped."""
+        self.clock.advance(tick)
+        if not self._fast_forward or not self._idle_gap(pending):
+            return
+        next_s = pending[0].arrival_s if pending else float("inf")
+        if self.chaos_events:
+            next_s = min(next_s, self.chaos_events[0].at_s)
+        limit = self.cfg.max_virtual_s
+        adv = self.clock.advance
+        now = self.clock.now
+        while now() < next_s and now() <= limit:
+            adv(tick)
+            self.ff_skipped += 1
+
     def run(self) -> Dict[str, object]:
         board_before = metrics.fleet_board().counts()
         health_before = metrics.health_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
-        pending = deque(self.trace)
-        ticks = 0
+        pending = self._pending
         while True:
             now = self.clock.now()
-            self._now = now
             if now > self.cfg.max_virtual_s:
                 break
-            self._apply_chaos(now)
-            if self.sched is not None:
-                self._drain_migrations(now)
-                self._sched_step(now)
-                healed = [w for w in self._rebinding
-                          if w[0] <= now]
-                self._rebinding = [w for w in self._rebinding
-                                   if w[0] > now]
-                for _, replica in healed:
-                    replica.restore(now)
-                    metrics.recovery_log().record(
-                        "fleet_gang_rebound",
-                        replica=replica.replica_id,
-                        at_s=round(now, 6))
-                if healed:
-                    self._refresh_link_slowdowns(now)
-                for _, replica in healed:
-                    comp = f"replica-{replica.replica_id}"
-                    if (self.health is not None
-                            and self.health.quarantined(comp)):
-                        # the gang rebound onto healthy hardware —
-                        # the replacement is a new individual
-                        self.health.restore(comp, now,
-                                            reason="rebound")
-            while pending and pending[0].arrival_s <= now:
-                shed = self.router.offer(pending.popleft(), now)
-                if shed is not None:
-                    self._record(shed, -1)
-            if self.health is not None and (pending
-                                            or self.router.queue):
-                # probe only while user traffic still flows — an
-                # endless probe loop must never keep a drained sim
-                # alive
-                self._probe_quarantined(now)
-            for comp in self.router.dispatch(now):
-                self._record(comp, -1)
-            for replica in list(self.replicas):
-                for comp in replica.tick(now, tick):
-                    if comp.request.request_id.startswith(
-                            "__probe-"):
-                        # synthetic health probe: feeds the detector
-                        # (its quarantined-component probe path),
-                        # never the SLO log
-                        self._observe_health(
-                            replica.replica_id, comp, now)
-                        continue
-                    self._record(comp, replica.replica_id)
-            for replica in list(self._draining):
-                for comp in replica.tick(now, tick):
-                    self._record(comp, replica.replica_id)
-                if replica.idle():
-                    self._draining.remove(replica)
-                    if self.sched is not None:
-                        self.sched.release(
-                            f"replica-{replica.replica_id}", now,
-                            reason="scale-down drained")
-            if (self.autoscaler is not None
-                    and ticks % self.cfg.eval_every_ticks == 0):
-                self._autoscale(now)
-            ticks += 1
-            if (not pending and not self.router.queue
-                    and not self._warming
-                    and all(r.idle() for r in self.replicas
-                            if r.healthy)
-                    and not self._draining
-                    and not self.chaos_events
-                    and not (self.sched is not None
-                             and (self.sched.pending
-                                  or self._rebinding))):
+            self.step(now, tick, pending)
+            if self.quiescent(pending):
                 break
-            self.clock.advance(tick)
+            self._advance(tick, pending)
         self.log.sort(key=lambda e: (e["finish_s"],
                                      e["request_id"]))
         report: Dict[str, object] = {

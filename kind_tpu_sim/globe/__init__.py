@@ -1,0 +1,41 @@
+"""Multi-cell / multi-zone fleet-of-fleets simulator (docs/GLOBE.md).
+
+The layer above the fleet: zones group cells (each cell = one sched
+inventory + one FleetSim) into correlated failure domains behind a
+global anycast-style front door (latency/capacity-aware admission,
+bounded cross-cell spill, sticky prefix-affinity), with a global
+capacity planner trading a spot-replica budget across zones above
+the per-cell autoscalers, and blast-radius chaos — zone loss, DCN
+brown-out, thundering-herd failover, cell drain. Same seed, same
+config => byte-identical reports.
+
+Knobs: KIND_TPU_SIM_GLOBE_SEED (sim.resolve_seed), plus every fleet/
+sched/health knob the embedded cells inherit.
+"""
+
+from kind_tpu_sim.globe.cell import (  # noqa: F401
+    Cell,
+    CellConfig,
+)
+from kind_tpu_sim.globe.frontdoor import (  # noqa: F401
+    FrontDoor,
+    FrontDoorConfig,
+)
+from kind_tpu_sim.globe.planner import (  # noqa: F401
+    GlobalPlanner,
+    PlannerConfig,
+)
+from kind_tpu_sim.globe.sim import (  # noqa: F401
+    GLOBE_CHAOS_ACTIONS,
+    GLOBE_SEED_ENV,
+    GlobeChaosEvent,
+    GlobeConfig,
+    GlobeSim,
+    GlobeWorkloadSpec,
+    attainment_over,
+    generate_globe_traces,
+    load_globe_trace,
+    resolve_seed,
+    save_globe_trace,
+    zone_seed,
+)

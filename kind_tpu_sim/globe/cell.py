@@ -1,0 +1,169 @@
+"""One cell of the globe: a sched inventory + fleet sim, embedded.
+
+A **cell** is the unit of deployment the production papers describe
+(one cluster of TPU slices behind one regional load balancer): here
+it is exactly one :class:`~kind_tpu_sim.fleet.FleetSim` — router,
+replicas, optional autoscaler, optionally scheduler-backed placement
+on its own zone-labeled inventory (``FleetConfig.sched``) — advanced
+tick-by-tick by the globe driver on ONE shared virtual clock instead
+of running its own loop. The front door (frontdoor.py) is the only
+traffic source: requests arrive with a modeled DCN delivery delay
+and join ``pending``; everything after that is the unmodified fleet
+data plane, which is the point — the globe composes the existing
+layers, it does not reimplement them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from kind_tpu_sim.fleet.loadgen import TraceRequest, VirtualClock
+from kind_tpu_sim.fleet.sim import FleetConfig, FleetSim
+
+
+@dataclasses.dataclass(frozen=True)
+class CellConfig:
+    """One cell's identity + its fleet. ``name`` sorts the globe's
+    deterministic iteration order; ``zone`` is the correlated
+    failure domain the cell dies with under ``zone_loss``."""
+
+    name: str
+    zone: str
+    fleet: FleetConfig
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "zone": self.zone,
+            "fleet": self.fleet.as_dict(),
+        }
+
+
+class Cell:
+    """A fleet sim plus the globe-facing plumbing: a delivery heap
+    (requests in DCN flight), the admitted-but-unticked ``pending``
+    deque the fleet pops each step, and alive/draining flags the
+    front door consults."""
+
+    def __init__(self, cfg: CellConfig, clock: VirtualClock,
+                 on_complete: Optional[Callable] = None):
+        self.cfg = cfg
+        self.name = cfg.name
+        self.zone = cfg.zone
+        self.sim = FleetSim(cfg.fleet, trace=[], clock=clock)
+        if on_complete is not None:
+            self.sim.on_complete = on_complete
+        self.pending: deque = deque()
+        # (deliver_s, seq, request): seq is admission order — the
+        # deterministic tiebreak for same-tick deliveries
+        self.delivery: List[tuple] = []
+        self._seq = 0
+        self.alive = True
+        self.draining = False
+        self.peak_outstanding = 0
+
+    # -- capacity / load (the front door's scoring inputs) -----------
+
+    def routable_replicas(self) -> int:
+        return sum(1 for r in self.sim.router.replicas if r.healthy)
+
+    def capacity(self) -> int:
+        """Concurrent service slots across routable replicas — the
+        unit the front door's admission bounds are denominated in."""
+        slots = getattr(self.sim.cfg.sim, "max_slots", 1)
+        return self.routable_replicas() * slots
+
+    def outstanding(self) -> int:
+        """Everything the cell owes: queued at the router, in flight
+        on replicas, admitted but unticked, and still in DCN
+        flight."""
+        return (len(self.sim.router.queue)
+                + sum(r.outstanding()
+                      for r in self.sim.replicas if r.healthy)
+                + len(self.pending) + len(self.delivery))
+
+    def routable(self) -> bool:
+        return (self.alive and not self.draining
+                and self.routable_replicas() > 0)
+
+    # -- the globe driver's surface ----------------------------------
+
+    def admit(self, req: TraceRequest, deliver_s: float) -> None:
+        heapq.heappush(self.delivery,
+                       (deliver_s, self._seq, req))
+        self._seq += 1
+        self.peak_outstanding = max(self.peak_outstanding,
+                                    self.outstanding())
+
+    def deliver_due(self, now: float) -> None:
+        while self.delivery and self.delivery[0][0] <= now:
+            self.pending.append(heapq.heappop(self.delivery)[2])
+
+    def step(self, now: float, tick: float) -> None:
+        if self.alive:
+            self.sim.step(now, tick, self.pending)
+
+    def quiescent(self) -> bool:
+        return (not self.pending and not self.delivery
+                and self.sim.quiescent(self.pending))
+
+    def idle_gap(self) -> bool:
+        """Nothing due on this cell before external input arrives —
+        the per-cell leg of the globe's fast-forward test."""
+        if self.pending or self.delivery:
+            return False
+        if not self.alive:
+            # a dead cell is inert by construction (its load was
+            # displaced at failure; it is not stepped)
+            return True
+        return self.sim._idle_gap(self.pending)
+
+    # -- blast-radius chaos ------------------------------------------
+
+    def fail(self, now: float) -> List[TraceRequest]:
+        """Zone loss / herd failover hits this cell: every queued,
+        in-flight, admitted, and in-DCN-flight request is displaced
+        back to the front door; replicas go unhealthy until
+        :meth:`restore`."""
+        displaced: List[TraceRequest] = []
+        for replica in self.sim.replicas:
+            if replica.healthy:
+                displaced.extend(replica.fail(now))
+        displaced.extend(self.sim.router.queue)
+        self.sim.router.queue = []
+        displaced.extend(self.pending)
+        self.pending.clear()
+        displaced.extend(req for _, _, req in self.delivery)
+        self.delivery = []
+        self.alive = False
+        return displaced
+
+    def restore(self, now: float) -> None:
+        for replica in self.sim.replicas:
+            if not replica.healthy:
+                replica.restore(now)
+        self.alive = True
+
+    def report(self) -> Dict[str, object]:
+        """Per-cell board for the globe report: the cell's own SLO
+        view, router counters, and replica health — everything but
+        the per-request log (the globe's global log carries that)."""
+        out: Dict[str, object] = {
+            "zone": self.zone,
+            "alive": self.alive,
+            "draining": self.draining,
+            "replicas": len(self.sim.replicas),
+            "routable_replicas": self.routable_replicas(),
+            "peak_outstanding": self.peak_outstanding,
+            "slo": self.sim.tracker.report(),
+            "router": self.sim.router.report(),
+        }
+        if self.sim.autoscaler is not None:
+            out["autoscaler"] = self.sim.autoscaler.report()
+        if self.sim.sched is not None:
+            out["sched_event_counts"] = \
+                self.sim.sched.report()["event_counts"]
+        return out

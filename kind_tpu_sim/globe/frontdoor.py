@@ -1,0 +1,218 @@
+"""The global front door: anycast-style admission over N cells.
+
+The planet-facing half of the globe layer (docs/GLOBE.md). Every
+request originates in a zone and hits the front door at its arrival
+tick; the front door picks a cell the way a global load balancer
+does — **nearest healthy cell first** (DCN round-trip to the cell's
+zone is the leading cost), **capacity-aware** (a cell's queue depth,
+normalized by its routable slots, is the second), with **sticky
+prefix-affinity** (a shared-prefix cohort keeps one home cell so the
+cell-level prefix caches stay warm) and **spill** when the preferred
+cell is saturated or breaching its SLO window.
+
+Two bounds make the spill safe instead of a cascade amplifier:
+
+* the **nominal depth** (``queue_depth`` × slots) past which a cell
+  stops being anyone's first choice, and
+* the **hard limit** (nominal × (1 + ``spill_headroom``)) past which
+  the front door refuses to admit AT ALL — a surviving cell can
+  never be flooded beyond its configured headroom by a thundering
+  herd; overflow waits in the front door's own FCFS queue (admission
+  control at the planet tier, the same move the router makes at the
+  cell tier) and sheds loudly only past ``max_queue``.
+
+Determinism: candidate order is (saturation, cost, cell name); the
+affinity map hashes the group id over the STATIC cell list; no
+entropy anywhere — same seed, same admissions, byte-identical
+reports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import zlib
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+from kind_tpu_sim import metrics
+from kind_tpu_sim.fleet.loadgen import TraceRequest
+from kind_tpu_sim.globe.cell import Cell
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontDoorConfig:
+    # requests per routable slot a cell absorbs before it stops
+    # being a first choice (the saturation-spill trigger)
+    queue_depth: float = 4.0
+    # extra fraction over nominal depth a cell will accept from
+    # spill before the front door refuses outright — the herd bound
+    spill_headroom: float = 0.5
+    # how many requests MORE loaded (absolute) a cohort's home cell
+    # may be than the best candidate before affinity yields
+    affinity_spill: int = 8
+    # spill away from a cell whose recent SLO window drops below
+    # this attainment (None = saturation-only spill)
+    slo_spill_below: Optional[float] = 0.7
+    slo_window: int = 32
+    # cost weight of one unit of normalized load vs one second of
+    # DCN round-trip (0.01 ~ "10 ms of latency buys one queue slot
+    # per slot of capacity")
+    load_weight_s: float = 0.01
+    # front-door FCFS overflow queue bound; beyond it, shed loudly
+    max_queue: int = 4096
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FrontDoor:
+    """Admission + cross-cell spill. ``rtt_s(origin_zone, zone)`` is
+    the globe's DCN latency model (degraded links inflate it, which
+    is how a browned-out path steers traffic away)."""
+
+    def __init__(self, cfg: FrontDoorConfig, cells: Sequence[Cell],
+                 rtt_s: Callable[[str, str], float]):
+        self.cfg = cfg
+        self.cells = list(cells)          # static: affinity keyspace
+        self.rtt_s = rtt_s
+        self.queue: deque = deque()       # (request, origin_zone)
+        self.routed = 0
+        self.spilled = 0
+        self.affinity_hits = 0
+        self.shed: List[tuple] = []       # (request, origin, at_s)
+        self.readmitted = 0
+        self._slo_window: Dict[str, deque] = {
+            c.name: deque(maxlen=cfg.slo_window) for c in cells}
+
+    # -- scoring ------------------------------------------------------
+
+    def _nominal(self, cell: Cell) -> float:
+        return max(1.0, cell.capacity() * self.cfg.queue_depth)
+
+    def _hard_limit(self, cell: Cell) -> float:
+        return math.ceil(
+            self._nominal(cell) * (1.0 + self.cfg.spill_headroom))
+
+    def _slo_breaching(self, cell: Cell) -> bool:
+        if self.cfg.slo_spill_below is None:
+            return False
+        window = self._slo_window[cell.name]
+        if len(window) < window.maxlen // 2:
+            return False
+        return (sum(window) / len(window)
+                < self.cfg.slo_spill_below)
+
+    def note_result(self, cell_name: str, slo_ok: bool) -> None:
+        """The globe streams every completion's SLO verdict back so
+        spill can react to a breaching cell before its queue shows
+        it (slow-but-alive cells fill slowly)."""
+        window = self._slo_window.get(cell_name)
+        if window is not None:
+            window.append(1 if slo_ok else 0)
+
+    def _candidates(self, origin: str) -> List[Cell]:
+        """Routable cells under their hard limit, best first:
+        unsaturated before saturated, then DCN-latency + load cost,
+        then name — a pure function of (origin, cell states)."""
+        scored = []
+        for cell in self.cells:
+            if not cell.routable():
+                continue
+            load = cell.outstanding()
+            if load >= self._hard_limit(cell):
+                continue  # the herd bound: never flood past headroom
+            saturated = (load >= self._nominal(cell)
+                         or self._slo_breaching(cell))
+            cost = (self.rtt_s(origin, cell.zone)
+                    + self.cfg.load_weight_s
+                    * load / max(1, cell.capacity()))
+            scored.append((1 if saturated else 0, cost, cell.name,
+                           cell))
+        scored.sort(key=lambda t: t[:3])
+        return [t[3] for t in scored]
+
+    def _home(self, req: TraceRequest) -> Optional[Cell]:
+        """Sticky prefix-affinity: the cohort's home cell, hashed
+        over the static cell list so the mapping survives cell
+        failures (a dead home just spills until it returns)."""
+        if req.prefix_group < 0 or not self.cells:
+            return None
+        key = zlib.crc32(
+            f"globe-group:{req.prefix_group}".encode("utf-8"))
+        return self.cells[key % len(self.cells)]
+
+    def pick(self, req: TraceRequest,
+             origin: str) -> Optional[Cell]:
+        candidates = self._candidates(origin)
+        if not candidates:
+            return None
+        home = self._home(req)
+        if home is not None and home in candidates:
+            floor = min(c.outstanding() for c in candidates)
+            if home.outstanding() - floor <= self.cfg.affinity_spill:
+                self.affinity_hits += 1
+                metrics.globe_board().incr("affinity_hits")
+                return home
+        return candidates[0]
+
+    # -- admission ----------------------------------------------------
+
+    def offer(self, req: TraceRequest, origin: str, now: float,
+              readmit: bool = False) -> Optional[tuple]:
+        """Route one request (or queue it when every cell is at its
+        bound). Returns a shed marker tuple only when even the
+        front-door queue is full — the caller records it."""
+        cell = self.pick(req, origin)
+        if cell is not None:
+            self._admit(cell, req, origin, now, readmit)
+            return None
+        if len(self.queue) < self.cfg.max_queue:
+            self.queue.append((req, origin))
+            metrics.globe_board().incr("frontdoor_queued")
+            return None
+        metrics.globe_board().incr("frontdoor_shed")
+        self.shed.append((req, origin, now))
+        return (req, origin, now)
+
+    def _admit(self, cell: Cell, req: TraceRequest, origin: str,
+               now: float, readmit: bool) -> None:
+        # the full DCN round trip rides on the delivery time, so
+        # every latency the cell later measures for this request
+        # already includes the network the front door chose
+        cell.admit(req, now + self.rtt_s(origin, cell.zone))
+        self.routed += 1
+        if origin == cell.zone:
+            metrics.globe_board().incr("admit_local")
+        else:
+            self.spilled += 1
+            metrics.globe_board().incr("admit_spill")
+        if readmit:
+            self.readmitted += 1
+            metrics.globe_board().incr("herd_readmissions")
+
+    def pump(self, now: float) -> None:
+        """Retry the FCFS overflow queue head-first; the head
+        blocking keeps global admission fair, same as the cell
+        router's dispatch."""
+        while self.queue:
+            req, origin = self.queue[0]
+            cell = self.pick(req, origin)
+            if cell is None:
+                return
+            self.queue.popleft()
+            self._admit(cell, req, origin, now, readmit=False)
+
+    def report(self) -> Dict[str, object]:
+        return {
+            "routed": self.routed,
+            "spilled": self.spilled,
+            "affinity_hits": self.affinity_hits,
+            "readmitted": self.readmitted,
+            "queued": len(self.queue),
+            "shed": len(self.shed),
+            "hard_limits": {
+                c.name: self._hard_limit(c) for c in self.cells},
+            "peak_outstanding": {
+                c.name: c.peak_outstanding for c in self.cells},
+        }

@@ -1,0 +1,572 @@
+"""The globe driver: zones of cells behind one front door.
+
+The fleet-of-fleets (docs/GLOBE.md): per-zone seeded demand (with
+optional follow-the-sun diurnal phase offsets) arrives at the front
+door, which admits each request to a cell — nearest healthy first,
+capacity-aware, spill-bounded; every cell is a full
+:class:`~kind_tpu_sim.fleet.FleetSim` (optionally scheduler-backed on
+its own zone-labeled inventory) stepped in lockstep on ONE shared
+virtual clock; a global capacity planner moves a spot-replica budget
+between the cells' autoscalers as the sun moves the load.
+
+Chaos grows the **blast-radius tier** here: ``zone_loss`` kills every
+cell in a zone (their whole load re-enters the front door and spills
+cross-zone), ``herd_failover`` is the same failure under peak burst
+(the spill bound is what keeps it from cascading), ``dcn_degrade``
+browns out a zone's inter-zone links (the tier-parameterized ring
+cost model from parallel/collectives.py sets the inflation), and
+``cell_drain`` is planned maintenance. Per-zone SLO boards prove
+containment: a fault's damage must stay inside its failure domain.
+
+Determinism: everything is a pure function of (config, seed) —
+per-zone traces derive sub-seeds from ``KIND_TPU_SIM_GLOBE_SEED``,
+cells iterate in name order, the front door scores without entropy —
+so `globe run --seed 7` twice emits byte-identical reports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import zlib
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kind_tpu_sim import metrics
+from kind_tpu_sim.parallel import collectives
+from kind_tpu_sim.fleet.autoscaler import AutoscalerConfig
+from kind_tpu_sim.fleet.loadgen import (
+    TraceRequest,
+    VirtualClock,
+    WorkloadSpec,
+    generate_trace,
+)
+from kind_tpu_sim.fleet.router import SimReplicaConfig
+from kind_tpu_sim.fleet.sim import (
+    FleetConfig,
+    FleetSchedConfig,
+    resolve_fast_forward,
+    resolve_tick_s,
+)
+from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
+from kind_tpu_sim.globe.cell import Cell, CellConfig
+from kind_tpu_sim.globe.frontdoor import FrontDoor, FrontDoorConfig
+from kind_tpu_sim.globe.planner import GlobalPlanner, PlannerConfig
+
+GLOBE_SEED_ENV = "KIND_TPU_SIM_GLOBE_SEED"
+
+GLOBE_CHAOS_ACTIONS = (
+    "zone_loss", "zone_restore", "herd_failover",
+    "dcn_degrade", "dcn_restore", "cell_drain", "cell_undrain",
+)
+
+
+def resolve_seed(seed: Optional[int] = None) -> int:
+    """Explicit seed > env (KIND_TPU_SIM_GLOBE_SEED) > 0."""
+    if seed is not None:
+        return int(seed)
+    try:
+        return int(os.environ.get(GLOBE_SEED_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobeWorkloadSpec:
+    """Per-zone demand. With ``follow_the_sun`` and a diurnal
+    process, zone i's rate profile is phase-shifted by i/len(zones)
+    of a period — the staggered peaks the planner's spot budget
+    chases."""
+
+    process: str = "poisson"
+    rps: float = 40.0
+    n_per_zone: int = 200
+    prompt_len: Tuple[int, int] = (8, 24)
+    max_new: Tuple[int, int] = (4, 12)
+    shared_prefix_frac: float = 0.0
+    prefix_groups: int = 4
+    deadline_s: Optional[float] = None
+    diurnal_period_s: float = 20.0
+    follow_the_sun: bool = True
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["prompt_len"] = list(self.prompt_len)
+        d["max_new"] = list(self.max_new)
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobeChaosEvent:
+    """One blast-radius fault. ``target`` names a zone (``zone_*``,
+    ``herd_failover``, ``dcn_*``) or a cell (``cell_*``); ``param``
+    is the DCN link bandwidth factor for ``dcn_degrade``."""
+
+    at_s: float
+    action: str
+    target: str
+    param: float = 0.0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class GlobeConfig:
+    zones: Tuple[str, ...] = ("zone-a", "zone-b", "zone-c")
+    cells_per_zone: int = 1
+    replicas_per_cell: int = 2
+    policy: str = "least-outstanding"   # per-cell router policy
+    tick_s: Optional[float] = None
+    max_virtual_s: float = 600.0
+    sim: SimReplicaConfig = SimReplicaConfig()
+    slo: SloPolicy = SloPolicy(ttft_s=1.0, e2e_s=5.0)
+    # scheduler-backed cells: each cell's replicas are gangs on its
+    # own zone-labeled inventory (FleetConfig.sched, docs/SCHED.md)
+    sched: bool = True
+    sched_policy: str = "ici"
+    autoscale: bool = False
+    autoscaler: AutoscalerConfig = AutoscalerConfig()
+    frontdoor: FrontDoorConfig = FrontDoorConfig()
+    planner: Optional[PlannerConfig] = None
+    workload: GlobeWorkloadSpec = GlobeWorkloadSpec()
+    # one-way DCN latency unit between adjacent zones; zone pairs
+    # farther apart in the zone list cost proportionally more
+    dcn_base_s: float = 0.01
+    intra_zone_s: float = 0.0005
+    fast_forward: Optional[bool] = None
+
+    def cell_names(self) -> List[str]:
+        return [f"{z}/c{i}" for z in self.zones
+                for i in range(self.cells_per_zone)]
+
+    def as_dict(self) -> dict:
+        return {
+            "zones": list(self.zones),
+            "cells_per_zone": self.cells_per_zone,
+            "replicas_per_cell": self.replicas_per_cell,
+            "policy": self.policy,
+            "tick_s": resolve_tick_s(self.tick_s),
+            "sim": dataclasses.asdict(self.sim),
+            "slo": {k: v for k, v in
+                    dataclasses.asdict(self.slo).items()
+                    if v is not None},
+            "sched": (self.sched_policy if self.sched else None),
+            "autoscale": self.autoscale,
+            "frontdoor": self.frontdoor.as_dict(),
+            "planner": (self.planner.as_dict()
+                        if self.planner is not None else None),
+            "workload": self.workload.as_dict(),
+            "dcn_base_s": self.dcn_base_s,
+            "intra_zone_s": self.intra_zone_s,
+        }
+
+
+# -- per-zone traffic --------------------------------------------------
+
+
+def zone_seed(seed: int, zone: str) -> int:
+    """Each zone's private loadgen stream, derived from the globe
+    seed — the ChaosSchedule recipe, so zone traffic identity is
+    exactly (seed, zone) identity."""
+    return zlib.crc32(f"globe:{seed}:{zone}".encode("utf-8"))
+
+
+def generate_globe_traces(
+        cfg: GlobeConfig,
+        seed: Optional[int] = None) -> Dict[str, List[TraceRequest]]:
+    """One seeded trace per zone; request ids are zone-prefixed so
+    they stay unique in the global completion log. Diurnal zones get
+    follow-the-sun phase offsets (zone i peaks i/len of a period
+    later)."""
+    seed = resolve_seed(seed)
+    w = cfg.workload
+    out: Dict[str, List[TraceRequest]] = {}
+    for i, zone in enumerate(cfg.zones):
+        phase = 0.0
+        if (w.follow_the_sun and w.process == "diurnal"
+                and len(cfg.zones) > 1):
+            phase = round(
+                i * w.diurnal_period_s / len(cfg.zones), 6)
+        spec = WorkloadSpec(
+            process=w.process, rps=w.rps,
+            n_requests=w.n_per_zone,
+            prompt_len=w.prompt_len, max_new=w.max_new,
+            shared_prefix_frac=w.shared_prefix_frac,
+            prefix_groups=w.prefix_groups,
+            deadline_s=w.deadline_s,
+            diurnal_period_s=w.diurnal_period_s,
+            phase_s=phase)
+        out[zone] = [
+            dataclasses.replace(r,
+                                request_id=f"{zone}/{r.request_id}")
+            for r in generate_trace(spec, zone_seed(seed, zone))]
+    return out
+
+
+def save_globe_trace(path: str,
+                     traces: Dict[str, List[TraceRequest]]) -> None:
+    """One JSON object per line with the origin zone riding along —
+    byte-stable (sorted keys, zone then arrival order)."""
+    with open(path, "w", encoding="utf-8") as fh:
+        for zone in sorted(traces):
+            for req in traces[zone]:
+                d = req.as_dict()
+                d["origin"] = zone
+                fh.write(json.dumps(d, sort_keys=True))
+                fh.write("\n")
+
+
+def load_globe_trace(path: str) -> Dict[str, List[TraceRequest]]:
+    out: Dict[str, List[TraceRequest]] = {}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            zone = d.pop("origin")
+            out.setdefault(zone, []).append(
+                TraceRequest.from_dict(d))
+    return out
+
+
+# -- the driver --------------------------------------------------------
+
+
+class GlobeSim:
+    """One globe run: cells in name order, one shared clock, the
+    front door as the only traffic source, blast-radius chaos at
+    planned virtual times."""
+
+    def __init__(self, cfg: GlobeConfig,
+                 traces: Optional[Dict[str, List[TraceRequest]]]
+                 = None,
+                 seed: Optional[int] = None,
+                 chaos_events: Sequence[GlobeChaosEvent] = ()):
+        self.cfg = cfg
+        self.seed = resolve_seed(seed)
+        self.clock = VirtualClock()
+        self.traces = (traces if traces is not None
+                       else generate_globe_traces(cfg, self.seed))
+        unknown = set(self.traces) - set(cfg.zones)
+        if unknown:
+            raise ValueError(
+                f"trace zones {sorted(unknown)} not in config "
+                f"zones {list(cfg.zones)}")
+        for ev in chaos_events:
+            if ev.action not in GLOBE_CHAOS_ACTIONS:
+                raise ValueError(
+                    f"unknown globe chaos action {ev.action!r}; "
+                    f"known: {', '.join(GLOBE_CHAOS_ACTIONS)}")
+        self.chaos_events = sorted(
+            chaos_events, key=lambda e: (e.at_s, e.action, e.target))
+        self.chaos_applied: List[dict] = []
+        self._zone_idx = {z: i for i, z in enumerate(cfg.zones)}
+        self._dcn_factor: Dict[str, float] = {}
+        self.cells = [
+            Cell(CellConfig(name=name, zone=name.split("/")[0],
+                            fleet=self._fleet_config(
+                                name.split("/")[0])),
+                 self.clock)
+            for name in cfg.cell_names()]
+        for cell in self.cells:
+            cell.sim.on_complete = self._completion_hook(cell)
+        self.frontdoor = FrontDoor(cfg.frontdoor, self.cells,
+                                   self.rtt_s)
+        self.planner = (GlobalPlanner(cfg.planner, self.cells)
+                        if cfg.planner is not None else None)
+        self._next_eval = 0.0
+        self.tracker = SloTracker(cfg.slo)
+        self._zone_tracker = {z: SloTracker(cfg.slo)
+                              for z in cfg.zones}
+        self._origin: Dict[str, str] = {}
+        self.log: List[dict] = []
+        self._arrivals: deque = deque(sorted(
+            ((req, zone) for zone, reqs in self.traces.items()
+             for req in reqs),
+            key=lambda t: (t[0].arrival_s, t[0].request_id)))
+        self.requests = len(self._arrivals)
+        self._ff = resolve_fast_forward(cfg.fast_forward)
+        # empty ticks skipped by fast-forward — observability only,
+        # NOT in the report (ff on/off must diff clean)
+        self.ff_skipped = 0
+
+    def _fleet_config(self, zone: str) -> FleetConfig:
+        cfg = self.cfg
+        return FleetConfig(
+            replicas=cfg.replicas_per_cell, policy=cfg.policy,
+            tick_s=cfg.tick_s,
+            # the FRONT DOOR is the admission layer: its per-cell
+            # hard limit keeps cell queues bounded, so the cell
+            # router never sheds on its own (max_queue=0 = no bound)
+            max_queue=0,
+            max_virtual_s=cfg.max_virtual_s,
+            autoscale=cfg.autoscale,
+            slo=cfg.slo, sim=cfg.sim,
+            autoscaler=cfg.autoscaler,
+            sched=(FleetSchedConfig(policy=cfg.sched_policy,
+                                    zone=zone)
+                   if cfg.sched else None),
+            fast_forward=False)  # the globe fast-forwards, not cells
+
+    # -- DCN model ----------------------------------------------------
+
+    def rtt_s(self, z_from: str, z_to: str) -> float:
+        """Modeled round trip between a request's origin zone and a
+        cell's zone. Inter-zone distance scales with zone-list
+        separation; a browned-out link (``dcn_degrade``) inflates
+        every path touching the degraded zone by the shared
+        tier-parameterized ring cost model (transfer time is inverse
+        in the slowest link's bandwidth factor)."""
+        zi = self._zone_idx[z_from]
+        zj = self._zone_idx[z_to]
+        if zi == zj:
+            return 2.0 * self.cfg.intra_zone_s
+        base = 2.0 * self.cfg.dcn_base_s * (1.0 + 0.5 * abs(zi - zj))
+        factor = min(self._dcn_factor.get(z_from, 1.0),
+                     self._dcn_factor.get(z_to, 1.0))
+        if factor < 1.0:
+            base *= collectives.tier_slowdown(factor, 1.0,
+                                              tier="dcn")
+        return base
+
+    # -- completion stream --------------------------------------------
+
+    def _completion_hook(self, cell: Cell):
+        def hook(entry: dict, comp) -> None:
+            origin = self._origin.get(entry["request_id"],
+                                      cell.zone)
+            g = dict(entry)
+            g["cell"] = cell.name
+            g["serving_zone"] = cell.zone
+            g["origin"] = origin
+            self.log.append(g)
+            req = comp.request
+            shed = comp.finish_reason == "shed"
+            expired = comp.finish_reason == "deadline_exceeded"
+            self.tracker.observe(
+                arrival_s=req.arrival_s, first_s=comp.first_s,
+                finish_s=comp.finish_s, tokens=comp.tokens,
+                shed=shed, deadline_exceeded=expired)
+            self._zone_tracker[origin].observe(
+                arrival_s=req.arrival_s, first_s=comp.first_s,
+                finish_s=comp.finish_s, tokens=comp.tokens,
+                shed=shed, deadline_exceeded=expired)
+            self.frontdoor.note_result(cell.name, g["slo_ok"])
+        return hook
+
+    def _record_frontdoor_shed(self, req: TraceRequest,
+                               origin: str, now: float) -> None:
+        self.log.append({
+            "request_id": req.request_id,
+            "cell": None, "serving_zone": None, "origin": origin,
+            "replica": -1, "prefix_group": req.prefix_group,
+            "arrival_s": round(req.arrival_s, 6),
+            "dispatch_s": round(now, 6), "first_s": None,
+            "finish_s": round(now, 6), "tokens": 0,
+            "tokens_crc": 0, "finish_reason": "shed",
+            "slo_ok": False,
+        })
+        self.tracker.observe(
+            arrival_s=req.arrival_s, first_s=None, finish_s=now,
+            tokens=0, shed=True)
+        self._zone_tracker[origin].observe(
+            arrival_s=req.arrival_s, first_s=None, finish_s=now,
+            tokens=0, shed=True)
+
+    # -- blast-radius chaos -------------------------------------------
+
+    def _cells_of(self, zone: str) -> List[Cell]:
+        return [c for c in self.cells if c.zone == zone]
+
+    def _apply_chaos(self, now: float) -> None:
+        while self.chaos_events and self.chaos_events[0].at_s <= now:
+            ev = self.chaos_events.pop(0)
+            self.chaos_applied.append(
+                dict(ev.as_dict(), applied_at_s=round(now, 6)))
+            if ev.action in ("zone_loss", "herd_failover"):
+                self._lose_zone(ev.target, now, ev.action)
+            elif ev.action == "zone_restore":
+                for cell in self._cells_of(ev.target):
+                    cell.restore(now)
+                metrics.globe_board().incr("zone_restores")
+                metrics.recovery_log().record(
+                    "globe_zone_restore", zone=ev.target,
+                    at_s=round(now, 6))
+            elif ev.action == "dcn_degrade":
+                self._dcn_factor[ev.target] = max(1e-3, ev.param)
+                metrics.globe_board().incr("dcn_degrades")
+                metrics.recovery_log().record(
+                    "globe_dcn_degrade", zone=ev.target,
+                    factor=ev.param, at_s=round(now, 6))
+            elif ev.action == "dcn_restore":
+                self._dcn_factor.pop(ev.target, None)
+                metrics.globe_board().incr("dcn_restores")
+                metrics.recovery_log().record(
+                    "globe_dcn_restore", zone=ev.target,
+                    at_s=round(now, 6))
+            elif ev.action == "cell_drain":
+                for cell in self.cells:
+                    if cell.name == ev.target:
+                        cell.draining = True
+                metrics.globe_board().incr("cell_drains")
+                metrics.recovery_log().record(
+                    "globe_cell_drain", cell=ev.target,
+                    at_s=round(now, 6))
+            elif ev.action == "cell_undrain":
+                for cell in self.cells:
+                    if cell.name == ev.target:
+                        cell.draining = False
+                metrics.globe_board().incr("cell_undrains")
+
+    def _lose_zone(self, zone: str, now: float,
+                   action: str) -> None:
+        """A whole zone goes dark: every cell in it fails, and its
+        entire displaced load re-enters the front door in arrival
+        order — the thundering herd the spill bound must absorb
+        without cascading into the survivors."""
+        displaced: List[TraceRequest] = []
+        for cell in self._cells_of(zone):
+            displaced.extend(cell.fail(now))
+        displaced.sort(key=lambda r: (r.arrival_s, r.request_id))
+        metrics.globe_board().incr("zone_losses")
+        metrics.recovery_log().record(
+            f"globe_{action}", zone=zone,
+            displaced=len(displaced), at_s=round(now, 6))
+        for req in displaced:
+            origin = self._origin.get(req.request_id, zone)
+            shed = self.frontdoor.offer(req, origin, now,
+                                        readmit=True)
+            if shed is not None:
+                self._record_frontdoor_shed(req, origin, now)
+
+    # -- the loop -----------------------------------------------------
+
+    def _done(self) -> bool:
+        return bool(
+            not self._arrivals and not self.frontdoor.queue
+            and not self.chaos_events
+            and all(c.quiescent() for c in self.cells))
+
+    def _advance(self, tick: float) -> None:
+        """One clock tick — or, across a globally idle gap (every
+        cell idle, front door drained, no planner), every empty tick
+        up to the next arrival/chaos event, by the same sequence of
+        tick-sized additions (byte-identical replays, docs/FLEET.md
+        fast-forward contract)."""
+        self.clock.advance(tick)
+        if not self._ff or self.planner is not None:
+            return
+        if self.frontdoor.queue:
+            return
+        if not all(c.idle_gap() for c in self.cells):
+            return
+        next_s = (self._arrivals[0][0].arrival_s
+                  if self._arrivals else float("inf"))
+        if self.chaos_events:
+            next_s = min(next_s, self.chaos_events[0].at_s)
+        limit = self.cfg.max_virtual_s
+        adv = self.clock.advance
+        now = self.clock.now
+        while now() < next_s and now() <= limit:
+            adv(tick)
+            self.ff_skipped += 1
+
+    def run(self) -> Dict[str, object]:
+        board_before = metrics.globe_board().counts()
+        tick = resolve_tick_s(self.cfg.tick_s)
+        # origin map first: displaced requests keep their origin
+        # wherever they complete
+        for zone, reqs in self.traces.items():
+            for req in reqs:
+                self._origin[req.request_id] = zone
+        while True:
+            now = self.clock.now()
+            if now > self.cfg.max_virtual_s:
+                break
+            self._apply_chaos(now)
+            if self.planner is not None:
+                while now >= self._next_eval:
+                    self.planner.evaluate(now)
+                    self._next_eval = round(
+                        self._next_eval
+                        + self.cfg.planner.eval_every_s, 9)
+            while (self._arrivals
+                   and self._arrivals[0][0].arrival_s <= now):
+                req, origin = self._arrivals.popleft()
+                shed = self.frontdoor.offer(req, origin, now)
+                if shed is not None:
+                    self._record_frontdoor_shed(req, origin, now)
+            self.frontdoor.pump(now)
+            for cell in self.cells:
+                cell.deliver_due(now)
+                cell.step(now, tick)
+            if self._done():
+                break
+            self._advance(tick)
+        self.log.sort(key=lambda e: (e["finish_s"],
+                                     e["request_id"]))
+        return self._report(board_before)
+
+    # -- reporting ----------------------------------------------------
+
+    def _report(self, board_before: Dict[str, int]
+                ) -> Dict[str, object]:
+        span = self.clock.now()
+        served_local = sum(
+            1 for e in self.log
+            if e["serving_zone"] is not None
+            and e["serving_zone"] == e["origin"])
+        zones: Dict[str, dict] = {}
+        for zone in self.cfg.zones:
+            entries = [e for e in self.log
+                       if e["origin"] == zone]
+            zones[zone] = {
+                "requests": len(entries),
+                "spilled_out": sum(
+                    1 for e in entries
+                    if e["serving_zone"] is not None
+                    and e["serving_zone"] != zone),
+                "shed": sum(1 for e in entries
+                            if e["finish_reason"] == "shed"),
+                "slo": self._zone_tracker[zone].report(
+                    span_s=span),
+            }
+        report: Dict[str, object] = {
+            "config": self.cfg.as_dict(),
+            "seed": self.seed,
+            "requests": self.requests,
+            "completed": len(self.log),
+            "virtual_s": round(span, 6),
+            "global_slo": self.tracker.report(span_s=span),
+            "served_in_origin_zone": served_local,
+            "zones": zones,
+            "cells": {c.name: c.report() for c in self.cells},
+            "frontdoor": self.frontdoor.report(),
+            "completions": self.log,
+            "globe_counters":
+                metrics.globe_board().snapshot_since(board_before),
+            "ok": len(self.log) == self.requests,
+        }
+        if self.chaos_applied:
+            report["chaos"] = self.chaos_applied
+        if self.planner is not None:
+            report["planner"] = self.planner.report()
+        return report
+
+
+def attainment_over(log: Sequence[dict], t_from: float,
+                    t_to: float = float("inf"),
+                    zone: Optional[str] = None) -> Optional[float]:
+    """SLO attainment over requests ARRIVING in a window, optionally
+    restricted to one origin zone — how the globe chaos scenarios
+    judge recovery and containment without the backlog-drain period
+    polluting the number."""
+    window = [e for e in log
+              if t_from <= e["arrival_s"] < t_to
+              and (zone is None or e["origin"] == zone)]
+    if not window:
+        return None
+    return sum(1 for e in window if e["slo_ok"]) / len(window)

@@ -244,6 +244,18 @@ def health_board() -> CounterBoard:
     return _HEALTH_BOARD
 
 
+_GLOBE_BOARD = CounterBoard()
+
+
+def globe_board() -> CounterBoard:
+    """The process-global globe counter board (front-door
+    admissions/spills/sheds, zone losses, DCN degrades, herd
+    re-admissions, planner grants/reclaims — kind_tpu_sim.globe
+    records into it; globe reports, chaos scenario reports, and
+    bench globe extras snapshot it)."""
+    return _GLOBE_BOARD
+
+
 _SCHED_BOARD = CounterBoard()
 
 

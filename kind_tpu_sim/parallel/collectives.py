@@ -20,30 +20,52 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-# Nominal per-direction ICI link bandwidth used by the ANALYTIC cost
-# model below (v5e-class ballpark). Fleet/sched conclusions come from
-# RELATIVE comparisons at fixed config, not this absolute.
-DEFAULT_ICI_GBPS = 90.0
+# The two interconnect tiers the analytic ring model serves. ICI is
+# the within-pod fabric (v5e-class per-direction link ballpark, the
+# PR 5 gray-failure numbers); DCN is the between-pod/zone datacenter
+# network — an order of magnitude less bandwidth and a much smaller
+# share of a serving step, which is exactly why a browned-out DCN
+# link hurts cross-zone spill long before it hurts a collective.
+# Fleet/sched/globe conclusions come from RELATIVE comparisons at
+# fixed config, not these absolutes.
+TIER_LINK_GBPS: Dict[str, float] = {"ici": 90.0, "dcn": 25.0}
+TIER_FRACTION: Dict[str, float] = {"ici": 0.35, "dcn": 0.10}
+DEFAULT_ICI_GBPS = TIER_LINK_GBPS["ici"]
+DEFAULT_DCN_GBPS = TIER_LINK_GBPS["dcn"]
+
+
+def _tier_gbps(tier: str) -> float:
+    if tier not in TIER_LINK_GBPS:
+        raise ValueError(
+            f"unknown interconnect tier {tier!r}; known: "
+            f"{', '.join(sorted(TIER_LINK_GBPS))}")
+    return TIER_LINK_GBPS[tier]
 
 
 def ring_allreduce_s(size_bytes: float, participants: int,
-                     link_gbps: float = DEFAULT_ICI_GBPS,
-                     link_factors: Optional[Sequence[float]] = None
-                     ) -> float:
-    """Modeled wall time of a bandwidth-optimal ring all-reduce.
+                     link_gbps: Optional[float] = None,
+                     link_factors: Optional[Sequence[float]] = None,
+                     tier: str = "ici") -> float:
+    """Modeled wall time of a bandwidth-optimal ring all-reduce on
+    one interconnect tier (``ici`` within a pod, ``dcn`` across
+    pods/zones — same ring, different nominal bandwidth).
 
     The standard 2(n-1)/n-transits model: each participant moves
     ``2 * (n-1)/n * size_bytes`` over its ring links, and the ring
     finishes at the pace of its SLOWEST link — which is exactly why a
-    single gray (degraded, not dead) ICI link inflates every
-    collective on the slice. ``link_factors`` are per-link bandwidth
-    multipliers in (0, 1]; the minimum governs. This is the cost
-    accounting the fleet/sched gray-failure tick math draws on
-    (docs/HEALTH.md); it models no latency term, so sub-KB transfers
-    are under-costed — fine for the relative comparisons it serves.
+    single gray (degraded, not dead) link inflates every collective
+    on the ring. ``link_factors`` are per-link bandwidth multipliers
+    in (0, 1]; the minimum governs; ``link_gbps`` overrides the
+    tier's nominal bandwidth. This is the cost accounting the
+    fleet/sched gray-failure tick math draws on (docs/HEALTH.md) and
+    the globe layer's DCN brown-out generalizes (docs/GLOBE.md); it
+    models no latency term, so sub-KB transfers are under-costed —
+    fine for the relative comparisons it serves.
     """
     if participants <= 1:
         return 0.0
+    if link_gbps is None:
+        link_gbps = _tier_gbps(tier)
     if size_bytes < 0 or link_gbps <= 0:
         raise ValueError(
             f"need size_bytes >= 0 and link_gbps > 0; got "
@@ -57,25 +79,50 @@ def ring_allreduce_s(size_bytes: float, participants: int,
     return transits * size_bytes / bytes_per_s
 
 
-def ici_slowdown(link_factor: float,
-                 ici_fraction: float = 0.35) -> float:
-    """Service-time multiplier for a workload whose step spends
-    ``ici_fraction`` of its time in ICI collectives when the slice's
-    slowest link runs at ``link_factor`` of nominal bandwidth.
+def tier_slowdown(link_factor: float,
+                  fraction: Optional[float] = None,
+                  tier: str = "ici") -> float:
+    """Service-time multiplier for a workload spending ``fraction``
+    of its time in collectives on ``tier`` when that tier's slowest
+    link runs at ``link_factor`` of nominal bandwidth.
 
     Amdahl's law applied to the ring model above: the compute share
     is unaffected, the collective share scales by ``1/link_factor``
     (ring time is inverse in the slowest link). ``link_factor=1`` is
-    exactly 1.0 — a healthy fabric adds nothing. The fleet applies
-    this to replicas whose gang sits on a degraded ICI domain, and
-    the scheduler inflates warm-up the same way (docs/HEALTH.md)."""
+    exactly 1.0 — a healthy fabric adds nothing. One parameterized
+    implementation serves both tiers: the fleet applies the ICI
+    instance to replicas whose gang sits on a degraded ICI domain
+    (and the scheduler inflates warm-up the same way, docs/HEALTH.md);
+    the globe layer applies the DCN instance to cross-zone traffic
+    riding a browned-out DCN link (docs/GLOBE.md)."""
     if not 0.0 < link_factor <= 1.0:
         raise ValueError(
             f"link_factor must be in (0, 1]; got {link_factor}")
-    if not 0.0 <= ici_fraction <= 1.0:
+    if fraction is None:
+        if tier not in TIER_FRACTION:
+            raise ValueError(
+                f"unknown interconnect tier {tier!r}; known: "
+                f"{', '.join(sorted(TIER_FRACTION))}")
+        fraction = TIER_FRACTION[tier]
+    if not 0.0 <= fraction <= 1.0:
         raise ValueError(
-            f"ici_fraction must be in [0, 1]; got {ici_fraction}")
-    return 1.0 + ici_fraction * (1.0 / link_factor - 1.0)
+            f"fraction must be in [0, 1]; got {fraction}")
+    return 1.0 + fraction * (1.0 / link_factor - 1.0)
+
+
+def ici_slowdown(link_factor: float,
+                 ici_fraction: float = 0.35) -> float:
+    """The ICI instance of :func:`tier_slowdown` — kept under its
+    PR 5 name (and numbers) for the fleet/sched gray-failure math."""
+    return tier_slowdown(link_factor, ici_fraction, tier="ici")
+
+
+def dcn_slowdown(link_factor: float,
+                 dcn_fraction: Optional[float] = None) -> float:
+    """The DCN instance of :func:`tier_slowdown`: the latency/cost
+    multiplier the globe layer applies to traffic crossing a
+    browned-out inter-zone link (docs/GLOBE.md)."""
+    return tier_slowdown(link_factor, dcn_fraction, tier="dcn")
 
 
 def psum_smoke(mesh=None) -> Dict[str, object]:

@@ -202,12 +202,15 @@ class Inventory:
     def candidate_placements(
         self, *, accelerator: str, host_block: Tuple[int, ...],
         chips_per_node: int, pool: Optional[str] = None,
+        zone: Optional[str] = None,
     ) -> List[Placement]:
         """Every feasible placement, deterministic order (domain id,
         then anchor lexicographic). ``host_block`` is the request's
         host grid — ``(1,) * ndims`` means single-host and admits
         chip-granular sharing; anything larger requires whole-free
-        hosts in a contiguous block."""
+        hosts in a contiguous block. ``zone`` pins the placement to
+        domains whose nodes carry that topology.kubernetes.io/zone
+        (the kubeface nodeSelector contract, docs/GLOBE.md)."""
         out: List[Placement] = []
         single = all(b == 1 for b in host_block)
         for did in sorted(self.domains):
@@ -216,6 +219,9 @@ class Inventory:
                 continue
             if pool is not None and any(
                     n.pool != pool for n in dom.nodes.values()):
+                continue
+            if zone is not None and any(
+                    n.zone != zone for n in dom.nodes.values()):
                 continue
             if len(host_block) != len(dom.host_grid):
                 continue
@@ -324,10 +330,15 @@ def build_inventory(
     (accelerator, topology) — each entry one ICI domain whose host
     grid comes from :class:`~kind_tpu_sim.topology.SliceTopology`
     (so a v4-style ``2x2xN`` chip grid yields contiguous-placeable
-    host sub-blocks). Node names/labels mirror what the orchestrator
-    applies to kind workers."""
+    host sub-blocks). A 3-tuple (accelerator, topology, zone) entry
+    overrides ``zone`` for THAT pod — how a multi-zone inventory
+    (one failure domain per zone, docs/GLOBE.md) is declared. Node
+    names/labels mirror what the orchestrator applies to kind
+    workers."""
     domains: List[IciDomain] = []
-    for idx, (accelerator, topology) in enumerate(pods):
+    for idx, pod in enumerate(pods):
+        accelerator, topology = pod[0], pod[1]
+        pod_zone = pod[2] if len(pod) > 2 else zone
         s = topo.make_slice(accelerator, topology)
         did = f"pod-{idx}"
         nodes: Dict[Tuple[int, ...], Node] = {}
@@ -335,14 +346,14 @@ def build_inventory(
         for worker_id, coord in enumerate(coords):
             labels = dict(s.node_labels(worker_id))
             labels[LABEL_POOL] = pool
-            labels[LABEL_ZONE] = zone
+            labels[LABEL_ZONE] = pod_zone
             nodes[coord] = Node(
                 name=f"{name_prefix}-{idx}-{worker_id}",
                 domain=did,
                 coord=coord,
                 capacity=s.chips_per_host,
                 pool=pool,
-                zone=zone,
+                zone=pod_zone,
                 labels=labels,
             )
         domains.append(IciDomain(

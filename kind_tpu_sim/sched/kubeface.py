@@ -36,6 +36,7 @@ from typing import List, Optional
 import yaml
 
 from kind_tpu_sim import topology as topo
+from kind_tpu_sim.sched.inventory import LABEL_ZONE
 from kind_tpu_sim.sched.scheduler import SliceRequest
 
 ANNOTATION_PRIORITY = "kind-tpu-sim.dev/priority"
@@ -172,15 +173,22 @@ def slice_requests_from_yaml(text: str) -> List[SliceRequest]:
         replicas = int(doc.get("spec", {}).get("replicas", 1) or 1)
         priority = _priority(doc, spec)
         hold_s = _hold_s(doc)
-        pool = ((spec.get("nodeSelector", {}) or {})
-                .get("kind-tpu-sim.dev/pool"))
+        selector = spec.get("nodeSelector", {}) or {}
+        pool = selector.get("kind-tpu-sim.dev/pool")
+        # a topology.kubernetes.io/zone nodeSelector pins the gang
+        # to that zone's inventory; a topologySpreadConstraints
+        # entry on the same key leaves zone=None (any zone) and is
+        # honored by scheduling the replicas under the `spread`
+        # policy over a multi-zone inventory (docs/GLOBE.md)
+        zone = selector.get(LABEL_ZONE)
         if kind == "StatefulSet":
             # one gang of `replicas` hosts, all-or-nothing
             acc, topology = _accelerator_and_topology(
                 spec, chips, replicas)
             out.append(SliceRequest(
                 name=name, accelerator=acc, topology=topology,
-                priority=priority, hold_s=hold_s, pool=pool))
+                priority=priority, hold_s=hold_s, pool=pool,
+                zone=zone))
             continue
         acc, topology = _accelerator_and_topology(spec, chips, 1)
         if kind == "Deployment" and replicas > 1:
@@ -188,11 +196,12 @@ def slice_requests_from_yaml(text: str) -> List[SliceRequest]:
                 out.append(SliceRequest(
                     name=f"{name}-{i}", accelerator=acc,
                     topology=topology, priority=priority,
-                    hold_s=hold_s, pool=pool))
+                    hold_s=hold_s, pool=pool, zone=zone))
         else:
             out.append(SliceRequest(
                 name=name, accelerator=acc, topology=topology,
-                priority=priority, hold_s=hold_s, pool=pool))
+                priority=priority, hold_s=hold_s, pool=pool,
+                zone=zone))
     return out
 
 
@@ -208,6 +217,8 @@ def to_pod_manifest(req: SliceRequest) -> str:
     }
     if req.pool:
         selector["kind-tpu-sim.dev/pool"] = req.pool
+    if req.zone:
+        selector[LABEL_ZONE] = req.zone
     annotations = {ANNOTATION_PRIORITY: str(req.priority)}
     if req.hold_s:
         annotations[ANNOTATION_HOLD] = str(req.hold_s)

@@ -82,6 +82,10 @@ class SliceRequest:
     arrival_s: float = 0.0
     hold_s: float = 0.0
     pool: Optional[str] = None
+    # pin to one topology.kubernetes.io/zone (None = any zone): the
+    # kubeface maps a zone nodeSelector here, and the globe layer's
+    # per-zone cells pin their gangs to their own zone's inventory
+    zone: Optional[str] = None
 
     @property
     def slice_topo(self) -> topo.SliceTopology:
@@ -112,6 +116,7 @@ class SliceRequest:
             "arrival_s": round(self.arrival_s, 6),
             "hold_s": round(self.hold_s, 6),
             "pool": self.pool,
+            "zone": self.zone,
         }
 
 
@@ -249,7 +254,7 @@ class ClusterScheduler:
             accelerator=req.accelerator,
             host_block=req.host_block,
             chips_per_node=req.chips_per_node,
-            pool=req.pool)
+            pool=req.pool, zone=req.zone)
         if not cands:
             return None
         return min(cands, key=lambda p: self._score(req, p))
@@ -418,7 +423,7 @@ class ClusterScheduler:
                 accelerator=req.accelerator,
                 host_block=req.host_block,
                 chips_per_node=req.chips_per_node,
-                pool=req.pool)
+                pool=req.pool, zone=req.zone)
             if (p.domain, p.anchor) != (gang.placement.domain,
                                         gang.placement.anchor)]
         if not cands:

@@ -1,0 +1,540 @@
+"""Globe layer: fleet-of-fleets determinism, front door, blast radius.
+
+The load-bearing properties (ISSUE 6 acceptance): same seed =>
+byte-identical globe reports (KIND_TPU_SIM_GLOBE_SEED contract);
+traffic stays in its origin zone while the planet is healthy and
+spills nearest-healthy-first when it is not; the spill bound keeps a
+thundering herd from flooding any surviving cell past its headroom;
+blast-radius chaos (zone loss, DCN brown-out, cell drain) recovers
+globally while the per-zone boards prove containment; the capacity
+planner moves the spot budget to the pressured zone and takes it
+back; fast-forward is replay-invariant; and the DCN tier shares the
+ICI ring cost model with the PR 5 numbers unchanged. Everything here
+runs on the analytic (no-jax) replicas.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from kind_tpu_sim import chaos, fleet, globe
+from kind_tpu_sim.parallel import collectives
+
+pytestmark = pytest.mark.globe
+
+
+# -- per-zone traces ---------------------------------------------------
+
+
+def test_same_seed_identical_traces():
+    cfg = globe.GlobeConfig()
+    assert (globe.generate_globe_traces(cfg, 7)
+            == globe.generate_globe_traces(cfg, 7))
+    assert (globe.generate_globe_traces(cfg, 7)
+            != globe.generate_globe_traces(cfg, 8))
+
+
+def test_trace_ids_are_zone_prefixed_and_unique():
+    cfg = globe.GlobeConfig(
+        workload=globe.GlobeWorkloadSpec(n_per_zone=40))
+    traces = globe.generate_globe_traces(cfg, 3)
+    ids = [r.request_id for reqs in traces.values() for r in reqs]
+    assert len(ids) == len(set(ids)) == 120
+    for zone, reqs in traces.items():
+        assert all(r.request_id.startswith(f"{zone}/")
+                   for r in reqs)
+
+
+def test_globe_trace_roundtrip(tmp_path):
+    cfg = globe.GlobeConfig(
+        workload=globe.GlobeWorkloadSpec(n_per_zone=25,
+                                         shared_prefix_frac=0.5))
+    traces = globe.generate_globe_traces(cfg, 11)
+    path = tmp_path / "globe.jsonl"
+    globe.save_globe_trace(str(path), traces)
+    assert globe.load_globe_trace(str(path)) == traces
+
+
+def test_globe_seed_env(monkeypatch):
+    monkeypatch.setenv(globe.GLOBE_SEED_ENV, "42")
+    assert globe.resolve_seed() == 42
+    assert globe.resolve_seed(3) == 3
+    monkeypatch.delenv(globe.GLOBE_SEED_ENV)
+    assert globe.resolve_seed() == 0
+
+
+def test_follow_the_sun_staggers_diurnal_peaks():
+    """Zone i's diurnal profile is phase-shifted by i/len(zones) of
+    a period: the busiest window of each zone's arrivals must land
+    in a DIFFERENT part of the day."""
+    cfg = globe.GlobeConfig(
+        workload=globe.GlobeWorkloadSpec(
+            process="diurnal", rps=20.0, n_per_zone=300,
+            diurnal_period_s=30.0))
+    traces = globe.generate_globe_traces(cfg, 5)
+    peak_bins = {}
+    bins = 6
+    for zone, reqs in traces.items():
+        counts = [0] * bins
+        for r in reqs:
+            phase = (r.arrival_s % 30.0) / 30.0
+            counts[min(bins - 1, int(phase * bins))] += 1
+        peak_bins[zone] = counts.index(max(counts))
+    assert len(set(peak_bins.values())) == len(cfg.zones), peak_bins
+
+
+def test_diurnal_phase_offset_changes_profile_not_identity():
+    """phase_s slides the rate profile; phase 0 keeps the exact
+    pre-globe stream (seed compatibility)."""
+    base = fleet.WorkloadSpec(process="diurnal", rps=20.0,
+                              n_requests=200)
+    shifted = dataclasses.replace(base, phase_s=10.0)
+    assert (fleet.generate_trace(base, 7)
+            == fleet.generate_trace(dataclasses.replace(
+                base, phase_s=0.0), 7))
+    assert fleet.generate_trace(base, 7) != fleet.generate_trace(
+        shifted, 7)
+
+
+# -- whole-run determinism --------------------------------------------
+
+
+def _small_cfg(**kw):
+    defaults = dict(
+        zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=2,
+        workload=globe.GlobeWorkloadSpec(process="poisson",
+                                         rps=30.0, n_per_zone=60))
+    defaults.update(kw)
+    return globe.GlobeConfig(**defaults)
+
+
+def test_same_seed_byte_identical_report():
+    cfg = _small_cfg()
+    a = json.dumps(globe.GlobeSim(cfg, seed=7).run(),
+                   sort_keys=True)
+    b = json.dumps(globe.GlobeSim(cfg, seed=7).run(),
+                   sort_keys=True)
+    assert a == b
+
+
+def test_every_request_accounted():
+    rep = globe.GlobeSim(_small_cfg(), seed=3).run()
+    assert rep["ok"]
+    assert rep["completed"] == rep["requests"] == 180
+    ids = {e["request_id"] for e in rep["completions"]}
+    assert len(ids) == 180
+
+
+def test_healthy_planet_serves_locally():
+    """With every cell healthy and unsaturated, the nearest-cell
+    policy keeps all traffic in its origin zone — cross-zone DCN is
+    never paid without a reason."""
+    rep = globe.GlobeSim(_small_cfg(), seed=7).run()
+    assert rep["served_in_origin_zone"] == rep["requests"]
+    assert rep["frontdoor"]["spilled"] == 0
+
+
+def test_fast_forward_replay_identical_and_engaged():
+    cfg = _small_cfg()
+    sim_on = globe.GlobeSim(
+        dataclasses.replace(cfg, fast_forward=True), seed=7)
+    sim_off = globe.GlobeSim(
+        dataclasses.replace(cfg, fast_forward=False), seed=7)
+    a = json.dumps(sim_on.run(), sort_keys=True)
+    b = json.dumps(sim_off.run(), sort_keys=True)
+    assert a == b
+    assert sim_on.ff_skipped > 0 and sim_off.ff_skipped == 0
+
+
+# -- the front door ---------------------------------------------------
+
+
+def _burst_trace(zone, n, at_s=0.001, prefix_group=-1):
+    return [fleet.TraceRequest(
+        request_id=f"{zone}/b{i:05d}", arrival_s=at_s,
+        prompt=(1,) * 8, max_new=4, seed=i,
+        prefix_group=prefix_group) for i in range(n)]
+
+
+def test_saturation_spills_before_flooding():
+    """100 simultaneous arrivals in one zone: the front door fills
+    the local cell to its hard limit, spills cross-zone, queues the
+    rest — and NO cell is ever flooded past nominal x (1 +
+    headroom)."""
+    cfg = _small_cfg(zones=("zone-a", "zone-b"))
+    traces = {"zone-a": _burst_trace("zone-a", 100), "zone-b": []}
+    rep = globe.GlobeSim(cfg, traces=traces, seed=0).run()
+    assert rep["ok"] and rep["completed"] == 100
+    fd = rep["frontdoor"]
+    assert fd["spilled"] >= 1
+    for name, peak in fd["peak_outstanding"].items():
+        assert peak <= fd["hard_limits"][name], name
+    assert rep["global_slo"]["shed"] == 0
+
+
+def test_prefix_affinity_sticks_to_home_cell():
+    cfg = _small_cfg(
+        workload=globe.GlobeWorkloadSpec(
+            process="poisson", rps=30.0, n_per_zone=80,
+            shared_prefix_frac=1.0, prefix_groups=2))
+    rep = globe.GlobeSim(cfg, seed=9).run()
+    assert rep["frontdoor"]["affinity_hits"] > 0
+    served = {}
+    for e in rep["completions"]:
+        if e["prefix_group"] >= 0 and e["cell"] is not None:
+            served.setdefault(e["prefix_group"], []).append(
+                e["cell"])
+    for group, cells in served.items():
+        top = max(set(cells), key=cells.count)
+        assert cells.count(top) / len(cells) > 0.9, (group, cells)
+
+
+def test_dcn_latency_model():
+    sim = globe.GlobeSim(_small_cfg(), seed=0)
+    intra = sim.rtt_s("zone-a", "zone-a")
+    near = sim.rtt_s("zone-a", "zone-b")
+    far = sim.rtt_s("zone-a", "zone-c")
+    assert intra < near < far
+    assert sim.rtt_s("zone-b", "zone-c") == sim.rtt_s(
+        "zone-c", "zone-b")
+    # brown-out: transfer time is inverse in the slowest link's
+    # bandwidth factor (the shared DCN-tier ring cost model)
+    sim._dcn_factor["zone-c"] = 0.2
+    assert sim.rtt_s("zone-a", "zone-c") == pytest.approx(far / 0.2)
+    # intra-zone traffic never crosses DCN: unaffected
+    assert sim.rtt_s("zone-c", "zone-c") == intra
+
+
+def test_cell_drain_spills_then_returns():
+    cfg = _small_cfg(zones=("zone-a", "zone-b"))
+    traces = globe.generate_globe_traces(cfg, 7)
+    span = max(r.arrival_s for reqs in traces.values()
+               for r in reqs)
+    mid = round(span / 2.0, 6)
+    events = [
+        globe.GlobeChaosEvent(at_s=0.0, action="cell_drain",
+                              target="zone-a/c0"),
+        globe.GlobeChaosEvent(at_s=mid, action="cell_undrain",
+                              target="zone-a/c0"),
+    ]
+    rep = globe.GlobeSim(cfg, traces=traces, seed=7,
+                         chaos_events=events).run()
+    assert rep["ok"]
+    drained = [e for e in rep["completions"]
+               if e["origin"] == "zone-a"
+               and e["arrival_s"] < mid - 0.05]
+    after = [e for e in rep["completions"]
+             if e["origin"] == "zone-a"
+             and e["arrival_s"] >= mid + 0.1]
+    assert drained and all(e["serving_zone"] == "zone-b"
+                           for e in drained)
+    assert after and all(e["serving_zone"] == "zone-a"
+                         for e in after)
+
+
+# -- blast-radius chaos (the named scenarios) -------------------------
+
+
+def test_zone_loss_scenario_green():
+    rep = chaos.run_scenario("globe-zone-loss", seed=0)
+    assert rep["ok"], rep
+    assert rep["shed"] == 0 and rep["spilled"] >= 1
+    assert all(r <= 1.25
+               for r in rep["surviving_zone_p99_ratio"].values())
+
+
+def test_herd_failover_scenario_green():
+    rep = chaos.run_scenario("globe-herd-failover", seed=0)
+    assert rep["ok"], rep
+    assert rep["spill_bound_held"] and rep["readmitted"] >= 1
+    assert rep["cell_sheds"] == 0 and rep["frontdoor_sheds"] == 0
+
+
+def test_dcn_degrade_scenario_green():
+    rep = chaos.run_scenario("globe-dcn-degrade", seed=0)
+    assert rep["ok"], rep
+    assert rep["routed_around_degraded_link"]
+
+
+def test_globe_scenarios_registered_for_soak():
+    for name in ("globe-zone-loss", "globe-herd-failover",
+                 "globe-dcn-degrade"):
+        assert name in chaos.SCENARIOS
+        assert not chaos.SCENARIOS[name].slow
+
+
+def test_unknown_chaos_action_rejected():
+    with pytest.raises(ValueError, match="unknown globe chaos"):
+        globe.GlobeSim(_small_cfg(), seed=0, chaos_events=[
+            globe.GlobeChaosEvent(at_s=0.0, action="meteor",
+                                  target="zone-a")])
+
+
+# -- the global capacity planner --------------------------------------
+
+
+def test_planner_grants_to_pressure_and_conserves_budget():
+    """One zone bursts while the other idles: the spot budget flows
+    to the pressured cell (its autoscaler cap rises past the
+    reserved floor), never exceeds the budget, and the idle cell
+    gets nothing."""
+    cfg = _small_cfg(
+        zones=("zone-a", "zone-b"), replicas_per_cell=1,
+        autoscale=True,
+        sim=fleet.SimReplicaConfig(max_slots=4,
+                                   prefill_per_tok_s=0.004,
+                                   tpot_s=0.02),
+        autoscaler=fleet.AutoscalerConfig(min_replicas=1,
+                                          max_replicas=8,
+                                          up_backlog=2.0,
+                                          breach_evals=2,
+                                          cooldown_s=0.2,
+                                          warmup_s=0.2),
+        planner=globe.PlannerConfig(spot_budget=3,
+                                    eval_every_s=0.05))
+    # 20 simultaneous arrivals: heavy pressure on zone-a, yet under
+    # its hard limit — nothing spills, so zone-b stays truly idle
+    traces = {"zone-a": _burst_trace("zone-a", 20),
+              "zone-b": []}
+    sim = globe.GlobeSim(cfg, traces=traces, seed=0)
+    rep = sim.run()
+    assert rep["ok"]
+    planner = rep["planner"]
+    grants = [e for e in planner["events"]
+              if e["action"] == "grant"]
+    assert grants and all(e["cell"] == "zone-a/c0"
+                          for e in grants)
+    assert all(e["budget_left"] >= 0 for e in planner["events"])
+    assert planner["reserved"] == {"zone-a/c0": 1, "zone-b/c0": 1}
+    # the pressured cell actually scaled past its reserved floor
+    # (and back down once the burst drained); the idle cell never
+    # moved
+    assert (rep["cells"]["zone-a/c0"]["autoscaler"]["scale_ups"]
+            >= 1)
+    assert (rep["cells"]["zone-b/c0"]["autoscaler"]["scale_ups"]
+            == 0)
+    assert rep["cells"]["zone-b/c0"]["replicas"] == 1
+
+
+def test_planner_reclaims_after_the_peak():
+    """Follow-the-sun diurnal: each zone's peak earns grants that
+    are reclaimed once its evening comes — the budget ledger must
+    show both directions and never go negative."""
+    cfg = _small_cfg(
+        replicas_per_cell=1, autoscale=True,
+        autoscaler=fleet.AutoscalerConfig(min_replicas=1,
+                                          max_replicas=8,
+                                          warmup_s=0.2),
+        planner=globe.PlannerConfig(spot_budget=3),
+        workload=globe.GlobeWorkloadSpec(
+            process="diurnal", rps=60.0, n_per_zone=150))
+    rep = globe.GlobeSim(cfg, seed=7).run()
+    assert rep["ok"]
+    actions = [e["action"] for e in rep["planner"]["events"]]
+    assert "grant" in actions and "reclaim" in actions
+    assert all(e["budget_left"] >= 0
+               for e in rep["planner"]["events"])
+
+
+# -- multi-hour horizons (fast-forward makes them tractable) ----------
+
+
+def test_six_hour_diurnal_trace_save_replay_identical(tmp_path):
+    """A >= 6h simulated day of follow-the-sun diurnal traffic runs
+    in seconds (fast-forward), and replaying the saved trace
+    produces a byte-identical completion log."""
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=1,
+        tick_s=0.05, max_virtual_s=90000.0,
+        workload=globe.GlobeWorkloadSpec(
+            process="diurnal", rps=0.0066, n_per_zone=150,
+            diurnal_period_s=21600.0))
+    traces = globe.generate_globe_traces(cfg, 7)
+    span = max(r.arrival_s for reqs in traces.values()
+               for r in reqs)
+    assert span >= 6 * 3600, span
+    sim = globe.GlobeSim(cfg, traces=traces, seed=7)
+    rep = sim.run()
+    assert rep["ok"] and rep["virtual_s"] >= 6 * 3600
+    assert sim.ff_skipped > 100_000  # the gaps, actually skipped
+    path = tmp_path / "day.jsonl"
+    globe.save_globe_trace(str(path), traces)
+    replayed = globe.GlobeSim(
+        cfg, traces=globe.load_globe_trace(str(path)),
+        seed=7).run()
+    assert (json.dumps(rep["completions"], sort_keys=True)
+            == json.dumps(replayed["completions"], sort_keys=True))
+
+
+def test_fleet_fast_forward_scenario_suite_identical(monkeypatch):
+    """The satellite contract: the existing fleet scenario suite is
+    byte-identical with fast-forward on vs off."""
+    for scenario in ("fleet-flaky-replica", "sched-node-drain"):
+        monkeypatch.setenv(fleet.sim.FF_ENV, "0")
+        off = chaos.run_scenario(scenario, seed=3)
+        monkeypatch.setenv(fleet.sim.FF_ENV, "1")
+        on = chaos.run_scenario(scenario, seed=3)
+        assert (json.dumps(on, sort_keys=True, default=str)
+                == json.dumps(off, sort_keys=True, default=str)), \
+            scenario
+
+
+def test_fleet_fast_forward_engages_on_sparse_trace():
+    spec = fleet.WorkloadSpec(process="poisson", rps=2.0,
+                              n_requests=20)
+    trace = fleet.generate_trace(spec, 7)
+    on = fleet.FleetSim(
+        fleet.FleetConfig(replicas=2, fast_forward=True), trace)
+    off = fleet.FleetSim(
+        fleet.FleetConfig(replicas=2, fast_forward=False), trace)
+    a, b = on.run(), off.run()
+    assert json.dumps(a, sort_keys=True) == json.dumps(
+        b, sort_keys=True)
+    assert on.ff_skipped > 0 and off.ff_skipped == 0
+
+
+# -- the shared ICI/DCN tier cost model -------------------------------
+
+
+def test_tier_slowdown_ici_numbers_unchanged():
+    """The PR 5 ICI instance must be bit-equal through the shared
+    tier-parameterized implementation."""
+    for factor in (1.0, 0.5, 0.25, 0.1):
+        for frac in (0.0, 0.35, 1.0):
+            assert (collectives.ici_slowdown(factor, frac)
+                    == collectives.tier_slowdown(factor, frac,
+                                                 tier="ici")
+                    == 1.0 + frac * (1.0 / factor - 1.0))
+
+
+def test_dcn_tier_has_its_own_bandwidth_and_fraction():
+    ici = collectives.ring_allreduce_s(1 << 30, 8, tier="ici")
+    dcn = collectives.ring_allreduce_s(1 << 30, 8, tier="dcn")
+    assert dcn == pytest.approx(
+        ici * collectives.DEFAULT_ICI_GBPS
+        / collectives.DEFAULT_DCN_GBPS)
+    assert collectives.dcn_slowdown(1.0) == 1.0
+    assert collectives.dcn_slowdown(0.5) == pytest.approx(
+        1.0 + collectives.TIER_FRACTION["dcn"])
+    with pytest.raises(ValueError, match="unknown interconnect"):
+        collectives.ring_allreduce_s(1024, 8, tier="wan")
+    with pytest.raises(ValueError, match="unknown interconnect"):
+        collectives.tier_slowdown(0.5, tier="wan")
+
+
+# -- zone wiring through sched + kubeface -----------------------------
+
+
+def test_inventory_zone_filter_and_per_pod_zones():
+    from kind_tpu_sim import sched as sched_mod
+
+    inv = sched_mod.build_inventory([
+        ("tpu-v5-lite-podslice", "4x8", "zone-a"),
+        ("tpu-v5-lite-podslice", "4x8", "zone-b"),
+    ])
+    zones = {n.zone for n in inv.nodes.values()}
+    assert zones == {"zone-a", "zone-b"}
+    pinned = inv.candidate_placements(
+        accelerator="tpu-v5-lite-podslice", host_block=(1, 1),
+        chips_per_node=4, zone="zone-b")
+    assert pinned and all(
+        inv.nodes[p.node_names[0]].zone == "zone-b"
+        for p in pinned)
+    anywhere = inv.candidate_placements(
+        accelerator="tpu-v5-lite-podslice", host_block=(1, 1),
+        chips_per_node=4)
+    assert len(anywhere) == 2 * len(pinned)
+
+
+def test_zone_nodeselector_roundtrip():
+    from kind_tpu_sim import sched as sched_mod
+
+    req = sched_mod.SliceRequest(
+        name="pinned", accelerator="tpu-v5-lite-podslice",
+        topology="2x4", priority=10, zone="zone-b")
+    text = sched_mod.to_pod_manifest(req)
+    assert "topology.kubernetes.io/zone: zone-b" in text
+    [parsed] = sched_mod.slice_requests_from_yaml(text)
+    assert parsed == req
+
+
+def test_multizone_manifest_lints_and_spreads():
+    """pods/tpu-serving-multizone.yaml: lint-valid, parses to three
+    independent single-host gangs with no zone pin, and under the
+    `spread` policy on a three-zone inventory lands exactly one
+    replica per zone — the topologySpreadConstraints posture."""
+    from kind_tpu_sim import manifest_lint
+    from kind_tpu_sim import sched as sched_mod
+
+    with open("pods/tpu-serving-multizone.yaml",
+              encoding="utf-8") as fh:
+        text = fh.read()
+    assert manifest_lint.validate_yaml(text) == []
+    reqs = sched_mod.slice_requests_from_yaml(text)
+    assert len(reqs) == 3
+    assert all(r.priority == 10 and r.zone is None for r in reqs)
+    inv = sched_mod.build_inventory([
+        ("tpu-v5-lite-podslice", "4x8", "zone-a"),
+        ("tpu-v5-lite-podslice", "4x8", "zone-b"),
+        ("tpu-v5-lite-podslice", "4x8", "zone-c"),
+    ])
+    sched = sched_mod.ClusterScheduler(
+        inv, sched_mod.SchedConfig(policy="spread"))
+    for req in reqs:
+        sched.submit(req, 0.0)
+    bound = sched.step(0.0)
+    assert len(bound) == 3
+    landed = {inv.nodes[g.placement.node_names[0]].zone
+              for g in bound}
+    assert landed == {"zone-a", "zone-b", "zone-c"}
+    # the zone-pinned variant schedules only into its zone
+    pinned = sched_mod.SliceRequest(
+        name="pinned", accelerator="tpu-v5-lite-podslice",
+        topology="2x4", zone="zone-b")
+    sched.submit(pinned, 1.0)
+    [gang] = sched.step(1.0)
+    assert inv.nodes[gang.placement.node_names[0]].zone == "zone-b"
+
+
+def test_globe_cells_inventory_carries_their_zone():
+    cfg = _small_cfg(zones=("zone-a", "zone-b"))
+    sim = globe.GlobeSim(cfg, traces={"zone-a": [], "zone-b": []},
+                         seed=0)
+    for cell in sim.cells:
+        inv = cell.sim.sched.inv
+        assert {n.zone for n in inv.nodes.values()} == {cell.zone}
+
+
+# -- CLI ---------------------------------------------------------------
+
+
+def test_globe_cli_byte_identical_reports(capsys):
+    from kind_tpu_sim import cli
+
+    argv = ["globe", "run", "--seed", "7", "--requests", "40",
+            "--json"]
+    assert cli.main(argv) == 0
+    first = capsys.readouterr().out
+    assert cli.main(argv) == 0
+    second = capsys.readouterr().out
+    assert first == second
+    report = json.loads(first)
+    assert report["ok"] and report["seed"] == 7
+    assert report["requests"] == 120
+
+
+def test_globe_cli_trace_replay(tmp_path, capsys):
+    from kind_tpu_sim import cli
+
+    path = tmp_path / "g.jsonl"
+    assert cli.main(["globe", "trace", "--seed", "3", "--requests",
+                     "20", "--save-trace", str(path)]) == 0
+    capsys.readouterr()
+    argv = ["globe", "run", "--trace-file", str(path), "--json"]
+    assert cli.main(argv) == 0
+    replayed = json.loads(capsys.readouterr().out)
+    assert cli.main(["globe", "run", "--seed", "3", "--requests",
+                     "20", "--json"]) == 0
+    direct = json.loads(capsys.readouterr().out)
+    assert replayed["completions"] == direct["completions"]
