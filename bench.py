@@ -55,7 +55,9 @@ SECTION_S: dict = {}
 
 # Satellite knob: skip the accelerator model pass entirely (the probe
 # + child budget can dominate bench wall-clock on tunnel-less hosts).
-SKIP_MODEL_ENV = "KIND_TPU_SIM_SKIP_MODEL_BENCH"
+from kind_tpu_sim.analysis import knobs as _knobs  # noqa: E402
+
+SKIP_MODEL_ENV = _knobs.SKIP_MODEL_BENCH
 
 import contextlib
 
@@ -2473,6 +2475,40 @@ def globe_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def analysis_smoke() -> dict | None:
+    """Determinism-tooling extras: detlint wall time over the whole
+    package with per-rule finding/waiver counts (tool cost and waiver
+    growth are tracked bench-to-bench), plus one replay-bisector run
+    of the fleet target — the contract check itself, timed."""
+    try:
+        from kind_tpu_sim.analysis import detlint, knobs, replaycheck
+
+        pkg = str(REPO / "kind_tpu_sim")
+        t0 = time.monotonic()
+        findings = detlint.lint_paths([pkg])
+        lint_s = round(time.monotonic() - t0, 3)
+        rep = detlint.report(
+            findings, files=len(detlint.iter_py_files([pkg])))
+        t1 = time.monotonic()
+        replay = replaycheck.replay("fleet-run", seed=7)
+        replay_s = round(time.monotonic() - t1, 3)
+        return {
+            "ok": bool(rep["ok"] and replay["ok"]),
+            "detlint_seconds": lint_s,
+            "files": rep["files"],
+            "findings": len(rep["findings"]),
+            "findings_by_rule": rep["findings_by_rule"],
+            "waivers": rep["waived"],
+            "waivers_by_rule": rep["waived_by_rule"],
+            "knobs_registered": len(knobs.REGISTRY),
+            "replay_seconds": replay_s,
+            "replay_events": replay["events"],
+            "replay_ok": replay["ok"],
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def multihost_smoke() -> dict | None:
     """DCN-tier proof: a 2-host simulated slice (one process per host,
     gloo collectives over loopback) comes up and passes cross-host
@@ -2499,8 +2535,8 @@ def capture_model_section(phases: dict) -> None:
     """Probe (bounded), then run the model pass via the streaming
     child. Fills phases['model'] with whatever was measured — or an
     explicit skip marker when the operator opted out."""
-    skip = os.environ.get(SKIP_MODEL_ENV)
-    if skip:
+    skip = _knobs.get_raw(SKIP_MODEL_ENV)
+    if skip and _knobs.get(SKIP_MODEL_ENV):
         phases["model"] = {
             "skipped": f"{SKIP_MODEL_ENV}={skip} (operator opt-out)"}
         return
@@ -2648,6 +2684,10 @@ def main(argv=None) -> int:
             globe_rep = globe_smoke()
         if globe_rep:
             phases["globe"] = globe_rep
+        with stopwatch("analysis"):
+            analysis_rep = analysis_smoke()
+        if analysis_rep:
+            phases["analysis"] = analysis_rep
     finally:
         if pool is not None:
             pool.close()

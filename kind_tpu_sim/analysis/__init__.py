@@ -1,0 +1,44 @@
+"""Determinism tooling — the machine-checked replay contract.
+
+Every simulator layer (chaos -> fleet -> sched -> health -> globe)
+stakes its correctness on one invariant: *same seed => byte-identical
+event log and report*. This package is the tooling that defends it:
+
+* :mod:`~kind_tpu_sim.analysis.knobs` — the registry every
+  ``KIND_TPU_SIM_*`` env read goes through; ``docs/KNOBS.md`` is
+  generated from it, so no knob ships undocumented.
+* :mod:`~kind_tpu_sim.analysis.detlint` — an AST-based static checker
+  that flags determinism hazards (wall-clock reads, unseeded entropy,
+  unordered iteration, unsorted JSON, import-time env reads,
+  unregistered knobs) with per-line ``detlint: ok(rule) -- reason``
+  comment waivers.
+* :mod:`~kind_tpu_sim.analysis.replaycheck` — a runtime sanitizer that
+  runs a scenario twice under the same seed, hashes the event stream
+  incrementally, and bisects a mismatch to the first divergent event.
+
+CLI: ``kind-tpu-sim analysis lint|knobs|replay`` (docs: README
+"The determinism contract", docs/ARCHITECTURE.md).
+
+``knobs`` is imported eagerly (the low-level layers need it);
+``detlint``/``replaycheck`` load lazily so the hot runtime import path
+doesn't pay for the tooling.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from kind_tpu_sim.analysis import knobs  # noqa: F401  (eager: low-level dep)
+
+_LAZY = ("detlint", "replaycheck")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        return importlib.import_module(f"kind_tpu_sim.analysis.{name}")
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

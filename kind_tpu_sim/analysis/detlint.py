@@ -1,0 +1,496 @@
+"""detlint — the determinism sanitizer (static AST pass).
+
+Every simulator layer promises *same seed => byte-identical report*,
+and every hazard that has ever broken that promise in an LLM-serving
+or cluster simulator is a one-liner: a stray ``time.time()``, an
+unseeded ``random.choice``, iteration over a ``set``, a ``json.dumps``
+without ``sort_keys``, an env read at import time. Example-based
+replay tests only catch the code paths they happen to cross; detlint
+walks the AST of the whole package and flags the hazard *class*:
+
+=================  ===================================================
+``wallclock``      ``time.time/monotonic/perf_counter`` /
+                   ``datetime.now`` outside the blessed measurement
+                   allowlist — virtual-clock code must never read the
+                   wall (``VirtualClock`` is the sanctioned clock).
+``entropy``        unseeded entropy: module-level ``random.*`` /
+                   ``np.random.*`` calls, no-arg ``random.Random()``
+                   / ``RandomState()`` / ``default_rng()``,
+                   ``uuid.uuid4``, ``os.urandom``, ``secrets.*``.
+                   (``jax.random`` is key-seeded and exempt.)
+``set-iter``       ordered consumption of an unordered collection:
+                   a set (literal, ``set()``, set ops) iterated /
+                   listed / joined / summed without ``sorted(...)``.
+``fs-order``       ``os.listdir`` / ``glob`` / ``Path.iterdir`` fed
+                   to iteration without ``sorted(...)`` — filesystem
+                   order is platform noise.
+``json-sort``      ``json.dumps``/``json.dump`` without
+                   ``sort_keys=True`` — unsorted keys are the classic
+                   byte-identity breaker.
+``env-import``     environment reads at import time (module or class
+                   scope): config frozen at import order, invisible
+                   to replays.
+``knob-env``       a ``KIND_TPU_SIM_*`` env var read directly instead
+                   of through :mod:`~kind_tpu_sim.analysis.knobs`.
+``unknown-knob``   a ``KIND_TPU_SIM_*`` token (code, help text, or
+                   docstring) that the knob registry doesn't know —
+                   the undocumented-knob guard.
+``waiver``         a malformed waiver: missing reason, unknown rule
+                   name, or a waiver that matches no finding.
+=================  ===================================================
+
+Waivers are per-line and must carry a reason::
+
+    t0 = time.monotonic()  [hash]detlint: ok(wallclock) -- real-time bench
+
+(with ``#`` for ``[hash]``; the comment may also sit alone on the
+line directly above). A reasonless waiver is itself a finding — the
+contract is *fix or justify*, never silence.
+
+Run it: ``kind-tpu-sim analysis lint kind_tpu_sim`` (wired into
+pre-commit and CI); the JSON output is sorted-keys and byte-identical
+across runs, like every other subcommand.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from kind_tpu_sim.analysis import knobs
+
+RULES = (
+    "wallclock", "entropy", "set-iter", "fs-order", "json-sort",
+    "env-import", "knob-env", "unknown-knob", "waiver",
+)
+
+# Files where wall-clock reads are the *point* — the real-time
+# measurement layers whose outputs are wall timings by design and
+# never feed a seeded report. Everything else justifies each read
+# with a per-line waiver.
+WALLCLOCK_ALLOW = (
+    "kind_tpu_sim/profiling.py",        # the stopwatch layer
+    "kind_tpu_sim/utils/worker_pool.py",  # subprocess IO deadlines
+)
+
+# The registry module declares knob names as literals; exempt it from
+# the knob rules it implements.
+KNOBS_MODULE = "kind_tpu_sim/analysis/knobs.py"
+
+_TIME_FNS = frozenset((
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "process_time", "process_time_ns",
+))
+_DATETIME_NAMES = frozenset(("datetime", "date", "_datetime"))
+_DATETIME_FNS = frozenset(("now", "utcnow", "today"))
+
+_RANDOM_MODULE_FNS = frozenset((
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "lognormvariate",
+    "expovariate", "betavariate", "gammavariate", "paretovariate",
+    "vonmisesvariate", "weibullvariate", "triangular", "getrandbits",
+    "randbytes", "seed",
+))
+_NP_RANDOM_FNS = frozenset((
+    "rand", "randn", "randint", "random", "random_sample", "ranf",
+    "choice", "shuffle", "permutation", "uniform", "normal",
+    "standard_normal", "exponential", "poisson", "seed", "sample",
+    "bytes",
+))
+_NP_NAMES = frozenset(("np", "numpy", "jnp"))
+_SET_OP_METHODS = frozenset((
+    "union", "intersection", "difference", "symmetric_difference",
+))
+_FS_CALLS = {
+    ("os", "listdir"), ("os", "scandir"), ("os", "walk"),
+    ("glob", "glob"), ("glob", "iglob"),
+}
+_FS_PATH_METHODS = frozenset(("iterdir", "glob", "rglob"))
+# iteration sinks where source order becomes output order
+_ORDER_SINK_NAMES = frozenset(("list", "tuple", "sum", "enumerate"))
+
+_KNOB_TOKEN = re.compile(r"KIND_TPU_SIM_[A-Z0-9_]+")
+_WAIVER = re.compile(
+    r"#\s*detlint:\s*ok\(([^)]*)\)(?:\s*--\s*(\S.*\S|\S))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}")
+
+
+@dataclasses.dataclass
+class _Waiver:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, norm: str):
+        self.path = path
+        self.norm = norm            # posix-normalized, for allowlists
+        self.out: List[Finding] = []
+        self._func_depth = 0
+        self.is_knobs = norm.endswith(KNOBS_MODULE)
+
+    # -- helpers ------------------------------------------------------
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        self.out.append(Finding(
+            self.path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), rule, message))
+
+    def _allow_wallclock(self) -> bool:
+        return any(self.norm.endswith(a) for a in WALLCLOCK_ALLOW)
+
+    # -- scope tracking (env-import) ----------------------------------
+
+    def visit_FunctionDef(self, node):      # noqa: N802
+        self._func_depth += 1
+        self.generic_visit(node)
+        self._func_depth -= 1
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        self.visit_FunctionDef(node)
+
+    def visit_Lambda(self, node):           # noqa: N802
+        self._func_depth += 1
+        self.generic_visit(node)
+        self._func_depth -= 1
+
+    # -- expression rules ---------------------------------------------
+
+    def visit_Attribute(self, node):        # noqa: N802
+        dotted = _dotted(node)
+        if dotted and not self._allow_wallclock():
+            base, _, attr = dotted.rpartition(".")
+            if base in ("time", "_time") and attr in _TIME_FNS:
+                self._emit(node, "wallclock",
+                           f"wall-clock read {dotted}() — virtual-"
+                           "clock code must take a clock parameter "
+                           "(VirtualClock) or be allowlisted")
+            elif (attr in _DATETIME_FNS
+                  and base.rpartition(".")[2] in _DATETIME_NAMES):
+                self._emit(node, "wallclock",
+                           f"wall-clock read {dotted}()")
+        if dotted == "os.environ" and self._func_depth == 0:
+            self._emit(node, "env-import",
+                       "os.environ read at import time — resolve "
+                       "inside a function (or through analysis.knobs "
+                       "at call time)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):             # noqa: N802
+        dotted = _dotted(node.func) or ""
+        base, _, attr = dotted.rpartition(".")
+
+        # entropy ------------------------------------------------------
+        if base == "random" and attr in _RANDOM_MODULE_FNS:
+            self._emit(node, "entropy",
+                       f"unseeded module-level {dotted}() — use a "
+                       "seeded random.Random instance")
+        elif dotted in ("random.Random", "numpy.random.RandomState",
+                        "np.random.RandomState",
+                        "numpy.random.default_rng",
+                        "np.random.default_rng") \
+                and not node.args and not node.keywords:
+            self._emit(node, "entropy",
+                       f"{dotted}() without a seed draws OS entropy")
+        elif dotted in ("random.SystemRandom", "os.urandom",
+                        "uuid.uuid4") or base == "secrets":
+            self._emit(node, "entropy",
+                       f"{dotted}() is inherently nondeterministic")
+        elif (base.rpartition(".")[0] in _NP_NAMES
+              and base.rpartition(".")[2] == "random"
+              and attr in _NP_RANDOM_FNS):
+            self._emit(node, "entropy",
+                       f"unseeded module-level {dotted}() — use a "
+                       "seeded Generator/RandomState")
+
+        # json-sort ----------------------------------------------------
+        if base in ("json", "_json") and attr in ("dumps", "dump"):
+            has_dynamic = any(kw.arg is None for kw in node.keywords)
+            sorted_kw = any(
+                kw.arg == "sort_keys"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords)
+            if not sorted_kw and not has_dynamic:
+                self._emit(node, "json-sort",
+                           f"{dotted}() without sort_keys=True — "
+                           "unsorted keys break byte-identity")
+
+        # env reads ----------------------------------------------------
+        if dotted in ("os.getenv", "os.environ.get"):
+            if self._func_depth == 0:
+                self._emit(node, "env-import",
+                           "environment read at import time")
+            self._check_knob_read(node)
+        elif attr == "get" and node.args:
+            # env.get("KIND_TPU_SIM_*") through any alias
+            self._check_knob_read(node)
+
+        # order sinks over unordered sources ---------------------------
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in _ORDER_SINK_NAMES and node.args:
+            self._check_order(node.args[0],
+                              f"{node.func.id}(...)")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join" and node.args:
+            # catches '","'.join(...) too (dotted name is None for a
+            # str-literal receiver)
+            self._check_order(node.args[0], "str.join(...)")
+
+        self.generic_visit(node)
+
+    def _check_knob_read(self, node: ast.Call) -> None:
+        if self.is_knobs or not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and arg.value.startswith(knobs.PREFIX):
+            self._emit(node, "knob-env",
+                       f"direct env read of {arg.value} — go through "
+                       "kind_tpu_sim.analysis.knobs.get()")
+
+    def visit_Subscript(self, node):        # noqa: N802
+        if _dotted(node.value) == "os.environ":
+            if self._func_depth == 0:
+                self._emit(node, "env-import",
+                           "os.environ read at import time")
+            key = node.slice
+            if isinstance(key, ast.Constant) \
+                    and isinstance(key.value, str) \
+                    and key.value.startswith(knobs.PREFIX) \
+                    and not self.is_knobs:
+                self._emit(node, "knob-env",
+                           f"direct env read of {key.value} — go "
+                           "through analysis.knobs.get()")
+        self.generic_visit(node)
+
+    # -- iteration order ----------------------------------------------
+
+    def _is_unordered(self, node: ast.AST) -> Tuple[bool, str]:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True, "a set"
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func) or ""
+            if d in ("set", "frozenset"):
+                return True, f"{d}(...)"
+            _, _, attr = d.rpartition(".")
+            if attr in _SET_OP_METHODS:
+                return True, f".{attr}(...)"
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _FS_PATH_METHODS:
+                return True, f".{node.func.attr}()"
+            b, _, a = d.rpartition(".")
+            if (b, a) in _FS_CALLS:
+                return True, f"{d}()"
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.BitXor)):
+            for side in (node.left, node.right):
+                hit, what = self._is_unordered(side)
+                if hit:
+                    return True, what
+        return False, ""
+
+    def _check_order(self, source: ast.AST, sink: str) -> None:
+        hit, what = self._is_unordered(source)
+        if not hit:
+            return
+        rule = ("fs-order" if "dir" in what or "glob" in what
+                or "walk" in what else "set-iter")
+        self._emit(source, rule,
+                   f"{sink} consumes {what} without sorted(...) — "
+                   "unordered iteration reaching output breaks "
+                   "byte-identity")
+
+    def visit_For(self, node):              # noqa: N802
+        self._check_order(node.iter, "for-loop")
+        self.generic_visit(node)
+
+    def _visit_comp(self, node):
+        for gen in node.generators:
+            self._check_order(gen.iter, "comprehension")
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp            # noqa: N815
+    visit_DictComp = _visit_comp            # noqa: N815
+    visit_GeneratorExp = _visit_comp        # noqa: N815
+
+    def visit_SetComp(self, node):          # noqa: N802
+        # building a set is order-free; only its consumption matters
+        self.generic_visit(node)
+
+    # -- knob tokens in strings ---------------------------------------
+
+    def visit_Constant(self, node):         # noqa: N802
+        if isinstance(node.value, str) and not self.is_knobs:
+            for match in _KNOB_TOKEN.finditer(node.value):
+                token = match.group(0)
+                if knobs.is_registered(token):
+                    continue
+                if token.endswith("_") and any(
+                        name.startswith(token)
+                        for name in knobs.REGISTRY):
+                    continue  # prefix reference, e.g. FOO_* in docs
+                self._emit(node, "unknown-knob",
+                           f"{token} is not in the knob registry "
+                           "(kind_tpu_sim/analysis/knobs.py) — "
+                           "register it or fix the name")
+        self.generic_visit(node)
+
+
+def _parse_waivers(source: str) -> Tuple[Dict[int, _Waiver],
+                                         List[Finding]]:
+    """Line -> waiver, plus findings for malformed waivers. A waiver
+    on a comment-only line covers the next line instead."""
+    waivers: Dict[int, _Waiver] = {}
+    bad: List[Finding] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _WAIVER.search(text)
+        if not m:
+            continue
+        rules = tuple(sorted(
+            r.strip() for r in m.group(1).split(",") if r.strip()))
+        reason = (m.group(2) or "").strip()
+        target = (lineno + 1
+                  if text.lstrip().startswith("#") else lineno)
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            bad.append(Finding(
+                "", lineno, m.start(), "waiver",
+                f"waiver names unknown rule(s) "
+                f"{', '.join(unknown)}"))
+        if not reason:
+            bad.append(Finding(
+                "", lineno, m.start(), "waiver",
+                "waiver without a reason — append "
+                "'-- <why this is safe>'"))
+        waivers[target] = _Waiver(lineno, rules, reason)
+    return waivers, bad
+
+
+def lint_source(source: str, path: str = "<string>"
+                ) -> List[Finding]:
+    """All findings (waived ones included, marked) for one module."""
+    norm = path.replace("\\", "/")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 1, exc.offset or 0,
+                        "syntax", f"syntax error: {exc.msg}")]
+    visitor = _Visitor(path, norm)
+    visitor.visit(tree)
+    # a module-level os.environ.get() trips both the Call and the
+    # inner Attribute check — one finding per (line, rule) is enough
+    seen = set()
+    raw: List[Finding] = []
+    for f in visitor.out:
+        key = (f.line, f.col, f.rule)
+        if key in seen:
+            continue
+        dup = (f.line, f.rule)
+        if f.rule == "env-import" and dup in seen:
+            continue
+        seen.add(key)
+        seen.add(dup if f.rule == "env-import" else key)
+        raw.append(f)
+    waivers, bad = _parse_waivers(source)
+    out: List[Finding] = []
+    for f in raw:
+        w = waivers.get(f.line)
+        if w is not None and (f.rule in w.rules):
+            w.used = True
+            out.append(dataclasses.replace(
+                f, waived=bool(w.reason),
+                waiver_reason=w.reason))
+        else:
+            out.append(f)
+    for f in bad:
+        out.append(dataclasses.replace(f, path=path))
+    for w in waivers.values():
+        if not w.used:
+            out.append(Finding(
+                path, w.line, 0, "waiver",
+                "waiver matches no finding on its line — stale "
+                "waivers hide future regressions; delete it"))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    import pathlib
+
+    files: List[str] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(
+                str(f) for f in sorted(path.rglob("*.py"))
+                if "__pycache__" not in f.parts)
+        elif path.suffix == ".py":
+            files.append(str(path))
+    return sorted(set(files))
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for fname in iter_py_files(paths):
+        with open(fname, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), fname))
+    return findings
+
+
+def report(findings: Iterable[Finding],
+           files: Optional[int] = None) -> dict:
+    """JSON-able summary: unwaived findings are the failures; waived
+    ones are counted (bench tracks waiver growth)."""
+    unwaived = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+    by_rule: Dict[str, int] = {}
+    for f in unwaived:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    waived_by_rule: Dict[str, int] = {}
+    for f in waived:
+        waived_by_rule[f.rule] = waived_by_rule.get(f.rule, 0) + 1
+    out = {
+        "findings": [f.as_dict() for f in unwaived],
+        "findings_by_rule": by_rule,
+        "waived": len(waived),
+        "waived_by_rule": waived_by_rule,
+        "rules": list(RULES),
+        "ok": not unwaived,
+    }
+    if files is not None:
+        out["files"] = files
+    return out

@@ -1,0 +1,301 @@
+"""The knob registry — every ``KIND_TPU_SIM_*`` env var, in one place.
+
+The simulator's determinism contract (docs/ARCHITECTURE.md) makes the
+report a pure function of *(config, seed)* — and env knobs are config.
+A knob read scattered as a bare ``os.environ.get("KIND_TPU_SIM_...")``
+is config the replay can't see: it ships undocumented, its parse
+semantics drift per call site, and a typo'd name fails silently to its
+default. So every ``KIND_TPU_SIM_*`` read goes through this registry:
+
+* :data:`REGISTRY` declares each knob once — name, typed default,
+  owning layer, one-line doc. ``docs/KNOBS.md`` is *generated* from it
+  (:func:`render_markdown`), so no knob can ship undocumented, and the
+  generated page is CI-checked against the registry
+  (``kind-tpu-sim analysis knobs --check-docs``).
+* :func:`get` / :func:`get_raw` are the only sanctioned read paths;
+  **detlint** (analysis/detlint.py, rule ``knob-env``) flags any direct
+  ``os.environ`` read of a ``KIND_TPU_SIM_*`` key elsewhere, and rule
+  ``unknown-knob`` flags any ``KIND_TPU_SIM_*`` token — in code, help
+  text, or docs — that this registry doesn't know.
+
+Parse semantics are uniform and forgiving, matching the historical
+per-module ``resolve_*`` helpers: a missing or unparseable value falls
+back to the default (a typo'd knob must degrade, never crash a run),
+and booleans treat ``"" / "0" / "false" / "no"`` (case-insensitive) as
+false, anything else as true.
+
+This module is imported by the lowest layers (utils/shell.py, the
+worker-pool child) — it must stay stdlib-only and import nothing from
+``kind_tpu_sim``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, List, Optional
+
+PREFIX = "KIND_TPU_SIM_"
+
+# Values a bool knob reads as OFF; everything else non-missing is ON.
+FALSE_VALUES = ("", "0", "false", "no")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One registered env knob. ``default`` is already typed (or None
+    for no-default knobs like CACHE_DIR); ``kind`` drives parsing."""
+
+    name: str
+    default: object
+    kind: str          # "int" | "float" | "bool" | "str"
+    layer: str         # owning subsystem (docs/KNOBS.md grouping)
+    description: str
+
+    def parse(self, raw: Optional[str]) -> object:
+        """Typed value of ``raw``; missing/unparseable -> default."""
+        if raw is None:
+            return self.default
+        if self.kind == "bool":
+            return raw.lower() not in FALSE_VALUES
+        if self.kind == "int":
+            try:
+                return int(raw)
+            except ValueError:
+                return self.default
+        if self.kind == "float":
+            try:
+                return float(raw)
+            except ValueError:
+                return self.default
+        return raw
+
+
+REGISTRY: Dict[str, Knob] = {}
+
+
+def _register(name: str, default: object, kind: str, layer: str,
+              description: str) -> str:
+    if not name.startswith(PREFIX):
+        raise ValueError(f"knob {name!r} lacks the {PREFIX} prefix")
+    if name in REGISTRY:
+        raise ValueError(f"knob {name!r} registered twice")
+    REGISTRY[name] = Knob(name, default, kind, layer, description)
+    return name
+
+
+# -- the declarations (docs/KNOBS.md is generated from this table) ----
+
+# runtime / warm path (docs/PERFORMANCE.md)
+CACHE_DIR = _register(
+    "KIND_TPU_SIM_CACHE_DIR", None, "str", "runtime",
+    "Persistent XLA compilation-cache directory "
+    "(default: `<repo>/.cache/jax`).")
+NO_COMPILATION_CACHE = _register(
+    "KIND_TPU_SIM_NO_COMPILATION_CACHE", False, "bool", "runtime",
+    "Disable the persistent XLA compilation cache entirely.")
+POOL_WARM = _register(
+    "KIND_TPU_SIM_POOL_WARM", False, "bool", "runtime",
+    "Worker-pool child warms up (jax import + backend init + psum "
+    "compile) before answering; set by `WorkerPool(warm=True)`.")
+MAX_RETRIES = _register(
+    "KIND_TPU_SIM_MAX_RETRIES", 3, "int", "runtime",
+    "Retries for transiently-failing external commands "
+    "(classified retry, docs/CHAOS.md).")
+RETRY_BASE_MS = _register(
+    "KIND_TPU_SIM_RETRY_BASE_MS", 50.0, "float", "runtime",
+    "Base backoff in ms; doubles per retry with full jitter on top.")
+CMD_TIMEOUT_S = _register(
+    "KIND_TPU_SIM_CMD_TIMEOUT_S", None, "float", "runtime",
+    "Per-command wall deadline in seconds (unset: no cap); an "
+    "overrunning command is killed and classified transient.")
+
+# parallel / kernels
+RING_DOUBLE_BUFFER = _register(
+    "KIND_TPU_SIM_RING_DOUBLE_BUFFER", True, "bool", "parallel",
+    "Double-buffered ring-attention K/V rotation; `0` restores the "
+    "serial rotate-then-compute ordering.")
+
+# chaos (docs/CHAOS.md)
+CHAOS_SEED = _register(
+    "KIND_TPU_SIM_CHAOS_SEED", 0, "int", "chaos",
+    "Fault-plan seed: the same seed replays the identical fault "
+    "schedule (also pins retry-backoff jitter).")
+CHAOS_FAULT = _register(
+    "KIND_TPU_SIM_CHAOS_FAULT", None, "str", "chaos",
+    "Injected worker fault spec: `crash@N`, `hang@N:S`, `slow@N:S`, "
+    "or `flaky@K:S` (docs/CHAOS.md, docs/HEALTH.md).")
+
+# fleet (docs/FLEET.md)
+FLEET_SEED = _register(
+    "KIND_TPU_SIM_FLEET_SEED", 0, "int", "fleet",
+    "Workload seed for the fleet simulator: same seed, "
+    "byte-identical report.")
+FLEET_TICK_S = _register(
+    "KIND_TPU_SIM_FLEET_TICK_S", 0.01, "float", "fleet",
+    "Virtual scheduling quantum in seconds.")
+FLEET_FF = _register(
+    "KIND_TPU_SIM_FLEET_FF", True, "bool", "fleet",
+    "Idle-gap fast-forward (replay-identical); `0` forces the plain "
+    "per-tick loop.")
+FLEET_WARMUP_S = _register(
+    "KIND_TPU_SIM_FLEET_WARMUP_S", 0.55, "float", "fleet",
+    "Modeled replica warm-up in virtual seconds (default: the "
+    "measured warm bring-up, docs/PERFORMANCE.md).")
+
+# sched (docs/SCHED.md)
+SCHED_SEED = _register(
+    "KIND_TPU_SIM_SCHED_SEED", 0, "int", "sched",
+    "Workload seed for the scheduler simulator: same seed, "
+    "byte-identical event log.")
+
+# globe (docs/GLOBE.md)
+GLOBE_SEED = _register(
+    "KIND_TPU_SIM_GLOBE_SEED", 0, "int", "globe",
+    "Workload seed for the fleet-of-fleets simulator; per-zone "
+    "traces derive sub-seeds from it.")
+
+# health / gray-failure detection (docs/HEALTH.md)
+HEALTH_ALPHA = _register(
+    "KIND_TPU_SIM_HEALTH_ALPHA", 0.25, "float", "health",
+    "EWMA weight of the detector's global latency baseline.")
+HEALTH_SUSPECT_PHI = _register(
+    "KIND_TPU_SIM_HEALTH_SUSPECT_PHI", 2.0, "float", "health",
+    "Phi-accrual suspicion level marking a component suspect.")
+HEALTH_QUARANTINE_PHI = _register(
+    "KIND_TPU_SIM_HEALTH_QUARANTINE_PHI", 8.0, "float", "health",
+    "Phi level a suspicious sample must reach to count toward "
+    "quarantine.")
+HEALTH_QUARANTINE_EVALS = _register(
+    "KIND_TPU_SIM_HEALTH_QUARANTINE_EVALS", 3, "int", "health",
+    "Consecutive suspicious samples escalating suspect -> "
+    "quarantined (no-flap hysteresis).")
+HEALTH_PROBE_OK = _register(
+    "KIND_TPU_SIM_HEALTH_PROBE_OK", 2, "int", "health",
+    "Clean probes required to lift a quarantine.")
+HEALTH_PROBE_INTERVAL_S = _register(
+    "KIND_TPU_SIM_HEALTH_PROBE_INTERVAL_S", 0.25, "float", "health",
+    "Seconds between probes of a quarantined component.")
+HEALTH_MIN_SAMPLES = _register(
+    "KIND_TPU_SIM_HEALTH_MIN_SAMPLES", 4, "int", "health",
+    "Baseline samples required before suspicion can fire.")
+HEALTH_SIGMA_FRAC = _register(
+    "KIND_TPU_SIM_HEALTH_SIGMA_FRAC", 0.1, "float", "health",
+    "Sigma floor as a fraction of the baseline mean (keeps jitter "
+    "on a near-constant baseline from reading as suspicion).")
+HEALTH_SIGMA_ABS = _register(
+    "KIND_TPU_SIM_HEALTH_SIGMA_ABS", 1e-4, "float", "health",
+    "Absolute sigma floor in seconds.")
+HEALTH_PROBE_TIMEOUT_S = _register(
+    "KIND_TPU_SIM_HEALTH_PROBE_TIMEOUT_S", 2.0, "float", "health",
+    "Worker-grid probe deadline; a slower probe is a failed probe.")
+HEALTH_SPEC_RATIO = _register(
+    "KIND_TPU_SIM_HEALTH_SPEC_RATIO", 3.0, "float", "health",
+    "In-flight cell age (x expected service time) that triggers "
+    "speculative re-dispatch.")
+
+# bench
+SKIP_MODEL_BENCH = _register(
+    "KIND_TPU_SIM_SKIP_MODEL_BENCH", False, "bool", "bench",
+    "Skip the accelerator model pass in bench.py (operator opt-out "
+    "on tunnel-less hosts).")
+
+# Display order of layers in docs/KNOBS.md — pipeline order, not
+# alphabetical, so the page reads like the architecture diagram.
+LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "sched",
+               "globe", "health", "bench")
+
+# Layer -> its doc page (links are relative to docs/, where the
+# generated KNOBS.md lives).
+LAYER_DOCS = {
+    "runtime": "PERFORMANCE.md",
+    "parallel": "PERFORMANCE.md",
+    "chaos": "CHAOS.md",
+    "fleet": "FLEET.md",
+    "sched": "SCHED.md",
+    "globe": "GLOBE.md",
+    "health": "HEALTH.md",
+    "bench": "PERFORMANCE.md",
+}
+
+
+# -- the sanctioned read paths ----------------------------------------
+
+
+def get_raw(name: str,
+            environ: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """The raw env string of a REGISTERED knob (None when unset).
+    Reading an unregistered name is a programming error — the exact
+    silent-typo failure the registry exists to make loud."""
+    if name not in REGISTRY:
+        raise KeyError(f"unregistered knob {name!r}; declare it in "
+                       "kind_tpu_sim/analysis/knobs.py")
+    env = os.environ if environ is None else environ
+    return env.get(name)
+
+
+def get(name: str, environ: Optional[Dict[str, str]] = None) -> object:
+    """Resolved, typed value of a registered knob: env > default,
+    with unparseable values falling back to the default."""
+    return REGISTRY[name].parse(get_raw(name, environ))
+
+
+def resolve_all(environ: Optional[Dict[str, str]] = None
+                ) -> Dict[str, object]:
+    """Every knob's resolved value, by name — the `analysis knobs`
+    CLI surface."""
+    return {name: get(name, environ) for name in sorted(REGISTRY)}
+
+
+def is_registered(name: str) -> bool:
+    return name in REGISTRY
+
+
+# -- docs generation (docs/KNOBS.md) ----------------------------------
+
+
+def _fmt_default(knob: Knob) -> str:
+    if knob.default is None:
+        return "*(unset)*"
+    if knob.kind == "bool":
+        return "on" if knob.default else "off"
+    return f"`{knob.default}`"
+
+
+def render_markdown() -> str:
+    """The full, deterministic text of docs/KNOBS.md. Regenerate with
+    `kind-tpu-sim analysis knobs --write-docs`; CI verifies the file
+    matches byte-for-byte (`--check-docs`)."""
+    lines: List[str] = [
+        "# Env knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. -->",
+        "<!-- Source of truth: kind_tpu_sim/analysis/knobs.py. -->",
+        "<!-- Regenerate: python -m kind_tpu_sim analysis knobs"
+        " --write-docs -->",
+        "",
+        "Every `KIND_TPU_SIM_*` environment variable the simulator",
+        "reads, generated from the machine-readable registry",
+        "(`kind_tpu_sim/analysis/knobs.py`). Knob reads anywhere else",
+        "in the tree are rejected by the determinism sanitizer",
+        "(`kind-tpu-sim analysis lint`, rule `knob-env`), so this page",
+        "is complete by construction. Booleans read `` ``/`0`/`false`/",
+        "`no` as off and anything else as on; an unparseable value",
+        "falls back to the default.",
+        "",
+    ]
+    for layer in LAYER_ORDER:
+        knobs = sorted((k for k in REGISTRY.values()
+                        if k.layer == layer), key=lambda k: k.name)
+        if not knobs:
+            continue
+        lines.append(f"## {layer} — see [{LAYER_DOCS[layer]}]"
+                     f"({LAYER_DOCS[layer]})")
+        lines.append("")
+        lines.append("| Knob | Default | Purpose |")
+        lines.append("| --- | --- | --- |")
+        for knob in knobs:
+            lines.append(f"| `{knob.name}` | {_fmt_default(knob)} "
+                         f"| {knob.description} |")
+        lines.append("")
+    return "\n".join(lines)

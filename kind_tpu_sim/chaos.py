@@ -38,6 +38,7 @@ import zlib
 from typing import Callable, Dict, List, Optional, Sequence
 
 from kind_tpu_sim import manifests, metrics
+from kind_tpu_sim.analysis import knobs
 from kind_tpu_sim.cluster import ClusterManager
 from kind_tpu_sim.config import SimConfig
 from kind_tpu_sim.runtime import ContainerRuntime
@@ -50,7 +51,7 @@ from kind_tpu_sim.utils.shell import (
 
 log = logging.getLogger("kind-tpu-sim")
 
-CHAOS_SEED_ENV = "KIND_TPU_SIM_CHAOS_SEED"
+CHAOS_SEED_ENV = knobs.CHAOS_SEED
 
 # The fault vocabulary. Each kind maps onto the layer that recovers
 # from it (docs/CHAOS.md has the full matrix).
@@ -86,10 +87,7 @@ def resolve_seed(seed: Optional[int] = None) -> int:
     """Explicit seed > env (KIND_TPU_SIM_CHAOS_SEED) > 0."""
     if seed is not None:
         return int(seed)
-    try:
-        return int(os.environ.get(CHAOS_SEED_ENV, "0"))
-    except ValueError:
-        return 0
+    return int(knobs.get(CHAOS_SEED_ENV))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -451,14 +449,16 @@ def _scenario_node_flap(seed: int) -> dict:
             mgr.start_node(node)
             metrics.recovery_log().record("node_restart", node=node)
     # recovery invariant: every killed node is restarted before the
-    # scenario ends, whatever order the plan drew
-    for node in set(killed):
+    # scenario ends, whatever order the plan drew. sorted(): set
+    # order is hash-seed noise, and these restarts drive the
+    # recorded command stream scenarios assert on byte-for-byte.
+    for node in sorted(set(killed)):
         mgr.start_node(node)
     cmds = mgr.rt.executor.commands()
     stops = [c for c in cmds if c.startswith("docker stop")]
     starts = [c for c in cmds if c.startswith("docker start")]
     ok = all(any(s.endswith(node) for s in starts)
-             for node in set(killed))
+             for node in sorted(set(killed)))
     return {
         "plan": plan.as_dict(),
         "kills": len(stops),

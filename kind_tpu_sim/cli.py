@@ -458,6 +458,48 @@ def build_parser() -> argparse.ArgumentParser:
     he.add_argument("--samples", type=int, default=120)
     he.add_argument("--json", action="store_true", dest="as_json")
 
+    an = sub.add_parser(
+        "analysis",
+        help=(
+            "determinism tooling (docs/ARCHITECTURE.md, "
+            "docs/KNOBS.md): lint = detlint static sanitizer over "
+            "the package (wall-clock reads, unseeded entropy, "
+            "unordered iteration, unsorted JSON, rogue env knobs); "
+            "knobs = the registry every KIND_TPU_SIM_* read goes "
+            "through (--check-docs / --write-docs for the generated "
+            "docs/KNOBS.md); replay = run a scenario twice under one "
+            "seed and bisect any divergence to the first differing "
+            "event"
+        ),
+    )
+    an.add_argument("action", choices=["lint", "knobs", "replay"])
+    an.add_argument(
+        "paths", nargs="*",
+        help="files/directories for 'lint' (default: the installed "
+             "kind_tpu_sim package)")
+    an.add_argument(
+        "--scenario", default=None,
+        help="replay target for 'replay' (omit to list targets)")
+    an.add_argument(
+        "--seed", type=int, default=None,
+        help="replay seed (default: KIND_TPU_SIM_CHAOS_SEED or 0)")
+    an.add_argument(
+        "--runs", type=int, default=2,
+        help="replay run count (divergence is judged against run 0)")
+    an.add_argument(
+        "--inject-entropy-bug", action="store_true", dest="inject",
+        help="deliberately perturb every run after the first "
+             "(bisector self-test: the report must name the first "
+             "divergent event)")
+    an.add_argument(
+        "--check-docs", action="store_true",
+        help="knobs: verify docs/KNOBS.md matches the registry and "
+             "README/docs name only registered knobs (CI gate)")
+    an.add_argument(
+        "--write-docs", action="store_true",
+        help="knobs: regenerate docs/KNOBS.md from the registry")
+    an.add_argument("--json", action="store_true", dest="as_json")
+
     man = sub.add_parser(
         "manifests",
         help=(
@@ -556,7 +598,7 @@ def run_slice_smoke(args: argparse.Namespace) -> int:
         if serving_rep is not None:
             out["serving"] = serving_rep
             out["speculative"] = spec_rep
-        print(json.dumps(out))
+        print(json.dumps(out, sort_keys=True))
     else:
         for rank, rep in enumerate(reports):
             ring = ""
@@ -597,20 +639,20 @@ def run_jax_smoke(args: argparse.Namespace) -> int:
 
     from kind_tpu_sim.utils import worker_pool as wp
 
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # detlint: ok(wallclock) -- real-time smoke timing
     runs = []
     with wp.WorkerPool(
             size=1, warm=True,
             extra_env=wp.simulated_slice_env(args.chips)) as pool:
         first = pool.submit("collectives_suite",
                             topology=args.topology, timeout=300)
-        cold_s = time.monotonic() - t0
+        cold_s = time.monotonic() - t0  # detlint: ok(wallclock) -- real-time smoke timing
         ok = bool(first["ok"])
         for _ in range(max(0, args.repeat - 1)):
-            t1 = time.monotonic()
+            t1 = time.monotonic()  # detlint: ok(wallclock) -- real-time smoke timing
             rep = pool.submit("collectives_suite",
                               topology=args.topology, timeout=120)
-            runs.append(round(time.monotonic() - t1, 4))
+            runs.append(round(time.monotonic() - t1, 4))  # detlint: ok(wallclock) -- real-time smoke timing
             ok = ok and bool(rep["ok"])
         hello = pool.bringup()
     report = {
@@ -624,7 +666,7 @@ def run_jax_smoke(args: argparse.Namespace) -> int:
                         if isinstance(v, dict) and "ok" in v},
     }
     if args.as_json:
-        print(json.dumps(report))
+        print(json.dumps(report, sort_keys=True))
     else:
         print(f"worker {report['worker_pid']}: "
               f"{report['devices']} devices, warm-up "
@@ -646,7 +688,7 @@ def run_chaos_engine(args: argparse.Namespace) -> int:
                                 seed=args.seed,
                                 include_slow=args.include_slow)
         if args.as_json:
-            print(json.dumps(report))
+            print(json.dumps(report, sort_keys=True))
         else:
             for run in report["runs"]:
                 print(f"  {run['scenario']:<24} seed={run['seed']:<12}"
@@ -673,7 +715,7 @@ def run_chaos_engine(args: argparse.Namespace) -> int:
     if args.as_json:
         out = reports[0] if len(reports) == 1 else {
             "ok": ok, "scenarios": reports}
-        print(json.dumps(out))
+        print(json.dumps(out, sort_keys=True))
     else:
         for rep in reports:
             events = ", ".join(
@@ -1024,6 +1066,133 @@ def run_health(args: argparse.Namespace) -> int:
     return 0 if report["ok"] else 1
 
 
+def run_analysis(args: argparse.Namespace) -> int:
+    """`analysis lint|knobs|replay`: the determinism-contract
+    tooling (kind_tpu_sim/analysis/, docs/ARCHITECTURE.md). All JSON
+    output is sorted-keys and a pure function of (tree, args) — the
+    linter obeys the byte-identity contract it enforces."""
+    import pathlib
+
+    from kind_tpu_sim.analysis import detlint, knobs, replaycheck
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+
+    if args.action == "lint":
+        paths = args.paths or [str(repo / "kind_tpu_sim")]
+        findings = detlint.lint_paths(paths)
+        rep = detlint.report(
+            findings, files=len(detlint.iter_py_files(paths)))
+        if args.as_json:
+            print(json.dumps(rep, sort_keys=True))
+        else:
+            for f in findings:
+                if not f.waived:
+                    print(f.render())
+            print(f"detlint: {rep['files']} file(s), "
+                  f"{len(rep['findings'])} finding(s), "
+                  f"{rep['waived']} waived "
+                  + ("OK" if rep["ok"] else "FAILED"))
+        return 0 if rep["ok"] else 1
+
+    if args.action == "knobs":
+        docs_path = repo / "docs" / "KNOBS.md"
+        if args.write_docs:
+            text = knobs.render_markdown() + "\n"
+            docs_path.write_text(text, encoding="utf-8")
+            print(f"wrote {docs_path} ({len(knobs.REGISTRY)} knobs)")
+            return 0
+        if args.check_docs:
+            problems: List[str] = []
+            want = knobs.render_markdown() + "\n"
+            try:
+                have = docs_path.read_text(encoding="utf-8")
+            except OSError:
+                have = ""
+            if have != want:
+                problems.append(
+                    f"{docs_path} is stale — regenerate with "
+                    "`kind-tpu-sim analysis knobs --write-docs`")
+            # every knob token named anywhere in the docs must be
+            # registered (the no-undocumented-knobs cross-check)
+            import re as _re
+
+            token = _re.compile(r"KIND_TPU_SIM_[A-Z0-9_]+")
+            md_files = [repo / "README.md"] + sorted(
+                (repo / "docs").glob("*.md"))
+            for md in md_files:
+                try:
+                    text = md.read_text(encoding="utf-8")
+                except OSError:
+                    continue
+                for m in token.finditer(text):
+                    name = m.group(0)
+                    if knobs.is_registered(name):
+                        continue
+                    if name.endswith("_") and any(
+                            k.startswith(name)
+                            for k in knobs.REGISTRY):
+                        continue
+                    problems.append(
+                        f"{md.name}: {name} is not a registered "
+                        "knob")
+            ok = not problems
+            if args.as_json:
+                print(json.dumps(
+                    {"ok": ok, "problems": sorted(set(problems)),
+                     "knobs": len(knobs.REGISTRY)},
+                    sort_keys=True))
+            else:
+                for p in sorted(set(problems)):
+                    print(p)
+                print(f"knob docs ({len(knobs.REGISTRY)} knobs) "
+                      + ("OK" if ok else "STALE"))
+            return 0 if ok else 1
+        resolved = knobs.resolve_all()
+        if args.as_json:
+            print(json.dumps(resolved, sort_keys=True))
+        else:
+            for name, value in sorted(resolved.items()):
+                print(f"  {name:<40} {value}")
+        return 0
+
+    # replay ----------------------------------------------------------
+    if not args.scenario:
+        targets = replaycheck.list_targets()
+        if args.as_json:
+            print(json.dumps({"targets": targets}, sort_keys=True))
+        else:
+            print("replay targets (analysis replay --scenario NAME):")
+            for t in targets:
+                tag = ("[slow]" if t["slow"] else "") + (
+                    "[injectable]" if t["injectable"] else "")
+                print(f"  {t['name']:<28} {t['description']}"
+                      + (f" {tag}" if tag else ""))
+        return 0
+    report = replaycheck.replay(args.scenario, seed=args.seed,
+                                runs=args.runs, inject=args.inject)
+    if args.as_json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(f"replay {report['target']}: seed {report['seed']}, "
+              f"{report['runs']} runs, {report['events']} events, "
+              f"digest {report['stream_digest'][:16]}")
+        div = report.get("divergence")
+        if div is not None:
+            print(f"  FIRST DIVERGENT EVENT: #{div['index']} "
+                  f"(stream {div['stream']}, run 0 vs run "
+                  f"{report['diverged_run']})")
+            for ctx in div["context"]:
+                print("    shared: "
+                      + json.dumps(ctx, sort_keys=True)[:120])
+            print("    run 0:  " + json.dumps(
+                div["a"], sort_keys=True)[:240])
+            print("    run N:  " + json.dumps(
+                div["b"], sort_keys=True)[:240])
+        print("ANALYSIS REPLAY "
+              + ("OK" if report["ok"] else "DIVERGED"))
+    return 0 if report["ok"] else 1
+
+
 def run_manifests(args: argparse.Namespace) -> int:
     cfg = SimConfig(
         vendor="tpu",
@@ -1061,13 +1230,13 @@ def run_train_smoke(args: argparse.Namespace) -> int:
 
     state = init(jax.random.PRNGKey(0))
     losses = []
-    t0 = time.monotonic()
+    t0 = time.monotonic()  # detlint: ok(wallclock) -- real tokens/s measurement
     with data.input_pipeline(cfg, batch=args.batch,
                              steps=args.steps) as pipe:
         for tokens in pipe:
             state, loss = step(state, tokens)
             losses.append(float(loss))
-    elapsed = time.monotonic() - t0
+    elapsed = time.monotonic() - t0  # detlint: ok(wallclock) -- real tokens/s measurement
     head = float(np.mean(losses[:5]))
     tail = float(np.mean(losses[-5:]))
     report = {
@@ -1112,7 +1281,7 @@ def run_train_smoke(args: argparse.Namespace) -> int:
         report["ok"] = report["ok"] and report["resume_ok"]
 
     if args.as_json:
-        print(json.dumps(report))
+        print(json.dumps(report, sort_keys=True))
     else:
         print(f"train-smoke: {report['steps']} steps, loss "
               f"{report['loss_first5']} -> {report['loss_last5']}, "
@@ -1130,7 +1299,7 @@ def run_profile(args: argparse.Namespace) -> int:
 
     report = profiling.profile_flagship(args.out)
     if args.as_json:
-        print(json.dumps(report))
+        print(json.dumps(report, sort_keys=True))
         return 0
     print(f"model {report['model']}: one step in "
           f"{report['wall_s']}s, trace in {report['log_dir']}")
@@ -1283,7 +1452,7 @@ class Simulator:
             report["nodes"].append(entry)
         report["ready_latency"] = ready_latency_summary(pods_json)
         if as_json:
-            print(json.dumps(report, indent=2))
+            print(json.dumps(report, indent=2, sort_keys=True))
         else:
             for entry in report["nodes"]:
                 accel = ", ".join(
@@ -1329,6 +1498,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_globe(args)
         if args.command == "health":
             return run_health(args)
+        if args.command == "analysis":
+            return run_analysis(args)
         if args.command == "profile":
             return run_profile(args)
         if args.command == "chaos" and args.action in ("run", "soak"):
@@ -1339,7 +1510,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             sim.create(skip_plugin=args.skip_plugin)
             if args.timing_json:
                 with open(args.timing_json, "w", encoding="utf-8") as fh:
-                    json.dump(sim.timer.as_dict(), fh, indent=2)
+                    json.dump(sim.timer.as_dict(), fh, indent=2,
+                              sort_keys=True)
         elif args.command == "delete":
             sim.delete()
         elif args.command == "load":

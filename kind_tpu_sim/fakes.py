@@ -36,8 +36,8 @@ def dry_run_executor(cfg: SimConfig) -> FakeExecutor:
             }
             for n in names
         ]
-    })
-    pods_json = json.dumps({"items": []})
+    }, sort_keys=True)
+    pods_json = json.dumps({"items": []}, sort_keys=True)
     return FakeExecutor(rules={
         "kubectl get nodes -o jsonpath": ExecResult(0, node_list),
         "kubectl get nodes -o json": ExecResult(0, nodes_json),

@@ -24,12 +24,12 @@ request is ever displaced by a scale decision (only chaos does that).
 from __future__ import annotations
 
 import dataclasses
-import os
 from typing import Dict, List, Optional
 
 from kind_tpu_sim import metrics
+from kind_tpu_sim.analysis import knobs
 
-WARMUP_ENV = "KIND_TPU_SIM_FLEET_WARMUP_S"
+WARMUP_ENV = knobs.FLEET_WARMUP_S
 DEFAULT_WARMUP_S = 0.55  # measured warm bring-up (docs/PERFORMANCE.md)
 
 
@@ -38,10 +38,7 @@ def resolve_warmup_s(value: Optional[float] = None) -> float:
     measured default."""
     if value is not None:
         return float(value)
-    try:
-        return float(os.environ.get(WARMUP_ENV, DEFAULT_WARMUP_S))
-    except ValueError:
-        return DEFAULT_WARMUP_S
+    return float(knobs.get(WARMUP_ENV))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -29,22 +29,20 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-import os
 import random
 import zlib
 from typing import List, Optional, Sequence
 
-FLEET_SEED_ENV = "KIND_TPU_SIM_FLEET_SEED"
+from kind_tpu_sim.analysis import knobs
+
+FLEET_SEED_ENV = knobs.FLEET_SEED
 
 
 def resolve_seed(seed: Optional[int] = None) -> int:
     """Explicit seed > env (KIND_TPU_SIM_FLEET_SEED) > 0."""
     if seed is not None:
         return int(seed)
-    try:
-        return int(os.environ.get(FLEET_SEED_ENV, "0"))
-    except ValueError:
-        return 0
+    return int(knobs.get(FLEET_SEED_ENV))
 
 
 class VirtualClock:

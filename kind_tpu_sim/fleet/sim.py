@@ -19,11 +19,11 @@ byte-identical — `fleet run --seed 7` twice diffs clean.
 from __future__ import annotations
 
 import dataclasses
-import os
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
 from kind_tpu_sim import metrics
+from kind_tpu_sim.analysis import knobs
 from kind_tpu_sim.health import DetectorConfig, FailureDetector
 from kind_tpu_sim.parallel import collectives
 from kind_tpu_sim.fleet.autoscaler import (
@@ -40,19 +40,16 @@ from kind_tpu_sim.fleet.router import (
 )
 from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
 
-TICK_ENV = "KIND_TPU_SIM_FLEET_TICK_S"
+TICK_ENV = knobs.FLEET_TICK_S
 DEFAULT_TICK_S = 0.01
-FF_ENV = "KIND_TPU_SIM_FLEET_FF"
+FF_ENV = knobs.FLEET_FF
 
 
 def resolve_tick_s(value: Optional[float] = None) -> float:
     """Explicit value > env (KIND_TPU_SIM_FLEET_TICK_S) > 0.01."""
     if value is not None:
         return float(value)
-    try:
-        return float(os.environ.get(TICK_ENV, DEFAULT_TICK_S))
-    except ValueError:
-        return DEFAULT_TICK_S
+    return float(knobs.get(TICK_ENV))
 
 
 def resolve_fast_forward(value: Optional[bool] = None) -> bool:
@@ -67,7 +64,7 @@ def resolve_fast_forward(value: Optional[bool] = None) -> bool:
     ``KIND_TPU_SIM_FLEET_FF=0`` to force the plain loop."""
     if value is not None:
         return bool(value)
-    return os.environ.get(FF_ENV, "1") not in ("0", "false", "no")
+    return bool(knobs.get(FF_ENV))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -28,12 +28,12 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
 import zlib
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kind_tpu_sim import metrics
+from kind_tpu_sim.analysis import knobs
 from kind_tpu_sim.parallel import collectives
 from kind_tpu_sim.fleet.autoscaler import AutoscalerConfig
 from kind_tpu_sim.fleet.loadgen import (
@@ -54,7 +54,7 @@ from kind_tpu_sim.globe.cell import Cell, CellConfig
 from kind_tpu_sim.globe.frontdoor import FrontDoor, FrontDoorConfig
 from kind_tpu_sim.globe.planner import GlobalPlanner, PlannerConfig
 
-GLOBE_SEED_ENV = "KIND_TPU_SIM_GLOBE_SEED"
+GLOBE_SEED_ENV = knobs.GLOBE_SEED
 
 GLOBE_CHAOS_ACTIONS = (
     "zone_loss", "zone_restore", "herd_failover",
@@ -66,10 +66,7 @@ def resolve_seed(seed: Optional[int] = None) -> int:
     """Explicit seed > env (KIND_TPU_SIM_GLOBE_SEED) > 0."""
     if seed is not None:
         return int(seed)
-    try:
-        return int(os.environ.get(GLOBE_SEED_ENV, "0"))
-    except ValueError:
-        return 0
+    return int(knobs.get(GLOBE_SEED_ENV))
 
 
 @dataclasses.dataclass(frozen=True)

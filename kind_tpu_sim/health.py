@@ -48,10 +48,10 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
 from typing import Dict, List, Optional
 
 from kind_tpu_sim import metrics
+from kind_tpu_sim.analysis import knobs
 
 # component states
 HEALTHY = "healthy"
@@ -61,23 +61,6 @@ QUARANTINED = "quarantined"
 # phi is capped here: erfc underflows around z ~ 38 and "suspicion
 # beyond astronomical" carries no extra information
 PHI_CAP = 300.0
-
-_ENV_PREFIX = "KIND_TPU_SIM_HEALTH_"
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(_ENV_PREFIX + name, default))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(_ENV_PREFIX + name, default))
-    except ValueError:
-        return default
-
 
 @dataclasses.dataclass(frozen=True)
 class DetectorConfig:
@@ -110,26 +93,23 @@ class DetectorConfig:
 
     @classmethod
     def from_env(cls) -> "DetectorConfig":
+        # the registry's defaults mirror the dataclass defaults
+        # (tests assert from_env() == DetectorConfig() on a clean env)
         return cls(
-            ewma_alpha=_env_float("ALPHA", cls.ewma_alpha),
-            suspect_phi=_env_float("SUSPECT_PHI", cls.suspect_phi),
-            quarantine_phi=_env_float("QUARANTINE_PHI",
-                                      cls.quarantine_phi),
-            quarantine_evals=_env_int("QUARANTINE_EVALS",
-                                      cls.quarantine_evals),
-            probe_ok_required=_env_int("PROBE_OK",
-                                       cls.probe_ok_required),
-            probe_interval_s=_env_float("PROBE_INTERVAL_S",
-                                        cls.probe_interval_s),
-            min_samples=_env_int("MIN_SAMPLES", cls.min_samples),
-            sigma_floor_frac=_env_float("SIGMA_FRAC",
-                                        cls.sigma_floor_frac),
-            sigma_floor_abs=_env_float("SIGMA_ABS",
-                                       cls.sigma_floor_abs),
-            probe_timeout_s=_env_float("PROBE_TIMEOUT_S",
-                                       cls.probe_timeout_s),
-            spec_age_ratio=_env_float("SPEC_RATIO",
-                                      cls.spec_age_ratio),
+            ewma_alpha=knobs.get(knobs.HEALTH_ALPHA),
+            suspect_phi=knobs.get(knobs.HEALTH_SUSPECT_PHI),
+            quarantine_phi=knobs.get(knobs.HEALTH_QUARANTINE_PHI),
+            quarantine_evals=knobs.get(
+                knobs.HEALTH_QUARANTINE_EVALS),
+            probe_ok_required=knobs.get(knobs.HEALTH_PROBE_OK),
+            probe_interval_s=knobs.get(
+                knobs.HEALTH_PROBE_INTERVAL_S),
+            min_samples=knobs.get(knobs.HEALTH_MIN_SAMPLES),
+            sigma_floor_frac=knobs.get(knobs.HEALTH_SIGMA_FRAC),
+            sigma_floor_abs=knobs.get(knobs.HEALTH_SIGMA_ABS),
+            probe_timeout_s=knobs.get(
+                knobs.HEALTH_PROBE_TIMEOUT_S),
+            spec_age_ratio=knobs.get(knobs.HEALTH_SPEC_RATIO),
         )
 
     def as_dict(self) -> dict:

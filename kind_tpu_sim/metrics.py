@@ -38,6 +38,7 @@ class PhaseTimer:
     :attr:`overlap_saved_seconds` report the overlapped schedule
     against the serialized sum."""
 
+    # detlint: ok(wallclock) -- default for REAL bring-up timing; sims inject a virtual clock
     def __init__(self, clock=time.monotonic):
         self._clock = clock
         self._lock = threading.Lock()

@@ -129,7 +129,7 @@ def latest_step(directory) -> Optional[int]:
     if not path.exists():
         return None
     steps = []
-    for child in path.iterdir():
+    for child in sorted(path.iterdir()):
         if not child.is_dir() or child.name.startswith("."):
             continue
         try:

@@ -969,7 +969,7 @@ class ServingEngine:
         # time; the fleet simulator binds its virtual clock here so
         # engine-backed fleet runs are deterministic and deadlines
         # are evaluated in simulated time.
-        self._clock = clock if clock is not None else _time.monotonic
+        self._clock = clock if clock is not None else _time.monotonic  # detlint: ok(wallclock) -- real-time default; fleet injects VirtualClock
 
         self.mesh = mesh
         n = serving.max_slots
@@ -1128,6 +1128,7 @@ class ServingEngine:
             # Request records the seed that actually ran (replayable)
             import os
 
+            # detlint: ok(entropy) -- deliberate: the one draw for an unseeded request; stored on the Request so the run replays
             request.seed = int.from_bytes(os.urandom(4), "little")
         if request.request_id in self._req_clock:
             # ids were a pure label before latency metrics keyed host

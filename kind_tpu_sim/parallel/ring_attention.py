@@ -136,12 +136,9 @@ def _double_buffer_default() -> bool:
     simulation tier an A/B at 32k measured the orderings equivalent
     within host noise (~±5%). KIND_TPU_SIM_RING_DOUBLE_BUFFER=0
     restores the serial rotate-then-compute ordering."""
-    import os
+    from kind_tpu_sim.analysis import knobs
 
-    knob = os.environ.get("KIND_TPU_SIM_RING_DOUBLE_BUFFER")
-    if knob is not None:
-        return knob not in ("0", "false", "no")
-    return True
+    return bool(knobs.get(knobs.RING_DOUBLE_BUFFER))
 
 
 @functools.lru_cache(maxsize=32)
@@ -258,9 +255,9 @@ def bench_report(small_tokens: int = 8192, large_tokens: int = 32768,
         last = jax.block_until_ready(fn(*args))
         best = None
         for _ in range(reps):
-            t0 = time.monotonic()
+            t0 = time.monotonic()  # detlint: ok(wallclock) -- A/B microbench
             last = jax.block_until_ready(fn(*args))
-            dt = time.monotonic() - t0
+            dt = time.monotonic() - t0  # detlint: ok(wallclock) -- A/B microbench
             best = dt if best is None else min(best, dt)
         return best, last
 

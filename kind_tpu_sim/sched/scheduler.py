@@ -36,12 +36,12 @@ same seed + config always yields a byte-identical event log
 from __future__ import annotations
 
 import dataclasses
-import os
 import random
 import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from kind_tpu_sim import metrics
+from kind_tpu_sim.analysis import knobs
 from kind_tpu_sim import topology as topo
 from kind_tpu_sim.sched.inventory import (
     Inventory,
@@ -51,17 +51,14 @@ from kind_tpu_sim.sched.inventory import (
 
 POLICIES = ("binpack", "spread", "ici")
 
-SCHED_SEED_ENV = "KIND_TPU_SIM_SCHED_SEED"
+SCHED_SEED_ENV = knobs.SCHED_SEED
 
 
 def resolve_seed(seed: Optional[int] = None) -> int:
     """Explicit seed > env (KIND_TPU_SIM_SCHED_SEED) > 0."""
     if seed is not None:
         return int(seed)
-    try:
-        return int(os.environ.get(SCHED_SEED_ENV, "0"))
-    except ValueError:
-        return 0
+    return int(knobs.get(SCHED_SEED_ENV))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -16,6 +16,8 @@ import subprocess
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from kind_tpu_sim.analysis import knobs
+
 log = logging.getLogger("kind-tpu-sim")
 
 # Env-var prefixes of TPU-tunnel sitecustomize hooks (axon): when
@@ -23,10 +25,10 @@ log = logging.getLogger("kind-tpu-sim")
 # startup ~0.6-1.7s. CPU-only Python subprocesses strip them.
 TUNNEL_ENV_PREFIXES = ("_AXON", "PALLAS_AXON")
 
-# Warm-path knobs (docs/PERFORMANCE.md): where the XLA persistent
-# compilation cache lives, and the off switch.
-CACHE_DIR_ENV = "KIND_TPU_SIM_CACHE_DIR"
-NO_CACHE_ENV = "KIND_TPU_SIM_NO_COMPILATION_CACHE"
+# Warm-path knobs (docs/PERFORMANCE.md, docs/KNOBS.md): where the XLA
+# persistent compilation cache lives, and the off switch.
+CACHE_DIR_ENV = knobs.CACHE_DIR
+NO_CACHE_ENV = knobs.NO_COMPILATION_CACHE
 
 
 def compilation_cache_dir():
@@ -35,12 +37,11 @@ def compilation_cache_dir():
     location with CACHE_DIR_ENV; default is `<repo>/.cache/jax`
     (gitignored) so psum/ring/transformer compiles amortize across
     bench and CLI invocations on the same host."""
-    import os
     import pathlib
 
-    if os.environ.get(NO_CACHE_ENV):
+    if knobs.get(NO_CACHE_ENV):
         return None
-    override = os.environ.get(CACHE_DIR_ENV)
+    override = knobs.get(CACHE_DIR_ENV)
     if override:
         return pathlib.Path(override)
     repo = pathlib.Path(__file__).resolve().parents[2]
@@ -146,9 +147,9 @@ FATAL_PATTERNS = (
 # children report 137) — transient by definition.
 TRANSIENT_RETURNCODES = (124, 137)
 
-MAX_RETRIES_ENV = "KIND_TPU_SIM_MAX_RETRIES"
-RETRY_BASE_MS_ENV = "KIND_TPU_SIM_RETRY_BASE_MS"
-CMD_TIMEOUT_ENV = "KIND_TPU_SIM_CMD_TIMEOUT_S"
+MAX_RETRIES_ENV = knobs.MAX_RETRIES
+RETRY_BASE_MS_ENV = knobs.RETRY_BASE_MS
+CMD_TIMEOUT_ENV = knobs.CMD_TIMEOUT_S
 
 
 def classify_failure(result: ExecResult) -> str:
@@ -185,21 +186,19 @@ class RetryPolicy:
     @classmethod
     def from_env(cls, environ: Optional[Dict[str, str]] = None
                  ) -> "RetryPolicy":
-        import os
-
-        env = os.environ if environ is None else environ
-
-        def num(key, default, cast):
-            try:
-                return cast(env[key])
-            except (KeyError, ValueError):
-                return default
-
+        # CHAOS_SEED deliberately resolves to None (not the knob's 0
+        # default) when unset: an unseeded policy draws fresh jitter,
+        # while any explicit seed pins the backoff schedule.
+        raw_seed = knobs.get_raw(knobs.CHAOS_SEED, environ)
+        try:
+            seed = int(raw_seed) if raw_seed is not None else None
+        except ValueError:
+            seed = None
         return cls(
-            max_retries=num(MAX_RETRIES_ENV, 3, int),
-            base_ms=num(RETRY_BASE_MS_ENV, 50.0, float),
-            deadline_s=num(CMD_TIMEOUT_ENV, None, float),
-            seed=num("KIND_TPU_SIM_CHAOS_SEED", None, int),
+            max_retries=knobs.get(MAX_RETRIES_ENV, environ),
+            base_ms=knobs.get(RETRY_BASE_MS_ENV, environ),
+            deadline_s=knobs.get(CMD_TIMEOUT_ENV, environ),
+            seed=seed,
         )
 
     def backoff_s(self, attempt: int, rng: random.Random) -> float:

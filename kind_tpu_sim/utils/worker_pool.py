@@ -59,9 +59,11 @@ import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
+from kind_tpu_sim.analysis import knobs
+
 log = logging.getLogger("kind-tpu-sim")
 
-WARM_ENV = "KIND_TPU_SIM_POOL_WARM"
+WARM_ENV = knobs.POOL_WARM
 
 # Injectable chaos fault for a protocol worker (docs/CHAOS.md,
 # docs/HEALTH.md): "crash@N" kills the worker (os._exit) when it
@@ -75,7 +77,7 @@ WARM_ENV = "KIND_TPU_SIM_POOL_WARM"
 # construction — exactly the failure the recovery paths (respawn+
 # retry, cell requeue, deadline kill, straggler quarantine +
 # speculative re-dispatch) exist for.
-CHAOS_FAULT_ENV = "KIND_TPU_SIM_CHAOS_FAULT"
+CHAOS_FAULT_ENV = knobs.CHAOS_FAULT
 
 # A frame bigger than this is protocol corruption, not data.
 MAX_FRAME_BYTES = 64 * 1024 * 1024
@@ -106,7 +108,7 @@ class WorkerCancelled(RuntimeError):
 
 
 def write_frame(stream, obj) -> None:
-    payload = json.dumps(obj).encode("utf-8")
+    payload = json.dumps(obj, sort_keys=True).encode("utf-8")
     stream.write(struct.pack(">I", len(payload)) + payload)
     stream.flush()
 
@@ -286,7 +288,7 @@ def _serve() -> int:
     inp = sys.stdin.buffer
 
     hello = {"hello": True, "pid": os.getpid()}
-    if os.environ.get(WARM_ENV) == "1":
+    if knobs.get(WARM_ENV):
         t0 = time.monotonic()
         try:
             hello.update(_warmup())
@@ -295,7 +297,7 @@ def _serve() -> int:
             hello["warm_error"] = f"{type(exc).__name__}: {exc}"[:500]
     write_frame(out, hello)
 
-    fault = _parse_fault(os.environ.get(CHAOS_FAULT_ENV))
+    fault = _parse_fault(knobs.get(CHAOS_FAULT_ENV))
     req_no = 0
     while True:
         try:

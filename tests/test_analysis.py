@@ -1,0 +1,489 @@
+"""Determinism-tooling tests (kind_tpu_sim/analysis/, ISSUE 7).
+
+Three subsystems under test:
+
+* **detlint** — every rule catches its seeded fixture violation, the
+  waiver machinery demands reasons and rejects stale waivers, and the
+  shipped package itself lints CLEAN (zero unwaived findings, every
+  waiver carrying a reason) — the acceptance gate CI enforces.
+* **knob registry** — typed resolution (env > default, unparseable ->
+  default), round-trip through the generated docs/KNOBS.md, and the
+  no-undocumented-knobs cross-check.
+* **replaycheck** — byte-identity proven on real sim targets; a
+  deliberately injected entropy bug is bisected to the FIRST
+  divergent event with both sides named.
+"""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from kind_tpu_sim.analysis import detlint, knobs, replaycheck
+
+pytestmark = pytest.mark.analysis
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def unwaived(src: str, path: str = "mod.py"):
+    return [f for f in detlint.lint_source(textwrap.dedent(src), path)
+            if not f.waived]
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- detlint rule fixtures --------------------------------------------
+
+
+def test_wallclock_flagged():
+    fs = unwaived("""
+        import time
+        def f():
+            return time.time()
+    """)
+    assert rules_of(fs) == ["wallclock"]
+    assert fs[0].line == 4
+
+
+def test_wallclock_reference_not_just_call_flagged():
+    fs = unwaived("""
+        import time
+        def f(clock=time.monotonic):
+            return clock()
+    """)
+    assert rules_of(fs) == ["wallclock"]
+
+
+def test_datetime_now_flagged():
+    fs = unwaived("""
+        import datetime
+        def f():
+            return datetime.datetime.now()
+    """)
+    assert rules_of(fs) == ["wallclock"]
+
+
+def test_wallclock_allowlisted_module_clean():
+    src = """
+        import time
+        def f():
+            return time.monotonic()
+    """
+    assert unwaived(src, "kind_tpu_sim/profiling.py") == []
+    assert rules_of(unwaived(src, "other.py")) == ["wallclock"]
+
+
+def test_entropy_module_level_random_flagged():
+    fs = unwaived("""
+        import random
+        def f():
+            return random.random() + random.randint(0, 3)
+    """)
+    assert [f.rule for f in fs] == ["entropy", "entropy"]
+
+
+def test_entropy_unseeded_constructors_flagged():
+    fs = unwaived("""
+        import random
+        import numpy as np
+        def f():
+            a = random.Random()
+            b = np.random.default_rng()
+            return a, b
+    """)
+    assert [f.rule for f in fs] == ["entropy", "entropy"]
+
+
+def test_entropy_seeded_streams_clean():
+    assert unwaived("""
+        import random
+        import numpy as np
+        def f(seed):
+            a = random.Random(seed)
+            b = np.random.RandomState(seed)
+            return a.random() + b.rand()
+    """) == []
+
+
+def test_entropy_jax_random_exempt():
+    assert unwaived("""
+        import jax
+        def f(key):
+            return jax.random.normal(key, (2,))
+    """) == []
+
+
+def test_set_iter_flagged_and_sorted_clean():
+    fs = unwaived("""
+        def f(xs):
+            return [x for x in set(xs)]
+    """)
+    assert rules_of(fs) == ["set-iter"]
+    assert unwaived("""
+        def f(xs):
+            return [x for x in sorted(set(xs))]
+    """) == []
+
+
+def test_set_iter_for_loop_and_join():
+    fs = unwaived("""
+        def f(xs):
+            out = []
+            for x in {1, 2} | set(xs):
+                out.append(x)
+            return ",".join(set(xs))
+    """)
+    assert [f.rule for f in fs] == ["set-iter", "set-iter"]
+
+
+def test_set_aggregations_order_free_clean():
+    # min/max/any/all/len don't depend on iteration order
+    assert unwaived("""
+        def f(xs):
+            s = set(xs)
+            return min(s), max(s), any(s), len(s)
+    """) == []
+
+
+def test_fs_order_flagged_and_sorted_clean():
+    fs = unwaived("""
+        import os
+        def f(d):
+            return [p for p in os.listdir(d)]
+    """)
+    assert rules_of(fs) == ["fs-order"]
+    assert unwaived("""
+        import os
+        def f(d):
+            return sorted(os.listdir(d))
+    """) == []
+
+
+def test_json_sort_flagged_and_fixed_clean():
+    fs = unwaived("""
+        import json
+        def f(d):
+            return json.dumps(d)
+    """)
+    assert rules_of(fs) == ["json-sort"]
+    assert unwaived("""
+        import json
+        def f(d):
+            return json.dumps(d, sort_keys=True)
+    """) == []
+
+
+def test_env_import_time_flagged_inside_function_clean():
+    fs = unwaived("""
+        import os
+        DEBUG = os.environ.get("DEBUG")
+    """)
+    assert rules_of(fs) == ["env-import"]
+    assert unwaived("""
+        import os
+        def f():
+            return os.environ.get("DEBUG")
+    """) == []
+
+
+def test_knob_env_direct_read_flagged():
+    fs = unwaived("""
+        import os
+        def f():
+            a = os.environ.get("KIND_TPU_SIM_CHAOS_SEED")
+            b = os.environ["KIND_TPU_SIM_FLEET_SEED"]
+            return a, b
+    """)
+    assert [f.rule for f in fs] == ["knob-env", "knob-env"]
+
+
+def test_unknown_knob_flagged_registered_clean():
+    fs = unwaived("""
+        HELP = "set KIND_TPU_SIM_NOT_A_REAL_KNOB to explode"
+    """)
+    assert rules_of(fs) == ["unknown-knob"]
+    assert unwaived("""
+        HELP = "set KIND_TPU_SIM_CHAOS_SEED; all KIND_TPU_SIM_HEALTH_* too"
+    """) == []
+
+
+def test_waiver_with_reason_waives():
+    src = ("import time\n"
+           "def f():\n"
+           "    return time.time()"
+           "  # detlint: ok(wallclock) -- fixture\n")
+    findings = detlint.lint_source(src, "m.py")
+    assert [f.rule for f in findings] == ["wallclock"]
+    assert findings[0].waived and findings[0].waiver_reason == "fixture"
+
+
+def test_waiver_on_preceding_comment_line_waives():
+    src = ("import time\n"
+           "def f():\n"
+           "    # detlint: ok(wallclock) -- fixture\n"
+           "    return time.time()\n")
+    findings = detlint.lint_source(src, "m.py")
+    assert [f.waived for f in findings] == [True]
+
+
+def test_waiver_without_reason_is_a_finding():
+    # a reasonless waiver waives NOTHING: the original finding
+    # survives and the malformed waiver is reported alongside it
+    src = ("import time\n"
+           "def f():\n"
+           "    return time.time()  # detlint: ok(wallclock)\n")
+    fs = [f for f in detlint.lint_source(src, "m.py") if not f.waived]
+    assert rules_of(fs) == ["waiver", "wallclock"]
+
+
+def test_waiver_wrong_rule_does_not_waive():
+    src = ("import time\n"
+           "def f():\n"
+           "    return time.time()"
+           "  # detlint: ok(entropy) -- wrong rule\n")
+    fs = [f for f in detlint.lint_source(src, "m.py") if not f.waived]
+    # the wallclock finding survives AND the waiver is reported stale
+    assert rules_of(fs) == ["waiver", "wallclock"]
+
+
+def test_stale_waiver_is_a_finding():
+    src = ("def f():\n"
+           "    return 1  # detlint: ok(wallclock) -- nothing here\n")
+    fs = unwaived(src := src)
+    assert rules_of(fs) == ["waiver"]
+
+
+def test_syntax_error_reported_not_raised():
+    fs = detlint.lint_source("def f(:\n", "m.py")
+    assert [f.rule for f in fs] == ["syntax"]
+
+
+# -- the package itself is clean (the CI acceptance gate) -------------
+
+
+def test_package_lints_clean_with_reasoned_waivers():
+    findings = detlint.lint_paths([str(REPO / "kind_tpu_sim")])
+    bad = [f.render() for f in findings if not f.waived]
+    assert bad == []
+    assert all(f.waiver_reason for f in findings if f.waived)
+    # the waiver budget is tracked: growth should be a conscious diff
+    assert len([f for f in findings if f.waived]) < 30
+
+
+def test_report_shape_and_determinism():
+    findings = detlint.lint_paths([str(REPO / "kind_tpu_sim")])
+    rep = detlint.report(findings, files=3)
+    assert rep["ok"] is True and rep["files"] == 3
+    a = json.dumps(rep, sort_keys=True)
+    b = json.dumps(detlint.report(
+        detlint.lint_paths([str(REPO / "kind_tpu_sim")]), files=3),
+        sort_keys=True)
+    assert a == b
+
+
+# -- knob registry ----------------------------------------------------
+
+
+def test_every_knob_prefixed_and_typed():
+    for name, knob in knobs.REGISTRY.items():
+        assert name.startswith(knobs.PREFIX)
+        assert knob.kind in ("int", "float", "bool", "str")
+        assert knob.layer in knobs.LAYER_ORDER
+        assert knob.description
+
+
+def test_knob_resolution_env_over_default(monkeypatch):
+    monkeypatch.setenv(knobs.FLEET_TICK_S, "0.5")
+    assert knobs.get(knobs.FLEET_TICK_S) == 0.5
+    monkeypatch.setenv(knobs.FLEET_TICK_S, "bogus")
+    assert knobs.get(knobs.FLEET_TICK_S) == 0.01  # unparseable -> default
+    monkeypatch.delenv(knobs.FLEET_TICK_S)
+    assert knobs.get(knobs.FLEET_TICK_S) == 0.01
+
+
+def test_knob_bool_parse(monkeypatch):
+    for off in ("0", "false", "no", "", "FALSE"):
+        monkeypatch.setenv(knobs.FLEET_FF, off)
+        assert knobs.get(knobs.FLEET_FF) is False
+    monkeypatch.setenv(knobs.FLEET_FF, "1")
+    assert knobs.get(knobs.FLEET_FF) is True
+    monkeypatch.delenv(knobs.FLEET_FF)
+    assert knobs.get(knobs.FLEET_FF) is True  # default on
+
+
+def test_unregistered_knob_read_raises():
+    with pytest.raises(KeyError):
+        knobs.get_raw("KIND_TPU_SIM_NOT_A_REAL_KNOB")
+
+
+def test_environ_override_param():
+    env = {knobs.CHAOS_SEED: "42"}
+    assert knobs.get(knobs.CHAOS_SEED, env) == 42
+    assert knobs.get(knobs.CHAOS_SEED, {}) == 0
+
+
+def test_resolve_all_covers_registry():
+    resolved = knobs.resolve_all({})
+    assert sorted(resolved) == sorted(knobs.REGISTRY)
+
+
+def test_knobs_docs_round_trip():
+    """docs/KNOBS.md is exactly the rendered registry (the CI gate),
+    and every registered knob appears in it."""
+    text = (REPO / "docs" / "KNOBS.md").read_text(encoding="utf-8")
+    assert text == knobs.render_markdown() + "\n"
+    for name in knobs.REGISTRY:
+        assert f"`{name}`" in text
+
+
+def test_detector_config_defaults_match_registry():
+    from kind_tpu_sim.health import DetectorConfig
+
+    cfg = DetectorConfig()
+    assert cfg.ewma_alpha == knobs.REGISTRY[knobs.HEALTH_ALPHA].default
+    assert (cfg.quarantine_evals
+            == knobs.REGISTRY[knobs.HEALTH_QUARANTINE_EVALS].default)
+
+
+# -- replaycheck ------------------------------------------------------
+
+
+def _events(n, start=0):
+    return [{"stream": "completions", "index": i,
+             "event": {"id": i, "v": i * i}}
+            for i in range(start, start + n)]
+
+
+def test_identical_streams_no_divergence():
+    a, b = _events(20), _events(20)
+    assert replaycheck.first_divergence(a, b) is None
+
+
+def test_bisector_names_first_divergent_event():
+    a, b = _events(50), _events(50)
+    b[17] = dict(b[17], event={"id": 17, "v": -1})
+    b[40] = dict(b[40], event={"id": 40, "v": -1})  # later noise
+    div = replaycheck.first_divergence(a, b)
+    assert div.index == 17
+    assert div.a["event"] == {"id": 17, "v": 289}
+    assert div.b["event"] == {"id": 17, "v": -1}
+    assert [c["index"] for c in div.context] == [15, 16]
+
+
+def test_bisector_length_divergence():
+    div = replaycheck.first_divergence(_events(10), _events(8))
+    assert div.index == 8 and div.b is None
+
+
+def test_event_stream_extracts_nested_streams():
+    report = {
+        "completions": [{"id": 1}, {"id": 2}],
+        "policies": {"ici": {"events": [{"t": 0}]}},
+        "ok": True,
+    }
+    events = replaycheck.event_stream(report)
+    streams = [e["stream"] for e in events]
+    assert streams == ["completions", "completions",
+                       "policies.ici.events", "report"]
+    # the summary event elides stream bodies but keeps the shape
+    assert events[-1]["event"]["completions"] == "<stream: 2 events>"
+
+
+def test_fleet_replay_identical():
+    rep = replaycheck.replay("fleet-run", seed=11)
+    assert rep["ok"] is True
+    assert rep["events"] > 100
+    assert len(rep["stream_digest"]) == 64
+
+
+def test_injected_entropy_bug_is_bisected():
+    """The acceptance self-test: a deliberately injected divergence
+    must be localized to the first divergent event, by name."""
+    rep = replaycheck.replay("fleet-run", seed=11, inject=True)
+    assert rep["ok"] is False and rep["injected"] is True
+    div = rep["divergence"]
+    assert div["stream"] == "completions"
+    assert div["a"]["event"]["request_id"] \
+        == div["b"]["event"]["request_id"]
+    assert div["a"]["event"] != div["b"]["event"]
+    clean = replaycheck.replay("fleet-run", seed=11)
+    assert clean["ok"] is True  # the bug was the injection, not us
+
+
+def test_globe_scenario_replay_identical():
+    rep = replaycheck.replay("globe-zone-loss", seed=5)
+    assert rep["ok"] is True
+
+
+def test_unknown_target_raises():
+    with pytest.raises(ValueError, match="unknown replay target"):
+        replaycheck.replay("not-a-target")
+    with pytest.raises(ValueError, match="injection"):
+        replaycheck.replay("sched-run", seed=1, inject=True)
+
+
+# -- CLI surface ------------------------------------------------------
+
+
+def _cli(capsys, *argv):
+    from kind_tpu_sim import cli
+
+    rc = cli.main(list(argv))
+    return rc, capsys.readouterr().out
+
+
+def test_cli_lint_clean_and_byte_identical(capsys):
+    rc1, out1 = _cli(capsys, "analysis", "lint",
+                     str(REPO / "kind_tpu_sim"), "--json")
+    rc2, out2 = _cli(capsys, "analysis", "lint",
+                     str(REPO / "kind_tpu_sim"), "--json")
+    assert rc1 == rc2 == 0
+    assert out1 == out2
+    rep = json.loads(out1)
+    assert rep["ok"] is True and rep["findings"] == []
+
+
+def test_cli_lint_fails_on_violation(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\ndef f():\n    return time.time()\n",
+                   encoding="utf-8")
+    rc, out = _cli(capsys, "analysis", "lint", str(bad), "--json")
+    assert rc == 1
+    rep = json.loads(out)
+    assert rep["findings"][0]["rule"] == "wallclock"
+
+
+def test_cli_knobs_json_byte_identical(capsys):
+    rc1, out1 = _cli(capsys, "analysis", "knobs", "--json")
+    rc2, out2 = _cli(capsys, "analysis", "knobs", "--json")
+    assert rc1 == rc2 == 0 and out1 == out2
+    assert json.loads(out1)[knobs.CHAOS_SEED] == 0
+
+
+def test_cli_knobs_check_docs_green(capsys):
+    rc, out = _cli(capsys, "analysis", "knobs", "--check-docs",
+                   "--json")
+    assert rc == 0
+    assert json.loads(out)["problems"] == []
+
+
+def test_cli_replay_json_and_exit_codes(capsys):
+    rc, out = _cli(capsys, "analysis", "replay",
+                   "--scenario", "fleet-run", "--seed", "3", "--json")
+    assert rc == 0 and json.loads(out)["ok"] is True
+    rc, out = _cli(capsys, "analysis", "replay",
+                   "--scenario", "fleet-run", "--seed", "3",
+                   "--inject-entropy-bug", "--json")
+    assert rc == 1
+    assert json.loads(out)["divergence"]["stream"] == "completions"
+
+
+def test_cli_replay_lists_targets(capsys):
+    rc, out = _cli(capsys, "analysis", "replay", "--json")
+    assert rc == 0
+    names = [t["name"] for t in json.loads(out)["targets"]]
+    assert "globe-zone-loss" in names and "fleet-run" in names
