@@ -2251,6 +2251,94 @@ def fleet_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def fleet_scale() -> dict | None:
+    """The sim-speed headline (ROADMAP item 1, docs/PERFORMANCE.md
+    "The event core"): a seeded 100k-request compressed diurnal day
+    through the fleet simulator with the event-heap core on vs off —
+    events/s, sim-seconds-per-wall-second, boundaries stepped vs
+    skipped, and the byte-identity verdict between the two modes
+    (the contract the speed is not allowed to cost). With
+    KIND_TPU_SIM_BENCH_SLOW=1 the 1M-request 24h trace with
+    autoscaling and chaos rides along as the slow extra."""
+    try:
+        import json as _json
+
+        from kind_tpu_sim import fleet
+        from kind_tpu_sim.analysis import knobs as _knobs
+
+        def run_once(trace, cfg, chaos_events=()):
+            sim = fleet.FleetSim(cfg, trace,
+                                 chaos_events=list(chaos_events))
+            t0 = time.monotonic()
+            rep = sim.run()
+            wall = max(time.monotonic() - t0, 1e-9)
+            return rep, {
+                "ok": rep["ok"],
+                "wall_s": round(wall, 3),
+                "virtual_s": rep["virtual_s"],
+                "events_per_s": round(len(rep["completions"])
+                                      / wall),
+                "sim_s_per_wall_s": round(rep["virtual_s"] / wall),
+                "boundaries_stepped": sim._ticks - sim.ev_skipped,
+                "boundaries_skipped": sim.ev_skipped
+                + sim.ff_skipped,
+            }
+
+        spec = fleet.WorkloadSpec(
+            process="diurnal", rps=12.0, n_requests=100_000,
+            diurnal_period_s=8640.0, prompt_len=(8, 24),
+            max_new=(4, 12))
+        trace = fleet.generate_trace(spec, seed=7)
+        base = dict(replicas=3, policy="least-outstanding",
+                    max_queue=65536, max_virtual_s=1e9)
+        rep_on, on = run_once(
+            trace, fleet.FleetConfig(event_core=True, **base))
+        rep_off, off = run_once(
+            trace, fleet.FleetConfig(event_core=False,
+                                     fast_forward=False, **base))
+        identical = (_json.dumps(rep_on, sort_keys=True)
+                     == _json.dumps(rep_off, sort_keys=True))
+        out = {
+            "ok": bool(on["ok"] and off["ok"] and identical),
+            "requests": len(trace),
+            "replay_identical_on_vs_off": identical,
+            "event_core_on": on,
+            "event_core_off": off,
+            "speedup": round(off["wall_s"] / on["wall_s"], 2),
+        }
+        if _knobs.get(_knobs.BENCH_SLOW):
+            # the acceptance headline: 1M requests, a 24h diurnal
+            # day, autoscaling and chaos — tens of seconds of wall
+            spec1m = fleet.WorkloadSpec(
+                process="diurnal", rps=11.574,
+                n_requests=1_000_000, diurnal_period_s=86400.0,
+                prompt_len=(8, 24), max_new=(4, 12))
+            t0 = time.monotonic()
+            trace1m = fleet.generate_trace(spec1m, seed=7)
+            gen_s = time.monotonic() - t0
+            cfg1m = fleet.FleetConfig(
+                replicas=2, policy="least-outstanding",
+                tick_s=0.05, max_queue=65536, max_virtual_s=1e9,
+                autoscale=True, eval_every_s=0.5,
+                autoscaler=fleet.AutoscalerConfig(
+                    min_replicas=2, max_replicas=8),
+                event_core=True)
+            chaos_events = [
+                fleet.ChaosEvent(at_s=30000.0, action="preempt",
+                                 target=0),
+                fleet.ChaosEvent(at_s=31000.0, action="restore",
+                                 target=0),
+            ]
+            _, one_m = run_once(trace1m, cfg1m, chaos_events)
+            one_m["trace_gen_s"] = round(gen_s, 3)
+            out["slow_1m_24h_diurnal"] = one_m
+            out["ok"] = bool(out["ok"] and one_m["ok"]
+                             and one_m["wall_s"] <= 60.0)
+        return out
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def sched_smoke() -> dict | None:
     """Scheduler-tier extras: the seeded gang workload run once per
     placement policy (pure virtual clock — milliseconds, no jax),
@@ -2672,6 +2760,10 @@ def main(argv=None) -> int:
             fleet_rep = fleet_smoke()
         if fleet_rep:
             phases["fleet"] = fleet_rep
+        with stopwatch("fleet_scale"):
+            scale_rep = fleet_scale()
+        if scale_rep:
+            phases["fleet_scale"] = scale_rep
         with stopwatch("sched"):
             sched_rep = sched_smoke()
         if sched_rep:

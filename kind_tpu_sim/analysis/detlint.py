@@ -32,6 +32,13 @@ walks the AST of the whole package and flags the hazard *class*:
                    to replays.
 ``knob-env``       a ``KIND_TPU_SIM_*`` env var read directly instead
                    of through :mod:`~kind_tpu_sim.analysis.knobs`.
+``heap-order``     ``heapq.heappush`` of a tuple without an integer
+                   tiebreaker before the payload — when two entries
+                   tie on the leading keys the comparison falls
+                   through to the payload (nondeterministic pop
+                   order, or a TypeError at the worst moment); push
+                   ``(time, lane, seq, payload)`` like
+                   :class:`~kind_tpu_sim.fleet.events.EventHeap`.
 ``unknown-knob``   a ``KIND_TPU_SIM_*`` token (code, help text, or
                    docstring) that the knob registry doesn't know —
                    the undocumented-knob guard.
@@ -63,8 +70,11 @@ from kind_tpu_sim.analysis import knobs
 
 RULES = (
     "wallclock", "entropy", "set-iter", "fs-order", "json-sort",
-    "env-import", "knob-env", "unknown-knob", "waiver",
+    "env-import", "knob-env", "heap-order", "unknown-knob", "waiver",
 )
+
+# heapq entry points whose pushed tuples need a tiebreaker
+_HEAP_PUSH_FNS = frozenset(("heappush", "heappushpop", "heapreplace"))
 
 # Files where wall-clock reads are the *point* — the real-time
 # measurement layers whose outputs are wall timings by design and
@@ -248,6 +258,18 @@ class _Visitor(ast.NodeVisitor):
                 self._emit(node, "json-sort",
                            f"{dotted}() without sort_keys=True — "
                            "unsorted keys break byte-identity")
+
+        # heap-order ---------------------------------------------------
+        if (base in ("heapq", "_heapq") and attr in _HEAP_PUSH_FNS
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Tuple)
+                and len(node.args[1].elts) < 3):
+            self._emit(node, "heap-order",
+                       f"{dotted}() of a {len(node.args[1].elts)}-"
+                       "tuple: with no integer tiebreaker before the "
+                       "payload, equal keys compare the payloads — "
+                       "nondeterministic pop order; push (time, "
+                       "lane, seq, payload) (fleet/events.EventHeap)")
 
         # env reads ----------------------------------------------------
         if dotted in ("os.getenv", "os.environ.get"):

@@ -142,6 +142,11 @@ FLEET_WARMUP_S = _register(
     "KIND_TPU_SIM_FLEET_WARMUP_S", 0.55, "float", "fleet",
     "Modeled replica warm-up in virtual seconds (default: the "
     "measured warm bring-up, docs/PERFORMANCE.md).")
+FLEET_EVENT_CORE = _register(
+    "KIND_TPU_SIM_FLEET_EVENT_CORE", True, "bool", "fleet",
+    "Event-heap simulation core: the fleet/globe drivers step only "
+    "the tick boundaries where an event lands (replay-identical); "
+    "`0` forces the plain per-tick loop.")
 
 # sched (docs/SCHED.md)
 SCHED_SEED = _register(
@@ -199,6 +204,10 @@ SKIP_MODEL_BENCH = _register(
     "KIND_TPU_SIM_SKIP_MODEL_BENCH", False, "bool", "bench",
     "Skip the accelerator model pass in bench.py (operator opt-out "
     "on tunnel-less hosts).")
+BENCH_SLOW = _register(
+    "KIND_TPU_SIM_BENCH_SLOW", False, "bool", "bench",
+    "Also capture the slow bench extras (the 1M-request 24h "
+    "fleet_scale trace); off by default to keep bench runs short.")
 
 # Display order of layers in docs/KNOBS.md — pipeline order, not
 # alphabetical, so the page reads like the architecture diagram.

@@ -1158,12 +1158,12 @@ def _scenario_gray_degraded_ici(seed: int) -> dict:
 
 
 @_scenario("globe-zone-loss",
-           "a whole zone goes dark under the globe's front door: "
-           "its cells' load spills cross-zone (nearest healthy "
-           "first), zero requests are lost, global p99 recovers "
-           "after the zone returns, and the surviving zones' boards "
-           "stay within noise of fault-free — the blast radius is "
-           "contained")
+           "a whole MULTI-CELL zone goes dark under the globe's "
+           "front door: both of its cells' load spills cross-zone "
+           "(nearest healthy first), zero requests are lost, global "
+           "p99 recovers after the zone returns, and the surviving "
+           "zones' boards stay within noise of fault-free — the "
+           "blast radius is the zone, not the planet")
 def _scenario_globe_zone_loss(seed: int) -> dict:
     import json as _json
 
@@ -1172,8 +1172,13 @@ def _scenario_globe_zone_loss(seed: int) -> dict:
     plan = ChaosSchedule(seed).plan(kinds=("zone_loss",),
                                     n_faults=1, horizon=6, targets=3)
     ev = plan.events[0]
+    # 2 cells per zone: the blast radius spans BOTH cells of the
+    # lost zone (they die together — a zone is a correlated failure
+    # domain) and the herd readmission spreads over the survivors'
+    # four cells, sibling-first within each zone
     cfg = globe.GlobeConfig(
-        zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=2,
+        zones=("zone-a", "zone-b", "zone-c"), cells_per_zone=2,
+        replicas_per_cell=1,
         workload=globe.GlobeWorkloadSpec(process="poisson",
                                          rps=30.0, n_per_zone=120))
     traces = globe.generate_globe_traces(cfg, seed)

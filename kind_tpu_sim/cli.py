@@ -308,6 +308,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="virtual scheduling quantum "
              "(default: KIND_TPU_SIM_FLEET_TICK_S or 0.01)")
     fl.add_argument(
+        "--eval-every-s", type=float, default=None,
+        help="autoscaler evaluation cadence in virtual seconds, "
+             "snapped to the tick grid (default: 10 ticks; "
+             "replaces the deprecated tick-count cadence)")
+    fl.add_argument(
+        "--no-event-core", action="store_true",
+        help="force the plain per-tick loop instead of the "
+             "event-heap core (byte-identical, just slower; "
+             "default: KIND_TPU_SIM_FLEET_EVENT_CORE or on)")
+    fl.add_argument(
         "--trace-file", default=None,
         help="replay this JSONL trace instead of generating one")
     fl.add_argument(
@@ -424,6 +434,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
              "(default: KIND_TPU_SIM_FLEET_TICK_S or 0.01)")
+    gl.add_argument(
+        "--no-event-core", action="store_true",
+        help="force the lockstep per-tick loop instead of the "
+             "event-heap core (byte-identical, just slower; "
+             "default: KIND_TPU_SIM_FLEET_EVENT_CORE or on)")
     gl.add_argument(
         "--max-virtual-s", type=float, default=600.0,
         help="virtual-time runaway backstop")
@@ -760,6 +775,7 @@ def run_fleet(args: argparse.Namespace) -> int:
     fc = fleet.FleetConfig(
         replicas=args.replicas, policy=args.policy,
         tick_s=args.tick_s, autoscale=args.autoscale,
+        eval_every_s=args.eval_every_s,
         slo=fleet.SloPolicy(ttft_s=args.ttft_slo,
                             e2e_s=args.e2e_slo),
         autoscaler=fleet.AutoscalerConfig(
@@ -768,7 +784,8 @@ def run_fleet(args: argparse.Namespace) -> int:
         sched=(fleet.FleetSchedConfig(policy=args.sched_policy)
                if args.sched else None),
         health=(fleet.DetectorConfig.from_env()
-                if args.health else None))
+                if args.health else None),
+        event_core=(False if args.no_event_core else None))
     clock = fleet.VirtualClock()
     factory = None
     if args.engine == "serving":
@@ -967,7 +984,8 @@ def run_globe(args: argparse.Namespace) -> int:
         workload=globe.GlobeWorkloadSpec(
             process=args.process, rps=args.rps,
             n_per_zone=args.requests,
-            diurnal_period_s=args.diurnal_period_s))
+            diurnal_period_s=args.diurnal_period_s),
+        event_core=(False if args.no_event_core else None))
     if args.trace_file:
         traces = globe.load_globe_trace(args.trace_file)
     else:

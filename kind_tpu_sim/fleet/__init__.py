@@ -25,6 +25,18 @@ from kind_tpu_sim.fleet.autoscaler import (  # noqa: F401
     ScaleEvent,
     resolve_warmup_s,
 )
+from kind_tpu_sim.fleet.events import (  # noqa: F401
+    LANE_ARRIVAL,
+    LANE_AUTOSCALER,
+    LANE_CHAOS,
+    LANE_COMPLETION,
+    LANE_HEALTH_PROBE,
+    LANE_PLANNER,
+    LANES,
+    DueSet,
+    EventHeap,
+    resolve_event_core,
+)
 from kind_tpu_sim.fleet.loadgen import (  # noqa: F401
     FLEET_SEED_ENV,
     TraceRequest,

@@ -1,0 +1,152 @@
+"""The deterministic event heap — the simulation core's clockwork.
+
+ROADMAP item 1 (docs/PERFORMANCE.md "The event core"): the fleet and
+globe drivers used to advance a fixed-width tick loop, so wall time
+scaled with *simulated seconds*; with the event core they advance
+between *interesting instants* — the tick boundaries at which some
+state can actually change — and wall time scales with *event count*.
+A 24h diurnal day of a million requests stops costing 8.6M full
+passes over every replica, router queue, and health probe.
+
+Two pieces live here:
+
+* :class:`EventHeap` — the deterministic priority queue every timed
+  occurrence (DCN deliveries, replica warm-ups, gang rebinds, chaos)
+  is scheduled on. Entries are ``(time, lane, seq, payload)``:
+  ``lane`` is a fixed total order over event kinds (arrival <
+  completion < chaos < health-probe < autoscaler-eval < planner) and
+  ``seq`` is a monotone per-lane counter, so a pop is a pure function
+  of the push sequence — payloads are NEVER compared (the classic
+  heapq nondeterminism detlint's ``heap-order`` rule now rejects),
+  and same-instant events resolve by (lane, insertion order), never
+  by dict identity or hash order.
+
+* :func:`resolve_event_core` — the ``KIND_TPU_SIM_FLEET_EVENT_CORE``
+  switch (default on). The event core is an *execution strategy*,
+  not workload config: reports are byte-identical with it on or off,
+  because decision-makers fire on the identical grid of tick-sized
+  float additions the plain loop takes (docs/PERFORMANCE.md "the
+  tick-grid contract") and the analytic replicas compute their event
+  times in closed form either way. ``0`` forces the per-tick loop.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+from kind_tpu_sim.analysis import knobs
+
+EVENT_CORE_ENV = knobs.FLEET_EVENT_CORE
+
+# The fixed total order over event kinds at one instant. Lower lane
+# wins the tie at equal time; within a lane, insertion order (seq)
+# wins. The order mirrors the step() sequence the drivers enforce at
+# each boundary, so heap order and processing order agree.
+LANE_ARRIVAL = 0
+LANE_COMPLETION = 1
+LANE_CHAOS = 2
+LANE_HEALTH_PROBE = 3
+LANE_AUTOSCALER = 4
+LANE_PLANNER = 5
+
+LANES = (LANE_ARRIVAL, LANE_COMPLETION, LANE_CHAOS,
+         LANE_HEALTH_PROBE, LANE_AUTOSCALER, LANE_PLANNER)
+
+
+def resolve_event_core(value: Optional[bool] = None) -> bool:
+    """Explicit value > env (KIND_TPU_SIM_FLEET_EVENT_CORE) > on."""
+    if value is not None:
+        return bool(value)
+    return bool(knobs.get(EVENT_CORE_ENV))
+
+
+class EventHeap:
+    """Deterministic min-heap of ``(time, lane, seq, payload)``.
+
+    The comparison NEVER reaches the payload: ``(time, lane)`` ties
+    break on the per-lane monotone ``seq``, so pop order is a pure
+    function of the seeded push sequence — the property the whole
+    byte-identical-replay contract rests on, and the property
+    ``detlint``'s ``heap-order`` rule checks every raw heappush in
+    the tree for.
+    """
+
+    __slots__ = ("_heap", "_seq")
+
+    def __init__(self) -> None:
+        self._heap: List[tuple] = []
+        self._seq: List[int] = [0] * len(LANES)
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:
+        return bool(self._heap)
+
+    def push(self, time_s: float, lane: int, payload: object) -> None:
+        seq = self._seq[lane]
+        self._seq[lane] = seq + 1
+        heapq.heappush(self._heap, (time_s, lane, seq, payload))
+
+    def peek_time(self) -> Optional[float]:
+        """Time of the earliest entry (None when empty) — the O(1)
+        read the drivers' next-wake computation is built on."""
+        return self._heap[0][0] if self._heap else None
+
+    def pop(self) -> Tuple[float, int, object]:
+        time_s, lane, _, payload = heapq.heappop(self._heap)
+        return time_s, lane, payload
+
+    def pop_due(self, now: float) -> List[object]:
+        """Payloads of every entry with ``time <= now``, in (time,
+        lane, seq) order — the per-boundary drain the drivers call."""
+        out: List[object] = []
+        while self._heap and self._heap[0][0] <= now:
+            out.append(heapq.heappop(self._heap)[3])
+        return out
+
+
+class DueSet:
+    """The three-way answer to "when must the driver step next?".
+
+    ``immediate`` — some state machine needs every boundary (a
+    non-empty router queue, a draining replica, scheduler activity,
+    an engine-backed replica mid-stream): step the very next tick.
+    ``ge`` — the earliest *boundary-condition* instant ``t``: the
+    first grid boundary ``B >= t`` must be stepped (arrivals, chaos,
+    warm-ups, probe deadlines all apply at ``t <= now``).
+    ``cover`` — the earliest *mid-tick* instant ``t`` (an analytic
+    replica's next slot event): the boundary ``B`` with
+    ``B + tick >= t`` must be stepped, because the per-tick loop
+    processes slot events in ``(now, now + tick]``.
+    """
+
+    __slots__ = ("immediate", "ge", "cover")
+
+    def __init__(self) -> None:
+        self.immediate = False
+        self.ge = float("inf")
+        self.cover = float("inf")
+
+    def need_now(self) -> "DueSet":
+        self.immediate = True
+        return self
+
+    def at(self, t: Optional[float]) -> "DueSet":
+        if t is not None and t < self.ge:
+            self.ge = t
+        return self
+
+    def covering(self, t: Optional[float]) -> "DueSet":
+        if t is not None and t < self.cover:
+            self.cover = t
+        return self
+
+    def merge(self, other: "DueSet") -> "DueSet":
+        self.immediate = self.immediate or other.immediate
+        if other.ge < self.ge:
+            self.ge = other.ge
+        if other.cover < self.cover:
+            self.cover = other.cover
+        return self

@@ -70,8 +70,13 @@ class SimReplicaConfig:
 
 class SimReplica:
     """Deterministic service-time model of one continuous-batching
-    engine. Slots run independent (prefill -> decode) timelines inside
-    each tick; admission and queue-deadline reaping happen at tick
+    engine. Slots run independent (prefill -> decode) timelines in
+    CLOSED FORM: every slot carries the absolute virtual time of its
+    next event (first token, next decoded token), so advancing a slot
+    over [t0, t1] produces the identical floats whether the span is
+    covered by one ``tick()`` call or a hundred — the partition
+    invariance the event core (docs/PERFORMANCE.md "The event core")
+    rests on. Admission and queue-deadline reaping happen at tick
     boundaries, like the engine's chunk-boundary scheduling."""
 
     def __init__(self, replica_id: int,
@@ -94,8 +99,11 @@ class SimReplica:
     def set_slowdown(self, factor: float) -> None:
         """Inflate (or restore, factor=1) this replica's service
         times: prefill and TPOT both scale. Applies to work admitted
-        OR advancing after the call — the gray fault is a property
-        of the hardware, not of individual requests."""
+        OR tokens scheduled after the call — the gray fault is a
+        property of the hardware, not of individual requests. An
+        already-scheduled in-flight token keeps its event time (the
+        remainder-carry semantics the gray scenarios were built on);
+        every subsequent token picks up the new factor."""
         self.slowdown = max(1.0, float(factor))
 
     # -- replica interface -------------------------------------------
@@ -147,84 +155,127 @@ class SimReplica:
         the prompt so a hit never zeroes prefill entirely."""
         return min(len(req.prompt) // 2, 16)
 
+    def next_due(self) -> tuple:
+        """``(ge_s, cover_s)`` — the event core's view of this
+        replica (docs/PERFORMANCE.md "The event core"). ``ge_s`` is
+        the earliest *boundary-condition* instant (a queued request's
+        deadline expiry, or 0.0 when queued work can admit into a
+        free slot at the very next boundary). ``cover_s`` is a SAFE
+        LOWER BOUND on the earliest externally visible in-slot event
+        — a request completing by length or by deadline; the
+        intermediate per-token events are internal and partition-
+        invariant, so the boundaries between completions need no
+        stepping. The bound is closed-form (one multiply) while the
+        true completion time is a chained sum, so a float-noise
+        margin keeps it on the early side: waking a tick early costs
+        one no-op step, waking late would break replay identity.
+        Either value is None when nothing is scheduled."""
+        if not self.healthy:
+            return (None, None)
+        ge = None
+        if self.queue:
+            if any(s is None for s in self._slots):
+                ge = 0.0  # admission at the next boundary
+            else:
+                for req in self.queue:
+                    if req.deadline_s is None:
+                        continue
+                    d = req.arrival_s + req.deadline_s
+                    if ge is None or d < ge:
+                        ge = d
+        cover = None
+        step = self.cfg.tpot_s * self.slowdown
+        for slot in self._slots:
+            if slot is None:
+                continue
+            req = slot["req"]
+            if slot["first_s"] is None:
+                # prefill event, then >= max(max_new - 1, 1) decodes
+                k = max(req.max_new - 1, 1)
+            else:
+                k = max(req.max_new - slot["tokens"], 1) - 1
+            lb = slot["next_s"] + k * step
+            if req.deadline_s is not None:
+                # a deadline emission fires at the last in-budget
+                # token event, somewhere in (deadline - step,
+                # deadline]
+                d = req.arrival_s + req.deadline_s - step
+                if d < lb:
+                    lb = d
+            lb -= 1e-9 + 1e-12 * abs(lb)
+            if cover is None or lb < cover:
+                cover = lb
+        return (ge, cover)
+
     def tick(self, now: float, dt: float) -> List[ReplicaCompletion]:
-        """Advance this replica's slots through [now, now + dt)."""
+        """Advance this replica through (now, now + dt]: reap and
+        admit at the boundary, then process every scheduled slot
+        event inside the window. A call covering no event is a
+        strict no-op — the property that lets the event core skip
+        the boundaries in between."""
         if not self.healthy:
             return []
         done: List[ReplicaCompletion] = []
-        # reap queued requests whose deadline passed while waiting
-        still: List[TraceRequest] = []
-        for req in self.queue:
-            if (req.deadline_s is not None
-                    and now >= req.arrival_s + req.deadline_s):
-                done.append(ReplicaCompletion(
-                    request=req, dispatch_s=now, first_s=None,
-                    finish_s=round(req.arrival_s + req.deadline_s, 9),
-                    tokens=0, tokens_crc=0,
-                    finish_reason="deadline_exceeded"))
-            else:
-                still.append(req)
-        self.queue = still
-        # admit into free slots (tick boundary = chunk boundary)
-        for i, slot in enumerate(self._slots):
-            if slot is None and self.queue:
-                req = self.queue.pop(0)
-                self._slots[i] = {
-                    "req": req,
-                    "dispatch_s": now,
-                    "prefill_left": self._prefill_cost(req),
-                    "decode_left": 0.0,  # current token's remainder
-                    "first_s": None,
-                    "tokens": 0,
-                    "t": now,  # slot-local timeline cursor
-                }
-        # advance each slot's local timeline to now + dt. Partial
-        # progress on the current token carries ACROSS ticks
-        # (decode_left) — truncating it at tick boundaries would
-        # stall decode outright whenever the (possibly gray-
-        # inflated) TPOT exceeds the tick quantum
+        if self.queue:
+            # reap queued requests whose deadline passed waiting
+            still: List[TraceRequest] = []
+            for req in self.queue:
+                if (req.deadline_s is not None
+                        and now >= req.arrival_s + req.deadline_s):
+                    done.append(ReplicaCompletion(
+                        request=req, dispatch_s=now, first_s=None,
+                        finish_s=round(
+                            req.arrival_s + req.deadline_s, 9),
+                        tokens=0, tokens_crc=0,
+                        finish_reason="deadline_exceeded"))
+                else:
+                    still.append(req)
+            self.queue = still
+            # admit into free slots (tick boundary = chunk boundary)
+            for i, slot in enumerate(self._slots):
+                if slot is None and self.queue:
+                    req = self.queue.pop(0)
+                    self._slots[i] = {
+                        "req": req,
+                        "dispatch_s": now,
+                        # absolute time of the slot's next event:
+                        # first token at prefill end, then one event
+                        # per decoded token
+                        "next_s": now + self._prefill_cost(req),
+                        "first_s": None,
+                        "tokens": 0,
+                    }
         end = now + dt
         for i, slot in enumerate(self._slots):
-            if slot is None:
+            if slot is None or slot["next_s"] > end:
                 continue
             req = slot["req"]
             deadline = (req.arrival_s + req.deadline_s
                         if req.deadline_s is not None else None)
-            while slot["t"] < end:
-                if slot["prefill_left"] > 0:
-                    step = min(slot["prefill_left"],
-                               end - slot["t"])
-                    slot["prefill_left"] -= step
-                    slot["t"] += step
-                    if slot["prefill_left"] <= 1e-12:
-                        slot["prefill_left"] = 0.0
-                        slot["first_s"] = slot["t"]
-                        slot["tokens"] = 1
-                    continue
-                if slot["decode_left"] <= 0.0:
-                    slot["decode_left"] = (self.cfg.tpot_s
-                                           * self.slowdown)
-                nxt = slot["t"] + slot["decode_left"]
+            while slot["next_s"] <= end:
+                t = slot["next_s"]
+                if slot["first_s"] is None:
+                    # prefill done: the first token lands at t
+                    slot["first_s"] = t
+                    slot["tokens"] = 1
+                else:
+                    slot["tokens"] += 1
+                    if slot["tokens"] >= req.max_new:
+                        done.append(self._complete(
+                            slot, finish_s=t, reason="length"))
+                        self._slots[i] = None
+                        break
+                # schedule the next token at the CURRENT slowdown;
+                # an overshooting deadline fires the moment it is
+                # provable, stamped at the deadline itself
+                nxt = t + self.cfg.tpot_s * self.slowdown
                 if deadline is not None and nxt > deadline:
                     done.append(self._complete(
                         slot, finish_s=deadline,
                         reason="deadline_exceeded"))
                     self._slots[i] = None
                     break
-                if nxt > end:
-                    slot["decode_left"] = nxt - end
-                    slot["t"] = end
-                    break
-                slot["t"] = nxt
-                slot["decode_left"] = 0.0
-                slot["tokens"] += 1
-                if slot["tokens"] >= req.max_new:
-                    done.append(self._complete(
-                        slot, finish_s=slot["t"], reason="length"))
-                    self._slots[i] = None
-                    break
-            else:
-                continue
+                slot["next_s"] = nxt
         # a slot that finished mid-tick stays empty until the next
         # tick's admission pass — the chunk-boundary contract
         return done

@@ -1,14 +1,24 @@
 """The fleet driver: trace -> router -> replicas -> SLO report.
 
-One tick loop on the virtual clock glues the pieces together:
-arrivals due this tick enter the router (or shed), the router places
-its queue by policy, every replica advances one tick, completions
-stream into the SLO tracker and the per-request completion log, and
-the autoscaler gets one observation per evaluation interval. Chaos
-events (replica preemption / restore) fire at planned virtual times
-and displaced requests requeue at the router — the same loop the
-`fleet run` CLI, the bench fleet section, and the chaos fleet
-scenarios all drive.
+One virtual-clock loop glues the pieces together: arrivals due at a
+boundary enter the router (or shed), the router places its queue by
+policy, every replica advances through the boundary's window,
+completions stream into the SLO tracker and the per-request
+completion log, and the autoscaler gets one observation per
+evaluation interval. Chaos events (replica preemption / restore)
+fire at planned virtual times and displaced requests requeue at the
+router — the same loop the `fleet run` CLI, the bench fleet section,
+and the chaos fleet scenarios all drive.
+
+Two execution strategies cover one semantics (docs/PERFORMANCE.md
+"The event core"): the plain loop steps EVERY tick boundary; the
+event core (``KIND_TPU_SIM_FLEET_EVENT_CORE``, default on) steps
+only the boundaries where an event lands — arrivals, chaos, replica
+slot events (closed-form, fleet/events.py), warm-ups, probe
+deadlines, autoscaler evaluations — and advances the clock through
+the skipped boundaries by the identical tick-sized float additions,
+so wall time scales with event count while reports stay
+byte-identical with the core on or off.
 
 Determinism: the loop consumes no wall time, no entropy, and iterates
 replicas in id order; the completion log is emitted sorted by
@@ -30,6 +40,13 @@ from kind_tpu_sim.fleet.autoscaler import (
     Autoscaler,
     AutoscalerConfig,
     resolve_warmup_s,
+)
+from kind_tpu_sim.fleet.events import (
+    LANE_AUTOSCALER,
+    LANE_CHAOS,
+    DueSet,
+    EventHeap,
+    resolve_event_core,
 )
 from kind_tpu_sim.fleet.loadgen import TraceRequest, VirtualClock
 from kind_tpu_sim.fleet.router import (
@@ -146,7 +163,15 @@ class FleetConfig:
     max_queue: int = 1024              # router admission bound
     max_virtual_s: float = 600.0       # runaway-loop backstop
     autoscale: bool = False
-    eval_every_ticks: int = 10         # autoscaler cadence
+    # autoscaler cadence. eval_every_ticks is DEPRECATED (it couples
+    # the real-time evaluation cadence to the tick width: changing
+    # KIND_TPU_SIM_FLEET_TICK_S silently changed how often the
+    # control loop ran); prefer eval_every_s — virtual seconds
+    # between evaluations, snapped to the tick grid. The derived
+    # default (eval_every_ticks * tick_s) keeps existing replays
+    # byte-identical.
+    eval_every_ticks: int = 10
+    eval_every_s: Optional[float] = None
     slo: SloPolicy = SloPolicy(ttft_s=0.5, e2e_s=2.0)
     sim: SimReplicaConfig = SimReplicaConfig()
     autoscaler: AutoscalerConfig = AutoscalerConfig()
@@ -161,6 +186,10 @@ class FleetConfig:
     # byte-identical either way, so it deliberately stays OUT of
     # as_dict() — an ff-on and an ff-off run must diff clean.
     fast_forward: Optional[bool] = None
+    # event-heap core (None -> resolve_event_core(), default on).
+    # Same contract as fast_forward: an execution strategy that must
+    # diff clean on vs off, so it stays OUT of as_dict() too.
+    event_core: Optional[bool] = None
 
     def as_dict(self) -> dict:
         out = {
@@ -174,6 +203,8 @@ class FleetConfig:
                     if v is not None},
             "sim": dataclasses.asdict(self.sim),
         }
+        if self.eval_every_s is not None:
+            out["eval_every_s"] = self.eval_every_s
         if self.sched is not None:
             out["sched"] = self.sched.as_dict()
         if self.health is not None:
@@ -221,7 +252,12 @@ class FleetSim:
         # recent attained-flags window: the autoscaler's SLO signal
         self._recent = deque(maxlen=64)
         self._next_replica_id = cfg.replicas
-        self._warming: List[tuple] = []   # (ready_at_s, replica)
+        # replicas paid for but not yet routable: an EventHeap of
+        # (ready_at_s, LANE_AUTOSCALER, seq, (replica, reason))
+        self._warming = EventHeap()
+        # gang-evicted replicas awaiting rebind+warmup heal — only
+        # populated on scheduler-backed fleets
+        self._rebinding = EventHeap()
         self._draining: List = []
         self.preemptions = 0
         self.sched = None
@@ -229,9 +265,28 @@ class FleetSim:
         self._ticks = 0
         self._pending = deque(self.trace)
         self._fast_forward = resolve_fast_forward(cfg.fast_forward)
-        # empty ticks skipped by fast-forward — observability only,
-        # deliberately NOT in the report (ff on/off must diff clean)
+        self._event_core = resolve_event_core(cfg.event_core)
+        # effective autoscaler cadence in ticks: eval_every_s snaps
+        # to the grid; the deprecated tick count is the fallback
+        if cfg.eval_every_s is not None:
+            self._eval_ticks = max(1, int(round(
+                cfg.eval_every_s / resolve_tick_s(cfg.tick_s))))
+        else:
+            self._eval_ticks = max(1, cfg.eval_every_ticks)
+        # empty ticks skipped by fast-forward / boundaries skipped by
+        # the event core — observability only, deliberately NOT in
+        # the report (each mode on/off must diff clean)
         self.ff_skipped = 0
+        self.ev_skipped = 0
+        # wake-scan backoff: when a scan concludes "step the next
+        # boundary anyway", hold off re-scanning for a few boundaries
+        # (doubling, capped). Stepping a boundary is ALWAYS
+        # semantics-identical to the plain loop, so this is a pure
+        # cost heuristic — dense regions stop paying scan overhead
+        # per tick, sparse regions amortize one scan over the whole
+        # jump. Deterministic: a function of sim state only.
+        self._scan_holdoff = 0
+        self._scan_backoff = 1
         # gray-failure bookkeeping: replicas currently slowed by an
         # explicit chaos `slow` (rid -> factor) or by a degraded ICI
         # domain — the ground truth false-positive accounting is
@@ -263,8 +318,6 @@ class FleetSim:
         self._gang_replica: Dict[str, int] = {}
         # gangs whose bind we are waiting on: name -> requested_at
         self._gang_requested: Dict[str, float] = {}
-        # replicas evicted by node chaos, awaiting rebind+warmup
-        self._rebinding: List[tuple] = []  # (ready_at_s, replica)
         self.time_to_routable: List[float] = []
         for replica in self.replicas:
             name = f"replica-{replica.replica_id}"
@@ -338,12 +391,13 @@ class FleetSim:
             existing = self._replica_by_id(rid)
             if existing is not None:
                 # evicted replica rebound: heals at ready_at
-                self._rebinding.append((ready_at, existing))
+                self._rebinding.push(ready_at, LANE_CHAOS, existing)
             else:
                 # autoscaler scale-up: new replica warms up
-                self._warming.append((
-                    ready_at, self.factory(rid),
-                    f"bound+warm (time_to_routable={ttr}s)"))
+                self._warming.push(
+                    ready_at, LANE_AUTOSCALER,
+                    (self.factory(rid),
+                     f"bound+warm (time_to_routable={ttr}s)"))
 
     def _apply_node_chaos(self, ev: "ChaosEvent",
                           now: float) -> None:
@@ -596,12 +650,7 @@ class FleetSim:
     def _autoscale(self, now: float) -> None:
         scaler = self.autoscaler
         # warming replicas come online first
-        ready = [w for w in self._warming if w[0] <= now]
-        self._warming = [w for w in self._warming if w[0] > now]
-        for entry in ready:
-            replica = entry[1]
-            reason = (entry[2] if len(entry) > 2
-                      else "warmup complete")
+        for replica, reason in self._warming.pop_due(now):
             self.replicas.append(replica)
             self.router.replicas.append(replica)
             scaler.note_ready(now, len(self.router.replicas),
@@ -630,8 +679,9 @@ class FleetSim:
                 self._gang_replica[name] = rid
                 self._gang_requested[name] = now
             else:
-                self._warming.append(
-                    (now + scaler.warmup_s, self.factory(rid)))
+                self._warming.push(
+                    now + scaler.warmup_s, LANE_AUTOSCALER,
+                    (self.factory(rid), "warmup complete"))
         elif action == "scale_down":
             # drain the highest-id healthy replica: no new traffic,
             # removed once idle — scale-down never displaces work
@@ -660,11 +710,8 @@ class FleetSim:
         if self.sched is not None:
             self._drain_migrations(now)
             self._sched_step(now)
-            healed = [w for w in self._rebinding
-                      if w[0] <= now]
-            self._rebinding = [w for w in self._rebinding
-                               if w[0] > now]
-            for _, replica in healed:
+            healed = self._rebinding.pop_due(now)
+            for replica in healed:
                 replica.restore(now)
                 metrics.recovery_log().record(
                     "fleet_gang_rebound",
@@ -672,7 +719,7 @@ class FleetSim:
                     at_s=round(now, 6))
             if healed:
                 self._refresh_link_slowdowns(now)
-            for _, replica in healed:
+            for replica in healed:
                 comp = f"replica-{replica.replica_id}"
                 if (self.health is not None
                         and self.health.quarantined(comp)):
@@ -713,7 +760,7 @@ class FleetSim:
                         f"replica-{replica.replica_id}", now,
                         reason="scale-down drained")
         if (self.autoscaler is not None
-                and self._ticks % self.cfg.eval_every_ticks == 0):
+                and self._ticks % self._eval_ticks == 0):
             self._autoscale(now)
         self._ticks += 1
 
@@ -757,15 +804,125 @@ class FleetSim:
             return False
         return True
 
+    def _next_wake(self, pending: deque) -> DueSet:
+        """The event core's scheduling question: when does step()
+        stop being a no-op? Sources that need every boundary (a
+        non-empty router queue, scheduler activity, a draining
+        replica, an engine-backed replica mid-stream) answer
+        ``immediate``; timed sources (arrivals, chaos, warm-ups,
+        probe deadlines) answer with boundary-condition times; the
+        analytic replicas answer with closed-form in-slot event
+        times the covering tick must process. Everything here is a
+        pure read — the answer stays valid for exactly as long as no
+        boundary is stepped, which is the invariant the skip loop
+        relies on."""
+        due = DueSet()
+        if pending:
+            due.at(pending[0].arrival_s)
+        if self.chaos_events:
+            due.at(self.chaos_events[0].at_s)
+        if self.router.queue or self._draining:
+            return due.need_now()
+        if self.sched is not None and (
+                self.sched.pending or self._gang_requested
+                or self._migrate_pending):
+            return due.need_now()
+        due.at(self._warming.peek_time())
+        due.at(self._rebinding.peek_time())
+        for replica in self.replicas:
+            nd = getattr(replica, "next_due", None)
+            if nd is None:
+                # engine-backed (or foreign) replica: its stride
+                # counter advances per tick() call, so only a
+                # provably inert one may be skipped — the real-
+                # ServingEngine tick mode stays the slow path
+                if not (replica.idle()
+                        and getattr(replica, "slowdown", 1.0)
+                        == 1.0):
+                    return due.need_now()
+                continue
+            ge, cover = nd()
+            due.at(ge)
+            due.covering(cover)
+        if self.health is not None and pending:
+            # probes fire while user traffic still flows, one per
+            # suspect-or-quarantined alive replica per interval
+            for replica in self.replicas:
+                comp = f"replica-{replica.replica_id}"
+                if (not replica.healthy
+                        or self.health.state(comp) == "healthy"):
+                    continue
+                last = self._probe_last.get(comp)
+                due.at(0.0 if last is None else
+                       last + self.health.cfg.probe_interval_s)
+        return due
+
+    def _skip_uninteresting(self, tick: float,
+                            pending: deque) -> None:
+        """The event-core jump: having advanced to the next
+        boundary, keep advancing (identical tick-sized float
+        additions — a single n*tick jump would land on different
+        floats) past every boundary where step() is provably a
+        no-op. Skipped boundaries still count into the tick-grid
+        index so the autoscaler's evaluation cadence lands on the
+        identical boundaries as the plain loop."""
+        # dense-path fast exits: when an arrival or chaos event is
+        # already due at this boundary, it will be stepped no matter
+        # what — don't pay the wake scan just to learn that
+        b = self.clock.now()
+        if pending and pending[0].arrival_s <= b:
+            return
+        if self._scan_holdoff > 0:
+            self._scan_holdoff -= 1
+            return
+        if self.chaos_events and self.chaos_events[0].at_s <= b:
+            return
+        due = self._next_wake(pending)
+        if due.immediate:
+            return
+        evals_away = -1
+        if self.autoscaler is not None:
+            r = self._ticks % self._eval_ticks
+            evals_away = (self._eval_ticks - r) % self._eval_ticks
+            if evals_away == 0:
+                return  # this boundary IS an evaluation boundary
+        due_ge = due.ge
+        due_cover = due.cover
+        limit = self.cfg.max_virtual_s
+        adv = self.clock.advance
+        now = self.clock.now
+        skipped = 0
+        while True:
+            b = now()
+            if b > limit or due_ge <= b or due_cover <= b + tick:
+                break
+            adv(tick)
+            self._ticks += 1
+            skipped += 1
+            if evals_away > 0:
+                evals_away -= 1
+                if evals_away == 0:
+                    break
+        self.ev_skipped += skipped
+        if skipped:
+            self._scan_backoff = 1
+        else:
+            self._scan_holdoff = self._scan_backoff
+            self._scan_backoff = min(self._scan_backoff * 2, 32)
+
     def _advance(self, tick: float, pending: deque) -> None:
-        """Advance the clock one tick — or, on a provably idle gap
-        with fast-forward enabled, through every empty tick up to
-        the next arrival/chaos event in one tight loop. The clock
-        still takes the IDENTICAL sequence of tick-sized float
-        additions (a single jump of n*tick would land on a
-        different float), so replays diff clean with fast-forward
-        on or off; only the per-tick bookkeeping is skipped."""
+        """Advance the clock one tick — then, with the event core
+        enabled, jump past every provably uninteresting boundary
+        (docs/PERFORMANCE.md "The event core"); or, on a provably
+        idle gap with the legacy fast-forward enabled, through every
+        empty tick up to the next arrival/chaos event. Either way
+        the clock takes the IDENTICAL sequence of tick-sized float
+        additions, so replays diff clean with the core (or ff) on or
+        off; only the per-tick bookkeeping is skipped."""
         self.clock.advance(tick)
+        if self._event_core:
+            self._skip_uninteresting(tick, pending)
+            return
         if not self._fast_forward or not self._idle_gap(pending):
             return
         next_s = pending[0].arrival_s if pending else float("inf")

@@ -16,10 +16,10 @@ layers, it does not reimplement them.
 from __future__ import annotations
 
 import dataclasses
-import heapq
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from kind_tpu_sim.fleet.events import LANE_ARRIVAL, DueSet, EventHeap
 from kind_tpu_sim.fleet.loadgen import TraceRequest, VirtualClock
 from kind_tpu_sim.fleet.sim import FleetConfig, FleetSim
 
@@ -57,10 +57,11 @@ class Cell:
         if on_complete is not None:
             self.sim.on_complete = on_complete
         self.pending: deque = deque()
-        # (deliver_s, seq, request): seq is admission order — the
-        # deterministic tiebreak for same-tick deliveries
-        self.delivery: List[tuple] = []
-        self._seq = 0
+        # requests in DCN flight, on the deterministic event heap
+        # (fleet/events.py): (deliver_s, ARRIVAL lane, seq, request)
+        # — seq is admission order, the tiebreak for same-tick
+        # deliveries
+        self.delivery = EventHeap()
         self.alive = True
         self.draining = False
         self.peak_outstanding = 0
@@ -92,15 +93,12 @@ class Cell:
     # -- the globe driver's surface ----------------------------------
 
     def admit(self, req: TraceRequest, deliver_s: float) -> None:
-        heapq.heappush(self.delivery,
-                       (deliver_s, self._seq, req))
-        self._seq += 1
+        self.delivery.push(deliver_s, LANE_ARRIVAL, req)
         self.peak_outstanding = max(self.peak_outstanding,
                                     self.outstanding())
 
     def deliver_due(self, now: float) -> None:
-        while self.delivery and self.delivery[0][0] <= now:
-            self.pending.append(heapq.heappop(self.delivery)[2])
+        self.pending.extend(self.delivery.pop_due(now))
 
     def step(self, now: float, tick: float) -> None:
         if self.alive:
@@ -121,6 +119,20 @@ class Cell:
             return True
         return self.sim._idle_gap(self.pending)
 
+    def event_due(self) -> DueSet:
+        """The event core's per-cell leg (docs/PERFORMANCE.md "The
+        event core"): delivered-but-unticked work needs the next
+        boundary; in-DCN-flight requests apply at their delivery
+        instants; everything inside the fleet answers through the
+        fleet's own wake computation. A dead cell is inert."""
+        due = DueSet()
+        if not self.alive:
+            return due
+        if self.pending:
+            return due.need_now()
+        due.at(self.delivery.peek_time())
+        return due.merge(self.sim._next_wake(self.pending))
+
     # -- blast-radius chaos ------------------------------------------
 
     def fail(self, now: float) -> List[TraceRequest]:
@@ -136,8 +148,7 @@ class Cell:
         self.sim.router.queue = []
         displaced.extend(self.pending)
         self.pending.clear()
-        displaced.extend(req for _, _, req in self.delivery)
-        self.delivery = []
+        displaced.extend(self.delivery.pop_due(float("inf")))
         self.alive = False
         return displaced
 

@@ -42,6 +42,7 @@ from kind_tpu_sim.fleet.loadgen import (
     WorkloadSpec,
     generate_trace,
 )
+from kind_tpu_sim.fleet.events import DueSet, resolve_event_core
 from kind_tpu_sim.fleet.router import SimReplicaConfig
 from kind_tpu_sim.fleet.sim import (
     FleetConfig,
@@ -133,6 +134,10 @@ class GlobeConfig:
     dcn_base_s: float = 0.01
     intra_zone_s: float = 0.0005
     fast_forward: Optional[bool] = None
+    # event-heap core (None -> resolve_event_core(), default on) —
+    # an execution strategy like fast_forward: byte-identical on or
+    # off, so it stays OUT of as_dict()
+    event_core: Optional[bool] = None
 
     def cell_names(self) -> List[str]:
         return [f"{z}/c{i}" for z in self.zones
@@ -286,9 +291,16 @@ class GlobeSim:
             key=lambda t: (t[0].arrival_s, t[0].request_id)))
         self.requests = len(self._arrivals)
         self._ff = resolve_fast_forward(cfg.fast_forward)
-        # empty ticks skipped by fast-forward — observability only,
-        # NOT in the report (ff on/off must diff clean)
+        self._event_core = resolve_event_core(cfg.event_core)
+        # empty ticks skipped by fast-forward / boundaries skipped
+        # by the event core — observability only, NOT in the report
+        # (each mode on/off must diff clean)
         self.ff_skipped = 0
+        self.ev_skipped = 0
+        # wake-scan backoff (see fleet/sim.py): stepping is always
+        # safe, so scan frequency is a pure cost heuristic
+        self._scan_holdoff = 0
+        self._scan_backoff = 1
 
     def _fleet_config(self, zone: str) -> FleetConfig:
         cfg = self.cfg
@@ -447,13 +459,90 @@ class GlobeSim:
             and not self.chaos_events
             and all(c.quiescent() for c in self.cells))
 
+    def _skip_uninteresting(self, tick: float) -> None:
+        """The event-core jump at globe scale (docs/PERFORMANCE.md
+        "The event core"): cells stop being per-tick steppers and
+        become event producers — each answers when anything inside
+        it (DCN delivery, slot event, warm-up, scheduler activity)
+        next lands, the front door and planner contribute their own
+        instants, and every boundary in between is skipped by the
+        identical tick-sized float additions. Skipped boundaries
+        still count into each ALIVE cell's tick-grid index so
+        per-cell autoscaler cadences land on the identical
+        boundaries as the lockstep loop (a dead cell's index is
+        frozen either way — it is not stepped)."""
+        # dense-path fast exits: this boundary will be stepped no
+        # matter what — skip the cell scan
+        b = self.clock.now()
+        if self._arrivals and self._arrivals[0][0].arrival_s <= b:
+            return
+        if self._scan_holdoff > 0:
+            self._scan_holdoff -= 1
+            return
+        if self.chaos_events and self.chaos_events[0].at_s <= b:
+            return
+        if self.frontdoor.queue:
+            return
+        due = DueSet()
+        if self._arrivals:
+            due.at(self._arrivals[0][0].arrival_s)
+        if self.chaos_events:
+            due.at(self.chaos_events[0].at_s)
+        if self.planner is not None:
+            due.at(self._next_eval)
+        if self.frontdoor.queue:
+            due.need_now()
+        alive_sims = []
+        evals_away = -1
+        for cell in self.cells:
+            due.merge(cell.event_due())
+            if cell.alive:
+                sim = cell.sim
+                alive_sims.append(sim)
+                if sim.autoscaler is not None:
+                    r = sim._ticks % sim._eval_ticks
+                    away = (sim._eval_ticks - r) % sim._eval_ticks
+                    if evals_away < 0 or away < evals_away:
+                        evals_away = away
+        if due.immediate or evals_away == 0:
+            return
+        due_ge = due.ge
+        due_cover = due.cover
+        limit = self.cfg.max_virtual_s
+        adv = self.clock.advance
+        now = self.clock.now
+        skipped = 0
+        while True:
+            b = now()
+            if b > limit or due_ge <= b or due_cover <= b + tick:
+                break
+            adv(tick)
+            for sim in alive_sims:
+                sim._ticks += 1
+            skipped += 1
+            if evals_away > 0:
+                evals_away -= 1
+                if evals_away == 0:
+                    break
+        self.ev_skipped += skipped
+        if skipped:
+            self._scan_backoff = 1
+        else:
+            self._scan_holdoff = self._scan_backoff
+            self._scan_backoff = min(self._scan_backoff * 2, 32)
+
     def _advance(self, tick: float) -> None:
-        """One clock tick — or, across a globally idle gap (every
-        cell idle, front door drained, no planner), every empty tick
-        up to the next arrival/chaos event, by the same sequence of
+        """One clock tick — then, with the event core enabled, past
+        every provably uninteresting boundary; or, across a globally
+        idle gap (every cell idle, front door drained, no planner)
+        with the legacy fast-forward, every empty tick up to the
+        next arrival/chaos event. Always by the same sequence of
         tick-sized additions (byte-identical replays, docs/FLEET.md
         fast-forward contract)."""
         self.clock.advance(tick)
+        if self._event_core:
+            self._skip_uninteresting(tick)
+            return
         if not self._ff or self.planner is not None:
             return
         if self.frontdoor.queue:

@@ -201,6 +201,59 @@ def test_knob_env_direct_read_flagged():
     assert [f.rule for f in fs] == ["knob-env", "knob-env"]
 
 
+def test_heap_order_untiebroken_push_flagged():
+    fs = unwaived("""
+        import heapq
+        def f(heap, t, item):
+            heapq.heappush(heap, (t, item))
+    """)
+    assert rules_of(fs) == ["heap-order"]
+    fs = unwaived("""
+        import heapq
+        def f(heap, t, item):
+            heapq.heapreplace(heap, (t, item))
+    """)
+    assert rules_of(fs) == ["heap-order"]
+
+
+def test_heap_order_tiebroken_and_scalar_pushes_clean():
+    assert unwaived("""
+        import heapq
+        def f(heap, t, seq, item):
+            heapq.heappush(heap, (t, 0, seq, item))
+            heapq.heappush(heap, t)
+    """) == []
+
+
+def test_heap_order_waivable():
+    fs = [f for f in detlint.lint_source(textwrap.dedent("""
+        import heapq
+        def f(heap, t):
+            heapq.heappush(heap, (t, t))  # detlint: ok(heap-order) -- both elements are floats
+    """), "m.py") if not f.waived]
+    assert fs == []
+
+
+def test_event_heap_deterministic_pop_order():
+    """Same-time entries pop by (lane, insertion order) — payloads
+    are never compared (the hazard heap-order exists to catch)."""
+    from kind_tpu_sim.fleet.events import (
+        LANE_ARRIVAL,
+        LANE_CHAOS,
+        EventHeap,
+    )
+
+    h = EventHeap()
+    h.push(1.0, LANE_CHAOS, {"unorderable": True})
+    h.push(1.0, LANE_ARRIVAL, {"unorderable": "too"})
+    h.push(1.0, LANE_ARRIVAL, "second-in-lane")
+    h.push(0.5, LANE_CHAOS, "earliest")
+    assert h.pop_due(1.0) == [
+        "earliest", {"unorderable": "too"}, "second-in-lane",
+        {"unorderable": True}]
+    assert len(h) == 0 and h.peek_time() is None
+
+
 def test_unknown_knob_flagged_registered_clean():
     fs = unwaived("""
         HELP = "set KIND_TPU_SIM_NOT_A_REAL_KNOB to explode"

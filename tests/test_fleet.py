@@ -349,6 +349,128 @@ def test_fleet_scale_down_drains_without_displacement():
             == json.dumps(rep2, sort_keys=True))
 
 
+# -- the event-heap core (docs/PERFORMANCE.md "The event core") -------
+
+
+def test_event_core_byte_identical_and_engaged():
+    """The tentpole contract: event core on vs off produces
+    byte-identical reports — including deadlines, shared prefixes,
+    autoscaling, and chaos — and the core actually skips
+    boundaries."""
+    spec = fleet.WorkloadSpec(process="bursty", rps=300.0,
+                              n_requests=200, deadline_s=2.0,
+                              shared_prefix_frac=0.5)
+    trace = fleet.generate_trace(spec, 3)
+    base = dict(replicas=1, policy="least-outstanding",
+                autoscale=True,
+                sim=fleet.SimReplicaConfig(max_slots=2,
+                                           tpot_s=0.004),
+                autoscaler=fleet.AutoscalerConfig(
+                    min_replicas=1, max_replicas=4, warmup_s=0.2,
+                    cooldown_s=0.5))
+    events = [fleet.ChaosEvent(at_s=0.3, action="preempt",
+                               target=0),
+              fleet.ChaosEvent(at_s=0.6, action="restore",
+                               target=0)]
+    on = fleet.FleetSim(fleet.FleetConfig(event_core=True, **base),
+                        trace, chaos_events=events)
+    a = json.dumps(on.run(), sort_keys=True)
+    off = fleet.FleetSim(
+        fleet.FleetConfig(event_core=False, fast_forward=False,
+                          **base),
+        trace, chaos_events=events)
+    b = json.dumps(off.run(), sort_keys=True)
+    assert a == b
+
+
+def test_event_core_engages_between_completions():
+    """On a trace with headroom, the core skips the boundaries
+    between interesting instants — including ones where requests are
+    IN FLIGHT (the gap fast-forward could never cross)."""
+    spec = fleet.WorkloadSpec(process="poisson", rps=10.0,
+                              n_requests=40, max_new=(32, 64))
+    trace = fleet.generate_trace(spec, 7)
+    on = fleet.FleetSim(
+        fleet.FleetConfig(replicas=2, event_core=True,
+                          fast_forward=False), trace)
+    a = json.dumps(on.run(), sort_keys=True)
+    off = fleet.FleetSim(
+        fleet.FleetConfig(replicas=2, event_core=False,
+                          fast_forward=False), trace)
+    b = json.dumps(off.run(), sort_keys=True)
+    assert a == b
+    assert on.ev_skipped > 0 and off.ev_skipped == 0
+
+
+def test_event_core_knob_default_on(monkeypatch):
+    assert fleet.resolve_event_core() is True
+    monkeypatch.setenv(fleet.events.EVENT_CORE_ENV, "0")
+    assert fleet.resolve_event_core() is False
+    assert fleet.resolve_event_core(True) is True
+
+
+def test_sim_replica_advance_is_partition_invariant():
+    """The closed-form slot model: advancing a replica over a span
+    in one call or many produces identical completions — the
+    property that makes skipped boundaries provable no-ops."""
+    req = fleet.TraceRequest(request_id="r0", arrival_s=0.0,
+                             prompt=(1,) * 16, max_new=8, seed=0)
+    fine = fleet.SimReplica(0)
+    fine.submit(req, 0.0)
+    got_fine = []
+    t = 0.0
+    for _ in range(200):
+        got_fine.extend(fine.tick(t, 0.001))
+        t += 0.001
+    coarse = fleet.SimReplica(0)
+    coarse.submit(req, 0.0)
+    got_coarse = list(coarse.tick(0.0, 0.2))
+    assert [c.finish_s for c in got_fine] \
+        == [c.finish_s for c in got_coarse]
+    assert [c.first_s for c in got_fine] \
+        == [c.first_s for c in got_coarse]
+
+
+# -- autoscaler cadence in seconds (eval_every_ticks deprecation) -----
+
+
+def test_eval_every_s_default_matches_tick_count_cadence():
+    """The derived default (eval_every_ticks * tick_s) keeps
+    existing replays byte-identical: spelling the cadence in seconds
+    produces the same report as the deprecated tick count."""
+    spec = fleet.WorkloadSpec(process="bursty", rps=300.0,
+                              n_requests=150)
+    trace = fleet.generate_trace(spec, 3)
+    base = dict(replicas=1, policy="least-outstanding",
+                autoscale=True,
+                autoscaler=fleet.AutoscalerConfig(
+                    min_replicas=1, max_replicas=4, warmup_s=0.2))
+    by_ticks = fleet.FleetSim(
+        fleet.FleetConfig(eval_every_ticks=10, **base), trace).run()
+    by_seconds = fleet.FleetSim(
+        fleet.FleetConfig(eval_every_s=10 * fleet.resolve_tick_s(),
+                          **base), trace).run()
+    a = {k: v for k, v in by_ticks.items() if k != "config"}
+    b = {k: v for k, v in by_seconds.items() if k != "config"}
+    assert json.dumps(a, sort_keys=True) \
+        == json.dumps(b, sort_keys=True)
+
+
+def test_eval_every_s_decouples_cadence_from_tick_width():
+    """The bug the knob fixes: with the tick-count cadence, halving
+    the tick silently halved the real-time evaluation interval;
+    eval_every_s holds the interval constant across tick widths."""
+    coarse = fleet.FleetSim(fleet.FleetConfig(
+        tick_s=0.01, eval_every_s=0.1), [])
+    fine = fleet.FleetSim(fleet.FleetConfig(
+        tick_s=0.005, eval_every_s=0.1), [])
+    assert coarse._eval_ticks == 10
+    assert fine._eval_ticks == 20  # same 0.1 s of virtual time
+    legacy = fleet.FleetSim(fleet.FleetConfig(
+        tick_s=0.005, eval_every_ticks=10), [])
+    assert legacy._eval_ticks == 10  # deprecated: 2x the cadence
+
+
 # -- chaos scenarios ---------------------------------------------------
 
 

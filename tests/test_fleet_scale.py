@@ -1,0 +1,45 @@
+"""CI perf gate for the event-heap core (docs/PERFORMANCE.md "The
+event core").
+
+The tentpole claim of ISSUE 8 is that fleet wall time scales with
+EVENT COUNT, not with simulated seconds. This gate pins that claim:
+a seeded 100k-request compressed diurnal day (~2 virtual hours,
+~720k tick boundaries) must complete well under a generous wall
+budget AND must actually skip most boundaries — so a future change
+that silently reintroduces per-tick scaling (or quietly disables the
+skip machinery) fails CI instead of rotting the headline. The budget
+is ~15x the measured dev-host wall (≈4 s), roomy enough for slow CI
+runners, tight enough to catch a return to per-tick scaling.
+"""
+
+import time
+
+import pytest
+
+from kind_tpu_sim import fleet
+
+pytestmark = [pytest.mark.fleet, pytest.mark.slow]
+
+WALL_BUDGET_S = 60.0
+
+
+def test_event_core_100k_diurnal_under_wall_budget():
+    spec = fleet.WorkloadSpec(
+        process="diurnal", rps=12.0, n_requests=100_000,
+        diurnal_period_s=8640.0, prompt_len=(8, 24),
+        max_new=(4, 12))
+    trace = fleet.generate_trace(spec, 7)
+    cfg = fleet.FleetConfig(
+        replicas=3, policy="least-outstanding", max_queue=65536,
+        max_virtual_s=1e9, event_core=True)
+    sim = fleet.FleetSim(cfg, trace)
+    t0 = time.monotonic()
+    rep = sim.run()
+    wall = time.monotonic() - t0
+    assert rep["ok"] and rep["completed"] == len(trace)
+    assert wall < WALL_BUDGET_S, (
+        f"100k-request event-core trace took {wall:.1f}s "
+        f"(budget {WALL_BUDGET_S}s) — per-tick scaling is back?")
+    # the core must actually be skipping boundaries, not just
+    # fitting the budget on a fast host
+    assert sim.ev_skipped > 100_000, sim.ev_skipped

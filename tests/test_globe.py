@@ -136,7 +136,9 @@ def test_healthy_planet_serves_locally():
 
 
 def test_fast_forward_replay_identical_and_engaged():
-    cfg = _small_cfg()
+    # the LEGACY idle-gap fast-forward (event_core=False pins the
+    # plain loop; the event core has its own engagement tests)
+    cfg = _small_cfg(event_core=False)
     sim_on = globe.GlobeSim(
         dataclasses.replace(cfg, fast_forward=True), seed=7)
     sim_off = globe.GlobeSim(
@@ -145,6 +147,21 @@ def test_fast_forward_replay_identical_and_engaged():
     b = json.dumps(sim_off.run(), sort_keys=True)
     assert a == b
     assert sim_on.ff_skipped > 0 and sim_off.ff_skipped == 0
+
+
+def test_event_core_replay_identical_and_engaged():
+    """The tentpole contract at globe scale: event core on vs off is
+    byte-identical, and the core actually skips boundaries."""
+    cfg = _small_cfg()
+    sim_on = globe.GlobeSim(
+        dataclasses.replace(cfg, event_core=True), seed=7)
+    sim_off = globe.GlobeSim(
+        dataclasses.replace(cfg, event_core=False,
+                            fast_forward=False), seed=7)
+    a = json.dumps(sim_on.run(), sort_keys=True)
+    b = json.dumps(sim_off.run(), sort_keys=True)
+    assert a == b
+    assert sim_on.ev_skipped > 0 and sim_off.ev_skipped == 0
 
 
 # -- the front door ---------------------------------------------------
@@ -204,6 +221,50 @@ def test_dcn_latency_model():
     assert sim.rtt_s("zone-a", "zone-c") == pytest.approx(far / 0.2)
     # intra-zone traffic never crosses DCN: unaffected
     assert sim.rtt_s("zone-c", "zone-c") == intra
+
+
+def test_intra_zone_spill_prefers_sibling_cell():
+    """cells_per_zone=2 (ROADMAP item 2 follow-on): with one cell of
+    a zone drained, its traffic spills to the SIBLING cell in the
+    same zone — intra-zone DCN is ~free, cross-zone is not, so under
+    moderate load nothing ever leaves the zone."""
+    cfg = _small_cfg(cells_per_zone=2)
+    traces = globe.generate_globe_traces(cfg, 7)
+    events = [globe.GlobeChaosEvent(at_s=0.0, action="cell_drain",
+                                    target="zone-a/c0")]
+    rep = globe.GlobeSim(cfg, traces=traces, seed=7,
+                         chaos_events=events).run()
+    assert rep["ok"]
+    served = [e for e in rep["completions"]
+              if e["origin"] == "zone-a"]
+    assert served
+    assert all(e["cell"] == "zone-a/c1" for e in served)
+    assert all(e["serving_zone"] == "zone-a" for e in served)
+
+
+def test_intra_zone_sibling_fills_before_cross_zone_spill():
+    """A same-tick burst bigger than the sibling can hold: the
+    sibling cell absorbs up to its nominal depth FIRST, and only the
+    overflow crosses zones — sibling-before-stranger, in that
+    order."""
+    cfg = _small_cfg(cells_per_zone=2, zones=("zone-a", "zone-b"))
+    traces = {"zone-a": _burst_trace("zone-a", 80), "zone-b": []}
+    events = [globe.GlobeChaosEvent(at_s=0.0, action="cell_drain",
+                                    target="zone-a/c0")]
+    rep = globe.GlobeSim(cfg, traces=traces, seed=0,
+                         chaos_events=events).run()
+    assert rep["ok"] and rep["completed"] == 80
+    sibling = [e for e in rep["completions"]
+               if e["cell"] == "zone-a/c1"]
+    crossed = [e for e in rep["completions"]
+               if e["serving_zone"] == "zone-b"]
+    assert sibling and crossed
+    # the sibling was filled to its nominal saturation depth before
+    # anything was sent across the DCN
+    nominal = (cfg.replicas_per_cell * cfg.sim.max_slots
+               * cfg.frontdoor.queue_depth)
+    assert (rep["frontdoor"]["peak_outstanding"]["zone-a/c1"]
+            >= nominal)
 
 
 def test_cell_drain_spills_then_returns():
@@ -342,8 +403,9 @@ def test_planner_reclaims_after_the_peak():
 
 def test_six_hour_diurnal_trace_save_replay_identical(tmp_path):
     """A >= 6h simulated day of follow-the-sun diurnal traffic runs
-    in seconds (fast-forward), and replaying the saved trace
-    produces a byte-identical completion log."""
+    in seconds (the event core skips the empty boundaries), and
+    replaying the saved trace produces a byte-identical completion
+    log."""
     cfg = globe.GlobeConfig(
         zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=1,
         tick_s=0.05, max_virtual_s=90000.0,
@@ -357,7 +419,7 @@ def test_six_hour_diurnal_trace_save_replay_identical(tmp_path):
     sim = globe.GlobeSim(cfg, traces=traces, seed=7)
     rep = sim.run()
     assert rep["ok"] and rep["virtual_s"] >= 6 * 3600
-    assert sim.ff_skipped > 100_000  # the gaps, actually skipped
+    assert sim.ev_skipped > 100_000  # the gaps, actually skipped
     path = tmp_path / "day.jsonl"
     globe.save_globe_trace(str(path), traces)
     replayed = globe.GlobeSim(
@@ -370,6 +432,8 @@ def test_six_hour_diurnal_trace_save_replay_identical(tmp_path):
 def test_fleet_fast_forward_scenario_suite_identical(monkeypatch):
     """The satellite contract: the existing fleet scenario suite is
     byte-identical with fast-forward on vs off."""
+    # pin the plain loop: this leg is about the LEGACY fast-forward
+    monkeypatch.setenv(fleet.events.EVENT_CORE_ENV, "0")
     for scenario in ("fleet-flaky-replica", "sched-node-drain"):
         monkeypatch.setenv(fleet.sim.FF_ENV, "0")
         off = chaos.run_scenario(scenario, seed=3)
@@ -381,13 +445,16 @@ def test_fleet_fast_forward_scenario_suite_identical(monkeypatch):
 
 
 def test_fleet_fast_forward_engages_on_sparse_trace():
+    # the legacy ff path (event_core=False pins the plain loop)
     spec = fleet.WorkloadSpec(process="poisson", rps=2.0,
                               n_requests=20)
     trace = fleet.generate_trace(spec, 7)
     on = fleet.FleetSim(
-        fleet.FleetConfig(replicas=2, fast_forward=True), trace)
+        fleet.FleetConfig(replicas=2, fast_forward=True,
+                          event_core=False), trace)
     off = fleet.FleetSim(
-        fleet.FleetConfig(replicas=2, fast_forward=False), trace)
+        fleet.FleetConfig(replicas=2, fast_forward=False,
+                          event_core=False), trace)
     a, b = on.run(), off.run()
     assert json.dumps(a, sort_keys=True) == json.dumps(
         b, sort_keys=True)
