@@ -2563,6 +2563,96 @@ def globe_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def overload_smoke() -> dict | None:
+    """Overload-containment extras (docs/OVERLOAD.md): one seeded
+    demand surge run three ways through an analytic fleet —
+    fault-free, surged with the full control set ON (retry budgets,
+    hedging, breakers, brownout), and surged with an unbudgeted
+    controls-OFF client. The headline observables are the metastable
+    signature: the surge-window goodput floor, the post-surge p99
+    recovery ratio controls-on vs the sustained-collapse ratio
+    controls-off, the hedge win rate, and the retries the budget
+    suppressed."""
+    try:
+        from kind_tpu_sim import fleet
+        from kind_tpu_sim.fleet.slo import brute_force_percentile
+
+        t0 = time.monotonic()
+        spec = fleet.WorkloadSpec(
+            process="poisson", rps=150.0, n_requests=900,
+            prompt_len=(8, 24), max_new=(4, 12), deadline_s=0.6)
+        base = fleet.generate_trace(spec, seed=7)
+        span = max(r.arrival_s for r in base)
+        s0, s1 = round(span * 0.3, 6), round(span * 0.45, 6)
+        surge = fleet.surge_trace(spec, 7, s0, s1, 4.0)
+        sim_cfg = fleet.SimReplicaConfig(
+            max_slots=4, prefill_per_tok_s=0.002, tpot_s=0.002)
+
+        def run(trace, ov):
+            return fleet.FleetSim(
+                fleet.FleetConfig(
+                    replicas=3, policy="least-outstanding",
+                    tick_s=0.01, sim=sim_cfg,
+                    slo=fleet.SloPolicy(ttft_s=0.3, e2e_s=0.6),
+                    max_queue=512, overload=ov,
+                    max_virtual_s=60.0),
+                trace).run()
+
+        clean = run(base, fleet.OverloadConfig())
+        on = run(surge, fleet.OverloadConfig())
+        off = run(surge,
+                  fleet.OverloadConfig.uncontrolled(max_attempts=6))
+
+        def window_p99(rep, t_from, t_to):
+            vals = [(e["first_s"] if e["first_s"] is not None
+                     else e["finish_s"]) - e["arrival_s"]
+                    for e in rep["completions"]
+                    if t_from <= e["arrival_s"] < t_to]
+            return brute_force_percentile(vals, 0.99)
+
+        def window_goodput(rep, t_from, t_to):
+            toks = sum(e["tokens"] for e in rep["completions"]
+                       if t_from <= e["arrival_s"] < t_to
+                       and e["slo_ok"])
+            return round(toks / max(1e-9, t_to - t_from), 3)
+
+        w0, w1 = round(s1 + 2.0, 6), round(span - 0.2, 6)
+        p_c = window_p99(clean, w0, w1)
+        p_on = window_p99(on, w0, w1)
+        p_off = window_p99(off, w0, w1)
+        oc_on = on["overload"]["counters"]
+        oc_off = off["overload"]["counters"]
+        hedges = oc_on.get("hedges_issued", 0)
+        g_clean = window_goodput(clean, s0, s1)
+        g_on = window_goodput(on, s0, s1)
+        return {
+            "ok": bool(clean["ok"] and on["ok"] and off["ok"]),
+            "requests": len(surge),
+            "seconds": round(time.monotonic() - t0, 3),
+            "surge_goodput_floor_frac": (
+                round(g_on / g_clean, 3) if g_clean else None),
+            "p99_recovery_ratio_on": (
+                round(p_on / p_c, 3)
+                if p_c and p_on is not None else None),
+            "p99_recovery_ratio_off": (
+                round(p_off / p_c, 3)
+                if p_c and p_off is not None else None),
+            "retries_suppressed": oc_on.get(
+                "retries_suppressed", 0),
+            "retries_off_vs_on": [
+                oc_off.get("retries_scheduled", 0),
+                oc_on.get("retries_scheduled", 0)],
+            "hedge_win_rate": (
+                round(oc_on.get("hedge_wins", 0) / hedges, 3)
+                if hedges else None),
+            "hedges_issued": hedges,
+            "brownout_transitions":
+                len(on["overload"]["brownout"]["transitions"]),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def analysis_smoke() -> dict | None:
     """Determinism-tooling extras: detlint wall time over the whole
     package with per-rule finding/waiver counts (tool cost and waiver
@@ -2776,6 +2866,10 @@ def main(argv=None) -> int:
             globe_rep = globe_smoke()
         if globe_rep:
             phases["globe"] = globe_rep
+        with stopwatch("overload"):
+            overload_rep = overload_smoke()
+        if overload_rep:
+            phases["overload"] = overload_rep
         with stopwatch("analysis"):
             analysis_rep = analysis_smoke()
         if analysis_rep:

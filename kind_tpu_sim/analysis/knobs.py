@@ -160,6 +160,28 @@ GLOBE_SEED = _register(
     "Workload seed for the fleet-of-fleets simulator; per-zone "
     "traces derive sub-seeds from it.")
 
+# overload containment (docs/OVERLOAD.md)
+OVERLOAD_RETRY_BUDGET = _register(
+    "KIND_TPU_SIM_OVERLOAD_RETRY_BUDGET", 0.1, "float", "overload",
+    "Client retry-budget earn ratio: budget tokens earned per "
+    "admitted first-attempt request; `0` disables the budget "
+    "(retries unbounded — the controls-off storm mode).")
+OVERLOAD_HEDGE_QUANTILE = _register(
+    "KIND_TPU_SIM_OVERLOAD_HEDGE_QUANTILE", 0.95, "float",
+    "overload",
+    "Latency quantile the hedge delay is derived from: a hedge "
+    "fires only after the primary has been in flight longer than "
+    "this quantile of observed service times.")
+OVERLOAD_BREAKER_WINDOW = _register(
+    "KIND_TPU_SIM_OVERLOAD_BREAKER_WINDOW", 16, "int", "overload",
+    "Rolling outcome-window length of the per-replica / per-cell "
+    "circuit breakers.")
+OVERLOAD_BROWNOUT = _register(
+    "KIND_TPU_SIM_OVERLOAD_BROWNOUT", True, "bool", "overload",
+    "Brownout ladder under sustained SLO breach (cap max_new, "
+    "disable hedging, shed low tiers); `0` keeps replicas serving "
+    "full requests all the way into queue collapse.")
+
 # health / gray-failure detection (docs/HEALTH.md)
 HEALTH_ALPHA = _register(
     "KIND_TPU_SIM_HEALTH_ALPHA", 0.25, "float", "health",
@@ -212,7 +234,7 @@ BENCH_SLOW = _register(
 # Display order of layers in docs/KNOBS.md — pipeline order, not
 # alphabetical, so the page reads like the architecture diagram.
 LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "sched",
-               "globe", "health", "bench")
+               "globe", "overload", "health", "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -223,6 +245,7 @@ LAYER_DOCS = {
     "fleet": "FLEET.md",
     "sched": "SCHED.md",
     "globe": "GLOBE.md",
+    "overload": "OVERLOAD.md",
     "health": "HEALTH.md",
     "bench": "PERFORMANCE.md",
 }

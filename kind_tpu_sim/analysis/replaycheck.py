@@ -218,6 +218,7 @@ _REPLAYABLE_SCENARIOS = {
     "gray-slow-replica": False, "gray-degraded-ici": False,
     "globe-zone-loss": False, "globe-herd-failover": False,
     "globe-dcn-degrade": False,
+    "overload-surge": False, "retry-storm": False,
 }
 
 

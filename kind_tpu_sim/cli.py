@@ -304,6 +304,14 @@ def build_parser() -> argparse.ArgumentParser:
              "when --sched is set; knobs KIND_TPU_SIM_HEALTH_*; "
              "report gains a 'health' section")
     fl.add_argument(
+        "--overload", action="store_true",
+        help="enable overload containment (docs/OVERLOAD.md): "
+             "client retry budgets, hedged requests with "
+             "first-completion-wins cancellation, per-replica "
+             "circuit breakers, and the brownout ladder; knobs "
+             "KIND_TPU_SIM_OVERLOAD_*; report gains an 'overload' "
+             "section")
+    fl.add_argument(
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
              "(default: KIND_TPU_SIM_FLEET_TICK_S or 0.01)")
@@ -430,6 +438,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--spill-headroom", type=float, default=0.5,
         help="extra load fraction a cell accepts from cross-cell "
              "spill before the front door refuses (the herd bound)")
+    gl.add_argument(
+        "--overload", action="store_true",
+        help="enable overload containment (docs/OVERLOAD.md): "
+             "per-origin client retry budgets and cross-cell "
+             "hedging at the front door, per-cell circuit "
+             "breakers, breaker+brownout inside every cell; knobs "
+             "KIND_TPU_SIM_OVERLOAD_*")
     gl.add_argument(
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
@@ -785,6 +800,8 @@ def run_fleet(args: argparse.Namespace) -> int:
                if args.sched else None),
         health=(fleet.DetectorConfig.from_env()
                 if args.health else None),
+        overload=(fleet.OverloadConfig()
+                  if args.overload else None),
         event_core=(False if args.no_event_core else None))
     clock = fleet.VirtualClock()
     factory = None
@@ -846,6 +863,14 @@ def run_fleet(args: argparse.Namespace) -> int:
             a = report["autoscaler"]
             print(f"  autoscaler: +{a['scale_ups']}/-"
                   f"{a['scale_downs']} (warmup {a['warmup_s']}s)")
+        if "overload" in report:
+            o = report["overload"]["counters"]
+            b = report["overload"]["brownout"]
+            print(f"  overload: retries {o.get('retries_scheduled', 0)} "
+                  f"(suppressed {o.get('retries_suppressed', 0)})  "
+                  f"hedges {o.get('hedges_issued', 0)} "
+                  f"(wins {o.get('hedge_wins', 0)})  "
+                  f"brownout level {b['level']}")
         if "scheduler" in report:
             s = report["scheduler"]
             ttr = s["time_to_routable"]
@@ -981,6 +1006,8 @@ def run_globe(args: argparse.Namespace) -> int:
         frontdoor=globe.FrontDoorConfig(
             spill_headroom=args.spill_headroom),
         planner=planner,
+        overload=(globe.OverloadConfig()
+                  if args.overload else None),
         workload=globe.GlobeWorkloadSpec(
             process=args.process, rps=args.rps,
             n_per_zone=args.requests,

@@ -47,6 +47,20 @@ from kind_tpu_sim.fleet.loadgen import (  # noqa: F401
     resolve_seed,
     save_trace,
 )
+from kind_tpu_sim.fleet.overload import (  # noqa: F401
+    BrownoutController,
+    CircuitBreaker,
+    LatencyQuantile,
+    OverloadConfig,
+    OverloadState,
+    TokenBucket,
+    request_tier,
+    resolve_breaker_window,
+    resolve_brownout,
+    resolve_hedge_quantile,
+    resolve_retry_budget,
+    surge_trace,
+)
 from kind_tpu_sim.fleet.router import (  # noqa: F401
     POLICIES,
     EngineReplica,

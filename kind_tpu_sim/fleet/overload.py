@@ -1,0 +1,572 @@
+"""Overload containment: the controls that keep saturation transient.
+
+Fail-stop (docs/CHAOS.md), gray failure (docs/HEALTH.md), and
+blast-radius loss (docs/GLOBE.md) all model *something breaking*. The
+failure mode that actually takes down serving fleets is different:
+**metastable overload** — a demand surge or capacity dip pushes the
+system past saturation, clients retry, hedges double-send, recovery
+herds pile on, and the amplified load keeps the system saturated long
+after the trigger clears (load returns to normal, latency does not).
+This module holds the four production controls, as deterministic
+primitives the fleet router and the globe front door both thread
+through (docs/OVERLOAD.md):
+
+* :class:`TokenBucket` — **client retry budgets** (and hedge
+  budgets): retries spend tokens earned by first-attempt admissions,
+  so a saturated system sees retry load *shrink* instead of amplify;
+  the ``retries_suppressed`` counter is the proof.
+* hedge-delay derivation — **hedged requests**: the hedge fires only
+  after the primary has been in flight longer than a p9x of observed
+  service times (:class:`LatencyQuantile`, a FixedBucketHistogram, so
+  the delay is a deterministic pure function of completions seen);
+  first completion wins and the loser is cancelled mid-stream.
+* :class:`CircuitBreaker` — **per-replica / per-cell breakers**:
+  rolling-window failure/latency ratios open the breaker (shed fast),
+  a half-open probe trickle tests recovery, success closes it. Sits
+  UNDER the phi-accrual detector: the detector catches
+  slow-but-alive hardware from service-time shape, the breaker
+  catches outcome collapse from any cause — distinct treatments.
+* :class:`BrownoutController` — **brownout mode**: under sustained
+  SLO breach replicas degrade deterministically (cap ``max_new``,
+  disable hedging, shed low tiers) instead of queue-collapsing, and
+  recover hysteretically (consecutive clean evaluations step the
+  ladder back down one level at a time).
+
+Everything is a pure function of (config, completion stream, injected
+clock): no entropy, no wall time — byte-identical replays, event-core
+compatible (timers live on EventHeap lanes owned by the drivers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from collections import deque
+from typing import Dict, List, Optional
+
+from kind_tpu_sim.analysis import knobs
+from kind_tpu_sim.fleet.loadgen import TraceRequest, WorkloadSpec, \
+    generate_trace
+from kind_tpu_sim.fleet.slo import FixedBucketHistogram
+
+RETRY_BUDGET_ENV = knobs.OVERLOAD_RETRY_BUDGET
+HEDGE_QUANTILE_ENV = knobs.OVERLOAD_HEDGE_QUANTILE
+BREAKER_WINDOW_ENV = knobs.OVERLOAD_BREAKER_WINDOW
+BROWNOUT_ENV = knobs.OVERLOAD_BROWNOUT
+
+
+def resolve_retry_budget(value: Optional[float] = None) -> float:
+    """Explicit value > env (KIND_TPU_SIM_OVERLOAD_RETRY_BUDGET) >
+    0.1 (the classic ~10%-of-traffic retry budget)."""
+    if value is not None:
+        return float(value)
+    return float(knobs.get(RETRY_BUDGET_ENV))
+
+
+def resolve_hedge_quantile(value: Optional[float] = None) -> float:
+    """Explicit value > env (KIND_TPU_SIM_OVERLOAD_HEDGE_QUANTILE) >
+    0.95."""
+    if value is not None:
+        return float(value)
+    return float(knobs.get(HEDGE_QUANTILE_ENV))
+
+
+def resolve_breaker_window(value: Optional[int] = None) -> int:
+    """Explicit value > env (KIND_TPU_SIM_OVERLOAD_BREAKER_WINDOW) >
+    16."""
+    if value is not None:
+        return int(value)
+    return int(knobs.get(BREAKER_WINDOW_ENV))
+
+
+def resolve_brownout(value: Optional[bool] = None) -> bool:
+    """Explicit value > env (KIND_TPU_SIM_OVERLOAD_BROWNOUT) > on."""
+    if value is not None:
+        return bool(value)
+    return bool(knobs.get(BROWNOUT_ENV))
+
+
+@dataclasses.dataclass(frozen=True)
+class OverloadConfig:
+    """One layer's overload-containment policy (docs/OVERLOAD.md).
+
+    ``FleetConfig.overload`` threads it through the fleet router
+    (per-replica breakers, router hedging, client retries, replica
+    brownout); ``GlobeConfig.overload`` threads it through the front
+    door (per-cell breakers, cross-cell hedging, per-origin retry
+    budgets) with the embedded cells keeping breakers + brownout but
+    NOT their own client retries/hedges — the client lives at the
+    front door, and two stacked retry loops would be an amplifier of
+    their own."""
+
+    # client retry model: attempts INCLUDE the original request, so
+    # max_attempts=3 means up to two retries; backoff doubles per
+    # attempt (deterministic, no jitter — the budget, not entropy,
+    # is what breaks retry synchronization here)
+    max_attempts: int = 3
+    retry_backoff_s: float = 0.05
+    # budget tokens earned per admitted first-attempt request (the
+    # bucket starts full at `burst`); <= 0 disables the budget
+    # entirely — the controls-off retry-storm mode
+    retry_budget_ratio: Optional[float] = None
+    retry_budget_burst: float = 10.0
+    # hedging: a copy to the second-best target once the primary has
+    # been in flight past the hedge delay (a p9x of observed service
+    # times); bounded by its own token budget so hedging shuts
+    # itself off under saturation (a hedge on a saturated fleet is
+    # pure amplification)
+    hedge: bool = True
+    hedge_quantile: Optional[float] = None
+    hedge_min_delay_s: float = 0.02
+    hedge_warm_count: int = 16
+    hedge_budget_ratio: float = 0.05
+    hedge_budget_burst: float = 4.0
+    # circuit breakers: rolling-window outcome ratio per target
+    breaker: bool = True
+    breaker_window: Optional[int] = None
+    breaker_failure_ratio: float = 0.5
+    breaker_min_samples: int = 8
+    breaker_open_s: float = 0.25
+    breaker_probe_n: int = 2
+    # brownout ladder: level 1 caps max_new + disables hedging,
+    # level 2 also sheds low-tier requests at admission
+    brownout: Optional[bool] = None
+    brownout_window: int = 48
+    brownout_attainment: float = 0.5
+    brownout_evals: int = 3
+    brownout_recover_evals: int = 6
+    brownout_max_new_cap: int = 4
+    # deterministic share of requests classed low-tier (hashed from
+    # the request id, not drawn — the loadgen streams stay intact)
+    low_tier_frac: float = 0.25
+
+    @classmethod
+    def uncontrolled(cls, max_attempts: int = 4,
+                     retry_backoff_s: float = 0.05) -> "OverloadConfig":
+        """The controls-off client: retries WITHOUT a budget, no
+        hedging, no breakers, no brownout — the configuration that
+        turns a transient surge into a sustained retry storm (the
+        metastable baseline the scenarios prove the controls
+        against)."""
+        return cls(max_attempts=max_attempts,
+                   retry_backoff_s=retry_backoff_s,
+                   retry_budget_ratio=0.0, hedge=False,
+                   breaker=False, brownout=False)
+
+    def as_dict(self) -> dict:
+        return {
+            "max_attempts": self.max_attempts,
+            "retry_backoff_s": self.retry_backoff_s,
+            "retry_budget_ratio": resolve_retry_budget(
+                self.retry_budget_ratio),
+            "retry_budget_burst": self.retry_budget_burst,
+            "hedge": self.hedge,
+            "hedge_quantile": resolve_hedge_quantile(
+                self.hedge_quantile),
+            "hedge_min_delay_s": self.hedge_min_delay_s,
+            "hedge_budget_ratio": self.hedge_budget_ratio,
+            "breaker": self.breaker,
+            "breaker_window": resolve_breaker_window(
+                self.breaker_window),
+            "breaker_failure_ratio": self.breaker_failure_ratio,
+            "breaker_open_s": self.breaker_open_s,
+            "brownout": resolve_brownout(self.brownout),
+            "brownout_attainment": self.brownout_attainment,
+            "brownout_max_new_cap": self.brownout_max_new_cap,
+            "low_tier_frac": self.low_tier_frac,
+        }
+
+
+def request_tier(request_id: str, low_frac: float) -> int:
+    """Deterministic priority tier of a request: 1 (sheddable low
+    tier) for a stable ``low_frac`` share of ids, else 0. Hashed, not
+    drawn — tiering must not perturb the seeded loadgen streams, and
+    a request keeps its tier across retries (the hash runs on the
+    base id)."""
+    if low_frac <= 0:
+        return 0
+    base = request_id.split("~r", 1)[0]
+    h = zlib.crc32(f"tier:{base}".encode("utf-8")) % 1000
+    return 1 if h < int(low_frac * 1000) else 0
+
+
+class TokenBucket:
+    """Deterministic token bucket: ``earn()`` adds ``ratio`` tokens
+    per qualifying event (capped at ``burst``), ``spend()`` takes one
+    whole token or refuses. The bucket starts full so a cold system
+    can retry its first failures; a ``ratio`` of 0 disables the
+    bucket (every spend succeeds — the controls-off mode)."""
+
+    __slots__ = ("ratio", "burst", "tokens", "earned", "spent",
+                 "suppressed")
+
+    def __init__(self, ratio: float, burst: float):
+        self.ratio = float(ratio)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.earned = 0
+        self.spent = 0
+        self.suppressed = 0
+
+    @property
+    def disabled(self) -> bool:
+        return self.ratio <= 0.0
+
+    def earn(self, n: int = 1) -> None:
+        if self.disabled:
+            return
+        self.earned += n
+        self.tokens = min(self.burst, self.tokens + self.ratio * n)
+
+    def spend(self) -> bool:
+        if self.disabled:
+            self.spent += 1
+            return True
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            self.spent += 1
+            return True
+        self.suppressed += 1
+        return False
+
+    def report(self) -> Dict[str, object]:
+        return {
+            "ratio": self.ratio,
+            "tokens": round(self.tokens, 6),
+            "earned": self.earned,
+            "spent": self.spent,
+            "suppressed": self.suppressed,
+        }
+
+
+class LatencyQuantile:
+    """Streaming quantile of observed dispatch->finish service times
+    (a FixedBucketHistogram, O(buckets) forever) — the deterministic
+    p9x the hedge delay derives from. Until ``warm_count`` samples
+    arrive the delay floors at ``min_delay`` (hedging blind is
+    amplification, so the floor errs on the late side)."""
+
+    def __init__(self, quantile: float, min_delay_s: float,
+                 warm_count: int):
+        self.quantile = quantile
+        self.min_delay_s = min_delay_s
+        self.warm_count = warm_count
+        self.hist = FixedBucketHistogram(lo=1e-4, hi=1e3)
+
+    def observe(self, service_s: float) -> None:
+        if service_s >= 0:
+            self.hist.observe(service_s)
+
+    def delay_s(self) -> float:
+        if self.hist.total < self.warm_count:
+            return self.min_delay_s
+        q = self.hist.percentile(self.quantile)
+        return max(self.min_delay_s, q if q is not None else 0.0)
+
+
+class CircuitBreaker:
+    """One target's breaker: CLOSED -> (rolling-window failure ratio
+    over threshold) -> OPEN -> (``open_s`` elapsed) -> HALF_OPEN ->
+    (``probe_n`` consecutive successes) -> CLOSED, any half-open
+    failure snapping straight back to OPEN. The clock is injected
+    (``now`` on every call) and the window is outcome-ordered, so
+    the state machine is a pure function of the completion stream —
+    replays byte-identically."""
+
+    __slots__ = ("cfg", "name", "window", "state", "open_until",
+                 "half_open_ok", "half_open_inflight", "transitions",
+                 "opens", "fast_sheds")
+
+    def __init__(self, cfg: OverloadConfig, name: str):
+        self.cfg = cfg
+        self.name = name
+        self.window: deque = deque(
+            maxlen=resolve_breaker_window(cfg.breaker_window))
+        self.state = "closed"
+        self.open_until = 0.0
+        self.half_open_ok = 0
+        self.half_open_inflight = 0
+        self.transitions: List[dict] = []
+        self.opens = 0
+        self.fast_sheds = 0
+
+    def _transition(self, state: str, now: float) -> None:
+        self.transitions.append({
+            "at_s": round(now, 6), "from": self.state, "to": state})
+        self.state = state
+
+    def allow(self, now: float) -> bool:
+        """May this target take another request right now? An OPEN
+        breaker past its hold time moves to HALF_OPEN here (the
+        check IS the probe gate); HALF_OPEN admits at most
+        ``probe_n`` concurrent probes."""
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if now >= self.open_until:
+                self._transition("half_open", now)
+                self.half_open_ok = 0
+                self.half_open_inflight = 0
+                return True
+            self.fast_sheds += 1
+            return False
+        return self.half_open_inflight < self.cfg.breaker_probe_n
+
+    def note_dispatch(self) -> None:
+        if self.state == "half_open":
+            self.half_open_inflight += 1
+
+    def record(self, ok: bool, now: float) -> None:
+        """One terminal outcome at this target. ``ok`` is the SLO
+        verdict (latency breach and outright failure both count
+        against the window — the breaker trips on outcome collapse,
+        whatever its cause)."""
+        if self.state == "half_open":
+            self.half_open_inflight = max(
+                0, self.half_open_inflight - 1)
+            if ok:
+                self.half_open_ok += 1
+                if self.half_open_ok >= self.cfg.breaker_probe_n:
+                    self.window.clear()
+                    self._transition("closed", now)
+            else:
+                self.opens += 1
+                self.open_until = now + self.cfg.breaker_open_s
+                self._transition("open", now)
+            return
+        self.window.append(0 if ok else 1)
+        if self.state != "closed":
+            return
+        if len(self.window) < self.cfg.breaker_min_samples:
+            return
+        ratio = sum(self.window) / len(self.window)
+        if ratio >= self.cfg.breaker_failure_ratio:
+            self.opens += 1
+            self.open_until = now + self.cfg.breaker_open_s
+            self._transition("open", now)
+
+    def report(self) -> Dict[str, object]:
+        return {
+            "state": self.state,
+            "opens": self.opens,
+            "fast_sheds": self.fast_sheds,
+            "transitions": self.transitions,
+        }
+
+
+class BrownoutController:
+    """The brownout ladder: level 0 = full service, level 1 = cap
+    ``max_new`` + hedging off, level 2 = also shed low-tier requests
+    at admission. Escalation needs ``brownout_evals`` CONSECUTIVE
+    breaching evaluations (attainment over the rolling window below
+    ``brownout_attainment``); recovery needs ``recover_evals``
+    consecutive clean ones and steps DOWN one level at a time — the
+    hysteresis that keeps the ladder from flapping at the breach
+    boundary."""
+
+    MAX_LEVEL = 2
+
+    def __init__(self, cfg: OverloadConfig):
+        self.cfg = cfg
+        self.enabled = resolve_brownout(cfg.brownout)
+        self.level = 0
+        self.window: deque = deque(maxlen=cfg.brownout_window)
+        self._breach_streak = 0
+        self._ok_streak = 0
+        self.transitions: List[dict] = []
+        self.capped = 0
+        self.tier_shed = 0
+
+    def observe(self, ok: bool) -> None:
+        self.window.append(1 if ok else 0)
+
+    def evaluate(self, now: float) -> None:
+        if not self.enabled:
+            return
+        if len(self.window) < max(4, self.window.maxlen // 4):
+            return
+        attainment = sum(self.window) / len(self.window)
+        if attainment < self.cfg.brownout_attainment:
+            self._breach_streak += 1
+            self._ok_streak = 0
+        else:
+            self._ok_streak += 1
+            self._breach_streak = 0
+        if (self._breach_streak >= self.cfg.brownout_evals
+                and self.level < self.MAX_LEVEL):
+            self._breach_streak = 0
+            self.level += 1
+            self.transitions.append({
+                "at_s": round(now, 6), "level": self.level,
+                "direction": "escalate"})
+        elif (self._ok_streak >= self.cfg.brownout_recover_evals
+                and self.level > 0):
+            self._ok_streak = 0
+            self.level -= 1
+            self.transitions.append({
+                "at_s": round(now, 6), "level": self.level,
+                "direction": "recover"})
+
+    # -- the ladder's admission-time effects --------------------------
+
+    def cap_max_new(self, max_new: int) -> int:
+        if self.level >= 1 and max_new > self.cfg.brownout_max_new_cap:
+            self.capped += 1
+            return self.cfg.brownout_max_new_cap
+        return max_new
+
+    def hedging_allowed(self) -> bool:
+        return self.level == 0
+
+    def sheds_tier(self, tier: int) -> bool:
+        if self.level >= 2 and tier >= 1:
+            self.tier_shed += 1
+            return True
+        return False
+
+    def report(self) -> Dict[str, object]:
+        return {
+            "enabled": self.enabled,
+            "level": self.level,
+            "capped": self.capped,
+            "tier_shed": self.tier_shed,
+            "transitions": self.transitions,
+        }
+
+
+class OverloadState:
+    """One layer's live overload-containment state: per-origin retry
+    buckets, the hedge budget + delay quantile, per-target breakers,
+    the brownout ladder, and the counters the reports publish. The
+    fleet driver keys targets by replica id; the globe front door
+    keys them by cell name — same machinery, two tiers."""
+
+    def __init__(self, cfg: OverloadConfig):
+        self.cfg = cfg
+        self.retry_ratio = resolve_retry_budget(
+            cfg.retry_budget_ratio)
+        self._retry_buckets: Dict[str, TokenBucket] = {}
+        self.hedge_budget = TokenBucket(cfg.hedge_budget_ratio,
+                                        cfg.hedge_budget_burst)
+        self.latency = LatencyQuantile(
+            resolve_hedge_quantile(cfg.hedge_quantile),
+            cfg.hedge_min_delay_s, cfg.hedge_warm_count)
+        self.breakers: Dict[str, CircuitBreaker] = {}
+        self.brownout = BrownoutController(cfg)
+        self.counters: Dict[str, int] = {}
+
+    def incr(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    # -- retry budget -------------------------------------------------
+
+    def retry_bucket(self, origin: str) -> TokenBucket:
+        bucket = self._retry_buckets.get(origin)
+        if bucket is None:
+            bucket = TokenBucket(self.retry_ratio,
+                                 self.cfg.retry_budget_burst)
+            self._retry_buckets[origin] = bucket
+        return bucket
+
+    def earn_retry(self, origin: str) -> None:
+        self.retry_bucket(origin).earn()
+
+    def spend_retry(self, origin: str) -> bool:
+        ok = self.retry_bucket(origin).spend()
+        if ok:
+            self.incr("retries_scheduled")
+        else:
+            self.incr("retries_suppressed")
+        return ok
+
+    # -- hedging ------------------------------------------------------
+
+    def hedge_delay_s(self) -> float:
+        return self.latency.delay_s()
+
+    def hedge_enabled(self) -> bool:
+        return self.cfg.hedge and self.brownout.hedging_allowed()
+
+    def spend_hedge(self) -> bool:
+        ok = self.hedge_budget.spend()
+        if not ok:
+            self.incr("hedges_suppressed")
+        return ok
+
+    def observe_service(self, service_s: float) -> None:
+        self.latency.observe(service_s)
+        self.hedge_budget.earn()
+
+    # -- breakers -----------------------------------------------------
+
+    def breaker(self, target: str) -> CircuitBreaker:
+        b = self.breakers.get(target)
+        if b is None:
+            b = CircuitBreaker(self.cfg, target)
+            self.breakers[target] = b
+        return b
+
+    def breaker_allows(self, target: str, now: float) -> bool:
+        if not self.cfg.breaker:
+            return True
+        return self.breaker(target).allow(now)
+
+    def breaker_dispatch(self, target: str) -> None:
+        if self.cfg.breaker:
+            self.breaker(target).note_dispatch()
+
+    def breaker_record(self, target: str, ok: bool,
+                       now: float) -> None:
+        if self.cfg.breaker:
+            self.breaker(target).record(ok, now)
+
+    # -- reporting ----------------------------------------------------
+
+    def report(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "config": self.cfg.as_dict(),
+            "counters": {k: v for k, v in
+                         sorted(self.counters.items())},
+            "retry_budget": {
+                origin: bucket.report() for origin, bucket in
+                sorted(self._retry_buckets.items())},
+            "hedge_budget": self.hedge_budget.report(),
+            "brownout": self.brownout.report(),
+        }
+        if self.cfg.breaker:
+            out["breakers"] = {
+                name: b.report() for name, b in
+                sorted(self.breakers.items())}
+        return out
+
+
+# -- the demand_surge trace transform ---------------------------------
+
+
+def surge_trace(spec: WorkloadSpec, seed: int, t0: float, t1: float,
+                multiplier: float) -> List[TraceRequest]:
+    """The ``demand_surge`` fault kind's workload: the base seeded
+    trace plus a step of extra arrivals at ``(multiplier - 1) x rps``
+    confined to ``[t0, t1)``, drawn from a sub-seed derived the
+    ChaosSchedule way — same (spec, seed, window, multiplier), same
+    surge, byte for byte. Surge ids are ``s``-prefixed so the merged
+    trace stays id-unique."""
+    base = generate_trace(spec, seed)
+    extra_rps = spec.rps * max(0.0, multiplier - 1.0)
+    n_extra = int(extra_rps * max(0.0, t1 - t0))
+    merged = list(base)
+    if n_extra > 0:
+        sub_seed = zlib.crc32(
+            repr(("surge", seed, round(t0, 6), round(t1, 6),
+                  round(multiplier, 6))).encode("utf-8"))
+        surge_spec = dataclasses.replace(
+            spec, process="poisson", rps=extra_rps,
+            n_requests=n_extra)
+        for req in generate_trace(surge_spec, sub_seed):
+            at = round(t0 + req.arrival_s, 6)
+            if at >= t1:
+                break
+            merged.append(dataclasses.replace(
+                req, request_id=f"s{req.request_id}", arrival_s=at))
+    merged.sort(key=lambda r: (r.arrival_s, r.request_id))
+    return merged

@@ -106,6 +106,40 @@ class SimReplica:
         every subsequent token picks up the new factor."""
         self.slowdown = max(1.0, float(factor))
 
+    def cancel(self, request_id: str) -> bool:
+        """First-completion-wins cancellation (the hedging layer's
+        lever, docs/OVERLOAD.md — the WorkerCancelled read-cancel
+        precedent at replica granularity): drop the request from the
+        queue or free its slot mid-stream. Returns whether anything
+        was actually cancelled; a request already completed (or
+        never here) returns False so the caller can dedupe the late
+        completion instead."""
+        for i, req in enumerate(self.queue):
+            if req.request_id == request_id:
+                del self.queue[i]
+                return True
+        for i, slot in enumerate(self._slots):
+            if (slot is not None
+                    and slot["req"].request_id == request_id):
+                # the slot frees at the cancel boundary; its partial
+                # stream is discarded (the winner's stream is the
+                # request's one true output)
+                self._slots[i] = None
+                return True
+        return False
+
+    def warm_prefix(self, group: int) -> None:
+        """Pre-warm one prefix-cache group (the cross-cell failover
+        warm-up, docs/OVERLOAD.md): the group enters the LRU as if
+        just seen, without counting a hit or a miss — the next real
+        request of the cohort prefills suffix-only."""
+        if self.cfg.prefix_cache_entries <= 0 or group < 0:
+            return
+        self._prefix_seen.pop(group, None)
+        self._prefix_seen[group] = True
+        while len(self._prefix_seen) > self.cfg.prefix_cache_entries:
+            self._prefix_seen.pop(next(iter(self._prefix_seen)))
+
     # -- replica interface -------------------------------------------
 
     def outstanding(self) -> int:
@@ -405,6 +439,23 @@ class EngineReplica:
                 finish_reason=c.finish_reason))
         return out
 
+    def cancel(self, request_id: str) -> bool:
+        """Hedge cancellation on a real engine: a still-queued
+        request is withdrawn cleanly; one already prefilling keeps
+        its slot (we cannot unpick a real matmul mid-chunk) and the
+        caller dedupes its late completion instead — the same
+        drop-the-loser-result contract the worker pool's
+        WorkerCancelled read-cancel uses."""
+        eng = self.engine
+        for i, r in enumerate(eng.queue):
+            if r.request_id == request_id:
+                del eng.queue[i]
+                eng._req_clock.pop(request_id, None)
+                self._dispatched.pop(request_id, None)
+                self._dispatch_s.pop(request_id, None)
+                return True
+        return False
+
     def fail(self, now: float) -> List[TraceRequest]:
         """The real recovery lever: every slot takes
         ``inject_slot_failure`` (mid-stream requests requeue inside
@@ -451,7 +502,7 @@ class Router:
 
     def __init__(self, replicas: Sequence, policy: str = "round-robin",
                  max_queue: int = 0, affinity_spill: int = 8,
-                 health=None):
+                 health=None, overload=None):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r}; known: "
@@ -459,6 +510,16 @@ class Router:
         self.replicas: List = list(replicas)
         self.policy = policy
         self.max_queue = max_queue
+        # optional fleet.overload.OverloadState: per-replica circuit
+        # breakers gate the candidate set (an OPEN breaker sheds
+        # fast — its replica leaves the ordering until the half-open
+        # probe window), and every placement is reported back so the
+        # hedging layer can arm its timer (docs/OVERLOAD.md)
+        self.overload = overload
+        # placement hook: called (request, replica, now) on every
+        # successful submit — the fleet driver arms hedge timers and
+        # breaker probe accounting through it
+        self.on_place = None
         # optional kind_tpu_sim.health.FailureDetector: quarantined
         # replicas leave the candidate set entirely, and the load
         # orderings become LATENCY-AWARE — a replica's queue depth is
@@ -483,7 +544,7 @@ class Router:
 
     # -- policy ------------------------------------------------------
 
-    def _healthy(self) -> List:
+    def _healthy(self, now: float = 0.0) -> List:
         out = [r for r in self.replicas if r.healthy]
         if self.health is not None:
             unquarantined = [r for r in out
@@ -492,7 +553,16 @@ class Router:
             # never quarantine the whole fleet out of service: with
             # no clean replica left, degraded capacity beats none
             if unquarantined:
-                return unquarantined
+                out = unquarantined
+        if self.overload is not None:
+            allowed = [r for r in out
+                       if self.overload.breaker_allows(
+                           f"replica-{r.replica_id}", now)]
+            # the same never-empty rule as quarantine: all breakers
+            # open means the fleet is collapsing anyway — degraded
+            # candidates beat a routing black hole
+            if allowed:
+                out = allowed
         return out
 
     def _load_key(self, r) -> float:
@@ -505,10 +575,11 @@ class Router:
             f"replica-{r.replica_id}")
         return (r.outstanding() + 1) * rel
 
-    def _pick_order(self, req: TraceRequest) -> List:
+    def _pick_order(self, req: TraceRequest,
+                    now: float = 0.0) -> List:
         """Candidate replicas, best first, per policy. Ties break on
         replica_id — determinism over cleverness."""
-        healthy = self._healthy()
+        healthy = self._healthy(now)
         if not healthy:
             return []
         if self.policy == "round-robin":
@@ -531,6 +602,10 @@ class Router:
                 self.health is not None
                 and self.health.quarantined(
                     f"replica-{home.replica_id}")):
+            return by_load
+        if home not in healthy:
+            # filtered out above (e.g. an open breaker): affinity
+            # never overrides a tripped breaker
             return by_load
         floor = by_load[0].outstanding()
         if home.outstanding() - floor > self.affinity_spill:
@@ -587,7 +662,7 @@ class Router:
         while self.queue:
             req = self.queue[0]
             placed = False
-            for replica in self._pick_order(req):
+            for replica in self._pick_order(req, now):
                 if replica.submit(req, now):
                     self.queue.pop(0)
                     self.routed += 1
@@ -597,6 +672,11 @@ class Router:
                     metrics.fleet_board().incr("requests_routed")
                     if self.policy == "round-robin":
                         self._rr += 1
+                    if self.overload is not None:
+                        self.overload.breaker_dispatch(
+                            f"replica-{replica.replica_id}")
+                    if self.on_place is not None:
+                        self.on_place(req, replica, now)
                     placed = True
                     break
             if not placed:

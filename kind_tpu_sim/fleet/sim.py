@@ -29,6 +29,7 @@ byte-identical — `fleet run --seed 7` twice diffs clean.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -42,13 +43,20 @@ from kind_tpu_sim.fleet.autoscaler import (
     resolve_warmup_s,
 )
 from kind_tpu_sim.fleet.events import (
+    LANE_ARRIVAL,
     LANE_AUTOSCALER,
     LANE_CHAOS,
+    LANE_COMPLETION,
     DueSet,
     EventHeap,
     resolve_event_core,
 )
 from kind_tpu_sim.fleet.loadgen import TraceRequest, VirtualClock
+from kind_tpu_sim.fleet.overload import (
+    OverloadConfig,
+    OverloadState,
+    request_tier,
+)
 from kind_tpu_sim.fleet.router import (
     ReplicaCompletion,
     Router,
@@ -67,6 +75,24 @@ def resolve_tick_s(value: Optional[float] = None) -> float:
     if value is not None:
         return float(value)
     return float(knobs.get(TICK_ENV))
+
+
+_EVAL_TICKS_WARNED = False
+
+
+def _warn_eval_every_ticks() -> None:
+    """One-shot deprecation notice for the retired tick-count
+    cadence knob (the window opened in PR 8; docs/FLEET.md)."""
+    global _EVAL_TICKS_WARNED
+    if _EVAL_TICKS_WARNED:
+        return
+    _EVAL_TICKS_WARNED = True
+    warnings.warn(
+        "FleetConfig.eval_every_ticks is retired: it couples the "
+        "control-loop cadence to the tick width. Use eval_every_s "
+        "(virtual seconds, snapped to the tick grid) instead; the "
+        "value you set is honored as eval_every_ticks * tick_s.",
+        DeprecationWarning, stacklevel=3)
 
 
 def resolve_fast_forward(value: Optional[bool] = None) -> bool:
@@ -163,14 +189,16 @@ class FleetConfig:
     max_queue: int = 1024              # router admission bound
     max_virtual_s: float = 600.0       # runaway-loop backstop
     autoscale: bool = False
-    # autoscaler cadence. eval_every_ticks is DEPRECATED (it couples
-    # the real-time evaluation cadence to the tick width: changing
-    # KIND_TPU_SIM_FLEET_TICK_S silently changed how often the
-    # control loop ran); prefer eval_every_s — virtual seconds
-    # between evaluations, snapped to the tick grid. The derived
-    # default (eval_every_ticks * tick_s) keeps existing replays
-    # byte-identical.
-    eval_every_ticks: int = 10
+    # control-loop cadence (autoscaler evaluations AND the overload
+    # layer's brownout evaluations): eval_every_s is virtual seconds
+    # between evaluations, snapped to the tick grid (default: 10
+    # ticks' worth, which keeps pre-eval_every_s replays
+    # byte-identical). eval_every_ticks is RETIRED (PR 8 opened the
+    # deprecation window): setting it still works — it routes
+    # through eval_every_s as ticks * tick_s — but emits a one-shot
+    # DeprecationWarning; it couples the real-time cadence to the
+    # tick width, which is exactly the bug eval_every_s fixed.
+    eval_every_ticks: Optional[int] = None
     eval_every_s: Optional[float] = None
     slo: SloPolicy = SloPolicy(ttft_s=0.5, e2e_s=2.0)
     sim: SimReplicaConfig = SimReplicaConfig()
@@ -181,6 +209,11 @@ class FleetConfig:
     # leave the routing set, get probed, and (scheduler-backed) have
     # their gang migrated off the suspect hardware
     health: Optional[DetectorConfig] = None
+    # overload containment (docs/OVERLOAD.md): an OverloadConfig
+    # turns on client retry budgets, hedged requests with
+    # first-completion-wins cancellation, per-replica circuit
+    # breakers under the routing policies, and the brownout ladder
+    overload: Optional[OverloadConfig] = None
     # idle-gap fast-forward (None -> resolve_fast_forward()). An
     # execution strategy, not workload config: reports are
     # byte-identical either way, so it deliberately stays OUT of
@@ -209,6 +242,8 @@ class FleetConfig:
             out["sched"] = self.sched.as_dict()
         if self.health is not None:
             out["health"] = self.health.as_dict()
+        if self.overload is not None:
+            out["overload"] = self.overload.as_dict()
         return out
 
 
@@ -235,9 +270,14 @@ class FleetSim:
                          for i in range(cfg.replicas)]
         self.health = (FailureDetector(cfg.health)
                        if cfg.health is not None else None)
+        self.overload = (OverloadState(cfg.overload)
+                         if cfg.overload is not None else None)
         self.router = Router(self.replicas, policy=cfg.policy,
                              max_queue=cfg.max_queue,
-                             health=self.health)
+                             health=self.health,
+                             overload=self.overload)
+        if self.overload is not None:
+            self.router.on_place = self._on_place
         self.chaos_events = sorted(chaos_events,
                                    key=lambda e: (e.at_s, e.target))
         self.tracker = SloTracker(cfg.slo)
@@ -266,13 +306,19 @@ class FleetSim:
         self._pending = deque(self.trace)
         self._fast_forward = resolve_fast_forward(cfg.fast_forward)
         self._event_core = resolve_event_core(cfg.event_core)
-        # effective autoscaler cadence in ticks: eval_every_s snaps
-        # to the grid; the deprecated tick count is the fallback
+        # effective control-loop cadence in ticks: everything routes
+        # through eval_every_s snapped to the grid. The RETIRED
+        # eval_every_ticks still works (ticks * tick_s) but warns
+        # once; unset, the cadence defaults to 10 ticks' worth.
+        tick_s = resolve_tick_s(cfg.tick_s)
         if cfg.eval_every_s is not None:
-            self._eval_ticks = max(1, int(round(
-                cfg.eval_every_s / resolve_tick_s(cfg.tick_s))))
+            eval_every_s = cfg.eval_every_s
+        elif cfg.eval_every_ticks is not None:
+            _warn_eval_every_ticks()
+            eval_every_s = cfg.eval_every_ticks * tick_s
         else:
-            self._eval_ticks = max(1, cfg.eval_every_ticks)
+            eval_every_s = 10 * tick_s
+        self._eval_ticks = max(1, int(round(eval_every_s / tick_s)))
         # empty ticks skipped by fast-forward / boundaries skipped by
         # the event core — observability only, deliberately NOT in
         # the report (each mode on/off must diff clean)
@@ -296,6 +342,16 @@ class FleetSim:
         self._probe_last: Dict[str, float] = {}
         self._probe_n: Dict[str, int] = {}
         self._migrate_pending: List[int] = []
+        # overload containment (docs/OVERLOAD.md): client retries
+        # and hedge timers are EventHeap lanes on the virtual clock
+        # — never wall time — so the event core treats them as any
+        # other timed source and replays stay byte-identical
+        self._retry_heap = EventHeap()   # (due_s, ARRIVAL, request)
+        self._hedge_heap = EventHeap()   # (due_s, COMPLETION, ...)
+        self._attempts: Dict[str, int] = {}
+        self._hedges: Dict[str, dict] = {}
+        self._hedge_dropped: set = set()
+        self._completed_ids: set = set()
         if cfg.sched is not None:
             self._init_scheduler(cfg.sched)
 
@@ -555,10 +611,138 @@ class FleetSim:
         if transition is not None:
             self._on_health_transition(rid, transition, now)
 
+    # -- overload containment (docs/OVERLOAD.md) ----------------------
+
+    def _offer_arrival(self, req: TraceRequest, now: float,
+                       fresh: bool) -> None:
+        """One client-side admission: fresh arrivals earn retry
+        budget, the brownout ladder sheds low tiers and caps
+        ``max_new`` at its admission gate, and the router takes what
+        survives (its own shed path handles a full central queue)."""
+        ov = self.overload
+        if ov is not None:
+            if fresh:
+                ov.earn_retry("local")
+            bo = ov.brownout
+            if bo.sheds_tier(request_tier(
+                    req.request_id, ov.cfg.low_tier_frac)):
+                metrics.fleet_board().incr("brownout_shed")
+                self._record(ReplicaCompletion(
+                    request=req, dispatch_s=now, first_s=None,
+                    finish_s=now, tokens=0, tokens_crc=0,
+                    finish_reason="shed"), -1,
+                    brownout_observe=False)
+                return
+            capped = bo.cap_max_new(req.max_new)
+            if capped != req.max_new:
+                req = dataclasses.replace(req, max_new=capped)
+        shed = self.router.offer(req, now)
+        if shed is not None:
+            self._record(shed, -1)
+
+    def _on_place(self, req: TraceRequest, replica,
+                  now: float) -> None:
+        """Router placement hook: arm the hedge timer. The delay is
+        the p9x of observed dispatch->finish service times — a
+        deterministic pure function of completions seen — so the
+        hedge fires only once the primary is provably a tail case."""
+        ov = self.overload
+        rid = req.request_id
+        if rid.startswith("__probe-"):
+            return
+        if not ov.hedge_enabled() or rid in self._hedges:
+            return
+        self._hedge_heap.push(now + ov.hedge_delay_s(),
+                              LANE_COMPLETION, (req, replica))
+
+    def _fire_hedges(self, now: float) -> None:
+        """Due hedge timers: a request still in flight past its
+        hedge delay gets a copy on the second-best candidate —
+        budget-gated, so hedging shuts itself off under saturation
+        instead of doubling the overload."""
+        ov = self.overload
+        for req, primary in self._hedge_heap.pop_due(now):
+            rid = req.request_id
+            if rid in self._completed_ids or rid in self._hedges:
+                continue
+            if not ov.hedge_enabled():
+                continue
+            if not ov.spend_hedge():
+                continue
+            for cand in self.router._pick_order(req, now):
+                if cand is primary:
+                    continue
+                if cand.submit(req, now):
+                    self._hedges[rid] = {"primary": primary,
+                                         "hedge": cand}
+                    ov.incr("hedges_issued")
+                    ov.breaker_dispatch(
+                        f"replica-{cand.replica_id}")
+                    break
+
+    def _handle_completion(self, replica, comp: ReplicaCompletion,
+                           now: float) -> None:
+        """One replica completion through the overload filters:
+        late completions of cancelled hedge losers are dropped, the
+        first completion of a hedged pair wins and cancels the
+        loser mid-stream, duplicates (displacement races) dedupe on
+        the id."""
+        ov = self.overload
+        if ov is None:
+            self._record(comp, replica.replica_id)
+            return
+        rid = comp.request.request_id
+        if rid in self._hedge_dropped:
+            self._hedge_dropped.discard(rid)
+            ov.incr("hedge_late_drops")
+            return
+        if rid in self._completed_ids:
+            return
+        pair = self._hedges.pop(rid, None)
+        if pair is not None:
+            loser = (pair["hedge"] if replica is pair["primary"]
+                     else pair["primary"])
+            if replica is pair["hedge"]:
+                ov.incr("hedge_wins")
+            if (hasattr(loser, "cancel")
+                    and loser.cancel(rid)):
+                ov.incr("hedge_cancels")
+            else:
+                self._hedge_dropped.add(rid)
+        self._record(comp, replica.replica_id)
+
+    def _maybe_retry(self, comp: ReplicaCompletion,
+                     now: float) -> None:
+        """The client retry model: a shed or deadline-expired
+        attempt is retried after deterministic doubling backoff IF
+        the origin's token-bucket budget allows — a saturated fleet
+        sees retry load shrink, not amplify, and the suppressed
+        count proves it."""
+        ov = self.overload
+        if ov is None or comp.finish_reason not in (
+                "shed", "deadline_exceeded"):
+            return
+        if ov.cfg.max_attempts <= 1:
+            return  # client retries disabled at this tier
+        req = comp.request
+        base = req.request_id.split("~r", 1)[0]
+        attempt = self._attempts.get(base, 1)
+        if attempt >= ov.cfg.max_attempts:
+            ov.incr("retries_exhausted")
+            return
+        if not ov.spend_retry("local"):
+            return
+        self._attempts[base] = attempt + 1
+        delay = ov.cfg.retry_backoff_s * (2 ** (attempt - 1))
+        at = round(now + delay, 6)
+        self._retry_heap.push(at, LANE_ARRIVAL, dataclasses.replace(
+            req, request_id=f"{base}~r{attempt}", arrival_s=at))
+
     # -- bookkeeping ---------------------------------------------------
 
     def _record(self, comp: ReplicaCompletion,
-                replica_id: int) -> None:
+                replica_id: int,
+                brownout_observe: bool = True) -> None:
         req = comp.request
         ok = self.tracker.observe(
             arrival_s=req.arrival_s, first_s=comp.first_s,
@@ -585,6 +769,24 @@ class FleetSim:
                 and comp.finish_reason not in
                 ("shed", "deadline_exceeded")):
             self._observe_health(replica_id, comp, self._now)
+        if self.overload is not None:
+            self._completed_ids.add(req.request_id)
+            if brownout_observe:
+                # brownout-shed completions stay OUT of the window:
+                # the ladder must not read its own deliberate
+                # degradation as continued breach
+                self.overload.brownout.observe(ok)
+            if replica_id >= 0 and comp.finish_reason != "shed":
+                # breaker outcome = the SLO verdict: latency breach
+                # and deadline expiry both count against the window
+                self.overload.breaker_record(
+                    f"replica-{replica_id}", ok, self._now)
+            if (comp.first_s is not None
+                    and comp.finish_reason
+                    not in ("shed", "deadline_exceeded")):
+                self.overload.observe_service(
+                    comp.finish_s - comp.dispatch_s)
+            self._maybe_retry(comp, self._now)
         if self.on_complete is not None:
             self.on_complete(self.log[-1], comp)
 
@@ -728,9 +930,10 @@ class FleetSim:
                     self.health.restore(comp, now,
                                         reason="rebound")
         while pending and pending[0].arrival_s <= now:
-            shed = self.router.offer(pending.popleft(), now)
-            if shed is not None:
-                self._record(shed, -1)
+            self._offer_arrival(pending.popleft(), now, fresh=True)
+        if self.overload is not None:
+            for req in self._retry_heap.pop_due(now):
+                self._offer_arrival(req, now, fresh=False)
         if self.health is not None and (pending
                                         or self.router.queue):
             # probe only while user traffic still flows — an
@@ -739,6 +942,8 @@ class FleetSim:
             self._probe_quarantined(now)
         for comp in self.router.dispatch(now):
             self._record(comp, -1)
+        if self.overload is not None:
+            self._fire_hedges(now)
         for replica in list(self.replicas):
             for comp in replica.tick(now, tick):
                 if comp.request.request_id.startswith(
@@ -749,19 +954,21 @@ class FleetSim:
                     self._observe_health(
                         replica.replica_id, comp, now)
                     continue
-                self._record(comp, replica.replica_id)
+                self._handle_completion(replica, comp, now)
         for replica in list(self._draining):
             for comp in replica.tick(now, tick):
-                self._record(comp, replica.replica_id)
+                self._handle_completion(replica, comp, now)
             if replica.idle():
                 self._draining.remove(replica)
                 if self.sched is not None:
                     self.sched.release(
                         f"replica-{replica.replica_id}", now,
                         reason="scale-down drained")
-        if (self.autoscaler is not None
-                and self._ticks % self._eval_ticks == 0):
-            self._autoscale(now)
+        if self._ticks % self._eval_ticks == 0:
+            if self.autoscaler is not None:
+                self._autoscale(now)
+            if self.overload is not None:
+                self.overload.brownout.evaluate(now)
         self._ticks += 1
 
     def quiescent(self, pending: Optional[deque] = None) -> bool:
@@ -777,6 +984,7 @@ class FleetSim:
                     if r.healthy)
             and not self._draining
             and not self.chaos_events
+            and not self._retry_heap and not self._hedge_heap
             and not (self.sched is not None
                      and (self.sched.pending
                           or self._rebinding)))
@@ -787,7 +995,8 @@ class FleetSim:
         scheduler activity, and no per-tick decision makers
         (autoscaler evaluations and health probes are tick-cadenced
         events, so their presence disqualifies the gap)."""
-        if self.autoscaler is not None or self.health is not None:
+        if (self.autoscaler is not None or self.health is not None
+                or self.overload is not None):
             return False
         if (self.router.queue or self._warming or self._draining):
             return False
@@ -821,6 +1030,10 @@ class FleetSim:
             due.at(pending[0].arrival_s)
         if self.chaos_events:
             due.at(self.chaos_events[0].at_s)
+        # overload timers are boundary-condition events: a retry
+        # applies at its backoff expiry, a hedge at its delay expiry
+        due.at(self._retry_heap.peek_time())
+        due.at(self._hedge_heap.peek_time())
         if self.router.queue or self._draining:
             return due.need_now()
         if self.sched is not None and (
@@ -881,7 +1094,10 @@ class FleetSim:
         if due.immediate:
             return
         evals_away = -1
-        if self.autoscaler is not None:
+        if self.autoscaler is not None or self.overload is not None:
+            # the overload brownout ladder evaluates on the same
+            # tick grid as the autoscaler — eval boundaries must be
+            # stepped in both modes or the ladders diverge
             r = self._ticks % self._eval_ticks
             evals_away = (self._eval_ticks - r) % self._eval_ticks
             if evals_away == 0:
@@ -966,6 +1182,15 @@ class FleetSim:
                 board_before),
             "ok": len(self.log) == len(self.trace),
         }
+        if self.overload is not None:
+            # with client retries in play the log carries one entry
+            # per ATTEMPT; the run is ok when every original request
+            # reached a terminal outcome (its base id appears)
+            base_done = {e["request_id"].split("~r", 1)[0]
+                         for e in self.log}
+            report["ok"] = all(r.request_id in base_done
+                               for r in self.trace)
+            report["overload"] = self.overload.report()
         if self.preemptions:
             report["preemptions"] = self.preemptions
         if self.health is not None:

@@ -21,6 +21,10 @@ from kind_tpu_sim.globe.frontdoor import (  # noqa: F401
     FrontDoor,
     FrontDoorConfig,
 )
+from kind_tpu_sim.fleet.overload import (  # noqa: F401
+    OverloadConfig,
+    OverloadState,
+)
 from kind_tpu_sim.globe.planner import (  # noqa: F401
     GlobalPlanner,
     PlannerConfig,

@@ -62,6 +62,10 @@ class Cell:
         # — seq is admission order, the tiebreak for same-tick
         # deliveries
         self.delivery = EventHeap()
+        # hedge-cancelled request ids still in DCN flight: the heap
+        # has no removal, so cancellation is lazy — the id is
+        # dropped when its delivery pops (docs/OVERLOAD.md)
+        self._cancelled: set = set()
         self.alive = True
         self.draining = False
         self.peak_outstanding = 0
@@ -98,7 +102,46 @@ class Cell:
                                     self.outstanding())
 
     def deliver_due(self, now: float) -> None:
-        self.pending.extend(self.delivery.pop_due(now))
+        for req in self.delivery.pop_due(now):
+            if self._cancelled:
+                if req.request_id in self._cancelled:
+                    self._cancelled.discard(req.request_id)
+                    continue
+            self.pending.append(req)
+
+    def cancel(self, request_id: str) -> bool:
+        """First-completion-wins cancellation across the cell (the
+        globe hedging layer's lever, docs/OVERLOAD.md): withdraw the
+        request wherever it is — admitted-but-unticked, queued at
+        the router, or mid-stream on a replica; one still in DCN
+        flight cancels lazily at delivery. Returns False when the
+        request is nowhere here (already completed) so the caller
+        dedupes the late completion instead."""
+        for i, req in enumerate(self.pending):
+            if req.request_id == request_id:
+                del self.pending[i]
+                return True
+        queue = self.sim.router.queue
+        for i, req in enumerate(queue):
+            if req.request_id == request_id:
+                del queue[i]
+                return True
+        for replica in self.sim.replicas:
+            if (hasattr(replica, "cancel")
+                    and replica.cancel(request_id)):
+                return True
+        for entry in self.delivery._heap:
+            if entry[3].request_id == request_id:
+                self._cancelled.add(request_id)
+                return True
+        return False
+
+    def warm_prefix(self, group: int) -> None:
+        """Pre-warm one prefix-cache group on every replica (the
+        cross-cell failover warm-up, docs/OVERLOAD.md)."""
+        for replica in self.sim.replicas:
+            if hasattr(replica, "warm_prefix"):
+                replica.warm_prefix(group)
 
     def step(self, now: float, tick: float) -> None:
         if self.alive:
@@ -148,7 +191,11 @@ class Cell:
         self.sim.router.queue = []
         displaced.extend(self.pending)
         self.pending.clear()
-        displaced.extend(self.delivery.pop_due(float("inf")))
+        for req in self.delivery.pop_due(float("inf")):
+            if req.request_id in self._cancelled:
+                self._cancelled.discard(req.request_id)
+                continue
+            displaced.append(req)
         self.alive = False
         return displaced
 
@@ -174,6 +221,8 @@ class Cell:
         }
         if self.sim.autoscaler is not None:
             out["autoscaler"] = self.sim.autoscaler.report()
+        if self.sim.overload is not None:
+            out["overload"] = self.sim.overload.report()
         if self.sim.sched is not None:
             out["sched_event_counts"] = \
                 self.sim.sched.report()["event_counts"]

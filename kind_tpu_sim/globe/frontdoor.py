@@ -61,6 +61,14 @@ class FrontDoorConfig:
     load_weight_s: float = 0.01
     # front-door FCFS overflow queue bound; beyond it, shed loudly
     max_queue: int = 4096
+    # shed records kept for inspection (the TOTAL is a counter —
+    # a 1M-request surge run must not hold every shed request alive)
+    shed_window: int = 64
+    # cross-cell prefix-cache warm-up on failover (docs/OVERLOAD.md):
+    # when a cohort's home cell stops being routable, the cell now
+    # serving the cohort pre-warms its prefix group so post-failover
+    # TTFT recovers faster than a cold spill
+    warm_on_failover: bool = True
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -72,16 +80,31 @@ class FrontDoor:
     is how a browned-out path steers traffic away)."""
 
     def __init__(self, cfg: FrontDoorConfig, cells: Sequence[Cell],
-                 rtt_s: Callable[[str, str], float]):
+                 rtt_s: Callable[[str, str], float],
+                 overload=None):
         self.cfg = cfg
         self.cells = list(cells)          # static: affinity keyspace
         self.rtt_s = rtt_s
+        # optional fleet.overload.OverloadState: per-CELL circuit
+        # breakers gate the candidate set (the cell tier of the
+        # breaker ladder, docs/OVERLOAD.md) and note_result feeds
+        # them each completion's SLO verdict
+        self.overload = overload
+        # admission hook: called (request, origin, cell, now) on
+        # every admit — the globe driver arms cross-cell hedge
+        # timers through it
+        self.on_admit = None
         self.queue: deque = deque()       # (request, origin_zone)
         self.routed = 0
         self.spilled = 0
         self.affinity_hits = 0
-        self.shed: List[tuple] = []       # (request, origin, at_s)
+        # bounded recent window + exact total: long shed-heavy soaks
+        # must not hold every shed request alive
+        self.shed: deque = deque(maxlen=max(1, cfg.shed_window))
+        self.shed_total = 0
         self.readmitted = 0
+        self.prefix_warmups = 0
+        self._warmed: set = set()         # (cell, group) once each
         self._slo_window: Dict[str, deque] = {
             c.name: deque(maxlen=cfg.slo_window) for c in cells}
 
@@ -103,18 +126,28 @@ class FrontDoor:
         return (sum(window) / len(window)
                 < self.cfg.slo_spill_below)
 
-    def note_result(self, cell_name: str, slo_ok: bool) -> None:
+    def note_result(self, cell_name: str, slo_ok: bool,
+                    now: float = 0.0) -> None:
         """The globe streams every completion's SLO verdict back so
         spill can react to a breaching cell before its queue shows
-        it (slow-but-alive cells fill slowly)."""
+        it (slow-but-alive cells fill slowly); the same verdict
+        feeds the cell's circuit breaker when overload containment
+        is on."""
         window = self._slo_window.get(cell_name)
         if window is not None:
             window.append(1 if slo_ok else 0)
+        if self.overload is not None:
+            self.overload.breaker_record(cell_name, slo_ok, now)
 
-    def _candidates(self, origin: str) -> List[Cell]:
+    def _candidates(self, origin: str,
+                    now: float = 0.0) -> List[Cell]:
         """Routable cells under their hard limit, best first:
         unsaturated before saturated, then DCN-latency + load cost,
-        then name — a pure function of (origin, cell states)."""
+        then name — a pure function of (origin, cell states). An
+        OPEN per-cell breaker removes its cell from the set (shed
+        fast) unless every breaker is open — degraded candidates
+        beat a global black hole, the same never-empty rule the
+        fleet router applies to quarantine."""
         scored = []
         for cell in self.cells:
             if not cell.routable():
@@ -130,7 +163,13 @@ class FrontDoor:
             scored.append((1 if saturated else 0, cost, cell.name,
                            cell))
         scored.sort(key=lambda t: t[:3])
-        return [t[3] for t in scored]
+        out = [t[3] for t in scored]
+        if self.overload is not None:
+            allowed = [c for c in out
+                       if self.overload.breaker_allows(c.name, now)]
+            if allowed:
+                out = allowed
+        return out
 
     def _home(self, req: TraceRequest) -> Optional[Cell]:
         """Sticky prefix-affinity: the cohort's home cell, hashed
@@ -142,9 +181,9 @@ class FrontDoor:
             f"globe-group:{req.prefix_group}".encode("utf-8"))
         return self.cells[key % len(self.cells)]
 
-    def pick(self, req: TraceRequest,
-             origin: str) -> Optional[Cell]:
-        candidates = self._candidates(origin)
+    def pick(self, req: TraceRequest, origin: str,
+             now: float = 0.0) -> Optional[Cell]:
+        candidates = self._candidates(origin, now)
         if not candidates:
             return None
         home = self._home(req)
@@ -163,7 +202,7 @@ class FrontDoor:
         """Route one request (or queue it when every cell is at its
         bound). Returns a shed marker tuple only when even the
         front-door queue is full — the caller records it."""
-        cell = self.pick(req, origin)
+        cell = self.pick(req, origin, now)
         if cell is not None:
             self._admit(cell, req, origin, now, readmit)
             return None
@@ -172,11 +211,32 @@ class FrontDoor:
             metrics.globe_board().incr("frontdoor_queued")
             return None
         metrics.globe_board().incr("frontdoor_shed")
-        self.shed.append((req, origin, now))
+        self.shed_total += 1
+        self.shed.append((req.request_id, origin, round(now, 6)))
         return (req, origin, now)
+
+    def _warm_failover(self, cell: Cell, req: TraceRequest) -> None:
+        """Cross-cell prefix-cache warm-up (docs/OVERLOAD.md): the
+        cohort's home cell stopped being routable, so the cell now
+        serving it pre-warms the cohort's prefix group — once per
+        (cell, group) — and the first post-failover request of the
+        cohort prefills suffix-only instead of cold."""
+        if not self.cfg.warm_on_failover or req.prefix_group < 0:
+            return
+        home = self._home(req)
+        if home is None or home is cell or home.routable():
+            return
+        key = (cell.name, req.prefix_group)
+        if key in self._warmed:
+            return
+        self._warmed.add(key)
+        cell.warm_prefix(req.prefix_group)
+        self.prefix_warmups += 1
+        metrics.globe_board().incr("prefix_warmups")
 
     def _admit(self, cell: Cell, req: TraceRequest, origin: str,
                now: float, readmit: bool) -> None:
+        self._warm_failover(cell, req)
         # the full DCN round trip rides on the delivery time, so
         # every latency the cell later measures for this request
         # already includes the network the front door chose
@@ -190,6 +250,10 @@ class FrontDoor:
         if readmit:
             self.readmitted += 1
             metrics.globe_board().incr("herd_readmissions")
+        if self.overload is not None:
+            self.overload.breaker_dispatch(cell.name)
+        if self.on_admit is not None:
+            self.on_admit(req, origin, cell, now)
 
     def pump(self, now: float) -> None:
         """Retry the FCFS overflow queue head-first; the head
@@ -197,22 +261,25 @@ class FrontDoor:
         router's dispatch."""
         while self.queue:
             req, origin = self.queue[0]
-            cell = self.pick(req, origin)
+            cell = self.pick(req, origin, now)
             if cell is None:
                 return
             self.queue.popleft()
             self._admit(cell, req, origin, now, readmit=False)
 
     def report(self) -> Dict[str, object]:
-        return {
+        out: Dict[str, object] = {
             "routed": self.routed,
             "spilled": self.spilled,
             "affinity_hits": self.affinity_hits,
             "readmitted": self.readmitted,
             "queued": len(self.queue),
-            "shed": len(self.shed),
+            "shed": self.shed_total,
             "hard_limits": {
                 c.name: self._hard_limit(c) for c in self.cells},
             "peak_outstanding": {
                 c.name: c.peak_outstanding for c in self.cells},
         }
+        if self.prefix_warmups:
+            out["prefix_warmups"] = self.prefix_warmups
+        return out

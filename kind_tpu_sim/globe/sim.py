@@ -42,7 +42,14 @@ from kind_tpu_sim.fleet.loadgen import (
     WorkloadSpec,
     generate_trace,
 )
-from kind_tpu_sim.fleet.events import DueSet, resolve_event_core
+from kind_tpu_sim.fleet.events import (
+    LANE_ARRIVAL,
+    LANE_COMPLETION,
+    DueSet,
+    EventHeap,
+    resolve_event_core,
+)
+from kind_tpu_sim.fleet.overload import OverloadConfig, OverloadState
 from kind_tpu_sim.fleet.router import SimReplicaConfig
 from kind_tpu_sim.fleet.sim import (
     FleetConfig,
@@ -128,6 +135,12 @@ class GlobeConfig:
     autoscaler: AutoscalerConfig = AutoscalerConfig()
     frontdoor: FrontDoorConfig = FrontDoorConfig()
     planner: Optional[PlannerConfig] = None
+    # overload containment (docs/OVERLOAD.md): per-origin client
+    # retry budgets and cross-cell hedging live at the FRONT DOOR
+    # (the client tier); the embedded cells inherit breakers and
+    # brownout but never their own retries/hedges — two stacked
+    # retry loops would be an amplifier of their own
+    overload: Optional[OverloadConfig] = None
     workload: GlobeWorkloadSpec = GlobeWorkloadSpec()
     # one-way DCN latency unit between adjacent zones; zone pairs
     # farther apart in the zone list cost proportionally more
@@ -144,7 +157,7 @@ class GlobeConfig:
                 for i in range(self.cells_per_zone)]
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "zones": list(self.zones),
             "cells_per_zone": self.cells_per_zone,
             "replicas_per_cell": self.replicas_per_cell,
@@ -163,6 +176,9 @@ class GlobeConfig:
             "dcn_base_s": self.dcn_base_s,
             "intra_zone_s": self.intra_zone_s,
         }
+        if self.overload is not None:
+            out["overload"] = self.overload.as_dict()
+        return out
 
 
 # -- per-zone traffic --------------------------------------------------
@@ -275,8 +291,23 @@ class GlobeSim:
             for name in cfg.cell_names()]
         for cell in self.cells:
             cell.sim.on_complete = self._completion_hook(cell)
+        self._cell_by_name = {c.name: c for c in self.cells}
+        # overload containment at the client tier (docs/OVERLOAD.md):
+        # per-origin retry budgets, per-cell breakers, cross-cell
+        # hedging — all timers on EventHeaps, never wall clock
+        self.overload = (OverloadState(cfg.overload)
+                         if cfg.overload is not None else None)
+        self._g_retry = EventHeap()    # (due, ARRIVAL, (req, origin))
+        self._g_hedge = EventHeap()    # (due, COMPLETION, ...)
+        self._g_attempts: Dict[str, int] = {}
+        self._g_hedged: Dict[str, dict] = {}
+        self._g_dropped: set = set()
+        self._g_completed: set = set()
         self.frontdoor = FrontDoor(cfg.frontdoor, self.cells,
-                                   self.rtt_s)
+                                   self.rtt_s,
+                                   overload=self.overload)
+        if self.overload is not None:
+            self.frontdoor.on_admit = self._on_admit
         self.planner = (GlobalPlanner(cfg.planner, self.cells)
                         if cfg.planner is not None else None)
         self._next_eval = 0.0
@@ -318,6 +349,13 @@ class GlobeSim:
             sched=(FleetSchedConfig(policy=cfg.sched_policy,
                                     zone=zone)
                    if cfg.sched else None),
+            # cells keep the replica-tier controls (breakers,
+            # brownout) but the CLIENT lives at the front door:
+            # cell-level retries and hedges stay off
+            overload=(dataclasses.replace(cfg.overload,
+                                          max_attempts=1,
+                                          hedge=False)
+                      if cfg.overload is not None else None),
             fast_forward=False)  # the globe fast-forwards, not cells
 
     # -- DCN model ----------------------------------------------------
@@ -345,8 +383,32 @@ class GlobeSim:
 
     def _completion_hook(self, cell: Cell):
         def hook(entry: dict, comp) -> None:
-            origin = self._origin.get(entry["request_id"],
-                                      cell.zone)
+            rid = entry["request_id"]
+            now = self.clock.now()
+            ov = self.overload
+            if ov is not None:
+                if rid in self._g_dropped:
+                    # cancelled hedge loser finishing anyway: the
+                    # winner's stream is the request's one output
+                    self._g_dropped.discard(rid)
+                    ov.incr("hedge_late_drops")
+                    return
+                if rid in self._g_completed:
+                    return
+                pair = self._g_hedged.pop(rid, None)
+                if pair is not None:
+                    loser_name = (pair["hedge"]
+                                  if cell.name == pair["primary"]
+                                  else pair["primary"])
+                    if cell.name == pair["hedge"]:
+                        ov.incr("hedge_wins")
+                    loser = self._cell_by_name[loser_name]
+                    if loser.cancel(rid):
+                        ov.incr("hedge_cancels")
+                    else:
+                        self._g_dropped.add(rid)
+                self._g_completed.add(rid)
+            origin = self._origin.get(rid, cell.zone)
             g = dict(entry)
             g["cell"] = cell.name
             g["serving_zone"] = cell.zone
@@ -363,8 +425,78 @@ class GlobeSim:
                 arrival_s=req.arrival_s, first_s=comp.first_s,
                 finish_s=comp.finish_s, tokens=comp.tokens,
                 shed=shed, deadline_exceeded=expired)
-            self.frontdoor.note_result(cell.name, g["slo_ok"])
+            self.frontdoor.note_result(cell.name, g["slo_ok"], now)
+            if ov is not None:
+                if shed or expired:
+                    self._g_maybe_retry(req, origin, now)
+                elif comp.first_s is not None:
+                    ov.observe_service(comp.finish_s
+                                       - comp.dispatch_s)
         return hook
+
+    # -- overload containment at the front door (docs/OVERLOAD.md) ----
+
+    def _on_admit(self, req: TraceRequest, origin: str, cell: Cell,
+                  now: float) -> None:
+        """Front-door admission hook: arm the cross-cell hedge
+        timer at the p9x of observed service times."""
+        rid = req.request_id
+        if (not self.overload.hedge_enabled()
+                or rid in self._g_hedged
+                or rid in self._g_completed):
+            return
+        self._g_hedge.push(now + self.overload.hedge_delay_s(),
+                           LANE_COMPLETION, (req, origin, cell.name))
+
+    def _g_fire_hedges(self, now: float) -> None:
+        """Due hedge timers: a request still unfinished past its
+        hedge delay gets a copy admitted to the second-best cell —
+        budget-gated, herd-bounded (candidates already respect the
+        hard limit); first completion wins and the loser is
+        cancelled wherever it is (even mid-DCN-flight)."""
+        ov = self.overload
+        for req, origin, primary in self._g_hedge.pop_due(now):
+            rid = req.request_id
+            if rid in self._g_completed or rid in self._g_hedged:
+                continue
+            if not ov.hedge_enabled():
+                continue
+            if not ov.spend_hedge():
+                continue
+            for cand in self.frontdoor._candidates(origin, now):
+                if cand.name == primary:
+                    continue
+                self._g_hedged[rid] = {"primary": primary,
+                                       "hedge": cand.name}
+                cand.admit(req, now + self.rtt_s(origin, cand.zone))
+                ov.incr("hedges_issued")
+                ov.breaker_dispatch(cand.name)
+                break
+
+    def _g_maybe_retry(self, req: TraceRequest, origin: str,
+                       now: float) -> None:
+        """The per-origin client retry model: a shed or expired
+        attempt retries after deterministic doubling backoff IF the
+        origin zone's token-bucket budget allows — the suppressed
+        count is the proof that a saturated globe sees retry load
+        shrink, not amplify."""
+        ov = self.overload
+        if ov.cfg.max_attempts <= 1:
+            return
+        base = req.request_id.split("~r", 1)[0]
+        attempt = self._g_attempts.get(base, 1)
+        if attempt >= ov.cfg.max_attempts:
+            ov.incr("retries_exhausted")
+            return
+        if not ov.spend_retry(origin):
+            return
+        self._g_attempts[base] = attempt + 1
+        delay = ov.cfg.retry_backoff_s * (2 ** (attempt - 1))
+        at = round(now + delay, 6)
+        retry = dataclasses.replace(
+            req, request_id=f"{base}~r{attempt}", arrival_s=at)
+        self._origin[retry.request_id] = origin
+        self._g_retry.push(at, LANE_ARRIVAL, (retry, origin))
 
     def _record_frontdoor_shed(self, req: TraceRequest,
                                origin: str, now: float) -> None:
@@ -384,6 +516,9 @@ class GlobeSim:
         self._zone_tracker[origin].observe(
             arrival_s=req.arrival_s, first_s=None, finish_s=now,
             tokens=0, shed=True)
+        if self.overload is not None:
+            self._g_completed.add(req.request_id)
+            self._g_maybe_retry(req, origin, now)
 
     # -- blast-radius chaos -------------------------------------------
 
@@ -457,6 +592,7 @@ class GlobeSim:
         return bool(
             not self._arrivals and not self.frontdoor.queue
             and not self.chaos_events
+            and not self._g_retry and not self._g_hedge
             and all(c.quiescent() for c in self.cells))
 
     def _skip_uninteresting(self, tick: float) -> None:
@@ -490,6 +626,10 @@ class GlobeSim:
             due.at(self.chaos_events[0].at_s)
         if self.planner is not None:
             due.at(self._next_eval)
+        # front-door retry/hedge timers are boundary-condition
+        # events like arrivals
+        due.at(self._g_retry.peek_time())
+        due.at(self._g_hedge.peek_time())
         if self.frontdoor.queue:
             due.need_now()
         alive_sims = []
@@ -499,7 +639,11 @@ class GlobeSim:
             if cell.alive:
                 sim = cell.sim
                 alive_sims.append(sim)
-                if sim.autoscaler is not None:
+                if (sim.autoscaler is not None
+                        or sim.overload is not None):
+                    # cell brownout ladders evaluate on the same
+                    # tick grid as autoscalers — eval boundaries
+                    # must be stepped in both modes
                     r = sim._ticks % sim._eval_ticks
                     away = (sim._eval_ticks - r) % sim._eval_ticks
                     if evals_away < 0 or away < evals_away:
@@ -543,7 +687,8 @@ class GlobeSim:
         if self._event_core:
             self._skip_uninteresting(tick)
             return
-        if not self._ff or self.planner is not None:
+        if (not self._ff or self.planner is not None
+                or self.overload is not None):
             return
         if self.frontdoor.queue:
             return
@@ -582,10 +727,22 @@ class GlobeSim:
             while (self._arrivals
                    and self._arrivals[0][0].arrival_s <= now):
                 req, origin = self._arrivals.popleft()
+                if self.overload is not None:
+                    # first-attempt admissions fund the origin's
+                    # retry budget
+                    self.overload.earn_retry(origin)
                 shed = self.frontdoor.offer(req, origin, now)
                 if shed is not None:
                     self._record_frontdoor_shed(req, origin, now)
+            if self.overload is not None:
+                for req, origin in self._g_retry.pop_due(now):
+                    shed = self.frontdoor.offer(req, origin, now)
+                    if shed is not None:
+                        self._record_frontdoor_shed(req, origin,
+                                                    now)
             self.frontdoor.pump(now)
+            if self.overload is not None:
+                self._g_fire_hedges(now)
             for cell in self.cells:
                 cell.deliver_due(now)
                 cell.step(now, tick)
@@ -636,6 +793,16 @@ class GlobeSim:
                 metrics.globe_board().snapshot_since(board_before),
             "ok": len(self.log) == self.requests,
         }
+        if self.overload is not None:
+            # with retries the log carries one entry per ATTEMPT;
+            # ok when every original request reached a terminal
+            # outcome (its base id appears)
+            base_done = {e["request_id"].split("~r", 1)[0]
+                         for e in self.log}
+            report["ok"] = all(
+                req.request_id in base_done
+                for reqs in self.traces.values() for req in reqs)
+            report["overload"] = self.overload.report()
         if self.chaos_applied:
             report["chaos"] = self.chaos_applied
         if self.planner is not None:

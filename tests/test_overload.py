@@ -1,0 +1,599 @@
+"""Overload-containment tests (docs/OVERLOAD.md).
+
+The four controls as deterministic primitives (token buckets,
+breaker state machine, hedge-delay quantile, brownout ladder), their
+threading through the fleet router and the globe front door, the
+metastable-overload scenarios, and the byte-identical-replay contract
+with the event core on and off.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from kind_tpu_sim import fleet, globe
+
+pytestmark = pytest.mark.overload
+
+
+# -- primitives -------------------------------------------------------
+
+
+def test_token_bucket_earn_spend_suppress():
+    b = fleet.TokenBucket(ratio=0.5, burst=2.0)
+    # starts full: two spends succeed, the third is suppressed
+    assert b.spend() and b.spend()
+    assert not b.spend()
+    assert b.suppressed == 1
+    # four earns at 0.5/earn refill one token
+    for _ in range(4):
+        b.earn()
+    assert b.spend()
+    assert b.report()["spent"] == 3
+
+
+def test_token_bucket_disabled_is_unlimited():
+    b = fleet.TokenBucket(ratio=0.0, burst=1.0)
+    assert all(b.spend() for _ in range(100))
+    assert b.suppressed == 0
+
+
+def test_request_tier_deterministic_and_bounded():
+    frac = 0.25
+    tiers = [fleet.request_tier(f"f{i:05d}", frac)
+             for i in range(2000)]
+    low = sum(tiers)
+    # hashed share lands near the configured fraction
+    assert 0.15 < low / len(tiers) < 0.35
+    # stable across calls and across retry suffixes
+    assert fleet.request_tier("f00007", frac) == tiers[7]
+    assert (fleet.request_tier("f00007~r2", frac) == tiers[7])
+    assert fleet.request_tier("x", 0.0) == 0
+
+
+def test_circuit_breaker_state_machine():
+    cfg = fleet.OverloadConfig(breaker_window=8,
+                               breaker_failure_ratio=0.5,
+                               breaker_min_samples=4,
+                               breaker_open_s=1.0,
+                               breaker_probe_n=2)
+    b = fleet.CircuitBreaker(cfg, "replica-0")
+    for _ in range(4):
+        b.record(False, now=0.0)
+    assert b.state == "open"
+    assert not b.allow(0.5)       # still holding
+    assert b.fast_sheds == 1
+    assert b.allow(1.0)           # hold expired -> half-open
+    assert b.state == "half_open"
+    b.note_dispatch()
+    b.record(True, now=1.1)
+    b.note_dispatch()
+    b.record(True, now=1.2)       # probe_n successes close it
+    assert b.state == "closed"
+    # a half-open failure snaps straight back to open
+    for _ in range(4):
+        b.record(False, now=2.0)
+    assert b.allow(3.1)
+    b.note_dispatch()
+    b.record(False, now=3.2)
+    assert b.state == "open"
+    states = [t["to"] for t in b.transitions]
+    assert states == ["open", "half_open", "closed", "open",
+                      "half_open", "open"]
+
+
+def test_latency_quantile_floor_then_percentile():
+    q = fleet.LatencyQuantile(quantile=0.95, min_delay_s=0.02,
+                              warm_count=8)
+    assert q.delay_s() == 0.02    # blind hedging floors at min
+    for _ in range(20):
+        q.observe(0.5)
+    assert q.delay_s() >= 0.5 * 0.9
+
+
+def test_brownout_ladder_hysteresis():
+    cfg = fleet.OverloadConfig(brownout=True, brownout_window=8,
+                               brownout_attainment=0.5,
+                               brownout_evals=2,
+                               brownout_recover_evals=3)
+    bo = fleet.BrownoutController(cfg)
+    for _ in range(8):
+        bo.observe(False)
+    bo.evaluate(1.0)
+    assert bo.level == 0          # one breaching eval is noise
+    bo.evaluate(2.0)
+    assert bo.level == 1          # two consecutive escalate
+    bo.evaluate(3.0)
+    bo.evaluate(4.0)
+    assert bo.level == 2          # sustained breach climbs the ladder
+    for _ in range(8):
+        bo.observe(True)
+    bo.evaluate(5.0)
+    bo.evaluate(6.0)
+    assert bo.level == 2          # recovery needs recover_evals
+    bo.evaluate(7.0)
+    assert bo.level == 1          # ... and steps down ONE level
+    assert [t["direction"] for t in bo.transitions] == [
+        "escalate", "escalate", "recover"]
+
+
+def test_brownout_admission_effects_by_level():
+    cfg = fleet.OverloadConfig(brownout=True,
+                               brownout_max_new_cap=4)
+    bo = fleet.BrownoutController(cfg)
+    assert bo.cap_max_new(16) == 16 and bo.hedging_allowed()
+    assert not bo.sheds_tier(1)
+    bo.level = 1
+    assert bo.cap_max_new(16) == 4 and not bo.hedging_allowed()
+    assert not bo.sheds_tier(1)   # level 1 degrades, never sheds
+    bo.level = 2
+    assert bo.sheds_tier(1) and not bo.sheds_tier(0)
+
+
+def test_surge_trace_windowed_and_deterministic():
+    spec = fleet.WorkloadSpec(process="poisson", rps=100.0,
+                              n_requests=200)
+    base = fleet.generate_trace(spec, 7)
+    t1 = fleet.surge_trace(spec, 7, 0.5, 1.0, 3.0)
+    t2 = fleet.surge_trace(spec, 7, 0.5, 1.0, 3.0)
+    assert t1 == t2
+    extra = [r for r in t1 if r.request_id.startswith("s")]
+    assert extra and all(0.5 <= r.arrival_s < 1.0 for r in extra)
+    assert len({r.request_id for r in t1}) == len(t1)
+    assert len(t1) == len(base) + len(extra)
+
+
+# -- fleet threading --------------------------------------------------
+
+
+def _sat_cfg(ov, **kw):
+    return fleet.FleetConfig(
+        replicas=2, policy="least-outstanding", tick_s=0.01,
+        sim=fleet.SimReplicaConfig(max_slots=4,
+                                   prefill_per_tok_s=0.002,
+                                   tpot_s=0.002),
+        slo=fleet.SloPolicy(ttft_s=0.3, e2e_s=0.6),
+        max_queue=256, overload=ov, max_virtual_s=60.0, **kw)
+
+
+def _sat_trace(seed=7, rps=250.0, n=400):
+    return fleet.generate_trace(
+        fleet.WorkloadSpec(process="poisson", rps=rps,
+                           n_requests=n, prompt_len=(8, 24),
+                           max_new=(4, 12), deadline_s=0.5), seed)
+
+
+def test_fleet_retry_budget_suppresses_under_saturation():
+    trace = _sat_trace()
+    on = fleet.FleetSim(_sat_cfg(fleet.OverloadConfig()),
+                        trace).run()
+    off = fleet.FleetSim(
+        _sat_cfg(fleet.OverloadConfig.uncontrolled(max_attempts=3)),
+        trace).run()
+    oc_on = on["overload"]["counters"]
+    oc_off = off["overload"]["counters"]
+    assert on["ok"] and off["ok"]
+    # the budget provably suppressed retries the storm would have made
+    assert oc_on.get("retries_suppressed", 0) >= 1
+    assert (oc_off.get("retries_scheduled", 0)
+            > oc_on.get("retries_scheduled", 0))
+    # retries log one entry per attempt, ids suffixed ~rN
+    retried = [e for e in off["completions"]
+               if "~r" in e["request_id"]]
+    assert len(retried) == oc_off["retries_scheduled"]
+
+
+def test_fleet_retried_request_can_complete():
+    # one slot: "b" monopolizes it (0.65s prefill), "a" expires
+    # queued, and its retry arrives into an idle fleet and completes
+    trace = [fleet.TraceRequest("b", 0.0, (1,) * 64, 4, 2,
+                                deadline_s=5.0),
+             fleet.TraceRequest("a", 0.01, (1,) * 8, 4, 1,
+                                deadline_s=0.15)]
+    cfg = fleet.FleetConfig(
+        replicas=1, tick_s=0.01,
+        sim=fleet.SimReplicaConfig(max_slots=1,
+                                   prefill_per_tok_s=0.01,
+                                   tpot_s=0.01),
+        slo=fleet.SloPolicy(e2e_s=5.0),
+        overload=fleet.OverloadConfig(retry_backoff_s=0.8))
+    rep = fleet.FleetSim(cfg, trace).run()
+    a_entries = [e for e in rep["completions"]
+                 if e["request_id"].startswith("a")]
+    assert any(e["finish_reason"] == "deadline_exceeded"
+               for e in a_entries)
+    assert any(e["finish_reason"] == "length" for e in a_entries)
+    assert rep["ok"]
+
+
+def test_fleet_hedge_first_completion_wins_and_cancels():
+    # replica 0 slowed 20x from t=0: primaries placed there run past
+    # the hedge delay, the hedge on the fast replica wins, and the
+    # slow loser is cancelled mid-stream
+    trace = fleet.generate_trace(
+        fleet.WorkloadSpec(process="poisson", rps=30.0,
+                           n_requests=120, prompt_len=(8, 24),
+                           max_new=(4, 12)), 7)
+    cfg = fleet.FleetConfig(
+        replicas=2, policy="round-robin", tick_s=0.01,
+        sim=fleet.SimReplicaConfig(max_slots=4,
+                                   prefill_per_tok_s=0.002,
+                                   tpot_s=0.002),
+        slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+        overload=fleet.OverloadConfig(breaker=False,
+                                      brownout=False))
+    events = [fleet.ChaosEvent(at_s=0.0, action="slow", target=0,
+                               param=20.0)]
+    rep = fleet.FleetSim(cfg, trace, chaos_events=events).run()
+    oc = rep["overload"]["counters"]
+    assert rep["ok"]
+    assert oc.get("hedges_issued", 0) >= 1
+    assert oc.get("hedge_wins", 0) >= 1
+    assert (oc.get("hedge_cancels", 0)
+            + oc.get("hedge_late_drops", 0)) >= 1
+    # first-completion-wins: exactly one terminal entry per request
+    ids = [e["request_id"] for e in rep["completions"]]
+    assert len(ids) == len(set(ids))
+
+
+def test_fleet_hedge_budget_shuts_off_under_saturation():
+    rep = fleet.FleetSim(_sat_cfg(fleet.OverloadConfig()),
+                        _sat_trace()).run()
+    oc = rep["overload"]["counters"]
+    # saturation starves the hedge bucket: suppressions dominate
+    assert oc.get("hedges_suppressed", 0) > oc.get(
+        "hedges_issued", 0)
+
+
+def test_fleet_breaker_opens_under_sustained_breach():
+    rep = fleet.FleetSim(_sat_cfg(fleet.OverloadConfig()),
+                        _sat_trace()).run()
+    breakers = rep["overload"]["breakers"]
+    assert any(b["opens"] >= 1 for b in breakers.values())
+    # the breaker sheds fast while open
+    assert any(b["fast_sheds"] >= 1 for b in breakers.values())
+
+
+def test_fleet_brownout_engages_and_recovers():
+    # surge in the middle of an otherwise comfortable trace: the
+    # ladder climbs under the breach and recovers hysteretically
+    spec = fleet.WorkloadSpec(process="poisson", rps=150.0,
+                              n_requests=900, prompt_len=(8, 24),
+                              max_new=(4, 12), deadline_s=0.6)
+    base = fleet.generate_trace(spec, 7)
+    span = max(r.arrival_s for r in base)
+    trace = fleet.surge_trace(spec, 7, round(span * 0.3, 6),
+                              round(span * 0.45, 6), 4.0)
+    cfg = fleet.FleetConfig(
+        replicas=3, policy="least-outstanding", tick_s=0.01,
+        sim=fleet.SimReplicaConfig(max_slots=4,
+                                   prefill_per_tok_s=0.002,
+                                   tpot_s=0.002),
+        slo=fleet.SloPolicy(ttft_s=0.3, e2e_s=0.6),
+        max_queue=512, overload=fleet.OverloadConfig(),
+        max_virtual_s=60.0)
+    rep = fleet.FleetSim(cfg, trace).run()
+    bo = rep["overload"]["brownout"]
+    dirs = [t["direction"] for t in bo["transitions"]]
+    assert "escalate" in dirs and "recover" in dirs
+    assert bo["level"] == 0       # fully recovered by the end
+    assert bo["capped"] >= 1      # max_new was capped under brownout
+
+
+def test_fleet_overload_replay_and_event_core_identity():
+    trace = _sat_trace(seed=11)
+    ov = fleet.OverloadConfig()
+    r1 = fleet.FleetSim(_sat_cfg(ov), trace).run()
+    r2 = fleet.FleetSim(_sat_cfg(ov), trace).run()
+    r3 = fleet.FleetSim(_sat_cfg(ov, event_core=False),
+                        trace).run()
+    assert (json.dumps(r1, sort_keys=True)
+            == json.dumps(r2, sort_keys=True)
+            == json.dumps(r3, sort_keys=True))
+
+
+def test_fleet_config_dict_carries_overload():
+    cfg = _sat_cfg(fleet.OverloadConfig())
+    d = cfg.as_dict()["overload"]
+    assert d["max_attempts"] == 3
+    assert d["retry_budget_ratio"] == pytest.approx(0.1)
+    # controls-off mode is visible in config too
+    d_off = _sat_cfg(
+        fleet.OverloadConfig.uncontrolled()).as_dict()["overload"]
+    assert d_off["retry_budget_ratio"] == 0.0
+    assert not d_off["breaker"] and not d_off["brownout"]
+
+
+# -- eval_every_ticks retirement --------------------------------------
+
+
+def test_eval_every_ticks_emits_one_shot_deprecation():
+    from kind_tpu_sim.fleet import sim as fleet_sim
+
+    fleet_sim._EVAL_TICKS_WARNED = False
+    trace = fleet.generate_trace(
+        fleet.WorkloadSpec(n_requests=10), 3)
+    with pytest.warns(DeprecationWarning, match="eval_every_ticks"):
+        fleet.FleetSim(fleet.FleetConfig(eval_every_ticks=5),
+                       trace)
+    # one-shot: the second construction stays quiet
+    with warnings_none():
+        fleet.FleetSim(fleet.FleetConfig(eval_every_ticks=5),
+                       trace)
+
+
+class warnings_none:
+    def __enter__(self):
+        import warnings
+
+        self._cm = warnings.catch_warnings()
+        self._cm.__enter__()
+        import warnings as w
+
+        w.simplefilter("error", DeprecationWarning)
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+def test_eval_every_ticks_routes_through_eval_every_s():
+    from kind_tpu_sim.fleet import sim as fleet_sim
+
+    fleet_sim._EVAL_TICKS_WARNED = True  # silence for this test
+    trace = fleet.generate_trace(
+        fleet.WorkloadSpec(process="poisson", rps=200.0,
+                           n_requests=150), 7)
+    base = dict(replicas=1, policy="round-robin", tick_s=0.01,
+                autoscale=True)
+    by_ticks = fleet.FleetSim(
+        fleet.FleetConfig(eval_every_ticks=7, **base), trace).run()
+    by_s = fleet.FleetSim(
+        fleet.FleetConfig(eval_every_s=0.07, **base), trace).run()
+    assert (json.dumps(by_ticks["autoscaler"], sort_keys=True)
+            == json.dumps(by_s["autoscaler"], sort_keys=True))
+    assert (json.dumps(by_ticks["completions"], sort_keys=True)
+            == json.dumps(by_s["completions"], sort_keys=True))
+
+
+# -- globe threading --------------------------------------------------
+
+
+def _globe_cfg(ov, **kw):
+    return globe.GlobeConfig(
+        zones=("zone-a", "zone-b", "zone-c"), replicas_per_cell=1,
+        workload=globe.GlobeWorkloadSpec(process="poisson",
+                                         rps=30.0, n_per_zone=100,
+                                         deadline_s=1.5),
+        overload=ov, **kw)
+
+
+def test_globe_overload_replay_and_event_core_identity():
+    ov = globe.OverloadConfig()
+    cfg = _globe_cfg(ov)
+    traces = globe.generate_globe_traces(cfg, 7)
+    r1 = globe.GlobeSim(cfg, traces=traces, seed=7).run()
+    r2 = globe.GlobeSim(cfg, traces=traces, seed=7).run()
+    r3 = globe.GlobeSim(_globe_cfg(ov, event_core=False),
+                        traces=traces, seed=7).run()
+    assert (json.dumps(r1, sort_keys=True)
+            == json.dumps(r2, sort_keys=True)
+            == json.dumps(r3, sort_keys=True))
+    assert r1["ok"]
+
+
+def test_globe_cross_cell_hedging_dedupes_completions():
+    cfg = _globe_cfg(globe.OverloadConfig())
+    traces = globe.generate_globe_traces(cfg, 7)
+    rep = globe.GlobeSim(cfg, traces=traces, seed=7).run()
+    oc = rep["overload"]["counters"]
+    assert oc.get("hedges_issued", 0) >= 1
+    ids = [e["request_id"] for e in rep["completions"]]
+    assert len(ids) == len(set(ids))
+    assert rep["ok"]
+
+
+def test_globe_cell_fleets_keep_breakers_not_retries():
+    cfg = _globe_cfg(globe.OverloadConfig())
+    sim = globe.GlobeSim(cfg, seed=7)
+    for cell in sim.cells:
+        ov = cell.sim.overload
+        assert ov is not None
+        assert ov.cfg.max_attempts == 1   # no cell-tier retries
+        assert not ov.cfg.hedge           # no cell-tier hedging
+        assert ov.cfg.breaker             # breakers stay on
+
+
+def test_cell_cancel_reaches_every_stage():
+    cfg = _globe_cfg(globe.OverloadConfig())
+    sim = globe.GlobeSim(cfg, seed=7)
+    cell = sim.cells[0]
+    req = fleet.TraceRequest("hedge-x", 0.0, (1,) * 8, 4, 1)
+    # in DCN flight: lazy-cancel at delivery
+    cell.admit(req, deliver_s=1.0)
+    assert cell.cancel("hedge-x")
+    cell.deliver_due(2.0)
+    assert not cell.pending
+    # admitted but unticked
+    cell.admit(req, deliver_s=0.0)
+    cell.deliver_due(0.0)
+    assert cell.cancel("hedge-x") and not cell.pending
+    # nowhere: refuses, caller dedupes
+    assert not cell.cancel("hedge-x")
+
+
+# -- front door satellites --------------------------------------------
+
+
+def _loaded_frontdoor(shed_n=200, window=16):
+    """A front door at its bounds: zero-capacity cells force every
+    offer into the queue and past it into shed."""
+    cfg = globe.GlobeConfig(
+        zones=("zone-a",), replicas_per_cell=1,
+        frontdoor=globe.FrontDoorConfig(max_queue=4,
+                                        shed_window=window))
+    sim = globe.GlobeSim(cfg, traces={"zone-a": []}, seed=7)
+    for cell in sim.cells:
+        for replica in cell.sim.replicas:
+            replica.healthy = False   # nothing routable
+    fd = sim.frontdoor
+    sheds = 0
+    for i in range(shed_n):
+        req = fleet.TraceRequest(f"q{i:04d}", 0.0, (1,) * 4, 2, i)
+        if fd.offer(req, "zone-a", float(i)) is not None:
+            sheds += 1
+    return fd, sheds
+
+
+def test_frontdoor_shed_list_bounded_with_exact_total():
+    fd, sheds = _loaded_frontdoor(shed_n=200, window=16)
+    assert sheds == 200 - 4           # queue absorbed max_queue
+    assert len(fd.shed) == 16         # bounded window
+    assert fd.shed_total == sheds     # exact counter
+    assert fd.report()["shed"] == sheds
+
+
+def test_frontdoor_shed_heavy_replay_byte_identity():
+    # shed path under replay: tiny cells + a herd-sized workload
+    # push traffic through queue AND shed; two seeded runs must be
+    # byte-identical including every shed record
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b"), replicas_per_cell=1,
+        sim=fleet.SimReplicaConfig(max_slots=1, max_queue=4,
+                                   prefill_per_tok_s=0.01,
+                                   tpot_s=0.01),
+        frontdoor=globe.FrontDoorConfig(queue_depth=1.0,
+                                        spill_headroom=0.1,
+                                        max_queue=8),
+        workload=globe.GlobeWorkloadSpec(process="bursty",
+                                         rps=120.0, n_per_zone=150,
+                                         deadline_s=1.0),
+        max_virtual_s=120.0)
+    traces = globe.generate_globe_traces(cfg, 11)
+    r1 = globe.GlobeSim(cfg, traces=traces, seed=11).run()
+    r2 = globe.GlobeSim(cfg, traces=traces, seed=11).run()
+    assert r1["frontdoor"]["shed"] >= 1   # the path is exercised
+    assert (json.dumps(r1, sort_keys=True)
+            == json.dumps(r2, sort_keys=True))
+
+
+def test_note_result_slo_window_spill_hysteresis():
+    cfg = globe.GlobeConfig(
+        zones=("zone-a",), replicas_per_cell=1,
+        frontdoor=globe.FrontDoorConfig(slo_spill_below=0.7,
+                                        slo_window=8))
+    sim = globe.GlobeSim(cfg, traces={"zone-a": []}, seed=7)
+    fd = sim.frontdoor
+    cell = sim.cells[0]
+    # under half a window of samples: never breaching (cold start)
+    for _ in range(3):
+        fd.note_result(cell.name, False)
+    assert not fd._slo_breaching(cell)
+    # a full window of misses: breaching
+    for _ in range(5):
+        fd.note_result(cell.name, False)
+    assert fd._slo_breaching(cell)
+    # the window recovers as clean verdicts displace the misses
+    for _ in range(8):
+        fd.note_result(cell.name, True)
+    assert not fd._slo_breaching(cell)
+
+
+def test_prefix_warmup_beats_cold_failover():
+    # a shared-prefix cohort's home cell dies: with warm-up the new
+    # home pre-warms the cohort's prefix groups, so post-failover
+    # TTFT beats the cold spill
+    def run(warm):
+        cfg = globe.GlobeConfig(
+            zones=("zone-a", "zone-b"), replicas_per_cell=1,
+            frontdoor=globe.FrontDoorConfig(warm_on_failover=warm),
+            workload=globe.GlobeWorkloadSpec(
+                process="poisson", rps=25.0, n_per_zone=150,
+                shared_prefix_frac=1.0, prefix_groups=2,
+                prompt_len=(24, 32)),
+            max_virtual_s=120.0)
+        traces = globe.generate_globe_traces(cfg, 7)
+        span = max(r.arrival_s for rs in traces.values()
+                   for r in rs)
+        at = round(span * 0.4, 6)
+        events = [globe.GlobeChaosEvent(at_s=at,
+                                        action="zone_loss",
+                                        target="zone-a")]
+        rep = globe.GlobeSim(cfg, traces=traces, seed=7,
+                             chaos_events=events).run()
+        post = [e for e in rep["completions"]
+                if e["arrival_s"] >= at and e["cell"] is not None
+                and e["first_s"] is not None]
+        ttft = [e["first_s"] - e["arrival_s"] for e in post]
+        return rep, sum(ttft) / len(ttft)
+
+    warm_rep, warm_ttft = run(True)
+    cold_rep, cold_ttft = run(False)
+    assert warm_rep["frontdoor"].get("prefix_warmups", 0) >= 1
+    assert cold_rep["frontdoor"].get("prefix_warmups", 0) == 0
+    assert warm_ttft < cold_ttft
+
+
+# -- scenarios --------------------------------------------------------
+
+
+def test_overload_surge_scenario_green():
+    from kind_tpu_sim import chaos
+
+    rep = chaos.run_scenario("overload-surge", seed=3)
+    assert rep["ok"], rep
+    assert rep["goodput_floor_held"]
+    assert rep["p99_recovery_ratio_on"] <= 1.25
+    assert rep["p99_recovery_ratio_off"] > 1.25
+    assert rep["retries_suppressed"] >= 1
+    assert rep["retries_off"] > rep["retries_on"]
+    assert rep["replay_identical"]
+
+
+def test_retry_storm_scenario_green():
+    from kind_tpu_sim import chaos
+
+    rep = chaos.run_scenario("retry-storm", seed=3)
+    assert rep["ok"], rep
+    assert rep["p99_recovery_ratio_on"] <= 1.25
+    assert rep["p99_recovery_ratio_off"] > 1.25
+    assert rep["retries_suppressed"] >= 1
+    assert rep["replay_identical"]
+
+
+def test_overload_scenarios_in_soak_rotation():
+    from kind_tpu_sim import chaos
+
+    for name in ("overload-surge", "retry-storm"):
+        assert name in chaos.SCENARIOS
+        assert not chaos.SCENARIOS[name].slow
+    assert "demand_surge" in chaos.FAULT_KINDS
+    assert "retry_storm" in chaos.FAULT_KINDS
+
+
+def test_scenario_event_core_off_identity(monkeypatch):
+    from kind_tpu_sim import chaos
+
+    on = chaos.run_scenario("retry-storm", seed=5)
+    monkeypatch.setenv("KIND_TPU_SIM_FLEET_EVENT_CORE", "0")
+    off = chaos.run_scenario("retry-storm", seed=5)
+    on.pop("recovery_events")
+    off.pop("recovery_events")
+    assert (json.dumps(on, sort_keys=True)
+            == json.dumps(off, sort_keys=True))
+
+
+# -- knobs ------------------------------------------------------------
+
+
+def test_overload_knobs_resolve(monkeypatch):
+    monkeypatch.setenv("KIND_TPU_SIM_OVERLOAD_RETRY_BUDGET", "0.25")
+    monkeypatch.setenv("KIND_TPU_SIM_OVERLOAD_BROWNOUT", "0")
+    assert fleet.resolve_retry_budget() == pytest.approx(0.25)
+    assert fleet.resolve_brownout() is False
+    assert fleet.resolve_retry_budget(0.5) == pytest.approx(0.5)
+    cfg = fleet.OverloadConfig()
+    assert cfg.as_dict()["retry_budget_ratio"] == pytest.approx(
+        0.25)
+    assert not fleet.BrownoutController(cfg).enabled
