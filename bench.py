@@ -2653,6 +2653,92 @@ def overload_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def train_smoke() -> dict | None:
+    """Training-tenant extras (docs/TRAINING.md): serving + an LLM
+    gang (v4 ICI block) + an Ising sweep co-scheduled on a
+    heterogeneous inventory under node chaos that provably evicts
+    the gang — publishing training throughput (tokens/s, sweeps/s),
+    steps lost under chaos (MUST be zero: the PreemptionGuard
+    contract), checkpoint overhead fraction, and the serving p99
+    delta with training co-scheduled vs serving alone (the
+    co-tenancy cost, bounded by strict priority)."""
+    try:
+        from kind_tpu_sim import fleet
+        from kind_tpu_sim import metrics as _metrics
+        from kind_tpu_sim.chaos import _window_p99_ttft
+
+        t0 = time.monotonic()
+        board_before = _metrics.train_board().counts()
+        spec = fleet.WorkloadSpec(
+            process="poisson", rps=60.0, n_requests=300,
+            prompt_len=(8, 24), max_new=(4, 12))
+        trace = fleet.generate_trace(spec, seed=7)
+        span = max(r.arrival_s for r in trace)
+        sim_cfg = fleet.SimReplicaConfig(
+            max_slots=4, prefill_per_tok_s=0.002, tpot_s=0.002)
+        sc = fleet.FleetSchedConfig(
+            pods=(("tpu-v5-lite-podslice", "4x8"),
+                  ("tpu-v4-podslice", "2x2x4")))
+        tc = fleet.TrainingConfig(gangs=(
+            fleet.TrainingGangConfig(
+                name="llm0", accelerator="tpu-v4-podslice",
+                topology="2x2x4", total_steps=80,
+                checkpoint_every=8),
+            fleet.ising_gang("ising0", total_steps=120,
+                             checkpoint_every=20)))
+        # drain the first v4 node (the LLM gang provably sits on
+        # the v4 domain): checkpoint -> evict -> resume on restore
+        events = [
+            fleet.ChaosEvent(at_s=round(span * 0.25, 6),
+                             action="node_drain", target=4),
+            fleet.ChaosEvent(at_s=round(span * 0.5, 6),
+                             action="node_restore", target=4),
+        ]
+
+        def run(training):
+            fc = fleet.FleetConfig(
+                replicas=3, policy="least-outstanding",
+                tick_s=0.01, sim=sim_cfg,
+                slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+                sched=sc, training=(tc if training else None),
+                max_virtual_s=120.0)
+            return fleet.FleetSim(fc, trace,
+                                  chaos_events=events).run()
+
+        alone = run(False)
+        mixed = run(True)
+        tr = mixed["training"]
+        gangs = tr["gangs"]
+        p99_alone = _window_p99_ttft(alone["completions"], 0.0,
+                                     span + 1.0)
+        p99_mixed = _window_p99_ttft(mixed["completions"], 0.0,
+                                     span + 1.0)
+        return {
+            "ok": bool(mixed["ok"] and alone["ok"]
+                       and tr["all_done"] and tr["ledger_ok"]
+                       and tr["lost_steps"] == 0),
+            "seconds": round(time.monotonic() - t0, 3),
+            "llm_tokens_per_s": gangs["llm0"].get("work_per_s"),
+            "ising_sweeps_per_s":
+                gangs["ising0"].get("work_per_s"),
+            "steps_lost_under_chaos": tr["lost_steps"],
+            "evictions": tr["evictions"],
+            "checkpoint_overhead_frac": {
+                name: g["overhead_frac"]
+                for name, g in gangs.items()},
+            "serving_p99_alone_s": p99_alone,
+            "serving_p99_cosched_s": p99_mixed,
+            "serving_p99_delta_frac": (
+                round(p99_mixed / p99_alone - 1.0, 4)
+                if p99_alone and p99_mixed is not None else None),
+            "ledger_ok": tr["ledger_ok"],
+            "counters": _metrics.train_board().snapshot_since(
+                board_before),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def analysis_smoke() -> dict | None:
     """Determinism-tooling extras: detlint wall time over the whole
     package with per-rule finding/waiver counts (tool cost and waiver
@@ -2870,6 +2956,10 @@ def main(argv=None) -> int:
             overload_rep = overload_smoke()
         if overload_rep:
             phases["overload"] = overload_rep
+        with stopwatch("train"):
+            train_rep = train_smoke()
+        if train_rep:
+            phases["train"] = train_rep
         with stopwatch("analysis"):
             analysis_rep = analysis_smoke()
         if analysis_rep:

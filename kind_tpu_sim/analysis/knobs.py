@@ -154,6 +154,31 @@ SCHED_SEED = _register(
     "Workload seed for the scheduler simulator: same seed, "
     "byte-identical event log.")
 
+# training tenant (docs/TRAINING.md)
+TRAIN_CKPT_EVERY = _register(
+    "KIND_TPU_SIM_TRAIN_CKPT_EVERY", 0, "int", "train",
+    "Default checkpoint cadence in steps for training gangs; `0` "
+    "derives the Young-Daly optimum from the gang's step time, "
+    "checkpoint write cost, and the assumed preemption MTBF.")
+TRAIN_CKPT_WRITE_S = _register(
+    "KIND_TPU_SIM_TRAIN_CKPT_WRITE_S", 0.05, "float", "train",
+    "Modeled cost of one checkpoint write in virtual seconds (the "
+    "gang pauses stepping for this long at each cadence boundary).")
+TRAIN_RESTART_S = _register(
+    "KIND_TPU_SIM_TRAIN_RESTART_S", 0.2, "float", "train",
+    "Modeled resume cost in virtual seconds after a reschedule or "
+    "elastic resize (checkpoint load + re-init collectives).")
+TRAIN_MTBF_S = _register(
+    "KIND_TPU_SIM_TRAIN_MTBF_S", 60.0, "float", "train",
+    "Assumed mean time between preemptions in virtual seconds — "
+    "the rate the Young-Daly auto-cadence and the checkpoint-"
+    "economics report price lost work against.")
+TRAIN_ELASTIC = _register(
+    "KIND_TPU_SIM_TRAIN_ELASTIC", True, "bool", "train",
+    "Allow elastic gangs to grow onto scavenged/spot capacity and "
+    "shrink (never abort) on reclaim; `0` pins every gang at its "
+    "base topology.")
+
 # globe (docs/GLOBE.md)
 GLOBE_SEED = _register(
     "KIND_TPU_SIM_GLOBE_SEED", 0, "int", "globe",
@@ -234,7 +259,7 @@ BENCH_SLOW = _register(
 # Display order of layers in docs/KNOBS.md — pipeline order, not
 # alphabetical, so the page reads like the architecture diagram.
 LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "sched",
-               "globe", "overload", "health", "bench")
+               "train", "globe", "overload", "health", "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -244,6 +269,7 @@ LAYER_DOCS = {
     "chaos": "CHAOS.md",
     "fleet": "FLEET.md",
     "sched": "SCHED.md",
+    "train": "TRAINING.md",
     "globe": "GLOBE.md",
     "overload": "OVERLOAD.md",
     "health": "HEALTH.md",

@@ -219,6 +219,8 @@ _REPLAYABLE_SCENARIOS = {
     "globe-zone-loss": False, "globe-herd-failover": False,
     "globe-dcn-degrade": False,
     "overload-surge": False, "retry-storm": False,
+    "train-preempt-economics": False, "train-mixed-soak": False,
+    "train-globe-spot": False,
 }
 
 

@@ -312,6 +312,13 @@ def build_parser() -> argparse.ArgumentParser:
              "KIND_TPU_SIM_OVERLOAD_*; report gains an 'overload' "
              "section")
     fl.add_argument(
+        "--train", type=int, default=0, metavar="N",
+        help="co-schedule N LLM training gangs under the serving "
+             "fleet (docs/TRAINING.md; requires --sched): gangs "
+             "run at batch priority -10 with checkpointed "
+             "preemption and a zero-lost-step progress ledger; "
+             "the report gains a 'training' section")
+    fl.add_argument(
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
              "(default: KIND_TPU_SIM_FLEET_TICK_S or 0.01)")
@@ -548,6 +555,78 @@ def build_parser() -> argparse.ArgumentParser:
         help="write to this file instead of stdout",
     )
 
+    tr = sub.add_parser(
+        "train",
+        help=(
+            "training as a fleet tenant (docs/TRAINING.md): run = "
+            "co-scheduled training gangs (LLM and/or Ising sweeps) "
+            "under a serving fleet on the cluster scheduler, with "
+            "checkpoint economics and a zero-lost-step progress "
+            "ledger — same seed, byte-identical report; plan = the "
+            "checkpoint-cadence economics table (Young-Daly "
+            "optimum vs alternatives)"
+        ),
+    )
+    tr.add_argument("action", choices=["run", "plan"])
+    tr.add_argument(
+        "--seed", type=int, default=None,
+        help="serving workload seed (default: "
+             "KIND_TPU_SIM_FLEET_SEED or 0)")
+    tr.add_argument(
+        "--gangs", type=int, default=1,
+        help="LLM training gangs (GSPMD data x model mesh over "
+             "each gang's ICI block)")
+    tr.add_argument(
+        "--ising", type=int, default=0,
+        help="additional Monte-Carlo Ising sweep gangs "
+             "(all-throughput, sub-host, collective-free)")
+    tr.add_argument(
+        "--steps", type=int, default=80,
+        help="training steps per gang")
+    tr.add_argument(
+        "--cadence", type=int, default=None,
+        help="checkpoint cadence in steps (default: "
+             "KIND_TPU_SIM_TRAIN_CKPT_EVERY; 0 = the Young-Daly "
+             "optimum for the gang's step time)")
+    tr.add_argument(
+        "--elastic", action="store_true",
+        help="elastic gangs: grow onto scavenged free inventory "
+             "via checkpointed repartition, shrink (never abort) "
+             "on reclaim")
+    tr.add_argument(
+        "--manifest", default=None,
+        help="parse the training gangs from this kubernetes "
+             "manifest (e.g. pods/tpu-batch-train-job.yaml: a "
+             "StatefulSet is ONE gang at its annotated priority) "
+             "instead of synthesizing them")
+    tr.add_argument("--serving-rps", type=float, default=40.0,
+                    help="serving traffic riding along (req/s)")
+    tr.add_argument("--requests", type=int, default=150,
+                    help="serving requests in the trace")
+    tr.add_argument("--replicas", type=int, default=2,
+                    help="serving replicas (priority 10, above "
+                         "every training gang)")
+    tr.add_argument(
+        "--pods", default="tpu-v5-lite-podslice:4x8,"
+                          "tpu-v5-lite-podslice:4x8",
+        help="inventory as comma-separated accelerator:topology "
+             "pairs, one ICI domain each")
+    tr.add_argument(
+        "--mtbf-s", type=float, default=None,
+        help="assumed preemption MTBF for plan / auto cadence "
+             "(default: KIND_TPU_SIM_TRAIN_MTBF_S)")
+    tr.add_argument(
+        "--step-s", type=float, default=None,
+        help="plan: per-step time override (default: derived from "
+             "the default gang's mesh via the ring model)")
+    tr.add_argument(
+        "--no-event-core", action="store_true",
+        help="force the plain per-tick loop (byte-identical, "
+             "slower)")
+    tr.add_argument("--out", default=None,
+                    help="write the full JSON report to this file")
+    tr.add_argument("--json", action="store_true", dest="as_json")
+
     train = sub.add_parser(
         "train-smoke",
         help=(
@@ -757,6 +836,26 @@ def run_chaos_engine(args: argparse.Namespace) -> int:
     return 0 if ok else 1
 
 
+def _fleet_training_config(args: argparse.Namespace):
+    """`fleet run --train N`: N default LLM gangs co-scheduled
+    under the serving fleet (docs/TRAINING.md)."""
+    from kind_tpu_sim import fleet
+
+    if not getattr(args, "train", 0):
+        return None
+    if not args.sched:
+        raise SystemExit(
+            "--train needs --sched: training gangs are "
+            "scheduler-placed workloads (docs/TRAINING.md)")
+    # topology 2x8 = a 1x2 host ROW: it tiles next to the serving
+    # replicas' whole-host placements on the default 4x8 inventory
+    # (a 4x4 column block would not)
+    return fleet.TrainingConfig(gangs=tuple(
+        fleet.TrainingGangConfig(name=f"llm{i}", topology="2x8",
+                                 total_steps=80)
+        for i in range(args.train)))
+
+
 def run_fleet(args: argparse.Namespace) -> int:
     """`fleet run` / `fleet trace`: the deterministic multi-replica
     serving simulator (docs/FLEET.md). Everything advances on a
@@ -802,6 +901,7 @@ def run_fleet(args: argparse.Namespace) -> int:
                 if args.health else None),
         overload=(fleet.OverloadConfig()
                   if args.overload else None),
+        training=_fleet_training_config(args),
         event_core=(False if args.no_event_core else None))
     clock = fleet.VirtualClock()
     factory = None
@@ -879,9 +979,137 @@ def run_fleet(args: argparse.Namespace) -> int:
                   f"{ttr['mean_s']}/{ttr['max_s']} s over "
                   f"{ttr['count']} placement(s) "
                   f"(flat warmup {s['flat_warmup_s']}s)")
+        if "training" in report:
+            t = report["training"]
+            print(f"  training: {len(t['gangs'])} gang(s)  "
+                  f"all_done {t['all_done']}  ledger_ok "
+                  f"{t['ledger_ok']}  lost {t['lost_steps']}  "
+                  f"checkpoints {t['checkpoint_writes']}")
         if args.out:
             print(f"  report -> {args.out}")
         print("FLEET RUN " + ("OK" if report["ok"] else "FAILED"))
+    return 0 if report["ok"] else 1
+
+
+def run_train(args: argparse.Namespace) -> int:
+    """`train run` / `train plan`: the training-tenant simulator
+    (docs/TRAINING.md). `run` co-schedules training gangs under a
+    serving fleet on the cluster scheduler and reports throughput,
+    checkpoint overhead, and the zero-lost-step ledger verdict;
+    `plan` prints the checkpoint-cadence economics (write cost vs
+    expected lost work under the assumed preemption MTBF)."""
+    import dataclasses as _dc
+
+    from kind_tpu_sim import fleet
+    from kind_tpu_sim.fleet import training as tr_mod
+
+    if args.action == "plan":
+        gang = fleet.TrainingGangConfig(
+            name="plan", total_steps=max(1, args.steps))
+        step_s = (args.step_s if args.step_s is not None
+                  else fleet.step_time_s(gang, gang.topology))
+        write_s = tr_mod.resolve_ckpt_write_s()
+        mtbf = tr_mod.resolve_mtbf_s(args.mtbf_s)
+        opt = fleet.optimal_cadence_steps(step_s, write_s, mtbf)
+        rows = sorted({1, max(1, opt // 4), opt,
+                       max(1, opt * 4), max(1, args.steps)})
+        report = {
+            "step_s": round(step_s, 9),
+            "checkpoint_write_s": write_s,
+            "mtbf_s": mtbf,
+            "optimal_cadence_steps": opt,
+            "mesh": fleet.gang_mesh(gang.accelerator,
+                                    gang.topology, gang.kind),
+            "cadences": {
+                str(c): fleet.expected_overhead(step_s, c,
+                                                write_s, mtbf)
+                for c in rows},
+        }
+        text = json.dumps(report, sort_keys=True)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as fh:
+                fh.write(text + "\n")
+        if args.as_json:
+            print(text)
+        else:
+            print(f"train plan: step {report['step_s']}s, "
+                  f"write {write_s}s, MTBF {mtbf}s -> optimal "
+                  f"cadence {opt} step(s)")
+            for c in rows:
+                eo = report["cadences"][str(c)]
+                mark = " <-- optimal" if c == opt else ""
+                print(f"  every {c:>4}: write {eo['write_frac']}"
+                      f"  lost {eo['lost_frac']}  total "
+                      f"{eo['total_frac']}{mark}")
+        return 0
+
+    seed = fleet.resolve_seed(args.seed)
+    cadence = args.cadence
+    gangs = []
+    if args.manifest:
+        with open(args.manifest, encoding="utf-8") as fh:
+            parsed = fleet.gangs_from_manifest(fh.read())
+        if not parsed:
+            raise SystemExit(
+                f"{args.manifest}: no TPU training workloads "
+                "found (need a google.com/tpu limit)")
+        for g in parsed:
+            gangs.append(_dc.replace(
+                g, total_steps=args.steps,
+                checkpoint_every=cadence,
+                elastic=args.elastic))
+    else:
+        for i in range(args.gangs):
+            gangs.append(fleet.TrainingGangConfig(
+                name=f"llm{i}", total_steps=args.steps,
+                checkpoint_every=cadence, elastic=args.elastic))
+        for i in range(args.ising):
+            gangs.append(fleet.ising_gang(
+                f"ising{i}", total_steps=args.steps,
+                checkpoint_every=cadence))
+    pods = tuple(tuple(p.split(":", 1))
+                 for p in args.pods.split(","))
+    tc = fleet.TrainingConfig(gangs=tuple(gangs),
+                              scavenge=args.elastic)
+    spec = fleet.WorkloadSpec(
+        process="poisson", rps=args.serving_rps,
+        n_requests=args.requests, prompt_len=(8, 24),
+        max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    fc = fleet.FleetConfig(
+        replicas=args.replicas, policy="least-outstanding",
+        slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+        sched=fleet.FleetSchedConfig(pods=pods), training=tc,
+        event_core=(False if args.no_event_core else None))
+    report = fleet.FleetSim(fc, trace).run()
+    report["seed"] = seed
+    text = json.dumps(report, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    if args.as_json:
+        print(text)
+    else:
+        t = report["training"]
+        print(f"train: {len(t['gangs'])} gang(s) under "
+              f"{args.replicas} serving replica(s), seed {seed}")
+        for name, g in t["gangs"].items():
+            line = (f"  {name} [{g['config']['kind']}] "
+                    f"{g['state']} {g['unique_steps']}/"
+                    f"{g['config']['total_steps']} steps")
+            if "work_per_s" in g:
+                line += (f"  {g['work_per_s']} "
+                         f"{g['work_unit']}/s")
+            line += (f"  ckpt_overhead {g['overhead_frac']}"
+                     f"  lost {g['lost_steps']}")
+            print(line)
+        print(f"  ledger_ok {t['ledger_ok']}  evictions "
+              f"{t['evictions']}  checkpoints "
+              f"{t['checkpoint_writes']}  serving attainment "
+              f"{report['slo']['attainment']}")
+        if args.out:
+            print(f"  report -> {args.out}")
+        print("TRAIN RUN " + ("OK" if report["ok"] else "FAILED"))
     return 0 if report["ok"] else 1
 
 
@@ -1537,6 +1765,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_manifests(args)
         if args.command == "fleet":
             return run_fleet(args)
+        if args.command == "train":
+            return run_train(args)
         if args.command == "sched":
             return run_sched(args)
         if args.command == "globe":

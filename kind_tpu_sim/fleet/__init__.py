@@ -11,7 +11,8 @@ Knobs: KIND_TPU_SIM_FLEET_SEED (loadgen.resolve_seed),
 KIND_TPU_SIM_FLEET_TICK_S (sim.resolve_tick_s),
 KIND_TPU_SIM_FLEET_WARMUP_S (autoscaler.resolve_warmup_s),
 KIND_TPU_SIM_HEALTH_* (health.DetectorConfig — the gray-failure
-detection layer, docs/HEALTH.md).
+detection layer, docs/HEALTH.md), KIND_TPU_SIM_TRAIN_* (the
+training tenancy, docs/TRAINING.md).
 """
 
 from kind_tpu_sim.health import (  # noqa: F401
@@ -77,6 +78,23 @@ from kind_tpu_sim.fleet.sim import (  # noqa: F401
     attainment_over,
     resolve_fast_forward,
     resolve_tick_s,
+)
+from kind_tpu_sim.fleet.training import (  # noqa: F401
+    TRAIN_KINDS,
+    TrainingConfig,
+    TrainingGang,
+    TrainingGangConfig,
+    TrainingTenant,
+    expected_overhead,
+    gang_mesh,
+    gangs_from_manifest,
+    grow_topology,
+    ising_gang,
+    optimal_cadence_steps,
+    shrink_topology,
+    step_time_s,
+    to_manifest,
+    verify_ledger,
 )
 from kind_tpu_sim.fleet.slo import (  # noqa: F401
     FixedBucketHistogram,

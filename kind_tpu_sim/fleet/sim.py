@@ -64,6 +64,10 @@ from kind_tpu_sim.fleet.router import (
     SimReplicaConfig,
 )
 from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
+from kind_tpu_sim.fleet.training import (
+    TrainingConfig,
+    TrainingTenant,
+)
 
 TICK_ENV = knobs.FLEET_TICK_S
 DEFAULT_TICK_S = 0.01
@@ -214,6 +218,10 @@ class FleetConfig:
     # first-completion-wins cancellation, per-replica circuit
     # breakers under the routing policies, and the brownout ladder
     overload: Optional[OverloadConfig] = None
+    # training tenancy (docs/TRAINING.md): long-running training
+    # gangs co-scheduled UNDER serving on the same inventory under
+    # strict priority — requires a scheduler-backed fleet (sched)
+    training: Optional[TrainingConfig] = None
     # idle-gap fast-forward (None -> resolve_fast_forward()). An
     # execution strategy, not workload config: reports are
     # byte-identical either way, so it deliberately stays OUT of
@@ -244,6 +252,8 @@ class FleetConfig:
             out["health"] = self.health.as_dict()
         if self.overload is not None:
             out["overload"] = self.overload.as_dict()
+        if self.training is not None:
+            out["training"] = self.training.as_dict()
         return out
 
 
@@ -352,8 +362,18 @@ class FleetSim:
         self._hedges: Dict[str, dict] = {}
         self._hedge_dropped: set = set()
         self._completed_ids: set = set()
+        # training tenancy (docs/TRAINING.md): gangs co-scheduled
+        # under serving on the same inventory, strict priority
+        self.trainer: Optional[TrainingTenant] = None
         if cfg.sched is not None:
             self._init_scheduler(cfg.sched)
+        if cfg.training is not None:
+            if self.sched is None:
+                raise ValueError(
+                    "FleetConfig.training needs a scheduler-backed "
+                    "fleet (set FleetConfig.sched): training gangs "
+                    "are scheduler-placed workloads")
+            self.trainer = TrainingTenant(cfg.training, self.sched)
 
     # -- scheduler-backed placement (docs/SCHED.md) -------------------
 
@@ -405,6 +425,19 @@ class FleetSim:
         replica preempts through the existing chaos machinery (its
         load requeues at the router FRONT) and the gang rejoins the
         pending queue; the replica heals only after rebind+warmup."""
+        if (self.trainer is not None
+                and self.trainer.owns(request.name)):
+            bound = self.sched.bound.get(request.name)
+            if bound is not None:
+                # defrag moved the gang (it is ALREADY rebound): a
+                # checkpointed repartition at the same shape
+                dom = self.sched.inv.domains[bound.placement.domain]
+                self.trainer.on_migrated(
+                    request.name, self._now, dom.link_factor,
+                    self._sched_cfg.bind_s)
+            else:
+                self.trainer.on_evicted(request.name, self._now)
+            return
         rid = self._gang_replica.get(request.name)
         if rid is None:
             return
@@ -432,6 +465,12 @@ class FleetSim:
                   else resolve_warmup_s())
         for gang in self.sched.step(now):
             name = gang.request.name
+            if (self.trainer is not None
+                    and self.trainer.owns(name)):
+                dom = self.sched.inv.domains[gang.placement.domain]
+                self.trainer.on_bound(name, now, dom.link_factor,
+                                      self._sched_cfg.bind_s)
+                continue
             requested = self._gang_requested.pop(name, now)
             # warm-up is collective-heavy (compile + init all-reduce
             # smokes), so a degraded-link domain inflates it by the
@@ -500,6 +539,15 @@ class FleetSim:
         for name, gang in sorted(self.sched.bound.items()):
             rid = self._gang_replica.get(name)
             if rid is None:
+                if (self.trainer is not None
+                        and self.trainer.owns(name)):
+                    # link state changed under a training gang: its
+                    # ring slows/heals — a pure rate change, no
+                    # checkpoint (docs/TRAINING.md)
+                    self.trainer.gangs[name].reprice(
+                        now,
+                        self.sched.inv.domains[
+                            gang.placement.domain].link_factor)
                 continue
             replica = self._replica_by_id(rid)
             if replica is None or not hasattr(replica,
@@ -798,6 +846,13 @@ class FleetSim:
     def _apply_chaos(self, now: float) -> None:
         while self.chaos_events and self.chaos_events[0].at_s <= now:
             ev = self.chaos_events.pop(0)
+            if ev.action in ("train_preempt", "train_kill"):
+                if self.trainer is None:
+                    raise ValueError(
+                        f"{ev.action} chaos needs a training "
+                        "tenancy (FleetConfig.training)")
+                self.trainer.apply_chaos(ev.action, ev.target, now)
+                continue
             if ev.action.startswith("node_"):
                 if self.sched is None:
                     raise ValueError(
@@ -910,6 +965,11 @@ class FleetSim:
         self._now = now
         self._apply_chaos(now)
         if self.sched is not None:
+            if self.trainer is not None:
+                # submit due gang arrivals, commit closed-form
+                # progress, release finished gangs' inventory —
+                # all BEFORE the scheduling pass sees the queue
+                self.trainer.tick(now)
             self._drain_migrations(now)
             self._sched_step(now)
             healed = self._rebinding.pop_due(now)
@@ -969,6 +1029,10 @@ class FleetSim:
                 self._autoscale(now)
             if self.overload is not None:
                 self.overload.brownout.evaluate(now)
+            if self.trainer is not None:
+                # the elastic ladder (no-op unless an elastic gang
+                # is live, so skipped eval boundaries stay no-ops)
+                self.trainer.evaluate(now)
         self._ticks += 1
 
     def quiescent(self, pending: Optional[deque] = None) -> bool:
@@ -985,6 +1049,7 @@ class FleetSim:
             and not self._draining
             and not self.chaos_events
             and not self._retry_heap and not self._hedge_heap
+            and (self.trainer is None or self.trainer.quiescent())
             and not (self.sched is not None
                      and (self.sched.pending
                           or self._rebinding)))
@@ -997,6 +1062,9 @@ class FleetSim:
         events, so their presence disqualifies the gap)."""
         if (self.autoscaler is not None or self.health is not None
                 or self.overload is not None):
+            return False
+        if (self.trainer is not None
+                and not self.trainer.quiescent()):
             return False
         if (self.router.queue or self._warming or self._draining):
             return False
@@ -1034,6 +1102,10 @@ class FleetSim:
         # applies at its backoff expiry, a hedge at its delay expiry
         due.at(self._retry_heap.peek_time())
         due.at(self._hedge_heap.peek_time())
+        if self.trainer is not None:
+            # gang arrivals and segment completions are boundary-
+            # condition events; mid-segment progress is closed form
+            self.trainer.due(due)
         if self.router.queue or self._draining:
             return due.need_now()
         if self.sched is not None and (
@@ -1094,10 +1166,14 @@ class FleetSim:
         if due.immediate:
             return
         evals_away = -1
-        if self.autoscaler is not None or self.overload is not None:
-            # the overload brownout ladder evaluates on the same
-            # tick grid as the autoscaler — eval boundaries must be
-            # stepped in both modes or the ladders diverge
+        if (self.autoscaler is not None
+                or self.overload is not None
+                or (self.trainer is not None
+                    and self.trainer.wants_evals())):
+            # the overload brownout ladder and the training elastic
+            # ladder evaluate on the same tick grid as the
+            # autoscaler — eval boundaries must be stepped in both
+            # modes or the ladders diverge
             r = self._ticks % self._eval_ticks
             evals_away = (self._eval_ticks - r) % self._eval_ticks
             if evals_away == 0:
@@ -1191,6 +1267,10 @@ class FleetSim:
             report["ok"] = all(r.request_id in base_done
                                for r in self.trace)
             report["overload"] = self.overload.report()
+        if self.trainer is not None:
+            tr = self.trainer.report()
+            report["training"] = tr
+            report["ok"] = bool(report["ok"] and tr["ledger_ok"])
         if self.preemptions:
             report["preemptions"] = self.preemptions
         if self.health is not None:

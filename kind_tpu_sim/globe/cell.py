@@ -184,6 +184,13 @@ class Cell:
         back to the front door; replicas go unhealthy until
         :meth:`restore`."""
         displaced: List[TraceRequest] = []
+        if self.sim.trainer is not None:
+            # training gangs ride the PreemptionGuard contract
+            # (docs/TRAINING.md): checkpoint at the current step,
+            # evict, requeue — they rebind when the cell returns,
+            # with zero counted steps lost
+            self.sim._now = now
+            self.sim.trainer.evict_all(now, reason="cell failed")
         for replica in self.sim.replicas:
             if replica.healthy:
                 displaced.extend(replica.fail(now))
@@ -226,4 +233,6 @@ class Cell:
         if self.sim.sched is not None:
             out["sched_event_counts"] = \
                 self.sim.sched.report()["event_counts"]
+        if self.sim.trainer is not None:
+            out["training"] = self.sim.trainer.report()
         return out

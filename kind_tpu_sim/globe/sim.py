@@ -57,6 +57,7 @@ from kind_tpu_sim.fleet.sim import (
     resolve_fast_forward,
     resolve_tick_s,
 )
+from kind_tpu_sim.fleet.training import TrainingConfig
 from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
 from kind_tpu_sim.globe.cell import Cell, CellConfig
 from kind_tpu_sim.globe.frontdoor import FrontDoor, FrontDoorConfig
@@ -131,6 +132,11 @@ class GlobeConfig:
     # own zone-labeled inventory (FleetConfig.sched, docs/SCHED.md)
     sched: bool = True
     sched_policy: str = "ici"
+    # inventory shape of every cell's scheduler (None keeps the
+    # FleetSchedConfig default of one 4x8 pod) — a training cell
+    # needs headroom beyond serving for the elastic ladder to have
+    # anything to scavenge (docs/TRAINING.md)
+    cell_pods: Optional[Tuple] = None
     autoscale: bool = False
     autoscaler: AutoscalerConfig = AutoscalerConfig()
     frontdoor: FrontDoorConfig = FrontDoorConfig()
@@ -141,6 +147,12 @@ class GlobeConfig:
     # brownout but never their own retries/hedges — two stacked
     # retry loops would be an amplifier of their own
     overload: Optional[OverloadConfig] = None
+    # training tenancy (docs/TRAINING.md): the named cells run this
+    # TrainingConfig co-scheduled under their serving fleet (strict
+    # priority); empty training_cells defaults to the first cell.
+    # Requires scheduler-backed cells (sched=True).
+    training: Optional[TrainingConfig] = None
+    training_cells: Tuple[str, ...] = ()
     workload: GlobeWorkloadSpec = GlobeWorkloadSpec()
     # one-way DCN latency unit between adjacent zones; zone pairs
     # farther apart in the zone list cost proportionally more
@@ -156,6 +168,23 @@ class GlobeConfig:
         return [f"{z}/c{i}" for z in self.zones
                 for i in range(self.cells_per_zone)]
 
+    def resolve_training_cells(self) -> List[str]:
+        """The cells that host the training tenancy: the explicit
+        list, or the first cell when training is set and no list is
+        given."""
+        if self.training is None:
+            return []
+        if self.training_cells:
+            names = set(self.cell_names())
+            unknown = [c for c in self.training_cells
+                       if c not in names]
+            if unknown:
+                raise ValueError(
+                    f"training_cells {unknown} not in "
+                    f"{sorted(names)}")
+            return list(self.training_cells)
+        return self.cell_names()[:1]
+
     def as_dict(self) -> dict:
         out = {
             "zones": list(self.zones),
@@ -168,6 +197,8 @@ class GlobeConfig:
                     dataclasses.asdict(self.slo).items()
                     if v is not None},
             "sched": (self.sched_policy if self.sched else None),
+            "cell_pods": ([list(p) for p in self.cell_pods]
+                          if self.cell_pods is not None else None),
             "autoscale": self.autoscale,
             "frontdoor": self.frontdoor.as_dict(),
             "planner": (self.planner.as_dict()
@@ -178,6 +209,10 @@ class GlobeConfig:
         }
         if self.overload is not None:
             out["overload"] = self.overload.as_dict()
+        if self.training is not None:
+            out["training"] = self.training.as_dict()
+            out["training_cells"] = sorted(
+                self.resolve_training_cells())
         return out
 
 
@@ -283,10 +318,17 @@ class GlobeSim:
         self.chaos_applied: List[dict] = []
         self._zone_idx = {z: i for i, z in enumerate(cfg.zones)}
         self._dcn_factor: Dict[str, float] = {}
+        training_cells = set(cfg.resolve_training_cells())
+        if training_cells and not cfg.sched:
+            raise ValueError(
+                "GlobeConfig.training needs scheduler-backed cells "
+                "(sched=True): training gangs are scheduler-placed "
+                "workloads")
         self.cells = [
             Cell(CellConfig(name=name, zone=name.split("/")[0],
                             fleet=self._fleet_config(
-                                name.split("/")[0])),
+                                name.split("/")[0],
+                                training=name in training_cells)),
                  self.clock)
             for name in cfg.cell_names()]
         for cell in self.cells:
@@ -333,9 +375,11 @@ class GlobeSim:
         self._scan_holdoff = 0
         self._scan_backoff = 1
 
-    def _fleet_config(self, zone: str) -> FleetConfig:
+    def _fleet_config(self, zone: str,
+                      training: bool = False) -> FleetConfig:
         cfg = self.cfg
         return FleetConfig(
+            training=(cfg.training if training else None),
             replicas=cfg.replicas_per_cell, policy=cfg.policy,
             tick_s=cfg.tick_s,
             # the FRONT DOOR is the admission layer: its per-cell
@@ -347,7 +391,10 @@ class GlobeSim:
             slo=cfg.slo, sim=cfg.sim,
             autoscaler=cfg.autoscaler,
             sched=(FleetSchedConfig(policy=cfg.sched_policy,
-                                    zone=zone)
+                                    zone=zone,
+                                    **({"pods": cfg.cell_pods}
+                                       if cfg.cell_pods is not None
+                                       else {}))
                    if cfg.sched else None),
             # cells keep the replica-tier controls (breakers,
             # brownout) but the CLIENT lives at the front door:
@@ -640,10 +687,13 @@ class GlobeSim:
                 sim = cell.sim
                 alive_sims.append(sim)
                 if (sim.autoscaler is not None
-                        or sim.overload is not None):
-                    # cell brownout ladders evaluate on the same
-                    # tick grid as autoscalers — eval boundaries
-                    # must be stepped in both modes
+                        or sim.overload is not None
+                        or (sim.trainer is not None
+                            and sim.trainer.wants_evals())):
+                    # cell brownout ladders and training elastic
+                    # ladders evaluate on the same tick grid as
+                    # autoscalers — eval boundaries must be
+                    # stepped in both modes
                     r = sim._ticks % sim._eval_ticks
                     away = (sim._eval_ticks - r) % sim._eval_ticks
                     if evals_away < 0 or away < evals_away:
@@ -803,6 +853,26 @@ class GlobeSim:
                 req.request_id in base_done
                 for reqs in self.traces.values() for req in reqs)
             report["overload"] = self.overload.report()
+        trainers = {c.name: c.sim.trainer for c in self.cells
+                    if c.sim.trainer is not None}
+        if trainers:
+            # the globe-level training roll-up: per-cell detail
+            # lives in cells[*].training; the verdict joins ok
+            trep = {name: t.report()
+                    for name, t in sorted(trainers.items())}
+            report["training"] = {
+                "cells": sorted(trainers),
+                "all_done": all(t["all_done"]
+                                for t in trep.values()),
+                "ledger_ok": all(t["ledger_ok"]
+                                 for t in trep.values()),
+                "lost_steps": sum(t["lost_steps"]
+                                  for t in trep.values()),
+                "rerun_steps": sum(t["rerun_steps"]
+                                   for t in trep.values()),
+            }
+            report["ok"] = bool(report["ok"]
+                                and report["training"]["ledger_ok"])
         if self.chaos_applied:
             report["chaos"] = self.chaos_applied
         if self.planner is not None:

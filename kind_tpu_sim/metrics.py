@@ -269,6 +269,19 @@ def sched_board() -> CounterBoard:
     return _SCHED_BOARD
 
 
+_TRAIN_BOARD = CounterBoard()
+
+
+def train_board() -> CounterBoard:
+    """The process-global training-tenant counter board (gangs
+    submitted/bound/done, graceful preemptions vs hard kills,
+    checkpointed migrations, elastic grows/shrinks, spot grants —
+    kind_tpu_sim.fleet.training records into it; fleet/globe
+    reports, chaos scenario reports, and bench train extras
+    snapshot it)."""
+    return _TRAIN_BOARD
+
+
 def parse_k8s_time(stamp: str) -> float:
     """RFC3339 (kubernetes) timestamp -> unix seconds."""
     import datetime

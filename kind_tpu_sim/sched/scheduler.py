@@ -288,6 +288,23 @@ class ClusterScheduler:
         if requeue:
             self.pending.append(gang.request)
 
+    def withdraw(self, name: str, now: float,
+                 reason: str = "withdrawn") -> bool:
+        """Remove a PENDING gang from the queue and free its name
+        for resubmission — the training tenant's elastic-resize
+        lever (docs/TRAINING.md): evict, withdraw the auto-requeued
+        old-shape request, resubmit at the new shape. A bound gang
+        must be evicted or released first."""
+        for i, req in enumerate(self.pending):
+            if req.name == name:
+                del self.pending[i]
+                self._arrival_seq.pop(name, None)
+                self._last_fail_msg.pop(name, None)
+                self._event(now, "Withdrawn", name, reason)
+                metrics.sched_board().incr("gangs_withdrawn")
+                return True
+        return False
+
     def release(self, name: str, now: float,
                 reason: str = "completed") -> None:
         gang = self.bound.pop(name, None)

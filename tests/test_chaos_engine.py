@@ -328,3 +328,30 @@ def test_chaos_cli_help_covers_engine(capsys):
     for token in ("run", "soak", "--scenario", "--seed",
                   "--iterations", "KIND_TPU_SIM_CHAOS_SEED"):
         assert token in text
+
+
+def test_straggler_bounds_are_calibration_derived():
+    """The gray-straggler-grid flake fix (PR 8 noted the wall-clock
+    sensitivity as mode-independent): thresholds derive from a
+    two-run calibration probe plus the ABSOLUTE injected stall, so
+    a uniformly loaded host widens the on-bound instead of flipping
+    the verdict, and the off-floor can never be satisfied by noise
+    alone."""
+    quiet = chaos.derive_straggler_bounds(0.6, 0.62, 2.0)
+    # a detection-on run near the calibration baseline passes...
+    assert 0.62 + 0.5 * 2.0 < quiet["on_limit_s"]
+    # ...while an unmitigated run must exceed the faster clean run
+    # by over half a stall — pure host noise (no stall term) fails
+    assert quiet["off_floor_s"] > 0.62
+    assert quiet["off_floor_s"] < 0.6 + 2.0  # one full stall passes
+    # a busy host (both clean runs inflated 3x) scales the on-bound
+    # with the calibration instead of flipping the verdict
+    busy = chaos.derive_straggler_bounds(1.8, 1.86, 2.0)
+    assert busy["on_limit_s"] > quiet["on_limit_s"]
+    assert busy["on_limit_s"] >= 1.25 * 1.86 + 0.9 * 2.0 - 1e-9
+    # an asymmetric load spike during ONE clean run widens the
+    # on-bound (hi) but keeps the off-floor anchored to the quiet
+    # run (lo) — the floor must not inflate away its meaning
+    spiky = chaos.derive_straggler_bounds(0.6, 2.4, 2.0)
+    assert spiky["calib_hi_s"] == 2.4
+    assert spiky["off_floor_s"] == 0.6 + 0.6 * 2.0

@@ -1,0 +1,591 @@
+"""Training-tenant tests (kind_tpu_sim/fleet/training.py,
+docs/TRAINING.md).
+
+Everything runs on the virtual clock — no jax, no cluster, no
+wall-clock dependence — so the whole file is tier-1 fast. Coverage
+follows the ISSUE-10 acceptance list: the GSPMD mesh / ring-model
+step time, closed-form partition invariance, checkpoint economics
+(Young-Daly optimum; seeded preemption schedules whose ledger
+accounting matches brute-force step replay; bit-identical resume
+across two resume points), strict-priority co-scheduling under the
+fleet scheduler, elastic grow/shrink-never-abort, the manifest
+round-trip that lets pods/tpu-batch-train-job.yaml drive the sim,
+and the seed-swept mixed serving+training+batch soak with the
+event core on and off.
+"""
+
+import dataclasses
+import json
+import pathlib
+
+import pytest
+
+from kind_tpu_sim import chaos, fleet
+from kind_tpu_sim.fleet import training as tr
+
+pytestmark = pytest.mark.train
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+SIM_CFG = fleet.SimReplicaConfig(
+    max_slots=4, prefill_per_tok_s=0.002, tpot_s=0.002)
+SLO = fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0)
+TWO_PODS = fleet.FleetSchedConfig(
+    pods=(("tpu-v5-lite-podslice", "4x8"),
+          ("tpu-v5-lite-podslice", "4x8")))
+
+
+def mk_gang(**kw):
+    base = dict(name="g0", total_steps=40, checkpoint_every=8)
+    base.update(kw)
+    return tr.TrainingGangConfig(**base)
+
+
+def mk_fleet(tc, trace=(), chaos_events=(), sched=TWO_PODS, **kw):
+    base = dict(
+        replicas=2, policy="least-outstanding", tick_s=0.01,
+        sim=SIM_CFG, slo=SLO, sched=sched, training=tc,
+        max_virtual_s=120.0)
+    base.update(kw)
+    return fleet.FleetSim(fleet.FleetConfig(**base), list(trace),
+                          chaos_events=list(chaos_events))
+
+
+# -- mesh + step model -------------------------------------------------
+
+
+def test_gang_mesh_shapes():
+    # LLM: (data, model) = (hosts, chips/host) — the NamedSharding
+    # mesh of the gang's ICI block
+    assert tr.gang_mesh("tpu-v5-lite-podslice", "4x4") == {
+        "data": 2, "model": 8}
+    assert tr.gang_mesh("tpu-v4-podslice", "2x2x4", "llm") == {
+        "data": 4, "model": 4}
+    # Ising: one flat batch axis over every chip
+    assert tr.gang_mesh("tpu-v5-lite-podslice", "2x2",
+                        "ising") == {"batch": 4}
+    with pytest.raises(ValueError):
+        tr.gang_mesh("tpu-v5-lite-podslice", "4x4", "dreamer")
+
+
+def test_step_time_scales_with_chips_and_links():
+    g = mk_gang()
+    small = tr.step_time_s(g, "4x4")
+    big = tr.step_time_s(g, "4x8")
+    assert big < small  # more chips, faster step
+    # a degraded link inflates the multi-host ring...
+    assert tr.step_time_s(g, "4x4", link_factor=0.1) > small
+    # ...but a single-host Ising gang pays no ring at all
+    ig = tr.ising_gang("i0")
+    assert tr.step_time_s(ig, "2x2", link_factor=0.1) == \
+        tr.step_time_s(ig, "2x2", link_factor=1.0)
+
+
+def test_grow_shrink_ladder():
+    assert tr.grow_topology("tpu-v5-lite-podslice", "4x4") == "4x8"
+    assert tr.shrink_topology("tpu-v5-lite-podslice", "4x8",
+                              floor="4x4") == "4x4"
+    # shrink never goes below the floor
+    assert tr.shrink_topology("tpu-v5-lite-podslice", "4x4",
+                              floor="4x4") is None
+
+
+# -- checkpoint economics ----------------------------------------------
+
+
+def test_young_daly_cadence_properties():
+    # costlier writes -> longer interval; shakier hardware -> shorter
+    base = tr.optimal_cadence_steps(0.03, 0.05, 60.0)
+    assert tr.optimal_cadence_steps(0.03, 0.5, 60.0) > base
+    assert tr.optimal_cadence_steps(0.03, 0.05, 2.0) < base
+    assert tr.optimal_cadence_steps(0.03, 0.0, 60.0) == 1
+    # the optimum minimizes total_frac across a cadence sweep
+    step_s, write_s, mtbf = 0.03, 0.05, 10.0
+    opt = tr.optimal_cadence_steps(step_s, write_s, mtbf)
+    best = tr.expected_overhead(step_s, opt, write_s,
+                                mtbf)["total_frac"]
+    for cad in (1, max(1, opt // 3), opt * 3, opt * 10):
+        other = tr.expected_overhead(step_s, cad, write_s,
+                                     mtbf)["total_frac"]
+        assert best <= other + 1e-9
+
+
+def _bare_gang(total=60, every=5, step_s=0.1, write_s=0.05,
+               restart=0.2):
+    # allreduce_bytes=0 kills the ring term, so the per-step time
+    # is exactly step_compute_chip_s / 16 chips — a pure-timeline
+    # gang the oracle below can mirror
+    cfg = mk_gang(total_steps=total, checkpoint_every=every,
+                  step_compute_chip_s=step_s * 16,
+                  allreduce_bytes=0.0)
+    gang = tr.TrainingGang(cfg, ckpt_every=every,
+                           ckpt_write_s=write_s,
+                           restart_s=restart, elastic=False)
+    return gang
+
+
+def test_closed_form_partition_invariance():
+    """Advancing a segment in one call or many small calls lands on
+    the identical progress, ledger, and completion instant — the
+    property the event core's skipping rests on."""
+    a = _bare_gang()
+    b = _bare_gang()
+    a.bound(0.0, 1.0, bind_s=0.0)
+    b.bound(0.0, 1.0, bind_s=0.0)
+    end = a.completion_s() + 0.5
+    a.advance(end)
+    t = 0.0
+    while t < end:
+        t = round(t + 0.013, 9)
+        b.advance(min(t, end))
+    assert a.steps_done == b.steps_done == a.cfg.total_steps
+    assert a.state == b.state == "done"
+    assert a.done_s == b.done_s
+    assert a.ledger == b.ledger
+
+
+def brute_force_replay(total, every, step_s, write_s, restart,
+                       events):
+    """Step-by-step reference model of one gang under a (time,
+    kind) fault schedule: walks every step explicitly, applying the
+    same PreemptionGuard semantics (graceful = checkpoint at the
+    last completed step; kill = roll back to the last checkpoint) —
+    the oracle the closed-form ledger accounting is judged
+    against."""
+    now = restart  # first bind at t=0: resume after restart cost
+    done = 0
+    last_ckpt = 0
+    lost = 0
+    rerun = 0
+    high = 0
+    pending = sorted(events)
+    while done < total:
+        step_end = now + step_s
+        if pending and pending[0][0] <= step_end:
+            at, kind = pending.pop(0)
+            if kind == "kill":
+                lost += done - last_ckpt
+                done = last_ckpt
+            else:
+                last_ckpt = done
+            now = at + restart  # evict + instant rebind + restart
+            continue
+        now = step_end
+        done += 1
+        if done <= high:
+            rerun += 1
+        high = max(high, done)
+        if done % every == 0 or done == total:
+            last_ckpt = done
+            now += write_s
+    return {"unique": high, "lost": lost, "rerun": rerun}
+
+
+def test_ledger_matches_brute_force_replay():
+    """Property-style: for seeded preemption schedules the
+    closed-form ledger's lost-work accounting matches an explicit
+    per-step replay."""
+    for seed in range(6):
+        plan = chaos.ChaosSchedule(seed).plan(
+            kinds=("train_preempt", "train_kill"), n_faults=3,
+            horizon=40, targets=1)
+        total, every = 60, 5
+        write_s, restart = 0.05, 0.2
+        # fault times spread over the run's first two thirds (well
+        # clear of the final-write window), strictly ordered
+        events = sorted(
+            (round(0.7 + e.at * 0.08 + i * 0.013, 6),
+             "kill" if e.kind == "train_kill" else "preempt")
+            for i, e in enumerate(plan.events))
+        gang = _bare_gang(total=total, every=every,
+                          write_s=write_s, restart=restart)
+        gang.bound(0.0, 1.0, bind_s=0.0)
+        step_s = gang.step_s
+        for at, kind in events:
+            gang.preempt(at, graceful=(kind == "preempt"),
+                         reason=kind)
+            if gang.state == "done":
+                break
+            # instant requeue + rebind at the fault instant, zero
+            # bind latency — exactly the oracle's model
+            gang.bound(at, 1.0, bind_s=0.0)
+        gang.advance(1e9)
+        ref = brute_force_replay(total, every, step_s, write_s,
+                                 restart, events)
+        verify = tr.verify_ledger(gang.ledger, total)
+        assert verify["ok"], verify
+        assert gang.state == "done"
+        assert verify["unique_steps"] == ref["unique"] == total
+        assert verify["lost_steps"] == ref["lost"]
+        assert verify["rerun_steps"] == ref["rerun"]
+
+
+def test_resume_bit_identical_across_resume_points():
+    """The loss trajectory is a pure function of (seed, step):
+    running straight through, or preempting at two different
+    points and resuming from the checkpointed step, produces the
+    byte-identical losses-by-step map — the sim analog of the
+    preempt-train scenario's drift==0 check."""
+    def trajectory(preempt_at):
+        gang = _bare_gang(total=30, every=4)
+        gang.bound(0.0, 1.0, bind_s=0.0)
+        losses = {}
+        if preempt_at is not None:
+            gang.preempt(preempt_at, graceful=True, reason="test")
+            gang.bound(preempt_at, 1.0, bind_s=0.0)
+        gang.advance(1e9)
+        assert gang.state == "done"
+        for step in range(1, gang.cfg.total_steps + 1):
+            losses[step] = gang.loss_at(step)
+        return losses
+
+    straight = trajectory(None)
+    early = trajectory(0.7)
+    late = trajectory(2.3)
+    assert straight == early == late
+
+
+def test_verify_ledger_catches_gaps_and_double_counts():
+    bad_gap = [
+        {"kind": "run", "from_step": 0, "to_step": 10,
+         "t0": 0.0, "t1": 1.0},
+        {"kind": "run", "from_step": 12, "to_step": 20,
+         "t0": 1.0, "t1": 2.0},
+    ]
+    v = tr.verify_ledger(bad_gap, 20)
+    assert not v["ok"] and v["violations"]
+    # overlap WITHOUT a rollback record = double count
+    bad_dup = [
+        {"kind": "run", "from_step": 0, "to_step": 10,
+         "t0": 0.0, "t1": 1.0},
+        {"kind": "run", "from_step": 6, "to_step": 12,
+         "t0": 1.0, "t1": 2.0},
+    ]
+    v = tr.verify_ledger(bad_dup, 12)
+    assert not v["ok"]
+    # the same overlap opened by an explicit rollback is the legal
+    # re-run of lost work
+    good = [
+        {"kind": "run", "from_step": 0, "to_step": 10,
+         "t0": 0.0, "t1": 1.0},
+        {"kind": "rollback", "from_step": 10, "to_step": 6,
+         "at_s": 1.0, "lost_steps": 4},
+        {"kind": "run", "from_step": 6, "to_step": 12,
+         "t0": 1.0, "t1": 2.0},
+    ]
+    v = tr.verify_ledger(good, 12)
+    assert v["ok"]
+    assert v["lost_steps"] == 4 and v["rerun_steps"] == 4
+
+
+# -- fleet integration -------------------------------------------------
+
+
+def test_fleet_training_requires_scheduler():
+    tc = fleet.TrainingConfig(gangs=(mk_gang(),))
+    with pytest.raises(ValueError, match="scheduler-backed"):
+        fleet.FleetSim(fleet.FleetConfig(training=tc), [])
+
+
+def test_fleet_training_completes_and_replays():
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=120, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, 7)
+    tc = fleet.TrainingConfig(gangs=(
+        mk_gang(name="llm0", total_steps=50),
+        tr.ising_gang("ising0", total_steps=30,
+                      checkpoint_every=10)))
+    rep = mk_fleet(tc, trace).run()
+    assert rep["ok"]
+    t = rep["training"]
+    assert t["all_done"] and t["ledger_ok"]
+    assert t["lost_steps"] == 0 and t["rerun_steps"] == 0
+    for g in t["gangs"].values():
+        assert g["state"] == "done"
+        assert g["unique_steps"] == g["config"]["total_steps"]
+        assert g["ledger_verify"]["ok"]
+    rep2 = mk_fleet(tc, trace).run()
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(rep2, sort_keys=True)
+
+
+def test_event_core_on_off_byte_identical_with_training():
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=150, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, 11)
+    tc = fleet.TrainingConfig(gangs=(
+        mk_gang(name="llm0", total_steps=60),))
+    events = [
+        fleet.ChaosEvent(at_s=0.8, action="train_preempt",
+                         target=0),
+        fleet.ChaosEvent(at_s=1.5, action="train_kill", target=0),
+    ]
+    on = mk_fleet(tc, trace, events).run()
+    off = mk_fleet(tc, trace, events, event_core=False,
+                   fast_forward=False).run()
+    assert json.dumps(on, sort_keys=True) == \
+        json.dumps(off, sort_keys=True)
+
+
+def test_graceful_preempt_loses_zero_hard_kill_rolls_back():
+    tc = fleet.TrainingConfig(gangs=(
+        mk_gang(name="llm0", total_steps=60,
+                checkpoint_every=7),))
+    graceful = mk_fleet(tc, (), [fleet.ChaosEvent(
+        at_s=1.1, action="train_preempt", target=0)]).run()
+    g = graceful["training"]["gangs"]["llm0"]
+    assert g["state"] == "done" and g["evictions"] == 1
+    assert g["lost_steps"] == 0 and g["rerun_steps"] == 0
+    # 1.25 lands mid-cadence-interval (1.1 would hit step 21 — an
+    # exact multiple of 7 — and legitimately lose nothing)
+    hard = mk_fleet(tc, (), [fleet.ChaosEvent(
+        at_s=1.25, action="train_kill", target=0)]).run()
+    h = hard["training"]["gangs"]["llm0"]
+    assert h["state"] == "done"
+    assert 0 < h["lost_steps"] <= 7  # at most one cadence interval
+    assert h["rerun_steps"] == h["lost_steps"]
+    assert h["ledger_verify"]["ok"]
+
+
+def test_strict_priority_serving_preempts_training():
+    """A serving gang displaced onto a FULL inventory evicts the
+    training tenant (strictly lower priority), never the reverse —
+    and the tenant still finishes once capacity returns."""
+    sc = fleet.FleetSchedConfig(
+        pods=(("tpu-v5-lite-podslice", "4x8"),))
+    # 3 serving replicas + the sweep's chip fragment fill the
+    # domain; failing a serving node forces preemption
+    tc = fleet.TrainingConfig(gangs=(
+        tr.ising_gang("ising0", total_steps=200,
+                      checkpoint_every=25),))
+    events = [
+        fleet.ChaosEvent(at_s=1.0, action="node_fail", target=0),
+        fleet.ChaosEvent(at_s=2.0, action="node_restore",
+                         target=0),
+    ]
+    spec = fleet.WorkloadSpec(process="poisson", rps=40.0,
+                              n_requests=100, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, 3)
+    rep = mk_fleet(tc, trace, events, sched=sc, replicas=3).run()
+    evs = rep["scheduler"]["events"]
+    strict = [e for e in evs if e["type"] == "Preempted"
+              and e["gang"] == "train-ising0"
+              and "preempted by" in e["message"]]
+    assert strict, [e for e in evs if e["type"] == "Preempted"]
+    assert not any(e["type"] == "Preempted"
+                   and e["gang"].startswith("replica-")
+                   and "preempted by higher-priority gang train"
+                   in e["message"] for e in evs)
+    g = rep["training"]["gangs"]["ising0"]
+    assert g["state"] == "done" and g["ledger_verify"]["ok"]
+    assert g["lost_steps"] == 0
+
+
+def test_elastic_grow_on_scavenged_capacity_and_ledger_clean():
+    sc = fleet.FleetSchedConfig(
+        pods=(("tpu-v5-lite-podslice", "4x8"),
+              ("tpu-v5-lite-podslice", "4x8"),
+              ("tpu-v5-lite-podslice", "4x8")))
+    tc = fleet.TrainingConfig(
+        gangs=(mk_gang(name="llm0", total_steps=120,
+                       checkpoint_every=10, elastic=True,
+                       max_topology="4x8"),),
+        scavenge=True)
+    rep = mk_fleet(tc, (), sched=sc, replicas=1).run()
+    g = rep["training"]["gangs"]["llm0"]
+    assert g["grows"] >= 1
+    assert g["topology"] == "4x8"
+    assert g["state"] == "done" and g["ledger_verify"]["ok"]
+    assert g["lost_steps"] == 0
+    # the grown segment steps faster than the base segment
+    seg_step = {r["topology"]: r["step_s"]
+                for r in g["ledger"] if r["kind"] == "run"}
+    assert seg_step["4x8"] < seg_step["4x4"]
+
+
+def test_link_degrade_reprices_training_ring():
+    """A degraded ICI link under the gang's domain slows its ring
+    mid-run (a reprice, not a checkpoint); restore heals it."""
+    tc = fleet.TrainingConfig(gangs=(
+        mk_gang(name="llm0", total_steps=80,
+                checkpoint_every=20),))
+    sc = fleet.FleetSchedConfig(
+        pods=(("tpu-v5-lite-podslice", "4x8"),
+              ("tpu-v5-lite-podslice", "4x8")),
+        policy="spread")
+    clean = mk_fleet(tc, (), sched=sc).run()
+    g0 = clean["training"]["gangs"]["llm0"]
+    placed = next(e for e in clean["scheduler"]["events"]
+                  if e["type"] == "Scheduled"
+                  and e["gang"] == "train-llm0")
+    victim_domain = int(placed["nodes"][0].split("-")[2])
+    events = [
+        fleet.ChaosEvent(at_s=0.8, action="link_degrade",
+                         target=victim_domain, param=0.1),
+        fleet.ChaosEvent(at_s=1.6, action="link_restore",
+                         target=victim_domain),
+    ]
+    rep = mk_fleet(tc, (), events, sched=sc).run()
+    g = rep["training"]["gangs"]["llm0"]
+    assert g["state"] == "done" and g["ledger_verify"]["ok"]
+    reprices = [r for r in g["ledger"] if r["kind"] == "reprice"]
+    assert len(reprices) >= 2  # degrade + restore
+    assert g["done_s"] > g0["done_s"]  # the brown-out cost time
+    assert g["lost_steps"] == 0
+
+
+# -- the kubernetes face (satellite: manifest drives the sim) ----------
+
+
+def test_batch_train_manifest_round_trip():
+    """pods/tpu-batch-train-job.yaml parses into the training-
+    tenant spec (StatefulSet = one gang, priority -10, the 4x4
+    slice) and survives the render/parse round trip."""
+    text = (REPO / "pods" / "tpu-batch-train-job.yaml").read_text()
+    gangs = tr.gangs_from_manifest(text)
+    assert len(gangs) == 1
+    g = gangs[0]
+    assert g.name == "tpu-batch-train"
+    assert g.priority == -10
+    assert g.accelerator == "tpu-v5-lite-podslice"
+    assert g.topology == "4x4"
+    rendered = tr.to_manifest(g)
+    again = tr.gangs_from_manifest(rendered)
+    assert again == [g]
+
+
+def test_batch_train_manifest_drives_the_sim():
+    text = (REPO / "pods" / "tpu-batch-train-job.yaml").read_text()
+    gangs = tuple(dataclasses.replace(g, total_steps=30)
+                  for g in tr.gangs_from_manifest(text))
+    tc = fleet.TrainingConfig(gangs=gangs, checkpoint_every=6)
+    rep = mk_fleet(tc, ()).run()
+    g = rep["training"]["gangs"]["tpu-batch-train"]
+    assert g["state"] == "done"
+    assert g["unique_steps"] == 30
+    assert g["ledger_verify"]["ok"]
+
+
+# -- globe + planner ---------------------------------------------------
+
+
+def test_globe_zone_loss_training_survives():
+    from kind_tpu_sim import globe
+
+    tc = fleet.TrainingConfig(gangs=(
+        mk_gang(name="llm0", total_steps=80,
+                checkpoint_every=10),))
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b"), replicas_per_cell=1,
+        training=tc, training_cells=("zone-a/c0",),
+        workload=globe.GlobeWorkloadSpec(process="poisson",
+                                         rps=20.0, n_per_zone=60))
+    traces = globe.generate_globe_traces(cfg, 7)
+    span = max(r.arrival_s for reqs in traces.values()
+               for r in reqs)
+    events = [
+        globe.GlobeChaosEvent(at_s=round(span / 3, 6),
+                              action="zone_loss",
+                              target="zone-a"),
+        globe.GlobeChaosEvent(at_s=round(2 * span / 3, 6),
+                              action="zone_restore",
+                              target="zone-a"),
+    ]
+    rep = globe.GlobeSim(cfg, traces=traces, seed=7,
+                         chaos_events=events).run()
+    assert rep["ok"]
+    t = rep["training"]
+    assert t["all_done"] and t["ledger_ok"]
+    assert t["lost_steps"] == 0
+    g = rep["cells"]["zone-a/c0"]["training"]["gangs"]["llm0"]
+    assert g["evictions"] >= 1  # the zone loss displaced it
+    rep2 = globe.GlobeSim(cfg, traces=traces, seed=7,
+                          chaos_events=events).run()
+    assert json.dumps(rep, sort_keys=True) == \
+        json.dumps(rep2, sort_keys=True)
+
+
+def test_planner_grants_and_reclaims_training_spot():
+    """The spot scenario's mechanics, unit-sized: idle budget flows
+    to the elastic tenant; a pressured serving cell pulls it back;
+    the tenant shrinks (never aborts) and the rung returns."""
+    rep = chaos.run_scenario("train-globe-spot", seed=3)
+    assert rep["ok"], rep
+    assert rep["train_grants"] >= 1
+    assert rep["grows"] >= 1
+    assert rep["gang_done"] and rep["ledger_ok"]
+    assert rep["lost_steps"] == 0
+
+
+# -- scenarios (seed-swept acceptance) ---------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_scenario_train_preempt_economics(seed):
+    rep = chaos.run_scenario("train-preempt-economics", seed=seed)
+    assert rep["ok"], rep
+    assert rep["lost_steps"]["loose"] > rep["lost_steps"]["tight"]
+    assert rep["ledger_ok"]
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_scenario_train_mixed_soak(seed):
+    rep = chaos.run_scenario("train-mixed-soak", seed=seed)
+    assert rep["ok"], rep
+    assert rep["training"]["lost_steps"] == 0
+    assert rep["training"]["rerun_steps"] == 0
+    assert rep["strict_priority_preemptions"] >= 1
+    assert rep["serving_preempted_by_training"] == 0
+    assert rep["event_core_identical"]
+
+
+def test_scenario_train_globe_spot_swept():
+    for seed in (0, 7):
+        rep = chaos.run_scenario("train-globe-spot", seed=seed)
+        assert rep["ok"], rep
+
+
+# -- knobs + CLI -------------------------------------------------------
+
+
+def test_train_knobs_registered_and_typed(monkeypatch):
+    from kind_tpu_sim.analysis import knobs
+
+    for name in ("KIND_TPU_SIM_TRAIN_CKPT_EVERY",
+                 "KIND_TPU_SIM_TRAIN_CKPT_WRITE_S",
+                 "KIND_TPU_SIM_TRAIN_RESTART_S",
+                 "KIND_TPU_SIM_TRAIN_MTBF_S",
+                 "KIND_TPU_SIM_TRAIN_ELASTIC"):
+        assert knobs.is_registered(name)
+    monkeypatch.setenv("KIND_TPU_SIM_TRAIN_CKPT_WRITE_S", "0.125")
+    assert tr.resolve_ckpt_write_s() == 0.125
+    monkeypatch.setenv("KIND_TPU_SIM_TRAIN_ELASTIC", "0")
+    assert tr.resolve_elastic() is False
+
+
+def test_cli_train_run_byte_identical(capsys):
+    from kind_tpu_sim import cli
+
+    argv = ["train", "run", "--seed", "7", "--steps", "30",
+            "--requests", "40", "--json"]
+    assert cli.main(argv) == 0
+    first = capsys.readouterr().out
+    assert cli.main(argv) == 0
+    second = capsys.readouterr().out
+    assert first == second
+    rep = json.loads(first)
+    assert rep["training"]["ledger_ok"]
+
+
+def test_cli_train_plan(capsys):
+    from kind_tpu_sim import cli
+
+    assert cli.main(["train", "plan", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["optimal_cadence_steps"] >= 1
+    opt = rep["cadences"][str(rep["optimal_cadence_steps"])]
+    assert all(opt["total_frac"] <= c["total_frac"] + 1e-9
+               for c in rep["cadences"].values())
