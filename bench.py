@@ -2773,6 +2773,38 @@ def analysis_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def fuzz_smoke() -> dict | None:
+    """Scenario-fuzzer extras (docs/FUZZ.md): a small seeded
+    campaign timed end-to-end (runs/s and the fraction of wall time
+    spent in invariant checking — the fuzzer's overhead budget),
+    plus the planted-bug self-test's shrink-step count (shrinker
+    efficiency is tracked bench-to-bench)."""
+    try:
+        from kind_tpu_sim.scenarios import fuzz as fuzz_mod
+
+        rep = fuzz_mod.fuzz(budget=5, seed=0,
+                            timer=time.monotonic)
+        selftest = fuzz_mod.fuzz(budget=1, seed=0,
+                                 inject_bug=True)
+        shrunk = selftest["shrunk"]
+        return {
+            "ok": bool(rep["ok"] and selftest["ok"]),
+            "budget": rep["budget"],
+            "runs_per_s": rep["timings"]["runs_per_s"],
+            "invariant_frac": rep["timings"]["invariant_frac"],
+            "elapsed_seconds": rep["timings"]["elapsed_s"],
+            "selftest_found": selftest["selftest_found"],
+            "selftest_shrink_steps": (
+                shrunk[0]["shrink_steps"] if shrunk else 0),
+            "selftest_shrink_attempts": (
+                shrunk[0]["attempts"] if shrunk else 0),
+            "selftest_repro_faults": (
+                len(shrunk[0]["spec"]["faults"]) if shrunk else 0),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def multihost_smoke() -> dict | None:
     """DCN-tier proof: a 2-host simulated slice (one process per host,
     gloo collectives over loopback) comes up and passes cross-host
@@ -2964,6 +2996,10 @@ def main(argv=None) -> int:
             analysis_rep = analysis_smoke()
         if analysis_rep:
             phases["analysis"] = analysis_rep
+        with stopwatch("fuzz"):
+            fuzz_rep = fuzz_smoke()
+        if fuzz_rep:
+            phases["fuzz"] = fuzz_rep
     finally:
         if pool is not None:
             pool.close()

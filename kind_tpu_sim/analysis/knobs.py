@@ -246,6 +246,20 @@ HEALTH_SPEC_RATIO = _register(
     "In-flight cell age (x expected service time) that triggers "
     "speculative re-dispatch.")
 
+# fuzz
+FUZZ_BUDGET = _register(
+    "KIND_TPU_SIM_FUZZ_BUDGET", 25, "int", "fuzz",
+    "Default number of composed scenarios one `chaos fuzz` "
+    "campaign draws and runs.")
+FUZZ_SEED = _register(
+    "KIND_TPU_SIM_FUZZ_SEED", 0, "int", "fuzz",
+    "Default fuzz campaign seed; the whole report is a pure "
+    "function of (budget, seed, max-faults).")
+FUZZ_MAX_FAULTS = _register(
+    "KIND_TPU_SIM_FUZZ_MAX_FAULTS", 4, "int", "fuzz",
+    "Upper bound on concurrent fault kinds composed into one "
+    "drawn scenario (each draws 2..max).")
+
 # bench
 SKIP_MODEL_BENCH = _register(
     "KIND_TPU_SIM_SKIP_MODEL_BENCH", False, "bool", "bench",
@@ -259,7 +273,8 @@ BENCH_SLOW = _register(
 # Display order of layers in docs/KNOBS.md — pipeline order, not
 # alphabetical, so the page reads like the architecture diagram.
 LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "sched",
-               "train", "globe", "overload", "health", "bench")
+               "train", "globe", "overload", "health", "fuzz",
+               "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -273,6 +288,7 @@ LAYER_DOCS = {
     "globe": "GLOBE.md",
     "overload": "OVERLOAD.md",
     "health": "HEALTH.md",
+    "fuzz": "FUZZ.md",
     "bench": "PERFORMANCE.md",
 }
 

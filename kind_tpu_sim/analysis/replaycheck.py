@@ -207,24 +207,15 @@ def _scenario_runner(name: str):
     return run
 
 
-# Chaos scenarios whose reports are pure functions of (config, seed):
-# the virtual-clock families plus the fake-control-plane ones. The
-# worker-process scenarios (pids, wall timings) and the jax-engine
-# ones (slow) stay out.
-_REPLAYABLE_SCENARIOS = {
-    "flaky-exec": False, "device-flap": False, "node-flap": False,
-    "fleet-flaky-replica": False,
-    "sched-node-drain": False, "sched-preemption-priority": False,
-    "gray-slow-replica": False, "gray-degraded-ici": False,
-    "globe-zone-loss": False, "globe-herd-failover": False,
-    "globe-dcn-degrade": False,
-    "overload-surge": False, "retry-storm": False,
-    "train-preempt-economics": False, "train-mixed-soak": False,
-    "train-globe-spot": False,
-}
-
-
 def _targets() -> Dict[str, ReplayTarget]:
+    # The scenario targets derive from the registry's `replayable`
+    # flags (scenarios/registry.py) — the single declaration of
+    # which reports are pure functions of (config, seed). The
+    # worker-process scenarios (pids, wall timings) and the
+    # jax-engine ones (slow) are declared non-replayable there, so a
+    # new scenario can never be silently missing from this list.
+    from kind_tpu_sim.scenarios import registry
+
     out = {
         "fleet-run": ReplayTarget(
             "fleet-run", "direct FleetSim run (120 poisson "
@@ -236,10 +227,10 @@ def _targets() -> Dict[str, ReplayTarget]:
             "globe-run", "direct GlobeSim run (2 zones)",
             _run_globe, injectable=True),
     }
-    for name, slow in sorted(_REPLAYABLE_SCENARIOS.items()):
+    for name in registry.replayable_names():
         out[name] = ReplayTarget(
             name, f"chaos scenario {name!r}, full report",
-            _scenario_runner(name), slow=slow)
+            _scenario_runner(name))
     return out
 
 

@@ -98,6 +98,185 @@ def resolve_seed(seed: Optional[int] = None) -> int:
     return int(knobs.get(CHAOS_SEED_ENV))
 
 
+# Layers a fault schema may claim (the docs/CHAOS.md recovery
+# matrix's row owners).
+FAULT_LAYERS = ("runtime", "grid", "cluster", "engine", "fleet",
+                "sched", "health", "globe", "overload", "train")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSchema:
+    """The machine-readable contract of one fault kind — what the
+    scenario fuzzer (kind_tpu_sim/scenarios/fuzz.py, docs/FUZZ.md)
+    samples from instead of ad-hoc kwargs.
+
+    ``param`` is ``None`` (the kind has no magnitude) or a
+    ``(draw, lo, hi)`` triple: ``draw`` is ``"int"``
+    (``rng.randint(lo, hi)``) or ``"uniform"``
+    (``round(rng.uniform(lo, hi), 3)``) — exactly the historical
+    :meth:`ChaosSchedule.plan` draws, so schema-driven plans stay
+    byte-identical with pre-schema ones. ``scopes`` names the sim
+    topologies the kind can strike (``fleet`` / ``globe`` /
+    virtual-clock-free surfaces like ``worker``); ``needs`` the
+    config prerequisites (``sched``, ``training``, ``overload``,
+    ``jax``); ``fuzzable`` whether the fuzzer may compose it (the
+    process/engine kinds exercise real subprocesses or jitted
+    engines — deterministic to run, but not expressible as timed
+    virtual-clock windows); ``exclusive`` caps the kind at one per
+    composed spec (a second simultaneous zone loss or demand surge
+    is a different experiment, not a composition)."""
+
+    kind: str
+    layer: str
+    param: Optional[tuple] = None   # (draw, lo, hi)
+    param_doc: str = ""
+    scopes: tuple = ()
+    needs: tuple = ()
+    fuzzable: bool = False
+    exclusive: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "layer": self.layer,
+            "param": (list(self.param)
+                      if self.param is not None else None),
+            "param_doc": self.param_doc,
+            "scopes": list(self.scopes),
+            "needs": list(self.needs),
+            "fuzzable": self.fuzzable,
+            "exclusive": self.exclusive,
+        }
+
+
+# One schema per FAULT_KINDS entry — completeness is machine-checked
+# (fault_schema_problems(), wired into `analysis lint` and the test
+# suite the same way rule `unknown-knob` guards the knob registry).
+FAULT_SCHEMAS: Dict[str, FaultSchema] = {s.kind: s for s in (
+    FaultSchema("worker_crash", "grid", scopes=("worker",)),
+    FaultSchema("worker_hang", "grid", param=("int", 1, 5),
+                param_doc="hang seconds before the deadline kill",
+                scopes=("worker",)),
+    FaultSchema("device_flap", "cluster", scopes=("control-plane",)),
+    FaultSchema("node_kill", "cluster", scopes=("control-plane",)),
+    FaultSchema("node_restart", "cluster",
+                scopes=("control-plane",)),
+    FaultSchema("preempt_sigterm", "engine", scopes=("train",),
+                needs=("jax",)),
+    FaultSchema("cmd_transient", "runtime", param=("int", 1, 3),
+                param_doc="transient failures before success",
+                scopes=("control-plane",)),
+    FaultSchema("slot_failure", "engine", scopes=("serving",),
+                needs=("jax",)),
+    FaultSchema("replica_preempt", "fleet", scopes=("fleet",),
+                fuzzable=True),
+    FaultSchema("replica_flap", "fleet", scopes=("fleet",),
+                fuzzable=True),
+    FaultSchema("node_drain", "sched", scopes=("fleet",),
+                needs=("sched",), fuzzable=True),
+    FaultSchema("node_fail", "sched", scopes=("fleet",),
+                needs=("sched",), fuzzable=True),
+    FaultSchema("straggler_worker", "health",
+                param=("uniform", 1.6, 2.4),
+                param_doc="per-cell stall seconds",
+                scopes=("worker",)),
+    FaultSchema("degraded_link", "health",
+                param=("uniform", 0.08, 0.25),
+                param_doc="ICI link bandwidth factor",
+                scopes=("fleet",), needs=("sched",), fuzzable=True),
+    FaultSchema("slow_replica", "health",
+                param=("uniform", 3.0, 6.0),
+                param_doc="service-time inflation factor",
+                scopes=("fleet",), fuzzable=True),
+    FaultSchema("flaky_node", "health",
+                param=("uniform", 0.5, 1.5),
+                param_doc="intermittent stall seconds",
+                scopes=("worker",)),
+    FaultSchema("zone_loss", "globe", scopes=("globe",),
+                fuzzable=True, exclusive=True),
+    FaultSchema("dcn_degrade", "globe",
+                param=("uniform", 0.08, 0.25),
+                param_doc="inter-zone DCN bandwidth factor",
+                scopes=("globe",), fuzzable=True),
+    FaultSchema("herd_failover", "globe", scopes=("globe",),
+                fuzzable=True, exclusive=True),
+    FaultSchema("cell_drain", "globe", scopes=("globe",),
+                fuzzable=True),
+    FaultSchema("demand_surge", "overload",
+                param=("uniform", 3.0, 5.0),
+                param_doc="arrival-rate step multiplier",
+                scopes=("fleet",), needs=("overload",),
+                fuzzable=True, exclusive=True),
+    FaultSchema("retry_storm", "overload", param=("int", 3, 5),
+                param_doc="uncontrolled client max attempts",
+                scopes=("fleet",), needs=("overload",)),
+    FaultSchema("train_preempt", "train", scopes=("fleet",),
+                needs=("sched", "training"), fuzzable=True),
+    FaultSchema("train_kill", "train", scopes=("fleet",),
+                needs=("sched", "training"), fuzzable=True),
+)}
+
+
+def draw_param(kind: str, rng: random.Random) -> float:
+    """One seeded magnitude draw for ``kind``, per its schema — THE
+    param semantics (ChaosSchedule.plan and the fuzzer both route
+    through here, so a kind's magnitude range is declared exactly
+    once)."""
+    schema = FAULT_SCHEMAS[kind]
+    if schema.param is None:
+        return 0.0
+    draw, lo, hi = schema.param
+    if draw == "int":
+        return float(rng.randint(int(lo), int(hi)))
+    return round(rng.uniform(float(lo), float(hi)), 3)
+
+
+def fault_schema_problems() -> List[str]:
+    """Registry/schema cross-check (the `unknown-knob` idiom for
+    fault kinds): every FAULT_KINDS entry must be schema'd, every
+    schema must describe a known kind, and each schema must be
+    internally coherent. Wired into `analysis lint` and the test
+    suite so a new kind cannot ship samplable-but-unspecified."""
+    problems: List[str] = []
+    for kind in FAULT_KINDS:
+        if kind not in FAULT_SCHEMAS:
+            problems.append(
+                f"fault kind {kind!r} has no FaultSchema "
+                "(kind_tpu_sim/chaos.py FAULT_SCHEMAS)")
+    for kind, schema in sorted(FAULT_SCHEMAS.items()):
+        if kind not in FAULT_KINDS:
+            problems.append(
+                f"FaultSchema {kind!r} describes no FAULT_KINDS "
+                "entry")
+        if schema.kind != kind:
+            problems.append(
+                f"FaultSchema keyed {kind!r} names itself "
+                f"{schema.kind!r}")
+        if schema.layer not in FAULT_LAYERS:
+            problems.append(
+                f"FaultSchema {kind!r} claims unknown layer "
+                f"{schema.layer!r}; known: "
+                f"{', '.join(FAULT_LAYERS)}")
+        if schema.param is not None:
+            bad = (len(schema.param) != 3
+                   or schema.param[0] not in ("int", "uniform")
+                   or not schema.param[1] <= schema.param[2])
+            if bad:
+                problems.append(
+                    f"FaultSchema {kind!r} param {schema.param!r} "
+                    "is not a (draw, lo, hi) triple with draw in "
+                    "int|uniform and lo <= hi")
+        if schema.fuzzable and not schema.scopes:
+            problems.append(
+                f"FaultSchema {kind!r} is fuzzable but declares no "
+                "scopes — the fuzzer cannot place it")
+        if schema.exclusive and not schema.fuzzable:
+            problems.append(
+                f"FaultSchema {kind!r} is exclusive but not "
+                "fuzzable — exclusivity only constrains the fuzzer")
+    return problems
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultEvent:
     """One planned fault: ``kind`` strikes ``target`` at schedule
@@ -146,10 +325,11 @@ class ChaosSchedule:
              targets: int = 2) -> FaultPlan:
         """``n_faults`` events drawn over ``horizon`` schedule slots
         and ``targets`` possible victims, kinds cycled through the
-        seeded stream. ``param`` is drawn per kind: hang seconds in
-        [1, 5], transient counts in [1, 3], straggler/flaky stall
-        seconds, slow-replica service factors, degraded-link
-        bandwidth factors — else 0."""
+        seeded stream. ``param`` is drawn per kind from its
+        :data:`FAULT_SCHEMAS` range (hang seconds in [1, 5],
+        transient counts in [1, 3], straggler/flaky stall seconds,
+        slow-replica service factors, degraded-link bandwidth
+        factors — else 0)."""
         for kind in kinds:
             if kind not in FAULT_KINDS:
                 raise ValueError(
@@ -161,24 +341,10 @@ class ChaosSchedule:
         events = []
         for _ in range(n_faults):
             kind = rng.choice(list(kinds))
-            if kind == "worker_hang":
-                param = float(rng.randint(1, 5))
-            elif kind == "cmd_transient":
-                param = float(rng.randint(1, 3))
-            elif kind == "straggler_worker":
-                param = round(rng.uniform(1.6, 2.4), 3)
-            elif kind == "flaky_node":
-                param = round(rng.uniform(0.5, 1.5), 3)
-            elif kind == "slow_replica":
-                param = round(rng.uniform(3.0, 6.0), 3)
-            elif kind in ("degraded_link", "dcn_degrade"):
-                param = round(rng.uniform(0.08, 0.25), 3)
-            elif kind == "demand_surge":
-                param = round(rng.uniform(3.0, 5.0), 3)
-            elif kind == "retry_storm":
-                param = float(rng.randint(3, 5))
-            else:
-                param = 0.0
+            # param is drawn BEFORE the slot/target draws — the
+            # historical stream order, which schema-driven plans
+            # must reproduce byte-identically
+            param = draw_param(kind, rng)
             events.append(FaultEvent(
                 kind=kind,
                 at=rng.randrange(max(1, horizon)),
@@ -2064,13 +2230,17 @@ def run_scenario(name: str, seed: Optional[int] = None) -> dict:
     """Run one named scenario; the report carries the seed, the
     derived fault plan, the recovery-log delta (fault/recovery event
     counts attributable to THIS run), and the invariant verdict."""
-    if name not in SCENARIOS:
-        raise ValueError(
-            f"unknown scenario {name!r}; known: "
-            f"{', '.join(sorted(SCENARIOS))}")
+    # executor resolution goes through the scenario registry
+    # (scenarios/registry.py, lazily imported — the registry itself
+    # imports this module): legacy names keep their original
+    # functions (byte-identical reports), declarative specs compile
+    # through run_spec
+    from kind_tpu_sim.scenarios import registry
+
+    fn = registry.executor(name)
     seed = resolve_seed(seed)
     before = metrics.recovery_log().counts()
-    report = SCENARIOS[name].fn(seed)
+    report = fn(seed)
     report.update({
         "scenario": name,
         "seed": seed,
@@ -2086,10 +2256,14 @@ def soak(iterations: int = 10, seed: Optional[int] = None,
     iteration stream is itself derived from the seed, so a soak that
     finds a failure names the exact (scenario, seed) pair to replay
     with `chaos run`."""
+    from kind_tpu_sim.scenarios import registry
+
     seed = resolve_seed(seed)
     rng = random.Random(zlib.crc32(f"soak:{seed}".encode("utf-8")))
-    names = sorted(n for n, s in SCENARIOS.items()
-                   if include_slow or not s.slow)
+    # the pick pool derives from the registry (sorted), so a new
+    # scenario can never be silently missing from soak — and the
+    # seeded stream stays a pure function of the registry contents
+    names = registry.soak_names(include_slow=include_slow)
     runs = []
     failures = 0
     for i in range(iterations):

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 from typing import List, Optional
 
@@ -137,7 +138,7 @@ def build_parser() -> argparse.ArgumentParser:
     chaos.add_argument(
         "action",
         choices=["fail", "heal", "kill-node", "start-node",
-                 "run", "soak"],
+                 "run", "soak", "fuzz"],
     )
     chaos.add_argument("--node", default=None,
                        help="target node container name")
@@ -175,6 +176,31 @@ def build_parser() -> argparse.ArgumentParser:
         "--include-slow", action="store_true",
         help="run/soak may pick the multi-second jax scenarios "
              "(preempt-train, serving-slot-failure)",
+    )
+    chaos.add_argument(
+        "--list", action="store_true", dest="list_scenarios",
+        help="print the scenario registry (with --json: one sorted-"
+             "keys row per scenario) and exit",
+    )
+    chaos.add_argument(
+        "--budget", type=int, default=None,
+        help="composed scenarios one 'fuzz' campaign draws "
+             "(default: KIND_TPU_SIM_FUZZ_BUDGET)",
+    )
+    chaos.add_argument(
+        "--max-faults", type=int, default=None,
+        help="max concurrent fault kinds per drawn scenario "
+             "(default: KIND_TPU_SIM_FUZZ_MAX_FAULTS)",
+    )
+    chaos.add_argument(
+        "--inject-invariant-bug", action="store_true",
+        help="fuzz self-test: also check the deliberately broken "
+             "invariant; exit 0 iff the fuzzer finds AND shrinks it",
+    )
+    chaos.add_argument(
+        "--emit-repros", default=None, metavar="DIR",
+        help="write each shrunk violation as a pinned spec file "
+             "under DIR (the tests/repros/ workflow, docs/FUZZ.md)",
     )
     chaos.add_argument("--json", action="store_true", dest="as_json")
 
@@ -791,6 +817,25 @@ def run_chaos_engine(args: argparse.Namespace) -> int:
     cluster-free (fake control plane + cold worker processes), so
     recovery invariants are checkable anywhere tier-1 tests run."""
     from kind_tpu_sim import chaos as chaos_mod
+    from kind_tpu_sim.scenarios import registry
+
+    if getattr(args, "list_scenarios", False):
+        rows = registry.listing()
+        if args.as_json:
+            print(json.dumps(rows, sort_keys=True))
+        else:
+            for row in rows:
+                tags = "".join(
+                    f" [{t}]" for t, on in
+                    (("slow", row["slow"]), ("jax", row["needs_jax"]),
+                     ("replay", row["replayable"]))
+                    if on)
+                print(f"  {row['name']:<24} {row['description']}"
+                      f"{tags}")
+        return 0
+
+    if args.action == "fuzz":
+        return _run_chaos_fuzz(args)
 
     if args.action == "soak":
         report = chaos_mod.soak(iterations=args.iterations,
@@ -810,13 +855,11 @@ def run_chaos_engine(args: argparse.Namespace) -> int:
 
     if not args.scenario:
         print("available scenarios (chaos run --scenario NAME):")
-        for name in sorted(chaos_mod.SCENARIOS):
-            s = chaos_mod.SCENARIOS[name]
-            tag = " [slow]" if s.slow else ""
-            print(f"  {name:<24} {s.description}{tag}")
+        for row in registry.listing():
+            tag = " [slow]" if row["slow"] else ""
+            print(f"  {row['name']:<24} {row['description']}{tag}")
         return 0
-    names = (sorted(n for n, s in chaos_mod.SCENARIOS.items()
-                    if args.include_slow or not s.slow)
+    names = (registry.soak_names(include_slow=args.include_slow)
              if args.scenario == "all" else [args.scenario])
     reports = [chaos_mod.run_scenario(n, seed=args.seed)
                for n in names]
@@ -834,6 +877,54 @@ def run_chaos_engine(args: argparse.Namespace) -> int:
                   f"{'OK' if rep['ok'] else 'FAILED'}  [{events}]")
         print("CHAOS RUN " + ("OK" if ok else "FAILED"))
     return 0 if ok else 1
+
+
+def _run_chaos_fuzz(args: argparse.Namespace) -> int:
+    """`chaos fuzz`: the seeded campaign — composed multi-layer
+    fault schedules, every run checked against the universal
+    invariant set, violations auto-shrunk to minimal repro specs
+    (docs/FUZZ.md). The report is a pure function of
+    (budget, seed, max-faults)."""
+    from kind_tpu_sim import chaos as chaos_mod
+    from kind_tpu_sim.analysis import knobs
+    from kind_tpu_sim.scenarios import fuzz as fuzz_mod
+
+    budget = (args.budget if args.budget is not None
+              else knobs.get(knobs.FUZZ_BUDGET))
+    max_faults = (args.max_faults if args.max_faults is not None
+                  else knobs.get(knobs.FUZZ_MAX_FAULTS))
+    seed = (args.seed if args.seed is not None
+            else knobs.get(knobs.FUZZ_SEED))
+    report = fuzz_mod.fuzz(
+        budget=budget, seed=seed, max_faults=max_faults,
+        inject_bug=args.inject_invariant_bug)
+    if args.emit_repros and report["shrunk"]:
+        os.makedirs(args.emit_repros, exist_ok=True)
+        for repro in report["shrunk"]:
+            path = os.path.join(args.emit_repros,
+                                repro["spec"]["name"] + ".json")
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(repro, fh, sort_keys=True, indent=1)
+                fh.write("\n")
+            print(f"pinned repro: {path}", file=sys.stderr)
+    if args.as_json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        for run in report["runs"]:
+            mark = "OK" if run["ok"] else "VIOLATION"
+            kinds = ",".join(run["fault_kinds"]) or "-"
+            print(f"  {run['name']:<16} {run['topology']:<6} "
+                  f"{kinds:<48} {mark}")
+            for v in run["violations"]:
+                print(f"      {v['invariant']}: {v['detail']}")
+        for repro in report["shrunk"]:
+            print(f"  shrunk {repro['source']} -> "
+                  f"{repro['spec']['name']} "
+                  f"({len(repro['spec']['faults'])} faults, "
+                  f"{repro['shrink_steps']} steps)")
+        verdict = "OK" if report["ok"] else "FAILED"
+        print(f"CHAOS FUZZ (budget {budget}, seed {seed}) {verdict}")
+    return 0 if report["ok"] else 1
 
 
 def _fleet_training_config(args: argparse.Namespace):
@@ -1355,12 +1446,27 @@ def run_analysis(args: argparse.Namespace) -> int:
         findings = detlint.lint_paths(paths)
         rep = detlint.report(
             findings, files=len(detlint.iter_py_files(paths)))
+        # the schema/registry completeness cross-checks ride the
+        # lint gate (the `unknown-knob` idiom at the chaos layer):
+        # every fault kind schema'd, every scenario registered
+        from kind_tpu_sim.chaos import fault_schema_problems
+        from kind_tpu_sim.scenarios import registry
+
+        schema_problems = (fault_schema_problems()
+                           + registry.registry_problems())
+        rep["fault_schemas"] = {
+            "problems": schema_problems,
+            "ok": not schema_problems,
+        }
+        rep["ok"] = bool(rep["ok"]) and not schema_problems
         if args.as_json:
             print(json.dumps(rep, sort_keys=True))
         else:
             for f in findings:
                 if not f.waived:
                     print(f.render())
+            for p in schema_problems:
+                print(f"fault-schema: {p}")
             print(f"detlint: {rep['files']} file(s), "
                   f"{len(rep['findings'])} finding(s), "
                   f"{rep['waived']} waived "
@@ -1777,7 +1883,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_analysis(args)
         if args.command == "profile":
             return run_profile(args)
-        if args.command == "chaos" and args.action in ("run", "soak"):
+        if args.command == "chaos" and args.action in ("run", "soak",
+                                                       "fuzz"):
             return run_chaos_engine(args)
         cfg = config_from_args(args)
         sim = Simulator(cfg)

@@ -1081,7 +1081,8 @@ class FleetSim:
             return False
         return True
 
-    def _next_wake(self, pending: deque) -> DueSet:
+    def _next_wake(self, pending: deque,
+                   tick: float = 0.0) -> DueSet:
         """The event core's scheduling question: when does step()
         stop being a no-op? Sources that need every boundary (a
         non-empty router queue, scheduler activity, a draining
@@ -1097,7 +1098,18 @@ class FleetSim:
         if pending:
             due.at(pending[0].arrival_s)
         if self.chaos_events:
-            due.at(self.chaos_events[0].at_s)
+            ev0 = self.chaos_events[0]
+            at = ev0.at_s
+            if ev0.action in ("slow", "unslow", "link_degrade",
+                              "link_restore"):
+                # factor-change chaos rescales token scheduling from
+                # the moment it applies, so the boundary BEFORE the
+                # event must be stepped too: slots have to advance up
+                # to it under the OLD factor, exactly as the plain
+                # loop does, or the two cores schedule the straddling
+                # tokens at different rates
+                at = max(0.0, at - tick)
+            due.at(at)
         # overload timers are boundary-condition events: a retry
         # applies at its backoff expiry, a hedge at its delay expiry
         due.at(self._retry_heap.peek_time())
@@ -1162,7 +1174,7 @@ class FleetSim:
             return
         if self.chaos_events and self.chaos_events[0].at_s <= b:
             return
-        due = self._next_wake(pending)
+        due = self._next_wake(pending, tick)
         if due.immediate:
             return
         evals_away = -1

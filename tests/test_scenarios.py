@@ -1,0 +1,291 @@
+"""Scenario compiler + fuzzer tests (docs/FUZZ.md): the spec
+registry covers every chaos scenario, the universal invariants hold
+on composed runs, the seeded fuzz campaign is byte-identical per
+seed, and the shrinker reduces the planted self-test violation to
+exactly its triggering fault pair."""
+
+import dataclasses
+import json
+import pathlib
+
+import pytest
+
+from kind_tpu_sim import chaos
+from kind_tpu_sim.scenarios import fuzz, invariants, registry, shrink
+from kind_tpu_sim.scenarios.spec import (FaultWindow, ScenarioSpec,
+                                         TopologySpec, WorkloadDims,
+                                         run_spec, spec_problems)
+
+pytestmark = pytest.mark.fuzz
+
+REPROS = pathlib.Path(__file__).parent / "repros"
+
+
+# -- registry completeness -------------------------------------------
+
+
+def test_registry_covers_every_scenario():
+    """The never-silently-missing guarantee: every chaos.SCENARIOS
+    entry has registry metadata and vice versa."""
+    assert registry.registry_problems() == []
+    assert sorted(registry.specs()) == sorted(chaos.SCENARIOS)
+
+
+def test_soak_pool_derives_from_registry():
+    assert registry.soak_names() == sorted(
+        n for n, s in chaos.SCENARIOS.items() if not s.slow)
+    assert registry.soak_names(include_slow=True) == sorted(
+        chaos.SCENARIOS)
+
+
+def test_legacy_executors_are_the_original_functions():
+    """Byte-identical legacy reports by construction: the registry
+    hands back the exact scenario function objects."""
+    for name in registry.names():
+        assert registry.executor(name) is chaos.SCENARIOS[name].fn
+
+
+def test_listing_is_sorted_and_json_stable():
+    rows = registry.listing()
+    names = [r["name"] for r in rows]
+    assert names == sorted(names)
+    assert json.loads(json.dumps(rows, sort_keys=True)) == rows
+
+
+def test_replay_targets_derive_from_registry():
+    from kind_tpu_sim.analysis import replaycheck
+
+    scenario_targets = sorted(
+        n for n in replaycheck.REPLAY_TARGETS
+        if n not in ("fleet-run", "sched-run", "globe-run"))
+    assert scenario_targets == registry.replayable_names()
+
+
+def test_unknown_scenario_still_raises():
+    with pytest.raises(ValueError, match="unknown scenario"):
+        chaos.run_scenario("no-such-scenario")
+
+
+# -- fault schemas ----------------------------------------------------
+
+
+def test_every_fault_kind_has_a_schema():
+    assert chaos.fault_schema_problems() == []
+    assert sorted(chaos.FAULT_SCHEMAS) == sorted(chaos.FAULT_KINDS)
+
+
+def test_draw_param_respects_schema_ranges():
+    import random
+
+    rng = random.Random(0)
+    for kind in sorted(chaos.FAULT_SCHEMAS):
+        schema = chaos.FAULT_SCHEMAS[kind]
+        for _ in range(8):
+            v = chaos.draw_param(kind, rng)
+            if schema.param is None:
+                assert v == 0.0
+            else:
+                _, lo, hi = schema.param
+                assert float(lo) <= v <= float(hi)
+
+
+# -- spec validation and round-trip ----------------------------------
+
+
+def _small_spec(**kw):
+    base = dict(
+        name="t-spec",
+        topology=TopologySpec(kind="fleet", replicas=2, sched=True),
+        workload=WorkloadDims(rps=30.0, n_requests=40),
+        faults=(FaultWindow("replica_preempt", 0.2, 0.4, target=1),
+                FaultWindow("slow_replica", 0.3, 0.5, target=0,
+                            param=3.0)),
+        overload=True, seed=3)
+    base.update(kw)
+    return ScenarioSpec(**base)
+
+
+def test_spec_problems_gate():
+    bad = ScenarioSpec(
+        name="bad",
+        topology=TopologySpec(kind="fleet", sched=False),
+        faults=(FaultWindow("node_drain", 0.2, 0.4),))
+    assert any("scheduler-backed" in p for p in spec_problems(bad))
+    two_excl = ScenarioSpec(
+        name="bad2",
+        topology=TopologySpec(kind="globe", zones=3),
+        faults=(FaultWindow("zone_loss", 0.2, 0.4),
+                FaultWindow("herd_failover", 0.3, 0.5)))
+    assert any("exclusive" in p for p in spec_problems(two_excl))
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultWindow("not-a-kind", 0.1, 0.2)
+    with pytest.raises(ValueError, match="unknown invariant"):
+        invariants.check(_small_spec(), {}, names=("nope",))
+
+
+def test_spec_roundtrip_report_identical():
+    """The repro-pinning contract: spec -> JSON -> spec produces the
+    byte-identical report."""
+    spec = _small_spec()
+    clone = ScenarioSpec.from_dict(
+        json.loads(json.dumps(spec.as_dict(), sort_keys=True)))
+    assert clone == spec
+    a = json.dumps(run_spec(spec), sort_keys=True, default=str)
+    b = json.dumps(run_spec(clone), sort_keys=True, default=str)
+    assert a == b
+
+
+def test_universal_invariants_hold_on_composed_run():
+    spec = _small_spec()
+    report = run_spec(spec)
+    violations = invariants.check(
+        spec, report,
+        rerun=lambda ec: run_spec(spec, event_core=ec))
+    assert violations == []
+
+
+# -- fuzzer -----------------------------------------------------------
+
+
+def test_fuzz_deterministic_and_green():
+    a = fuzz.fuzz(budget=3, seed=0)
+    b = fuzz.fuzz(budget=3, seed=0)
+    assert a["ok"] and a["violating_runs"] == 0
+    assert (json.dumps(a, sort_keys=True)
+            == json.dumps(b, sort_keys=True))
+    # a different seed draws different scenarios
+    c = fuzz.fuzz(budget=3, seed=1)
+    assert (json.dumps(a, sort_keys=True)
+            != json.dumps(c, sort_keys=True))
+
+
+def test_fuzz_draws_are_valid_and_composed():
+    for index in range(12):
+        spec = fuzz.draw_spec(0, index)
+        assert spec_problems(spec) == []
+        assert 2 <= len(spec.faults) <= 4
+
+
+def test_fuzz_selftest_finds_and_shrinks():
+    """The end-to-end self-test: the planted invariant bug is found,
+    and the shrinker reduces the spec to exactly the overlapping
+    slow_replica x replica_preempt pair that triggers it."""
+    rep = fuzz.fuzz(budget=1, seed=0, inject_bug=True)
+    assert rep["selftest_found"] and rep["ok"]
+    assert len(rep["shrunk"]) == 1
+    repro = rep["shrunk"][0]
+    assert repro["violated"] == ["fuzz-selftest-bug"]
+    kinds = sorted(f["kind"] for f in repro["spec"]["faults"])
+    assert kinds == ["replica_preempt", "slow_replica"]
+    # byte-identical across two shrink runs
+    rep2 = fuzz.fuzz(budget=1, seed=0, inject_bug=True)
+    assert (json.dumps(rep, sort_keys=True)
+            == json.dumps(rep2, sort_keys=True))
+
+
+def test_shrinker_minimality():
+    """1-minimality: removing either fault of the shrunk repro loses
+    the violation — the repro is exactly the triggering pair."""
+    rep = fuzz.fuzz(budget=1, seed=0, inject_bug=True)
+    spec = ScenarioSpec.from_dict(rep["shrunk"][0]["spec"])
+    assert len(spec.faults) == 2
+    names = ("fuzz-selftest-bug",)
+    assert invariants.check(spec, {}, names=names)
+    for i in range(len(spec.faults)):
+        less = dataclasses.replace(
+            spec, faults=spec.faults[:i] + spec.faults[i + 1:])
+        assert invariants.check(less, {}, names=names) == []
+
+
+def test_shrink_direct_on_planted_violation():
+    spec = _small_spec(name="planted")
+    out = shrink.shrink(spec, ("fuzz-selftest-bug",))
+    got = ScenarioSpec.from_dict(out["spec"])
+    assert sorted(f.kind for f in got.faults) == [
+        "replica_preempt", "slow_replica"]
+    assert out["violated"] == ["fuzz-selftest-bug"]
+    assert got.workload.n_requests <= spec.workload.n_requests
+
+
+# -- pinned repros ----------------------------------------------------
+
+
+def test_pinned_repros_reproduce_standalone():
+    """Every pinned repro under tests/repros/ runs green under the
+    universal set and still trips the invariant it was shrunk for —
+    the violation reproduces from the spec file alone, forever."""
+    paths = sorted(REPROS.glob("*.json"))
+    assert paths, "no pinned repros found under tests/repros/"
+    for path in paths:
+        repro = json.loads(path.read_text(encoding="utf-8"))
+        spec = ScenarioSpec.from_dict(repro["spec"])
+        assert spec_problems(spec) == []
+        report = run_spec(spec)
+        universal = invariants.check(
+            spec, report,
+            rerun=lambda ec, s=spec: run_spec(s, event_core=ec))
+        assert universal == []
+        still = invariants.check(
+            spec, report, names=tuple(repro["violated"]))
+        assert [v["invariant"] for v in still] == repro["violated"]
+
+
+# -- invariant unit checks -------------------------------------------
+
+
+def test_no_lost_work_catches_duplicates_and_loss():
+    spec = _small_spec()
+    dup = {"ok": True, "requests": 2, "completions": [
+        {"request_id": "a"}, {"request_id": "a"}]}
+    out = invariants.check(spec, dup, names=("no-lost-work",))
+    assert out and "duplicated" in out[0]["detail"]
+    lost = {"ok": True, "requests": 3, "completions": [
+        {"request_id": "a"}, {"request_id": "b"}]}
+    out = invariants.check(spec, lost, names=("no-lost-work",))
+    assert out and "lost or phantom" in out[0]["detail"]
+    retried = {"ok": True, "requests": 2, "completions": [
+        {"request_id": "a"}, {"request_id": "a~r1"},
+        {"request_id": "b"}]}
+    assert invariants.check(spec, retried,
+                            names=("no-lost-work",)) == []
+
+
+def test_verdict_and_recovery_checks():
+    spec = _small_spec()
+    out = invariants.check(spec, {"ok": False},
+                           names=("verdict-ok",))
+    assert out and out[0]["invariant"] == "verdict-ok"
+    stuck = {"ok": True, "overload": {
+        "brownout": {"enabled": True, "level": 2},
+        "breakers": {"replica-0": {"state": "open"}}}}
+    out = invariants.check(spec, stuck, names=("recovery",))
+    assert out and "brownout" in out[0]["detail"]
+
+
+def test_ledger_check_gates_lost_steps_on_train_kill():
+    killer = _small_spec(
+        faults=(FaultWindow("train_kill", 0.2, 0.3),),
+        training_gangs=1)
+    lossy = {"ok": True, "training": {
+        "ledger_ok": True, "lost_steps": 3}}
+    assert invariants.check(killer, lossy,
+                            names=("ledger-clean",)) == []
+    benign = _small_spec()
+    out = invariants.check(benign, lossy, names=("ledger-clean",))
+    assert out and "without a train_kill" in out[0]["detail"]
+
+
+# -- knobs ------------------------------------------------------------
+
+
+def test_fuzz_knobs_registered():
+    from kind_tpu_sim.analysis import knobs
+
+    for name in (knobs.FUZZ_BUDGET, knobs.FUZZ_SEED,
+                 knobs.FUZZ_MAX_FAULTS):
+        assert knobs.is_registered(name)
+        assert knobs.REGISTRY[name].layer == "fuzz"
+    assert knobs.get(knobs.FUZZ_BUDGET, environ={}) == 25
+    assert knobs.get(
+        knobs.FUZZ_MAX_FAULTS,
+        environ={knobs.FUZZ_MAX_FAULTS: "3"}) == 3
