@@ -2745,7 +2745,8 @@ def analysis_smoke() -> dict | None:
     growth are tracked bench-to-bench), plus one replay-bisector run
     of the fleet target — the contract check itself, timed."""
     try:
-        from kind_tpu_sim.analysis import detlint, knobs, replaycheck
+        from kind_tpu_sim.analysis import (contractlint, detlint,
+                                           knobs, replaycheck)
 
         pkg = str(REPO / "kind_tpu_sim")
         t0 = time.monotonic()
@@ -2754,16 +2755,31 @@ def analysis_smoke() -> dict | None:
         rep = detlint.report(
             findings, files=len(detlint.iter_py_files([pkg])))
         t1 = time.monotonic()
+        cfindings = contractlint.lint_paths([pkg])
+        cchecks = contractlint.cross_check_problems(REPO)
+        contract_s = round(time.monotonic() - t1, 3)
+        crep = contractlint.report(
+            cfindings,
+            files=len(contractlint.iter_py_files([pkg])))
+        cproblems = sum(len(v) for v in cchecks.values())
+        t2 = time.monotonic()
         replay = replaycheck.replay("fleet-run", seed=7)
-        replay_s = round(time.monotonic() - t1, 3)
+        replay_s = round(time.monotonic() - t2, 3)
         return {
-            "ok": bool(rep["ok"] and replay["ok"]),
+            "ok": bool(rep["ok"] and crep["ok"] and replay["ok"]
+                       and not cproblems),
             "detlint_seconds": lint_s,
             "files": rep["files"],
             "findings": len(rep["findings"]),
             "findings_by_rule": rep["findings_by_rule"],
             "waivers": rep["waived"],
             "waivers_by_rule": rep["waived_by_rule"],
+            "contractlint_seconds": contract_s,
+            "contract_findings": len(crep["findings"]),
+            "contract_findings_by_rule": crep["findings_by_rule"],
+            "contract_waivers": crep["waived"],
+            "contract_waivers_by_rule": crep["waived_by_rule"],
+            "contract_cross_check_problems": cproblems,
             "knobs_registered": len(knobs.REGISTRY),
             "replay_seconds": replay_s,
             "replay_events": replay["events"],

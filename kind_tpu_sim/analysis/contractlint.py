@@ -1,0 +1,668 @@
+"""contractlint — the interface-contract sanitizer (static AST pass).
+
+detlint (:mod:`~kind_tpu_sim.analysis.detlint`) guards *determinism*:
+same seed, byte-identical report. This tool guards the layer beneath
+that promise — the **interfaces** the report is made of. Both classes
+of bug it hunts have already bitten this repo once: PR 12's fuzzer
+tripped over ``OverloadConfig.as_dict`` silently omitting
+``hedge_budget_burst`` (interface drift), and PR 8/9 spent real
+effort retiring a ticks-vs-seconds confusion (``eval_every_ticks``).
+Example-based tests only catch the paths they cross; contractlint
+walks the AST of the whole package and flags the *class*:
+
+=================  ===================================================
+``unit``           mixed-unit arithmetic, comparison, or keyword
+                   argument passing between identifiers carrying
+                   different unit suffixes (``_s``, ``_ms``,
+                   ``_ticks``, ``_frac``, ``_bytes``, ``_tok``,
+                   ``_gbps``). ``a_s + b_ticks`` is a bug even when
+                   both are floats; multiplication and division are
+                   exempt (that's how conversions are written).
+``drift``          a ``*Config`` dataclass field that its own
+                   ``as_dict`` does not serialize — the
+                   ``hedge_budget_burst`` class, caught by
+                   construction. Deliberate exclusions carry a
+                   per-field waiver with the reason in the source.
+``lane``           an :class:`~kind_tpu_sim.fleet.events.EventHeap`
+                   ``push`` whose lane argument is not a registered
+                   ``LANE_*`` constant (computed lanes break the
+                   fixed same-instant total order arrival <
+                   completion < chaos < probe < autoscaler <
+                   planner), or a ``LANE_*`` (re)definition outside
+                   ``fleet/events.py``.
+``waiver``         a malformed waiver: missing reason, unknown rule
+                   name, or a waiver that matches no finding.
+=================  ===================================================
+
+Beyond the per-line rules, :func:`cross_check_problems` holds the
+registry bijections (config <-> knob registry <-> ``FAULT_SCHEMAS``
+<-> CLI flags <-> lane table), and :func:`collect_report_schema` +
+:func:`schema_problems` pin the full report key-space
+(``kind_tpu_sim/analysis/report_schema.json``) so report drift is an
+explicit reviewed change instead of a replay surprise.
+
+Waivers are per-line and must carry a reason::
+
+    raw = ticks + offset_s  [hash]contractlint: ok(unit) -- grid math
+
+(with ``#`` for ``[hash]``; the comment may also sit alone on the
+line directly above). The waiver grammar, finding shape, and file
+walk are shared with detlint through
+:mod:`~kind_tpu_sim.analysis.lintcore` — *fix or justify*, never
+silence.
+
+Run it: ``kind-tpu-sim analysis contract`` (wired into pre-commit and
+CI beside ``analysis lint``); the JSON output is sorted-keys and
+byte-identical across runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from kind_tpu_sim.analysis import lintcore
+from kind_tpu_sim.analysis.lintcore import Finding
+
+RULES: Tuple[str, ...] = ("unit", "drift", "lane", "waiver")
+
+# ---------------------------------------------------------------- unit
+
+# Longest-match-first: `_ms` must win over `_s`, `_ticks` over `_s`.
+UNIT_SUFFIXES: Tuple[str, ...] = (
+    "_ticks", "_bytes", "_gbps", "_frac", "_tok", "_ms", "_s",
+)
+
+
+def unit_of_name(name: Optional[str]) -> Optional[str]:
+    """The unit a bare identifier carries by suffix convention, or
+    None. A name that *is* a suffix (``_s``) carries nothing."""
+    if not name:
+        return None
+    for suffix in UNIT_SUFFIXES:
+        if name.endswith(suffix) and len(name) > len(suffix):
+            return suffix
+    return None
+
+
+def unit_of_expr(node: ast.AST) -> Optional[str]:
+    """Best-effort unit of an expression: names and attributes by
+    their own suffix, calls by the called function's suffix (a
+    ``hedge_delay_s()`` call yields seconds). Anything opaque —
+    literals, subscripts, nested arithmetic — is unit-less and never
+    flagged; the rule only fires when BOTH sides are known."""
+    if isinstance(node, ast.Name):
+        return unit_of_name(node.id)
+    if isinstance(node, ast.Attribute):
+        return unit_of_name(node.attr)
+    if isinstance(node, ast.Call):
+        return unit_of_expr(node.func)
+    if isinstance(node, ast.UnaryOp):
+        return unit_of_expr(node.operand)
+    return None
+
+
+# ---------------------------------------------------------------- lane
+
+# The canonical same-instant total order. fleet/events.py is the one
+# place these are defined; lane_order_problems() holds the two in
+# bijection so neither can drift.
+CANONICAL_LANES: Tuple[Tuple[str, int], ...] = (
+    ("LANE_ARRIVAL", 0),
+    ("LANE_COMPLETION", 1),
+    ("LANE_CHAOS", 2),
+    ("LANE_HEALTH_PROBE", 3),
+    ("LANE_AUTOSCALER", 4),
+    ("LANE_PLANNER", 5),
+)
+LANE_NAMES = frozenset(name for name, _ in CANONICAL_LANES)
+
+_LANE_HOME = "fleet/events.py"
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+# --------------------------------------------------------------- drift
+
+
+def _is_dataclass(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _terminal_name(target)
+        if name == "dataclass":
+            return True
+    return False
+
+
+def _dataclass_fields(node: ast.ClassDef) -> List[Tuple[str, int, int]]:
+    """(name, line, col) of every dataclass field: annotated
+    assignments in the class body, minus ClassVar declarations."""
+    fields: List[Tuple[str, int, int]] = []
+    for stmt in node.body:
+        if not isinstance(stmt, ast.AnnAssign):
+            continue
+        if not isinstance(stmt.target, ast.Name):
+            continue
+        ann = ast.dump(stmt.annotation)
+        if "ClassVar" in ann:
+            continue
+        fields.append(
+            (stmt.target.id, stmt.lineno, stmt.col_offset))
+    return fields
+
+
+def _as_dict_coverage(fn: ast.FunctionDef) -> Tuple[bool, set]:
+    """What ``as_dict`` serializes: every string literal (report
+    keys) and every ``self.<attr>`` access. ``asdict(self)`` /
+    ``dataclasses.asdict(self)`` covers everything at once."""
+    covered: set = set()
+    for sub in ast.walk(fn):
+        if (isinstance(sub, ast.Call)
+                and _terminal_name(sub.func) == "asdict"
+                and sub.args
+                and isinstance(sub.args[0], ast.Name)
+                and sub.args[0].id == "self"):
+            # asdict(self) serializes every field; asdict(self.slo)
+            # serializes a SUB-config and covers nothing here
+            return True, covered
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            covered.add(sub.value)
+        if (isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"):
+            covered.add(sub.attr)
+    return False, covered
+
+
+# ------------------------------------------------------------- visitor
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, path: str, norm: str):
+        self.path = path
+        self.norm = norm  # forward-slash path for location checks
+        self.findings: List[Finding] = []
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(Finding(
+            self.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), rule, message))
+
+    # -- unit ---------------------------------------------------------
+
+    def _check_pair(self, node: ast.AST, left: ast.AST,
+                    right: ast.AST, what: str) -> None:
+        lu, ru = unit_of_expr(left), unit_of_expr(right)
+        if lu and ru and lu != ru:
+            self._emit(node, "unit",
+                       f"{what} mixes units {lu} and {ru} — "
+                       "convert explicitly (multiply/divide) first")
+
+    def visit_BinOp(self, node):            # noqa: N802
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            self._check_pair(node, node.left, node.right,
+                             "arithmetic")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):        # noqa: N802
+        if isinstance(node.op, (ast.Add, ast.Sub)):
+            self._check_pair(node, node.target, node.value,
+                             "augmented assignment")
+        self.generic_visit(node)
+
+    def visit_Compare(self, node):          # noqa: N802
+        left = node.left
+        for comparator in node.comparators:
+            self._check_pair(node, left, comparator, "comparison")
+            left = comparator
+        self.generic_visit(node)
+
+    def visit_Call(self, node):             # noqa: N802
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            pu = unit_of_name(kw.arg)
+            vu = unit_of_expr(kw.value)
+            if pu and vu and pu != vu:
+                self._emit(
+                    kw.value, "unit",
+                    f"keyword {kw.arg!r} (unit {pu}) receives a "
+                    f"{vu} value — convert explicitly first")
+        self._check_push(node)
+        self.generic_visit(node)
+
+    # -- lane ---------------------------------------------------------
+
+    def _check_push(self, node: ast.Call) -> None:
+        """Every EventHeap.push lane argument must be a registered
+        LANE_* constant — computed lanes break the same-instant
+        total order. Matched structurally: a method named ``push``
+        called with (time, lane, payload)."""
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "push"
+                and len(node.args) == 3):
+            return
+        lane = node.args[1]
+        name = _terminal_name(lane)
+        if name is None:
+            self._emit(
+                lane, "lane",
+                "EventHeap.push lane must be a registered LANE_* "
+                "constant, not a computed expression")
+        elif name not in LANE_NAMES:
+            self._emit(
+                lane, "lane",
+                f"EventHeap.push lane {name!r} is not a registered "
+                "lane constant (fleet/events.py LANES)")
+
+    def visit_Assign(self, node):           # noqa: N802
+        # a LANE_* name bound to an integer is a lane (re)definition;
+        # only fleet/events.py may do that. (Non-integer LANE_*
+        # bindings — sets, tuples of lanes — are bookkeeping, not
+        # redefinitions.)
+        if (not self.norm.endswith(_LANE_HOME)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            for target in node.targets:
+                name = _terminal_name(target)
+                if name and name.startswith("LANE_"):
+                    self._emit(
+                        node, "lane",
+                        f"{name} defined outside {_LANE_HOME} — "
+                        "lane constants have exactly one home")
+        self.generic_visit(node)
+
+    # -- drift --------------------------------------------------------
+
+    def visit_ClassDef(self, node):         # noqa: N802
+        if node.name.endswith("Config") and _is_dataclass(node):
+            self._check_config(node)
+        self.generic_visit(node)
+
+    def _check_config(self, node: ast.ClassDef) -> None:
+        as_dict = next(
+            (s for s in node.body
+             if isinstance(s, ast.FunctionDef)
+             and s.name == "as_dict"), None)
+        if as_dict is None:
+            return
+        full, covered = _as_dict_coverage(as_dict)
+        if full:
+            return
+        for fname, line, col in _dataclass_fields(node):
+            if fname not in covered:
+                self.findings.append(Finding(
+                    self.path, line, col, "drift",
+                    f"{node.name}.{fname} is not serialized by "
+                    "as_dict — report drift (the "
+                    "hedge_budget_burst class); serialize it or "
+                    "waive with the reason"))
+
+
+# ------------------------------------------------------------ lint API
+
+
+def lint_source(source: str, path: str = "<string>"
+                ) -> List[Finding]:
+    norm = path.replace("\\", "/")
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, 0, "drift",
+                        f"syntax error: {exc.msg}")]
+    visitor = _Visitor(path, norm)
+    visitor.visit(tree)
+    return lintcore.apply_waivers(
+        visitor.findings, source, path, "contractlint", RULES)
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    return lintcore.iter_py_files(paths)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    return lintcore.lint_paths(paths, lint_source)
+
+
+def report(findings: Iterable[Finding],
+           files: Optional[int] = None) -> dict:
+    return lintcore.report(findings, RULES, files)
+
+
+# -------------------------------------------------- registry bijections
+
+
+def lane_order_problems() -> List[str]:
+    """fleet/events.py lane table <-> the canonical same-instant
+    order, held in bijection: same names, same values, LANES sorted
+    and gap-free. Both fleet and globe import from that one table,
+    so this single check covers every push site's ordering."""
+    from kind_tpu_sim.fleet import events
+
+    problems: List[str] = []
+    for name, value in CANONICAL_LANES:
+        have = getattr(events, name, None)
+        if have is None:
+            problems.append(
+                f"fleet/events.py is missing lane constant {name}")
+        elif have != value:
+            problems.append(
+                f"{name} is {have}, canonical order says {value} "
+                "(arrival < completion < chaos < probe < "
+                "autoscaler < planner)")
+    lanes = getattr(events, "LANES", ())
+    want = tuple(v for _, v in CANONICAL_LANES)
+    if tuple(lanes) != want:
+        problems.append(
+            f"events.LANES is {tuple(lanes)!r}, expected the "
+            f"canonical {want!r}")
+    for extra in dir(events):
+        if extra.startswith("LANE_") and extra not in LANE_NAMES:
+            problems.append(
+                f"fleet/events.py defines {extra}, which the "
+                "canonical lane table does not know — register it "
+                "in contractlint.CANONICAL_LANES")
+    return problems
+
+
+RootLike = Optional[Union[pathlib.Path, str]]
+
+
+def _resolve_root(root: RootLike) -> pathlib.Path:
+    """Repo root for the cross-checks; accepts a str for library
+    callers, defaults to the checkout containing this file."""
+    if root is None:
+        return pathlib.Path(__file__).resolve().parents[2]
+    return pathlib.Path(root)
+
+
+def knob_coverage_problems(root: RootLike = None) -> List[str]:
+    """Knob registry <-> code, both directions. detlint's
+    `unknown-knob` rule already rejects unregistered KIND_TPU_SIM_*
+    tokens; this is the reverse: a registered knob whose alias
+    constant no module ever reads is dead weight (or a rename that
+    left the registry behind)."""
+    from kind_tpu_sim.analysis import knobs
+
+    root = _resolve_root(root)
+    aliases: Dict[str, str] = {}
+    for attr in dir(knobs):
+        if attr.startswith("_"):
+            continue
+        value = getattr(knobs, attr)
+        if isinstance(value, str) and knobs.is_registered(value):
+            aliases[value] = attr
+
+    searched: List[str] = []
+    pkg = root / "kind_tpu_sim"
+    if pkg.is_dir():
+        searched.extend(
+            str(f) for f in sorted(pkg.rglob("*.py"))
+            if "__pycache__" not in f.parts
+            and f.name != "knobs.py")
+    searched.extend(
+        str(f) for f in sorted(root.glob("*.py")))
+    corpus = []
+    for fname in searched:
+        try:
+            with open(fname, encoding="utf-8") as fh:
+                corpus.append(fh.read())
+        except OSError:
+            continue
+    text = "\n".join(corpus)
+
+    problems: List[str] = []
+    for name in sorted(knobs.REGISTRY):
+        alias = aliases.get(name)
+        read = name in text or (
+            alias is not None
+            and re.search(r"\b" + re.escape(alias) + r"\b", text))
+        if not read:
+            problems.append(
+                f"knob {name} is registered but never read outside "
+                "the registry — dead knob or rename drift")
+    return problems
+
+
+def cli_flag_problems(root: RootLike = None) -> List[str]:
+    """CLI flags <-> config fields, for the unit-carrying subset: a
+    ``--foo-bar-s`` flag must correspond to a real ``foo_bar_s``
+    dataclass field (or function parameter) somewhere in the
+    package. Catches the rename-the-field-forget-the-flag drift for
+    every flag that encodes a unit in its name."""
+    root = _resolve_root(root)
+    cli_path = root / "kind_tpu_sim" / "cli.py"
+    try:
+        cli_tree = ast.parse(cli_path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError) as exc:
+        return [f"cannot parse {cli_path}: {exc}"]
+
+    flags: List[Tuple[str, int]] = []
+    for node in ast.walk(cli_tree):
+        if not (isinstance(node, ast.Call)
+                and _terminal_name(node.func) == "add_argument"):
+            continue
+        for arg in node.args:
+            if (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)
+                    and arg.value.startswith("--")):
+                name = arg.value[2:].replace("-", "_")
+                if unit_of_name(name):
+                    flags.append((name, node.lineno))
+
+    # every dataclass field + function parameter name in the package
+    names: set = set()
+    pkg = root / "kind_tpu_sim"
+    for fname in sorted(pkg.rglob("*.py")):
+        if "__pycache__" in fname.parts:
+            continue
+        try:
+            tree = ast.parse(fname.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name):
+                names.add(node.target.id)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for a in (node.args.args + node.args.kwonlyargs):
+                    names.add(a.arg)
+
+    problems: List[str] = []
+    for flag, line in sorted(set(flags)):
+        if flag not in names:
+            problems.append(
+                f"cli.py:{line}: flag --{flag.replace('_', '-')} "
+                "names no config field or parameter "
+                f"{flag!r} — flag/field drift")
+    return problems
+
+
+def cross_check_problems(root: RootLike = None) -> Dict[str, List[str]]:
+    """All registry bijections the contract gate holds, by family.
+    fault-schemas and scenario-registry checks are shared with
+    `analysis lint` (they were born there); lanes, knob coverage,
+    and CLI flags are contractlint's own."""
+    from kind_tpu_sim.chaos import fault_schema_problems
+    from kind_tpu_sim.scenarios import registry
+
+    return {
+        "cli_flags": cli_flag_problems(root),
+        "fault_schemas": fault_schema_problems(),
+        "knob_coverage": knob_coverage_problems(root),
+        "lane_order": lane_order_problems(),
+        "scenario_registry": registry.registry_problems(),
+    }
+
+
+# ------------------------------------------------------- report schema
+
+SCHEMA_PATH = pathlib.Path(__file__).with_name("report_schema.json")
+
+# Containers whose keys are run-dependent (replica ids, zone names,
+# gang names, event types, counter names): the child segment is
+# collapsed to `*` so the schema pins structure, not instance names.
+_DYNAMIC_CONTAINERS = frozenset((
+    "breakers", "cells", "components", "event_counts",
+    "fleet_counters", "gangs", "globe_counters", "hard_limits",
+    "health_counters", "peak_outstanding", "per_replica",
+    "replicas", "retry_budget", "sched_counters",
+    "sched_event_counts",
+    "train_counters", "zones",
+))
+
+
+def _key_paths(obj: object, prefix: Tuple[str, ...] = ()
+               ) -> set:
+    out: set = set()
+    if isinstance(obj, dict):
+        parent = prefix[-1] if prefix else ""
+        for key, value in obj.items():
+            seg = "*" if parent in _DYNAMIC_CONTAINERS else str(key)
+            out |= _key_paths(value, prefix + (seg,))
+    elif isinstance(obj, (list, tuple)):
+        if not obj:
+            out.add(".".join(prefix) + "[]")
+        for value in obj:
+            out |= _key_paths(value, prefix + ("[]",))
+    else:
+        out.add(".".join(prefix))
+    return out
+
+
+def board_counter_keys(root: RootLike = None) -> Dict[str, List[str]]:
+    """Statically-extracted counter/gauge names per metrics board:
+    every ``metrics.<x>_board().incr("name")`` / ``.gauge("name")``
+    literal in the package. Dynamic names (f-strings) are recorded
+    as ``*``. This is the full *possible* key-space — run-independent
+    by construction, unlike observing one simulation."""
+    root = _resolve_root(root)
+    boards: Dict[str, set] = {}
+    pkg = root / "kind_tpu_sim"
+    for fname in sorted(pkg.rglob("*.py")):
+        if "__pycache__" in fname.parts:
+            continue
+        try:
+            tree = ast.parse(fname.read_text(encoding="utf-8"))
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("incr", "gauge")
+                    and node.args):
+                continue
+            recv = node.func.value
+            board = (_terminal_name(recv.func)
+                     if isinstance(recv, ast.Call) else None)
+            if board is None or not board.endswith("_board"):
+                continue
+            key = node.args[0]
+            name = (key.value
+                    if isinstance(key, ast.Constant)
+                    and isinstance(key.value, str) else "*")
+            boards.setdefault(board, set()).add(name)
+    return {b: sorted(ks) for b, ks in sorted(boards.items())}
+
+
+def collect_report_schema(
+        root: RootLike = None) -> dict:
+    """The current report key-space: seeded tiny fleet and globe
+    runs (every optional subsystem enabled so conditional keys
+    appear), plus the statically-extracted board counters. Seeds and
+    workloads are pinned — the schema is a pure function of the
+    code, so CI can diff it."""
+    from kind_tpu_sim import fleet, globe
+
+    fspec = fleet.WorkloadSpec(
+        process="poisson", rps=40.0, n_requests=40)
+    fcfg = fleet.FleetConfig(
+        replicas=2, policy="least-outstanding", autoscale=True,
+        sched=fleet.FleetSchedConfig(),
+        health=fleet.DetectorConfig.from_env(),
+        overload=fleet.OverloadConfig(),
+        training=fleet.TrainingConfig(gangs=(
+            fleet.TrainingGangConfig(
+                name="llm0", topology="2x8", total_steps=10),)))
+    fleet_report = fleet.FleetSim(
+        fcfg, fleet.generate_trace(fspec, 3)).run()
+
+    gspec = globe.GlobeWorkloadSpec(n_per_zone=20, rps=20.0)
+    gcfg = globe.GlobeConfig(
+        zones=("us-a", "eu-b"), max_virtual_s=60.0, workload=gspec,
+        autoscale=True, overload=fleet.OverloadConfig(hedge=True))
+    globe_report = globe.GlobeSim(
+        gcfg, globe.generate_globe_traces(gcfg, 5)).run()
+
+    return {
+        "boards": board_counter_keys(root),
+        "fleet": sorted(_key_paths(fleet_report)),
+        "globe": sorted(_key_paths(globe_report)),
+    }
+
+
+def schema_problems(have: dict, want: dict) -> List[str]:
+    """Diff the checked-in schema against the collected one. Every
+    added or removed key path is a problem line — report drift must
+    arrive as an explicit regenerate-and-review, never silently."""
+    problems: List[str] = []
+    for section in sorted(set(have) | set(want)):
+        h = have.get(section)
+        w = want.get(section)
+        if isinstance(h, dict) or isinstance(w, dict):
+            h = h or {}
+            w = w or {}
+            for board in sorted(set(h) | set(w)):
+                hs, ws = set(h.get(board, ())), set(w.get(board, ()))
+                for key in sorted(ws - hs):
+                    problems.append(
+                        f"{section}.{board}: new key {key!r} not in "
+                        "checked-in schema")
+                for key in sorted(hs - ws):
+                    problems.append(
+                        f"{section}.{board}: key {key!r} vanished "
+                        "from the code")
+        else:
+            hs, ws = set(h or ()), set(w or ())
+            for key in sorted(ws - hs):
+                problems.append(
+                    f"{section}: new report key {key!r} not in "
+                    "checked-in schema")
+            for key in sorted(hs - ws):
+                problems.append(
+                    f"{section}: report key {key!r} vanished from "
+                    "the report")
+    if problems:
+        problems.append(
+            "regenerate with `kind-tpu-sim analysis contract "
+            "--write-schema` and review the diff")
+    return problems
+
+
+def load_schema(path: Optional[pathlib.Path] = None) -> dict:
+    path = path or SCHEMA_PATH
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def write_schema(path: Optional[pathlib.Path] = None,
+                 root: RootLike = None) -> dict:
+    path = path or SCHEMA_PATH
+    schema = collect_report_schema(root)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(schema, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    return schema

@@ -56,17 +56,19 @@ contract is *fix or justify*, never silence.
 
 Run it: ``kind-tpu-sim analysis lint kind_tpu_sim`` (wired into
 pre-commit and CI); the JSON output is sorted-keys and byte-identical
-across runs, like every other subcommand.
+across runs, like every other subcommand. The finding/waiver/report
+machinery is shared with **contractlint** (the interface-contract
+sanitizer) through :mod:`~kind_tpu_sim.analysis.lintcore`.
 """
 
 from __future__ import annotations
 
 import ast
-import dataclasses
 import re
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Iterable, List, Optional, Sequence, Tuple
 
-from kind_tpu_sim.analysis import knobs
+from kind_tpu_sim.analysis import knobs, lintcore
+from kind_tpu_sim.analysis.lintcore import Finding
 
 RULES = (
     "wallclock", "entropy", "set-iter", "fs-order", "json-sort",
@@ -122,34 +124,6 @@ _FS_PATH_METHODS = frozenset(("iterdir", "glob", "rglob"))
 _ORDER_SINK_NAMES = frozenset(("list", "tuple", "sum", "enumerate"))
 
 _KNOB_TOKEN = re.compile(r"KIND_TPU_SIM_[A-Z0-9_]+")
-_WAIVER = re.compile(
-    r"#\s*detlint:\s*ok\(([^)]*)\)(?:\s*--\s*(\S.*\S|\S))?")
-
-
-@dataclasses.dataclass(frozen=True)
-class Finding:
-    path: str
-    line: int
-    col: int
-    rule: str
-    message: str
-    waived: bool = False
-    waiver_reason: str = ""
-
-    def as_dict(self) -> dict:
-        return dataclasses.asdict(self)
-
-    def render(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: "
-                f"[{self.rule}] {self.message}")
-
-
-@dataclasses.dataclass
-class _Waiver:
-    line: int
-    rules: Tuple[str, ...]
-    reason: str
-    used: bool = False
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -392,36 +366,6 @@ class _Visitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def _parse_waivers(source: str) -> Tuple[Dict[int, _Waiver],
-                                         List[Finding]]:
-    """Line -> waiver, plus findings for malformed waivers. A waiver
-    on a comment-only line covers the next line instead."""
-    waivers: Dict[int, _Waiver] = {}
-    bad: List[Finding] = []
-    for lineno, text in enumerate(source.splitlines(), start=1):
-        m = _WAIVER.search(text)
-        if not m:
-            continue
-        rules = tuple(sorted(
-            r.strip() for r in m.group(1).split(",") if r.strip()))
-        reason = (m.group(2) or "").strip()
-        target = (lineno + 1
-                  if text.lstrip().startswith("#") else lineno)
-        unknown = [r for r in rules if r not in RULES]
-        if unknown:
-            bad.append(Finding(
-                "", lineno, m.start(), "waiver",
-                f"waiver names unknown rule(s) "
-                f"{', '.join(unknown)}"))
-        if not reason:
-            bad.append(Finding(
-                "", lineno, m.start(), "waiver",
-                "waiver without a reason — append "
-                "'-- <why this is safe>'"))
-        waivers[target] = _Waiver(lineno, rules, reason)
-    return waivers, bad
-
-
 def lint_source(source: str, path: str = "<string>"
                 ) -> List[Finding]:
     """All findings (waived ones included, marked) for one module."""
@@ -447,72 +391,20 @@ def lint_source(source: str, path: str = "<string>"
         seen.add(key)
         seen.add(dup if f.rule == "env-import" else key)
         raw.append(f)
-    waivers, bad = _parse_waivers(source)
-    out: List[Finding] = []
-    for f in raw:
-        w = waivers.get(f.line)
-        if w is not None and (f.rule in w.rules):
-            w.used = True
-            out.append(dataclasses.replace(
-                f, waived=bool(w.reason),
-                waiver_reason=w.reason))
-        else:
-            out.append(f)
-    for f in bad:
-        out.append(dataclasses.replace(f, path=path))
-    for w in waivers.values():
-        if not w.used:
-            out.append(Finding(
-                path, w.line, 0, "waiver",
-                "waiver matches no finding on its line — stale "
-                "waivers hide future regressions; delete it"))
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return out
+    return lintcore.apply_waivers(raw, source, path, "detlint",
+                                  RULES)
 
 
 def iter_py_files(paths: Sequence[str]) -> List[str]:
-    import pathlib
-
-    files: List[str] = []
-    for p in paths:
-        path = pathlib.Path(p)
-        if path.is_dir():
-            files.extend(
-                str(f) for f in sorted(path.rglob("*.py"))
-                if "__pycache__" not in f.parts)
-        elif path.suffix == ".py":
-            files.append(str(path))
-    return sorted(set(files))
+    return lintcore.iter_py_files(paths)
 
 
 def lint_paths(paths: Sequence[str]) -> List[Finding]:
-    findings: List[Finding] = []
-    for fname in iter_py_files(paths):
-        with open(fname, encoding="utf-8") as fh:
-            findings.extend(lint_source(fh.read(), fname))
-    return findings
+    return lintcore.lint_paths(paths, lint_source)
 
 
 def report(findings: Iterable[Finding],
            files: Optional[int] = None) -> dict:
     """JSON-able summary: unwaived findings are the failures; waived
     ones are counted (bench tracks waiver growth)."""
-    unwaived = [f for f in findings if not f.waived]
-    waived = [f for f in findings if f.waived]
-    by_rule: Dict[str, int] = {}
-    for f in unwaived:
-        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
-    waived_by_rule: Dict[str, int] = {}
-    for f in waived:
-        waived_by_rule[f.rule] = waived_by_rule.get(f.rule, 0) + 1
-    out = {
-        "findings": [f.as_dict() for f in unwaived],
-        "findings_by_rule": by_rule,
-        "waived": len(waived),
-        "waived_by_rule": waived_by_rule,
-        "rules": list(RULES),
-        "ok": not unwaived,
-    }
-    if files is not None:
-        out["files"] = files
-    return out
+    return lintcore.report(findings, RULES, files)

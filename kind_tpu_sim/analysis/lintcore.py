@@ -1,0 +1,182 @@
+"""The shared sanitizer core — findings, waivers, file walking.
+
+Two static sanitizers guard the simulator's contracts: **detlint**
+(determinism hazards: wall-clock reads, unseeded entropy, unordered
+iteration, unsorted JSON — docs/ANALYSIS.md) and **contractlint**
+(interface contracts: unit-suffix mixing, ``as_dict`` drift,
+event-lane ordering). Both speak the same waiver grammar::
+
+    expr  # <tool>: ok(rule[, rule...]) -- <why this is safe>
+
+(with ``<tool>`` being ``detlint`` or ``contractlint``; the comment
+may also sit alone on the line directly above). The grammar's three
+hard rules live HERE, once, so the tools cannot drift apart:
+
+* a waiver without a reason is itself a finding — *fix or justify*,
+  never silence;
+* a waiver naming a rule the tool doesn't have is a finding — a
+  typo'd rule name must not silently waive nothing;
+* a waiver matching no finding on its line is a finding — stale
+  waivers hide future regressions.
+
+Each tool contributes only its AST visitor and rule table;
+:func:`apply_waivers` turns raw visitor output + source text into the
+final finding list, and :func:`report` renders the shared JSON shape
+(sorted, byte-identical across runs — the linters obey the contract
+they enforce).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One sanitizer hit. ``waived`` marks a justified (reasoned)
+    waiver; unwaived findings are the failures."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    waived: bool = False
+    waiver_reason: str = ""
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}")
+
+
+@dataclasses.dataclass
+class Waiver:
+    line: int
+    rules: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+def waiver_pattern(tool: str) -> "re.Pattern[str]":
+    """The per-line waiver comment for ``tool``:
+    ``# <tool>: ok(rules) -- reason``."""
+    return re.compile(
+        r"#\s*" + re.escape(tool)
+        + r":\s*ok\(([^)]*)\)(?:\s*--\s*(\S.*\S|\S))?")
+
+
+def parse_waivers(source: str, tool: str, rules: Sequence[str]
+                  ) -> Tuple[Dict[int, Waiver], List[Finding]]:
+    """Line -> waiver, plus findings for malformed waivers. A waiver
+    on a comment-only line covers the next line instead."""
+    pattern = waiver_pattern(tool)
+    waivers: Dict[int, Waiver] = {}
+    bad: List[Finding] = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = pattern.search(text)
+        if not m:
+            continue
+        named = tuple(sorted(
+            r.strip() for r in m.group(1).split(",") if r.strip()))
+        reason = (m.group(2) or "").strip()
+        target = (lineno + 1
+                  if text.lstrip().startswith("#") else lineno)
+        unknown = [r for r in named if r not in rules]
+        if unknown:
+            bad.append(Finding(
+                "", lineno, m.start(), "waiver",
+                f"waiver names unknown rule(s) "
+                f"{', '.join(unknown)}"))
+        if not reason:
+            bad.append(Finding(
+                "", lineno, m.start(), "waiver",
+                "waiver without a reason — append "
+                "'-- <why this is safe>'"))
+        waivers[target] = Waiver(lineno, named, reason)
+    return waivers, bad
+
+
+def apply_waivers(raw: Sequence[Finding], source: str, path: str,
+                  tool: str, rules: Sequence[str]) -> List[Finding]:
+    """Fold the source's waiver comments into the visitor's raw
+    findings: matching reasoned waivers mark findings ``waived``,
+    malformed and stale waivers become findings of their own, and the
+    result is sorted (path, line, col, rule) — deterministic output
+    for byte-identical lint reports."""
+    waivers, bad = parse_waivers(source, tool, rules)
+    out: List[Finding] = []
+    for f in raw:
+        w = waivers.get(f.line)
+        if w is not None and f.rule in w.rules:
+            w.used = True
+            out.append(dataclasses.replace(
+                f, waived=bool(w.reason), waiver_reason=w.reason))
+        else:
+            out.append(f)
+    for f in bad:
+        out.append(dataclasses.replace(f, path=path))
+    for w in waivers.values():
+        if not w.used:
+            out.append(Finding(
+                path, w.line, 0, "waiver",
+                "waiver matches no finding on its line — stale "
+                "waivers hide future regressions; delete it"))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    """Every ``*.py`` under ``paths`` (dirs recursed, sorted,
+    ``__pycache__`` skipped) — the shared file walk, so both tools
+    lint the identical tree."""
+    files: List[str] = []
+    for p in paths:
+        path = pathlib.Path(p)
+        if path.is_dir():
+            files.extend(
+                str(f) for f in sorted(path.rglob("*.py"))
+                if "__pycache__" not in f.parts)
+        elif path.suffix == ".py":
+            files.append(str(path))
+    return sorted(set(files))
+
+
+def lint_paths(paths: Sequence[str],
+               lint_source: Callable[[str, str], List[Finding]]
+               ) -> List[Finding]:
+    findings: List[Finding] = []
+    for fname in iter_py_files(paths):
+        with open(fname, encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), fname))
+    return findings
+
+
+def report(findings: Iterable[Finding], rules: Sequence[str],
+           files: Optional[int] = None) -> dict:
+    """JSON-able summary: unwaived findings are the failures; waived
+    ones are counted (bench tracks waiver growth)."""
+    unwaived = [f for f in findings if not f.waived]
+    waived = [f for f in findings if f.waived]
+    by_rule: Dict[str, int] = {}
+    for f in unwaived:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    waived_by_rule: Dict[str, int] = {}
+    for f in waived:
+        waived_by_rule[f.rule] = waived_by_rule.get(f.rule, 0) + 1
+    out = {
+        "findings": [f.as_dict() for f in unwaived],
+        "findings_by_rule": by_rule,
+        "waived": len(waived),
+        "waived_by_rule": waived_by_rule,
+        "rules": list(rules),
+        "ok": not unwaived,
+    }
+    if files is not None:
+        out["files"] = files
+    return out

@@ -532,10 +532,14 @@ def build_parser() -> argparse.ArgumentParser:
             "through (--check-docs / --write-docs for the generated "
             "docs/KNOBS.md); replay = run a scenario twice under one "
             "seed and bisect any divergence to the first differing "
-            "event"
+            "event; contract = contractlint interface sanitizer "
+            "(unit-suffix mixing, as_dict drift, event-lane "
+            "ordering, registry bijections, report-schema diff — "
+            "docs/ANALYSIS.md)"
         ),
     )
-    an.add_argument("action", choices=["lint", "knobs", "replay"])
+    an.add_argument("action",
+                    choices=["lint", "knobs", "replay", "contract"])
     an.add_argument(
         "paths", nargs="*",
         help="files/directories for 'lint' (default: the installed "
@@ -561,6 +565,16 @@ def build_parser() -> argparse.ArgumentParser:
     an.add_argument(
         "--write-docs", action="store_true",
         help="knobs: regenerate docs/KNOBS.md from the registry")
+    an.add_argument(
+        "--write-schema", action="store_true",
+        help="contract: regenerate the checked-in report-schema "
+             "registry (kind_tpu_sim/analysis/report_schema.json) "
+             "from seeded calibration runs")
+    an.add_argument(
+        "--no-schema", action="store_true",
+        help="contract: skip the report-schema diff (static rules "
+             "and registry bijections only — the fast pre-commit "
+             "mode; CI runs the full check)")
     an.add_argument("--json", action="store_true", dest="as_json")
 
     man = sub.add_parser(
@@ -1470,6 +1484,47 @@ def run_analysis(args: argparse.Namespace) -> int:
             print(f"detlint: {rep['files']} file(s), "
                   f"{len(rep['findings'])} finding(s), "
                   f"{rep['waived']} waived "
+                  + ("OK" if rep["ok"] else "FAILED"))
+        return 0 if rep["ok"] else 1
+
+    if args.action == "contract":
+        from kind_tpu_sim.analysis import contractlint
+
+        if args.write_schema:
+            schema = contractlint.write_schema(root=repo)
+            print(f"wrote {contractlint.SCHEMA_PATH} "
+                  f"({sum(len(v) for v in schema.values())} "
+                  "entries)")
+            return 0
+        paths = args.paths or [str(repo / "kind_tpu_sim")]
+        findings = contractlint.lint_paths(paths)
+        rep = contractlint.report(
+            findings, files=len(contractlint.iter_py_files(paths)))
+        checks = contractlint.cross_check_problems(repo)
+        if not args.no_schema:
+            checks["report_schema"] = contractlint.schema_problems(
+                contractlint.load_schema(),
+                contractlint.collect_report_schema(repo))
+        problems = [f"{family}: {p}"
+                    for family in sorted(checks)
+                    for p in checks[family]]
+        rep["cross_checks"] = {
+            family: {"problems": ps, "ok": not ps}
+            for family, ps in sorted(checks.items())
+        }
+        rep["ok"] = bool(rep["ok"]) and not problems
+        if args.as_json:
+            print(json.dumps(rep, sort_keys=True))
+        else:
+            for f in findings:
+                if not f.waived:
+                    print(f.render())
+            for p in problems:
+                print(p)
+            print(f"contractlint: {rep['files']} file(s), "
+                  f"{len(rep['findings'])} finding(s), "
+                  f"{rep['waived']} waived, "
+                  f"{len(problems)} cross-check problem(s) "
                   + ("OK" if rep["ok"] else "FAILED"))
         return 0 if rep["ok"] else 1
 

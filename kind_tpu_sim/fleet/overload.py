@@ -164,14 +164,21 @@ class OverloadConfig:
             "hedge_quantile": resolve_hedge_quantile(
                 self.hedge_quantile),
             "hedge_min_delay_s": self.hedge_min_delay_s,
+            "hedge_warm_count": self.hedge_warm_count,
             "hedge_budget_ratio": self.hedge_budget_ratio,
+            "hedge_budget_burst": self.hedge_budget_burst,
             "breaker": self.breaker,
             "breaker_window": resolve_breaker_window(
                 self.breaker_window),
             "breaker_failure_ratio": self.breaker_failure_ratio,
+            "breaker_min_samples": self.breaker_min_samples,
             "breaker_open_s": self.breaker_open_s,
+            "breaker_probe_n": self.breaker_probe_n,
             "brownout": resolve_brownout(self.brownout),
+            "brownout_window": self.brownout_window,
             "brownout_attainment": self.brownout_attainment,
+            "brownout_evals": self.brownout_evals,
+            "brownout_recover_evals": self.brownout_recover_evals,
             "brownout_max_new_cap": self.brownout_max_new_cap,
             "low_tier_frac": self.low_tier_frac,
         }

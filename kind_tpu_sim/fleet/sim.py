@@ -178,6 +178,7 @@ class FleetSchedConfig:
             "pods": [list(p) for p in self.pods],
             "policy": self.policy,
             "bind_s": self.bind_s,
+            "replica_accelerator": self.replica_accelerator,
             "replica_topology": self.replica_topology,
             "priority": self.priority,
             "ici_fraction": self.ici_fraction,
@@ -202,6 +203,7 @@ class FleetConfig:
     # through eval_every_s as ticks * tick_s — but emits a one-shot
     # DeprecationWarning; it couples the real-time cadence to the
     # tick width, which is exactly the bug eval_every_s fixed.
+    # contractlint: ok(drift) -- retired alias: reports carry the cadence as eval_every_s
     eval_every_ticks: Optional[int] = None
     eval_every_s: Optional[float] = None
     slo: SloPolicy = SloPolicy(ttft_s=0.5, e2e_s=2.0)
@@ -226,10 +228,12 @@ class FleetConfig:
     # execution strategy, not workload config: reports are
     # byte-identical either way, so it deliberately stays OUT of
     # as_dict() — an ff-on and an ff-off run must diff clean.
+    # contractlint: ok(drift) -- execution strategy: ff-on vs ff-off reports must diff clean
     fast_forward: Optional[bool] = None
     # event-heap core (None -> resolve_event_core(), default on).
     # Same contract as fast_forward: an execution strategy that must
     # diff clean on vs off, so it stays OUT of as_dict() too.
+    # contractlint: ok(drift) -- execution strategy: heap-core on vs off reports must diff clean
     event_core: Optional[bool] = None
 
     def as_dict(self) -> dict:
@@ -238,6 +242,7 @@ class FleetConfig:
             "policy": self.policy,
             "tick_s": resolve_tick_s(self.tick_s),
             "max_queue": self.max_queue,
+            "max_virtual_s": self.max_virtual_s,
             "autoscale": self.autoscale,
             "slo": {k: v for k, v in
                     dataclasses.asdict(self.slo).items()
@@ -246,6 +251,8 @@ class FleetConfig:
         }
         if self.eval_every_s is not None:
             out["eval_every_s"] = self.eval_every_s
+        if self.autoscale:
+            out["autoscaler"] = dataclasses.asdict(self.autoscaler)
         if self.sched is not None:
             out["sched"] = self.sched.as_dict()
         if self.health is not None:

@@ -184,12 +184,21 @@ class TrainingGangConfig:
             "priority": self.priority,
             "arrival_s": round(self.arrival_s, 6),
             "total_steps": self.total_steps,
+            "step_compute_chip_s": self.step_compute_chip_s,
+            "allreduce_bytes": self.allreduce_bytes,
             "work_per_step": self.work_per_step,
             "work_unit": self.work_unit,
             "elastic": self.elastic,
+            "loss_seed": self.loss_seed,
         }
         if self.max_topology is not None:
             out["max_topology"] = self.max_topology
+        if self.checkpoint_every is not None:
+            out["checkpoint_every"] = self.checkpoint_every
+        if self.checkpoint_write_s is not None:
+            out["checkpoint_write_s"] = self.checkpoint_write_s
+        if self.restart_s is not None:
+            out["restart_s"] = self.restart_s
         return out
 
 

@@ -158,10 +158,12 @@ class GlobeConfig:
     # farther apart in the zone list cost proportionally more
     dcn_base_s: float = 0.01
     intra_zone_s: float = 0.0005
+    # contractlint: ok(drift) -- execution strategy: ff-on vs ff-off reports must diff clean
     fast_forward: Optional[bool] = None
     # event-heap core (None -> resolve_event_core(), default on) —
     # an execution strategy like fast_forward: byte-identical on or
     # off, so it stays OUT of as_dict()
+    # contractlint: ok(drift) -- execution strategy: heap-core on vs off reports must diff clean
     event_core: Optional[bool] = None
 
     def cell_names(self) -> List[str]:
@@ -192,6 +194,7 @@ class GlobeConfig:
             "replicas_per_cell": self.replicas_per_cell,
             "policy": self.policy,
             "tick_s": resolve_tick_s(self.tick_s),
+            "max_virtual_s": self.max_virtual_s,
             "sim": dataclasses.asdict(self.sim),
             "slo": {k: v for k, v in
                     dataclasses.asdict(self.slo).items()
@@ -200,6 +203,8 @@ class GlobeConfig:
             "cell_pods": ([list(p) for p in self.cell_pods]
                           if self.cell_pods is not None else None),
             "autoscale": self.autoscale,
+            "autoscaler": (dataclasses.asdict(self.autoscaler)
+                           if self.autoscale else None),
             "frontdoor": self.frontdoor.as_dict(),
             "planner": (self.planner.as_dict()
                         if self.planner is not None else None),

@@ -163,17 +163,11 @@ def _check_containment(ctx: InvariantContext) -> Optional[str]:
         if "retry_budget" not in d or "counters" not in d:
             continue
         cfg = d.get("config", {})
-        # the report's config dict carries the retry burst but not
-        # the hedge burst; fall back to the dataclass defaults (no
-        # scenario overrides them — uncontrolled() zeroes the ratio,
-        # which skips the bucket check entirely)
-        from kind_tpu_sim.fleet import OverloadConfig
-
-        defaults = OverloadConfig()
-        retry_burst = cfg.get("retry_budget_burst",
-                              defaults.retry_budget_burst)
-        hedge_burst = cfg.get("hedge_budget_burst",
-                              defaults.hedge_budget_burst)
+        # OverloadConfig.as_dict serializes every field (contractlint
+        # `drift` holds it to that), so the bursts come straight from
+        # the report — a report without them is itself the bug
+        retry_burst = cfg["retry_budget_burst"]
+        hedge_burst = cfg["hedge_budget_burst"]
         spent = suppressed = 0
         disabled = False
         for origin in sorted(d["retry_budget"]):

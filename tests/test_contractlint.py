@@ -1,0 +1,503 @@
+"""Interface-contract sanitizer tests (contractlint, ISSUE 13).
+
+Four surfaces under test:
+
+* **rules** — every contractlint rule (unit / drift / lane) catches
+  its seeded fixture violation and stays silent on the clean
+  counterpart; the shared waiver machinery demands reasons and
+  rejects stale or unknown-rule waivers.
+* **package acceptance** — the shipped package itself lints CLEAN
+  (zero unwaived findings, every waiver carrying a reason) — the
+  gate CI enforces beside detlint's.
+* **registry bijections** — lane table, knob coverage, CLI flags,
+  report-schema registry: all empty-problem on the shipped tree,
+  and each diff direction detected on synthetic drift.
+* **regressions** — the true positives contractlint found on its
+  first whole-package run stay fixed: ``OverloadConfig.as_dict``
+  (the PR 12 ``hedge_budget_burst`` class, all seven fields),
+  ``FleetSchedConfig.replica_accelerator``, ``TrainingGangConfig``'s
+  perf-model fields, ``max_virtual_s``/``autoscaler`` on both sim
+  configs, and ``_check_containment`` reading bursts straight from
+  the report instead of dataclass defaults.
+"""
+
+import dataclasses
+import pathlib
+import textwrap
+
+import pytest
+
+from kind_tpu_sim.analysis import contractlint, lintcore
+
+pytestmark = pytest.mark.analysis
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def unwaived(src: str, path: str = "mod.py"):
+    return [f for f in contractlint.lint_source(
+        textwrap.dedent(src), path) if not f.waived]
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- unit rule --------------------------------------------------------
+
+
+def test_unit_mixed_addition_flagged():
+    fs = unwaived("""
+        def f(delay_s, width_ticks):
+            return delay_s + width_ticks
+    """)
+    assert rules_of(fs) == ["unit"]
+    assert "_s" in fs[0].message and "_ticks" in fs[0].message
+
+
+def test_unit_same_suffix_addition_clean():
+    assert unwaived("""
+        def f(a_s, b_s):
+            return a_s + b_s
+    """) == []
+
+
+def test_unit_multiplication_is_conversion_and_clean():
+    # mul/div are HOW conversions are written; only +/-/compare mix
+    assert unwaived("""
+        def f(n_ticks, tick_s):
+            return n_ticks * tick_s
+    """) == []
+
+
+def test_unit_comparison_flagged():
+    fs = unwaived("""
+        def f(deadline_s, budget_ms):
+            return deadline_s < budget_ms
+    """)
+    assert rules_of(fs) == ["unit"]
+
+
+def test_unit_ms_wins_over_s_suffix():
+    # longest-match: base_ms is milliseconds, not a `_s` identifier
+    assert unwaived("""
+        def f(base_ms, retry_ms):
+            return base_ms + retry_ms
+    """) == []
+
+
+def test_unit_keyword_argument_mismatch_flagged():
+    fs = unwaived("""
+        def f(g, width_ticks):
+            return g(timeout_s=width_ticks)
+    """)
+    assert rules_of(fs) == ["unit"]
+    assert "timeout_s" in fs[0].message
+
+
+def test_unit_keyword_argument_match_clean():
+    assert unwaived("""
+        def f(g, width_s):
+            return g(timeout_s=width_s)
+    """) == []
+
+
+def test_unit_unknown_side_clean():
+    # one unit-less operand: never flagged (best-effort, no guesses)
+    assert unwaived("""
+        def f(delay_s, x):
+            return delay_s + x
+    """) == []
+
+
+def test_unit_call_carries_callee_suffix():
+    fs = unwaived("""
+        def f(ov, n_ticks):
+            return ov.hedge_delay_s() + n_ticks
+    """)
+    assert rules_of(fs) == ["unit"]
+
+
+# -- drift rule -------------------------------------------------------
+
+_CONFIG_TEMPLATE = """
+    import dataclasses
+
+    @dataclasses.dataclass(frozen=True)
+    class DemoConfig:
+        alpha_s: float = 1.0
+        beta: int = 2
+
+        def as_dict(self):
+            return %s
+"""
+
+
+def test_drift_uncovered_field_flagged():
+    fs = unwaived(_CONFIG_TEMPLATE % '{"alpha_s": self.alpha_s}')
+    assert rules_of(fs) == ["drift"]
+    assert "DemoConfig.beta" in fs[0].message
+    # anchored at the FIELD's line so a per-field waiver can sit there
+    assert fs[0].line == 7
+
+
+def test_drift_all_fields_covered_clean():
+    assert unwaived(_CONFIG_TEMPLATE
+                    % '{"alpha_s": self.alpha_s, "beta": self.beta}'
+                    ) == []
+
+
+def test_drift_asdict_self_covers_everything():
+    assert unwaived(
+        _CONFIG_TEMPLATE % "dataclasses.asdict(self)") == []
+
+
+def test_drift_asdict_of_subconfig_covers_nothing():
+    # the bug the first implementation had: asdict(self.slo) must
+    # not count as full coverage of the OUTER config
+    fs = unwaived("""
+        import dataclasses
+
+        @dataclasses.dataclass(frozen=True)
+        class OuterConfig:
+            slo: object = None
+            gamma: int = 3
+
+            def as_dict(self):
+                return {"slo": dataclasses.asdict(self.slo)}
+    """)
+    assert rules_of(fs) == ["drift"]
+    assert "OuterConfig.gamma" in fs[0].message
+
+
+def test_drift_non_config_dataclass_skipped():
+    assert unwaived("""
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Sample:
+            hidden: int = 1
+
+            def as_dict(self):
+                return {}
+    """) == []
+
+
+def test_drift_config_without_as_dict_skipped():
+    assert unwaived("""
+        import dataclasses
+
+        @dataclasses.dataclass
+        class BareConfig:
+            hidden: int = 1
+    """) == []
+
+
+def test_drift_string_key_counts_as_coverage():
+    # conditional emission (`if self.x is not None: out["x"] = ...`)
+    # is coverage — presence of the key string is the contract
+    assert unwaived("""
+        import dataclasses
+
+        @dataclasses.dataclass
+        class CondConfig:
+            extra: object = None
+
+            def as_dict(self):
+                out = {}
+                if self.extra is not None:
+                    out["extra"] = 1
+                return out
+    """) == []
+
+
+# -- lane rule --------------------------------------------------------
+
+
+def test_lane_computed_lane_flagged():
+    fs = unwaived("""
+        def f(heap, t, i):
+            heap.push(t, i + 1, "payload")
+    """)
+    assert rules_of(fs) == ["lane"]
+    assert "computed" in fs[0].message
+
+
+def test_lane_unregistered_name_flagged():
+    fs = unwaived("""
+        LANE_BOGUS_SOURCE = object()
+        def f(heap, t):
+            heap.push(t, LANE_BOGUS_SOURCE, "payload")
+    """)
+    assert rules_of(fs) == ["lane"]
+    assert "LANE_BOGUS_SOURCE" in fs[0].message
+
+
+def test_lane_registered_constant_clean():
+    assert unwaived("""
+        from kind_tpu_sim.fleet.events import LANE_ARRIVAL
+        def f(heap, t):
+            heap.push(t, LANE_ARRIVAL, "payload")
+    """) == []
+
+
+def test_lane_two_arg_push_not_a_heap_push():
+    assert unwaived("""
+        def f(stack, item):
+            stack.push(item, 2)
+    """) == []
+
+
+def test_lane_redefinition_outside_events_flagged():
+    fs = unwaived("LANE_EXTRA = 7\n", path="kind_tpu_sim/globe/x.py")
+    assert rules_of(fs) == ["lane"]
+
+
+def test_lane_redefinition_in_events_home_allowed():
+    assert unwaived("LANE_ARRIVAL = 0\n",
+                    path="kind_tpu_sim/fleet/events.py") == []
+
+
+def test_lane_non_integer_lane_binding_is_bookkeeping():
+    # frozenset/tuple LANE_* aggregates are not redefinitions
+    assert unwaived(
+        "LANE_NAMES = frozenset(('LANE_ARRIVAL',))\n") == []
+
+
+# -- waiver machinery -------------------------------------------------
+
+
+def test_reasoned_waiver_suppresses():
+    fs = contractlint.lint_source(textwrap.dedent("""
+        def f(a_s, b_ticks):
+            return a_s + b_ticks  # contractlint: ok(unit) -- grid math, converted upstream
+    """), "mod.py")
+    assert [f for f in fs if not f.waived] == []
+    waived = [f for f in fs if f.waived]
+    assert len(waived) == 1
+    assert waived[0].waiver_reason.startswith("grid math")
+
+
+def test_waiver_on_line_above_covers_next_line():
+    fs = contractlint.lint_source(textwrap.dedent("""
+        def f(a_s, b_ticks):
+            # contractlint: ok(unit) -- grid math
+            return a_s + b_ticks
+    """), "mod.py")
+    assert [f for f in fs if not f.waived] == []
+
+
+def test_reasonless_waiver_is_a_finding():
+    fs = unwaived("""
+        def f(a_s, b_ticks):
+            return a_s + b_ticks  # contractlint: ok(unit)
+    """)
+    assert "waiver" in rules_of(fs)
+
+
+def test_stale_waiver_is_a_finding():
+    fs = unwaived("""
+        def f(a_s, b_s):
+            return a_s + b_s  # contractlint: ok(unit) -- nothing here to waive
+    """)
+    assert rules_of(fs) == ["waiver"]
+    assert "stale" in fs[0].message
+
+
+def test_unknown_rule_waiver_is_a_finding():
+    fs = unwaived("""
+        def f(a_s, b_ticks):
+            return a_s + b_ticks  # contractlint: ok(units) -- typo'd rule name
+    """)
+    assert "waiver" in rules_of(fs)
+
+
+def test_detlint_waiver_does_not_waive_contractlint():
+    fs = unwaived("""
+        def f(a_s, b_ticks):
+            return a_s + b_ticks  # detlint: ok(unit) -- wrong tool tag
+    """)
+    assert rules_of(fs) == ["unit"]
+
+
+# -- package acceptance -----------------------------------------------
+
+
+def test_package_lints_clean():
+    findings = contractlint.lint_paths(
+        [str(REPO / "kind_tpu_sim")])
+    bad = [f for f in findings if not f.waived]
+    assert bad == [], "\n".join(f.render() for f in bad)
+    for f in findings:
+        assert f.waiver_reason, f.render()
+
+
+def test_report_shape_is_sorted_and_json_stable():
+    findings = contractlint.lint_source(
+        "def f(a_s, b_ticks):\n    return a_s + b_ticks\n", "m.py")
+    rep = contractlint.report(findings, files=1)
+    assert rep["ok"] is False
+    assert rep["findings_by_rule"] == {"unit": 1}
+    assert rep["rules"] == list(contractlint.RULES)
+
+
+# -- registry bijections ----------------------------------------------
+
+
+def test_lane_order_bijection_holds():
+    assert contractlint.lane_order_problems() == []
+
+
+def test_lane_canonical_table_matches_events_module():
+    from kind_tpu_sim.fleet import events
+    for name, value in contractlint.CANONICAL_LANES:
+        assert getattr(events, name) == value
+    assert tuple(events.LANES) == tuple(
+        v for _, v in contractlint.CANONICAL_LANES)
+
+
+def test_knob_coverage_clean_on_shipped_tree():
+    assert contractlint.knob_coverage_problems(REPO) == []
+
+
+def test_cli_flags_bijection_clean_on_shipped_tree():
+    assert contractlint.cli_flag_problems(REPO) == []
+
+
+def test_cross_checks_all_clean():
+    checks = contractlint.cross_check_problems(REPO)
+    assert sorted(checks) == ["cli_flags", "fault_schemas",
+                              "knob_coverage", "lane_order",
+                              "scenario_registry"]
+    for family, problems in checks.items():
+        assert problems == [], (family, problems)
+
+
+def test_cross_checks_accept_str_root():
+    # library callers pass plain strings; the cross-checks must not
+    # require a pathlib.Path
+    assert contractlint.cli_flag_problems(str(REPO)) == []
+    assert contractlint.knob_coverage_problems(str(REPO)) == []
+
+
+# -- report schema ----------------------------------------------------
+
+
+def test_key_paths_collapse_dynamic_containers():
+    paths = contractlint._key_paths({
+        "zones": {"us-a": {"shed": 1}, "eu-b": {"shed": 2}},
+        "config": {"tick_s": 0.01},
+        "completions": [{"tokens": 3}],
+    })
+    assert paths == {"zones.*.shed", "config.tick_s",
+                     "completions.[].tokens"}
+
+
+def test_schema_problems_detect_both_directions():
+    have = {"fleet": ["a", "b"], "boards": {"x_board": ["k"]}}
+    want = {"fleet": ["a", "c"], "boards": {"x_board": ["k", "n"]}}
+    problems = contractlint.schema_problems(have, want)
+    text = "\n".join(problems)
+    assert "new report key 'c'" in text
+    assert "'b' vanished" in text
+    assert "new key 'n'" in text
+    assert "--write-schema" in text
+
+
+def test_schema_problems_empty_on_match():
+    schema = {"fleet": ["a"], "boards": {}}
+    assert contractlint.schema_problems(schema, schema) == []
+
+
+def test_board_counters_extracted_statically():
+    boards = contractlint.board_counter_keys(REPO)
+    assert "requests_routed" in boards["fleet_board"]
+    assert "gangs_scheduled" in boards["sched_board"]
+    assert "probes" in boards["health_board"]
+
+
+@pytest.mark.slow
+def test_checked_in_schema_matches_code():
+    # the CI gate: seeded calibration runs + static board extraction
+    # must reproduce kind_tpu_sim/analysis/report_schema.json exactly
+    assert contractlint.schema_problems(
+        contractlint.load_schema(),
+        contractlint.collect_report_schema(REPO)) == []
+
+
+# -- pinned regressions (first whole-package run's true positives) ----
+
+
+def test_overload_as_dict_serializes_every_field():
+    from kind_tpu_sim.fleet import OverloadConfig
+    cfg = OverloadConfig()
+    fields = {f.name for f in dataclasses.fields(cfg)}
+    assert set(cfg.as_dict()) == fields
+
+
+def test_overload_as_dict_hedge_budget_burst_round_trips():
+    from kind_tpu_sim.fleet import OverloadConfig
+    d = OverloadConfig(hedge_budget_burst=2.5).as_dict()
+    assert d["hedge_budget_burst"] == 2.5
+
+
+def test_fleet_sched_config_reports_replica_accelerator():
+    from kind_tpu_sim.fleet.sim import FleetSchedConfig
+    d = FleetSchedConfig(
+        replica_accelerator="tpu-v4-podslice").as_dict()
+    assert d["replica_accelerator"] == "tpu-v4-podslice"
+
+
+def test_training_gang_config_reports_perf_model():
+    from kind_tpu_sim.fleet import TrainingGangConfig
+    cfg = TrainingGangConfig(
+        name="g", step_compute_chip_s=0.2, allreduce_bytes=5e6,
+        loss_seed=9, checkpoint_every=7)
+    d = cfg.as_dict()
+    assert d["step_compute_chip_s"] == 0.2
+    assert d["allreduce_bytes"] == 5e6
+    assert d["loss_seed"] == 9
+    assert d["checkpoint_every"] == 7
+    # unset optionals stay out (no null keys in reports)
+    assert "restart_s" not in TrainingGangConfig(name="h").as_dict()
+
+
+def test_fleet_config_reports_backstop_and_autoscaler():
+    from kind_tpu_sim import fleet
+    off = fleet.FleetConfig().as_dict()
+    assert off["max_virtual_s"] == 600.0
+    assert "autoscaler" not in off
+    on = fleet.FleetConfig(autoscale=True).as_dict()
+    assert on["autoscaler"]["max_replicas"] == 8
+
+
+def test_globe_config_reports_backstop_and_autoscaler():
+    from kind_tpu_sim import globe
+    d = globe.GlobeConfig(autoscale=True).as_dict()
+    assert d["max_virtual_s"] == 600.0
+    assert d["autoscaler"]["min_replicas"] == 1
+    assert globe.GlobeConfig().as_dict()["autoscaler"] is None
+
+
+def test_containment_reads_bursts_from_report_not_defaults():
+    # PR 12's fallback hack read dataclass defaults when the report
+    # omitted the bursts; now the report always carries them and the
+    # invariant must judge against the REPORTED value
+    from kind_tpu_sim.scenarios import invariants
+
+    report = {
+        "config": {"retry_budget_burst": 1.0,
+                   "hedge_budget_burst": 0.0},
+        "counters": {"retries_scheduled": 3},
+        "retry_budget": {
+            "local": {"ratio": 0.5, "earned": 0, "spent": 3,
+                      "suppressed": 0},
+        },
+        "hedge_budget": {"ratio": 0.5, "earned": 0, "spent": 0,
+                         "suppressed": 0},
+    }
+    ctx = invariants.InvariantContext(None, report)
+    msg = invariants._check_containment(ctx)
+    # spent 3 > burst 1.0 + 0 earned: overspend judged against the
+    # report's burst (the old default of 10.0 would have passed it)
+    assert msg is not None and "overspent" in msg

@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""The typing gate: mypy (non-strict) over the analysis tooling and
+the event-heap core — the two places where a type confusion breaks a
+*contract checker* rather than a sim result, which is the one kind of
+bug the checkers cannot catch in themselves.
+
+Scope is deliberately narrow (``kind_tpu_sim/analysis/`` +
+``kind_tpu_sim/fleet/events.py``); widen it module-by-module as
+annotations land. Non-strict: ``--ignore-missing-imports`` because
+jax/numpy stubs are not guaranteed present, ``--follow-imports=silent``
+so the gate types only the named files, not the whole transitive
+package.
+
+When mypy is not installed (the dev container ships without it) the
+gate reports SKIPPED and exits 0 — CI installs mypy and runs the real
+check, so a laptop without it cannot mask a CI failure, only defer it.
+"""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+TARGETS = [
+    "kind_tpu_sim/analysis",
+    "kind_tpu_sim/fleet/events.py",
+]
+
+MYPY_ARGS = [
+    "--ignore-missing-imports",
+    "--follow-imports=silent",
+    "--no-error-summary",
+]
+
+
+def main() -> int:
+    if importlib.util.find_spec("mypy") is None:
+        print("typegate: mypy not installed — SKIPPED "
+              "(CI runs the real check)")
+        return 0
+    cmd = ([sys.executable, "-m", "mypy"] + MYPY_ARGS
+           + [str(REPO / t) for t in TARGETS])
+    proc = subprocess.run(cmd, cwd=str(REPO))
+    if proc.returncode == 0:
+        print(f"typegate: {len(TARGETS)} target(s) OK")
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
