@@ -2251,6 +2251,75 @@ def fleet_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def disagg_smoke() -> dict | None:
+    """Disaggregated-serving extras (docs/DISAGG.md): sweep P:D pool
+    ratios at a fixed total over a prefix-heavy trace (long prompts,
+    1-2 generated tokens) and a decode-heavy trace (short prompts,
+    long generations), both priced by the bench-calibrated cost
+    model. The headline observable is that the two traces pick
+    DIFFERENT optimal ratios (by e2e p50) — the economic argument
+    for phase-split pools — plus the per-phase analytic-vs-measured
+    calibration error the ≤15% test bound pins."""
+    try:
+        from kind_tpu_sim import fleet
+        from kind_tpu_sim import metrics as _metrics
+
+        ratios = ((1, 3), (2, 2), (3, 1))
+        workloads = {
+            "prefill_heavy": fleet.WorkloadSpec(
+                process="poisson", rps=2000.0, n_requests=300,
+                prompt_len=(512, 768), max_new=(1, 2)),
+            "decode_heavy": fleet.WorkloadSpec(
+                process="poisson", rps=800.0, n_requests=300,
+                prompt_len=(8, 16), max_new=(64, 96)),
+        }
+        t0 = time.monotonic()
+        board_before = _metrics.disagg_board().counts()
+        sweeps: dict = {}
+        best: dict = {}
+        for name, spec in workloads.items():
+            trace = fleet.generate_trace(spec, seed=11)
+            rows: dict = {}
+            for p, d in ratios:
+                rep = fleet.FleetSim(
+                    fleet.FleetConfig(
+                        replicas=p + d,
+                        policy="least-outstanding",
+                        disagg=fleet.DisaggConfig(
+                            prefill_replicas=p,
+                            decode_replicas=d),
+                        slo=fleet.SloPolicy(ttft_s=0.5,
+                                            e2e_s=2.0)),
+                    trace).run()
+                rows[f"{p}:{d}"] = {
+                    "ok": rep["ok"],
+                    "e2e_p50_s": rep["slo"]["e2e"].get("p50_s"),
+                    "ttft_p50_s": rep["slo"]["ttft"].get("p50_s"),
+                    "goodput_tok_s": rep["slo"].get(
+                        "goodput_tok_s"),
+                    "attainment": rep["slo"]["attainment"],
+                    "kv_handoffs": rep["disagg"]["kv"]["handoffs"],
+                }
+            sweeps[name] = rows
+            best[name] = min(
+                rows, key=lambda k: (rows[k]["e2e_p50_s"], k))
+        return {
+            "ok": (all(r["ok"] for rows in sweeps.values()
+                       for r in rows.values())
+                   and best["prefill_heavy"]
+                   != best["decode_heavy"]),
+            "seconds": round(time.monotonic() - t0, 3),
+            "ratios": [f"{p}:{d}" for p, d in ratios],
+            "sweeps": sweeps,
+            "best_ratio": best,
+            "calibration_error": fleet.CostModel().errors(),
+            "counters": _metrics.disagg_board().snapshot_since(
+                board_before),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def fleet_scale() -> dict | None:
     """The sim-speed headline (ROADMAP item 1, docs/PERFORMANCE.md
     "The event core"): a seeded 100k-request compressed diurnal day
@@ -3004,6 +3073,10 @@ def main(argv=None) -> int:
             overload_rep = overload_smoke()
         if overload_rep:
             phases["overload"] = overload_rep
+        with stopwatch("disagg"):
+            disagg_rep = disagg_smoke()
+        if disagg_rep:
+            phases["disagg"] = disagg_rep
         with stopwatch("train"):
             train_rep = train_smoke()
         if train_rep:
@@ -3070,6 +3143,10 @@ def main(argv=None) -> int:
     sd = phases.get("sched")
     if isinstance(sd, dict):
         compact_extra["sched_ok"] = sd.get("ok")
+    dg = phases.get("disagg")
+    if isinstance(dg, dict):
+        compact_extra["disagg_ok"] = dg.get("ok")
+        compact_extra["disagg_best_ratio"] = dg.get("best_ratio")
     emit_result(out, out_path, compact_extra)
     return 0
 

@@ -14,8 +14,9 @@ walks the AST of the whole package and flags the *class*:
 ``unit``           mixed-unit arithmetic, comparison, or keyword
                    argument passing between identifiers carrying
                    different unit suffixes (``_s``, ``_ms``,
-                   ``_ticks``, ``_frac``, ``_bytes``, ``_tok``,
-                   ``_gbps``). ``a_s + b_ticks`` is a bug even when
+                   ``_ticks``, ``_frac``, ``_bytes``, ``_mb``,
+                   ``_tok``, ``_gbps``). ``a_s + b_ticks`` is a bug
+                   even when
                    both are floats; multiplication and division are
                    exempt (that's how conversions are written).
 ``drift``          a ``*Config`` dataclass field that its own
@@ -73,7 +74,8 @@ RULES: Tuple[str, ...] = ("unit", "drift", "lane", "waiver")
 
 # Longest-match-first: `_ms` must win over `_s`, `_ticks` over `_s`.
 UNIT_SUFFIXES: Tuple[str, ...] = (
-    "_ticks", "_bytes", "_gbps", "_frac", "_tok", "_ms", "_s",
+    "_ticks", "_bytes", "_gbps", "_frac", "_tok", "_ms", "_mb",
+    "_s",
 )
 
 
@@ -117,6 +119,7 @@ CANONICAL_LANES: Tuple[Tuple[str, int], ...] = (
     ("LANE_HEALTH_PROBE", 3),
     ("LANE_AUTOSCALER", 4),
     ("LANE_PLANNER", 5),
+    ("LANE_KV_TRANSFER", 6),
 )
 LANE_NAMES = frozenset(name for name, _ in CANONICAL_LANES)
 
@@ -358,7 +361,7 @@ def lane_order_problems() -> List[str]:
             problems.append(
                 f"{name} is {have}, canonical order says {value} "
                 "(arrival < completion < chaos < probe < "
-                "autoscaler < planner)")
+                "autoscaler < planner < kv-transfer)")
     lanes = getattr(events, "LANES", ())
     want = tuple(v for _, v in CANONICAL_LANES)
     if tuple(lanes) != want:
@@ -604,9 +607,23 @@ def collect_report_schema(
     globe_report = globe.GlobeSim(
         gcfg, globe.generate_globe_traces(gcfg, 5)).run()
 
+    # disagg keys (pools / kv / calibration / itl / router kv lane)
+    # only exist on a phase-split fleet, which excludes sched — so
+    # they get their own pinned run instead of riding the main one
+    dspec = fleet.WorkloadSpec(
+        process="poisson", rps=40.0, n_requests=40)
+    dcfg = fleet.FleetConfig(
+        replicas=4, policy="least-outstanding", autoscale=True,
+        overload=fleet.OverloadConfig(),
+        disagg=fleet.DisaggConfig(prefill_replicas=2,
+                                  decode_replicas=2))
+    disagg_report = fleet.FleetSim(
+        dcfg, fleet.generate_trace(dspec, 7)).run()
+
     return {
         "boards": board_counter_keys(root),
         "fleet": sorted(_key_paths(fleet_report)),
+        "fleet_disagg": sorted(_key_paths(disagg_report)),
         "globe": sorted(_key_paths(globe_report)),
     }
 

@@ -148,6 +148,23 @@ FLEET_EVENT_CORE = _register(
     "the tick boundaries where an event lands (replay-identical); "
     "`0` forces the plain per-tick loop.")
 
+# disaggregated prefill/decode serving (docs/DISAGG.md)
+DISAGG_TIER = _register(
+    "KIND_TPU_SIM_DISAGG_TIER", "ici", "str", "disagg",
+    "Default fabric the prefill->decode KV-cache handoff crosses: "
+    "`ici` (same-pod interconnect) or `dcn` (cross-pod network); "
+    "bandwidths come from the collectives tier table.")
+DISAGG_DTYPE = _register(
+    "KIND_TPU_SIM_DISAGG_DTYPE", "bf16", "str", "disagg",
+    "Default decode arithmetic (`bf16` or `int8`) for calibrated "
+    "disagg replicas — picks the decode roofline point and the "
+    "KV-cache bytes-per-token.")
+CALIBRATION = _register(
+    "KIND_TPU_SIM_CALIBRATION", None, "str", "disagg",
+    "Path to a cost-model calibration file (default: the checked-in "
+    "`kind_tpu_sim/fleet/calibration/r05.json`); regenerate with "
+    "`kind-tpu-sim fleet calibrate`.")
+
 # sched (docs/SCHED.md)
 SCHED_SEED = _register(
     "KIND_TPU_SIM_SCHED_SEED", 0, "int", "sched",
@@ -272,9 +289,9 @@ BENCH_SLOW = _register(
 
 # Display order of layers in docs/KNOBS.md — pipeline order, not
 # alphabetical, so the page reads like the architecture diagram.
-LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "sched",
-               "train", "globe", "overload", "health", "fuzz",
-               "bench")
+LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "disagg",
+               "sched", "train", "globe", "overload", "health",
+               "fuzz", "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -283,6 +300,7 @@ LAYER_DOCS = {
     "parallel": "PERFORMANCE.md",
     "chaos": "CHAOS.md",
     "fleet": "FLEET.md",
+    "disagg": "DISAGG.md",
     "sched": "SCHED.md",
     "train": "TRAINING.md",
     "globe": "GLOBE.md",

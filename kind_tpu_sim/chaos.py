@@ -88,6 +88,10 @@ FAULT_KINDS = (
     # gangs — the checkpoint-economics levers
     "train_preempt",     # graceful gang preemption (guard semantics)
     "train_kill",        # hard gang kill: no grace, rollback to ckpt
+    # disaggregated serving (docs/DISAGG.md): faults aimed at the
+    # phase split itself — the pool boundary and the KV link
+    "prefill_pool_loss",   # every prefill replica preempted at once
+    "kv_transfer_degrade",  # KV link at param x nominal bandwidth
 )
 
 
@@ -214,6 +218,13 @@ FAULT_SCHEMAS: Dict[str, FaultSchema] = {s.kind: s for s in (
                 needs=("sched", "training"), fuzzable=True),
     FaultSchema("train_kill", "train", scopes=("fleet",),
                 needs=("sched", "training"), fuzzable=True),
+    FaultSchema("prefill_pool_loss", "fleet", scopes=("fleet",),
+                needs=("disagg",), fuzzable=True, exclusive=True),
+    FaultSchema("kv_transfer_degrade", "fleet",
+                param=("uniform", 0.08, 0.25),
+                param_doc="KV-transfer link bandwidth factor",
+                scopes=("fleet",), needs=("disagg",),
+                fuzzable=True),
 )}
 
 
@@ -803,6 +814,73 @@ def _scenario_fleet_flaky_replica(seed: int) -> dict:
         "tail_attainment_clean": tail_clean,
         "tail_attainment_faulted": tail_faulted,
         "ok": bool(faulted["ok"] and clean["ok"]
+                   and tokens(faulted) == tokens(clean)
+                   and recovered),
+    }
+
+
+@_scenario("disagg-pool-loss",
+           "a disaggregated fleet loses its whole prefill pool "
+           "mid-traffic, then its KV link degrades; the decode pool "
+           "keeps finishing already-prefilled work through the "
+           "outage, zero requests are lost, and post-heal SLO "
+           "attainment recovers to baseline")
+def _scenario_disagg_pool_loss(seed: int) -> dict:
+    from kind_tpu_sim import fleet
+
+    plan = ChaosSchedule(seed).plan(kinds=("kv_transfer_degrade",),
+                                    n_faults=1, horizon=8, targets=1)
+    factor = plan.events[0].param
+    spec = fleet.WorkloadSpec(process="poisson", rps=120.0,
+                              n_requests=100, prompt_len=(8, 24),
+                              max_new=(8, 16))
+    trace = fleet.generate_trace(spec, seed)
+    dis = fleet.DisaggConfig(prefill_replicas=2, decode_replicas=2)
+    fc = fleet.FleetConfig(replicas=4, policy="least-outstanding",
+                           tick_s=0.01, disagg=dis,
+                           slo=fleet.SloPolicy(ttft_s=1.0,
+                                               e2e_s=5.0))
+    clean = fleet.FleetSim(fc, trace).run()
+    span = clean["virtual_s"]
+    loss = round(span * 0.3, 6)
+    heal = round(span * 0.45, 6)
+    last_restore = round(span * 0.65, 6)
+    events = [
+        fleet.ChaosEvent(at_s=loss, action="prefill_pool_loss",
+                         target=0),
+        fleet.ChaosEvent(at_s=heal, action="prefill_pool_restore",
+                         target=0),
+        fleet.ChaosEvent(at_s=round(span * 0.5, 6),
+                         action="kv_degrade", target=0,
+                         param=factor),
+        fleet.ChaosEvent(at_s=last_restore, action="kv_restore",
+                         target=0),
+    ]
+    faulted = fleet.FleetSim(fc, trace, chaos_events=events).run()
+    # the disagg claim: requests whose KV crossed before the loss
+    # keep FINISHING inside the outage — a unified fleet at the same
+    # loss fraction would stall them behind the re-prefill queue
+    survivors = sum(1 for e in faulted["completions"]
+                    if loss <= e["finish_s"] < heal
+                    and e["finish_reason"] == "length")
+    tokens = lambda rep: sum(e["tokens"] for e in rep["completions"])  # noqa: E731
+    tail_clean = fleet.attainment_over(clean["completions"],
+                                       last_restore)
+    tail_faulted = fleet.attainment_over(faulted["completions"],
+                                         last_restore)
+    recovered = (tail_clean is None or tail_faulted is None
+                 or tail_faulted >= tail_clean)
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(trace),
+        "kv_factor": factor,
+        "decode_survivors": survivors,
+        "requeues": faulted["router"]["requeues"],
+        "kv": faulted["disagg"]["kv"],
+        "tail_attainment_clean": tail_clean,
+        "tail_attainment_faulted": tail_faulted,
+        "ok": bool(faulted["ok"] and clean["ok"]
+                   and survivors > 0
                    and tokens(faulted) == tokens(clean)
                    and recovered),
     }

@@ -271,7 +271,7 @@ def build_parser() -> argparse.ArgumentParser:
             "same seed, byte-identical report (docs/FLEET.md)"
         ),
     )
-    fl.add_argument("action", choices=["run", "trace"])
+    fl.add_argument("action", choices=["run", "trace", "calibrate"])
     fl.add_argument(
         "--seed", type=int, default=None,
         help="workload seed (default: KIND_TPU_SIM_FLEET_SEED or 0)")
@@ -344,6 +344,34 @@ def build_parser() -> argparse.ArgumentParser:
              "run at batch priority -10 with checkpointed "
              "preemption and a zero-lost-step progress ledger; "
              "the report gains a 'training' section")
+    fl.add_argument(
+        "--disagg", default=None, metavar="P:D",
+        help="split the fleet into phase pools (docs/DISAGG.md): P "
+             "prefill replicas feed D decode replicas over a "
+             "modeled KV transfer; replaces --replicas with P+D "
+             "and prices both pools off the bench calibration")
+    fl.add_argument(
+        "--disagg-tier", default=None, choices=["ici", "dcn"],
+        help="KV-transfer interconnect tier (default: "
+             "KIND_TPU_SIM_DISAGG_TIER or ici)")
+    fl.add_argument(
+        "--disagg-dtype", default=None, choices=["bf16", "int8"],
+        help="KV-cache dtype pricing the transfer and decode "
+             "bandwidth (default: KIND_TPU_SIM_DISAGG_DTYPE or "
+             "bf16)")
+    fl.add_argument(
+        "--calibration", default=None, metavar="PATH",
+        help="calibration JSON for the analytic cost model "
+             "(default: KIND_TPU_SIM_CALIBRATION or the checked-in "
+             "r05.json)")
+    fl.add_argument(
+        "--bench", default=None, metavar="PATH",
+        help="`fleet calibrate` input: a BENCH_LOCAL_*.json bench "
+             "artifact with the serving roofline block")
+    fl.add_argument(
+        "--itl-slo", type=float, default=None,
+        help="inter-token latency target (virtual s) — the decode "
+             "pool's autoscaling signal under --disagg")
     fl.add_argument(
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
@@ -961,6 +989,37 @@ def _fleet_training_config(args: argparse.Namespace):
         for i in range(args.train)))
 
 
+def _fleet_calibrate(args: argparse.Namespace) -> int:
+    """`fleet calibrate --bench BENCH_LOCAL_*.json [--out PATH]`:
+    regenerate the analytic cost-model calibration (docs/DISAGG.md)
+    from a bench artifact. Fails loudly when the bench lacks the
+    serving roofline keys; prints the per-phase analytic-vs-measured
+    error so regressions are visible at generation time."""
+    from kind_tpu_sim.fleet import costmodel
+
+    if not args.bench:
+        raise SystemExit(
+            "fleet calibrate requires --bench "
+            "BENCH_LOCAL_<host>.json (a `bench local` artifact "
+            "with the serving roofline block)")
+    with open(args.bench, encoding="utf-8") as fh:
+        bench = json.load(fh)
+    cal = costmodel.calibrate(bench)
+    out_path = args.out or str(costmodel.DEFAULT_CALIBRATION)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(cal, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    errors = costmodel.CostModel(cal).errors()
+    if args.as_json:
+        print(json.dumps(cal, sort_keys=True))
+    else:
+        print(f"calibration: {cal['model']} on {cal['chip']} "
+              f"(schema {cal['schema']}) -> {out_path}")
+        for phase in sorted(errors):
+            print(f"  {phase}: error_frac {errors[phase]}")
+    return 0 if max(errors.values()) <= 0.15 else 1
+
+
 def run_fleet(args: argparse.Namespace) -> int:
     """`fleet run` / `fleet trace`: the deterministic multi-replica
     serving simulator (docs/FLEET.md). Everything advances on a
@@ -969,6 +1028,8 @@ def run_fleet(args: argparse.Namespace) -> int:
     contract `--seed` promises."""
     from kind_tpu_sim import fleet
 
+    if args.action == "calibrate":
+        return _fleet_calibrate(args)
     seed = fleet.resolve_seed(args.seed)
     spec = fleet.WorkloadSpec(
         process=args.process, rps=args.rps,
@@ -991,14 +1052,37 @@ def run_fleet(args: argparse.Namespace) -> int:
                   f"{args.save_trace}")
         return 0
 
+    disagg = None
+    replicas = args.replicas
+    if args.disagg:
+        if args.sched:
+            raise SystemExit(
+                "--disagg is incompatible with --sched (phased "
+                "pools pin their own placements)")
+        if args.engine == "serving":
+            raise SystemExit(
+                "--disagg needs the analytic sim engine (serving "
+                "replicas have no phase split yet)")
+        if args.calibration:
+            import os
+
+            from kind_tpu_sim.analysis import knobs
+
+            os.environ[knobs.CALIBRATION] = args.calibration
+        disagg = fleet.DisaggConfig.parse(
+            args.disagg, tier=args.disagg_tier,
+            dtype=args.disagg_dtype)
+        replicas = (disagg.prefill_replicas
+                    + disagg.decode_replicas)
     fc = fleet.FleetConfig(
-        replicas=args.replicas, policy=args.policy,
+        replicas=replicas, policy=args.policy,
         tick_s=args.tick_s, autoscale=args.autoscale,
         eval_every_s=args.eval_every_s,
         slo=fleet.SloPolicy(ttft_s=args.ttft_slo,
-                            e2e_s=args.e2e_slo),
+                            e2e_s=args.e2e_slo,
+                            itl_s=args.itl_slo),
         autoscaler=fleet.AutoscalerConfig(
-            min_replicas=args.replicas,
+            min_replicas=replicas,
             max_replicas=args.max_replicas),
         sched=(fleet.FleetSchedConfig(policy=args.sched_policy)
                if args.sched else None),
@@ -1007,6 +1091,7 @@ def run_fleet(args: argparse.Namespace) -> int:
         overload=(fleet.OverloadConfig()
                   if args.overload else None),
         training=_fleet_training_config(args),
+        disagg=disagg,
         event_core=(False if args.no_event_core else None))
     clock = fleet.VirtualClock()
     factory = None
@@ -1051,8 +1136,20 @@ def run_fleet(args: argparse.Namespace) -> int:
     else:
         slo = report["slo"]
         print(f"fleet: {report['requests']} requests, "
-              f"{args.policy} over {args.replicas} replica(s), "
+              f"{args.policy} over {replicas} replica(s), "
               f"seed {seed}, engine {args.engine}")
+        if "disagg" in report:
+            d = report["disagg"]
+            kv = d["kv"]
+            errs = d["calibration_errors"]
+            worst = max(errs.values()) if errs else None
+            print(f"  disagg: {d['config']['prefill_replicas']}P:"
+                  f"{d['config']['decode_replicas']}D "
+                  f"({d['config']['dtype']}, {kv['tier']})  "
+                  f"kv handoffs {kv['handoffs']}  "
+                  f"{kv['bytes_total']} B in "
+                  f"{kv['transfer_s_total']}s  "
+                  f"worst calibration error {worst}")
         print(f"  attainment {slo['attainment']}  "
               f"goodput {slo.get('goodput_tok_s')} tok/s  "
               f"throughput {slo.get('throughput_tok_s')} tok/s")

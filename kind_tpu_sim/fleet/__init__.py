@@ -26,12 +26,29 @@ from kind_tpu_sim.fleet.autoscaler import (  # noqa: F401
     ScaleEvent,
     resolve_warmup_s,
 )
+from kind_tpu_sim.fleet.costmodel import (  # noqa: F401
+    CostModel,
+    RequestCost,
+    calibrate,
+    kv_bytes_per_token,
+    load_calibration,
+    parse_geometry,
+)
+from kind_tpu_sim.fleet.disagg import (  # noqa: F401
+    DisaggConfig,
+    KvHandoff,
+    calibrated_sim_config,
+    kv_transfer_s,
+    resolve_dtype,
+    resolve_tier,
+)
 from kind_tpu_sim.fleet.events import (  # noqa: F401
     LANE_ARRIVAL,
     LANE_AUTOSCALER,
     LANE_CHAOS,
     LANE_COMPLETION,
     LANE_HEALTH_PROBE,
+    LANE_KV_TRANSFER,
     LANE_PLANNER,
     LANES,
     DueSet,

@@ -1,0 +1,271 @@
+"""Roofline-style analytic serving cost model (docs/DISAGG.md).
+
+Prices the two serving phases from first principles, anchored to the
+measured r05 bench artifacts instead of hand-tuned constants:
+
+* **prefill** is compute-bound: the whole prompt runs through one
+  forward pass, so prefill time is ``prompt_tokens`` over the
+  MFU-capped forward rate (r05 measured 132k fwd tok/s at 58.6% MFU
+  on v5e; the end-to-end serving prefill rate of 120k tok/s sits
+  ~10% below it — sampling + host overhead — and that gap IS the
+  reported prefill calibration error).
+* **decode** is HBM-byte-bound: every generated token re-reads the
+  weights plus the request's KV cache, so step time is
+  ``weight_bytes / batch + kv_bytes(context)`` over the achieved
+  HBM bandwidth (r05: 728 GB/s bf16 / 793 GB/s int8 against the
+  819 GB/s roof — 89% / 97% of roofline).
+
+The anchor numbers live in a checked-in calibration file
+(``kind_tpu_sim/fleet/calibration/r05.json``), regenerated from any
+``BENCH_LOCAL_*.json`` with :func:`calibrate` (the `fleet calibrate`
+CLI). Per-phase analytic-vs-measured error is computed at calibration
+time and pinned ≤15% by the test suite, so a model change that walks
+away from the measurement fails loudly.
+
+Everything here is pure float arithmetic over the calibration dict —
+no clocks, no entropy — so any simulation built on a CostModel stays
+replay-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import re
+from typing import Dict, Optional
+
+from kind_tpu_sim.analysis import knobs
+
+CALIBRATION_ENV = knobs.CALIBRATION
+
+# The pinned calibration-file schema (bump on any shape change; the
+# loader refuses mismatches so a stale file cannot silently misprice).
+CALIBRATION_SCHEMA = 1
+
+DEFAULT_CALIBRATION = (pathlib.Path(__file__).parent
+                       / "calibration" / "r05.json")
+
+DTYPES = ("bf16", "int8")
+DTYPE_BYTES = {"bf16": 2, "int8": 1}
+
+# BENCH_LOCAL_*.json `/model/*` keys calibrate() refuses to run
+# without — a bench round that dropped its roofline sweep cannot
+# produce a calibration file by accident.
+REQUIRED_MODEL_KEYS = (
+    "backend", "chip", "decode_roofline", "decode_tokens_per_s",
+    "decode_int8_roofline", "decode_int8_tokens_per_s",
+    "fwd_tokens_per_s", "model", "prefill_tokens_per_s", "serving",
+)
+REQUIRED_ROOFLINE_KEYS = (
+    "achieved_gbps", "bytes_per_step_mb", "kv_mb", "roof_gbps",
+    "weight_mb",
+)
+
+_GEOMETRY_RE = re.compile(r"^d(\d+)xL(\d+)(?:-gqa(\d+))?$")
+
+
+def parse_geometry(model: str) -> Dict[str, int]:
+    """Decode the bench model string (``d2048xL8-gqa4``) into the
+    dimensions the KV-cache size depends on."""
+    m = _GEOMETRY_RE.match(model)
+    if m is None:
+        raise ValueError(
+            f"unparseable model geometry {model!r} (expected "
+            "d<d_model>xL<layers>[-gqa<group>])")
+    return {
+        "d_model": int(m.group(1)),
+        "layers": int(m.group(2)),
+        "gqa": int(m.group(3) or 1),
+    }
+
+
+def kv_bytes_per_token(geometry: Dict[str, int], dtype: str) -> int:
+    """KV-cache bytes one context token occupies: K and V, every
+    layer, at the grouped-query head width."""
+    if dtype not in DTYPE_BYTES:
+        raise ValueError(
+            f"unknown dtype {dtype!r}; known: {', '.join(DTYPES)}")
+    return (2 * geometry["layers"]
+            * (geometry["d_model"] // geometry["gqa"])
+            * DTYPE_BYTES[dtype])
+
+
+def _error_frac(analytic: float, measured: float) -> float:
+    return round(abs(analytic - measured) / measured, 6)
+
+
+def calibrate(bench: dict) -> dict:
+    """Derive the calibration file contents from one
+    ``BENCH_LOCAL_*.json`` report. Fails loudly (ValueError naming
+    every absent key) when the bench round lacks the roofline
+    sweeps — a partial bench must not recalibrate the fleet."""
+    model = bench.get("model")
+    if not isinstance(model, dict):
+        raise ValueError(
+            "bench report has no top-level 'model' block — not a "
+            "BENCH_LOCAL_*.json roofline round")
+    missing = [k for k in REQUIRED_MODEL_KEYS if k not in model]
+    for roof_key in ("decode_roofline", "decode_int8_roofline"):
+        roof = model.get(roof_key)
+        if isinstance(roof, dict):
+            missing.extend(f"{roof_key}.{k}"
+                           for k in REQUIRED_ROOFLINE_KEYS
+                           if k not in roof)
+    if missing:
+        raise ValueError(
+            "bench model block is missing roofline key(s): "
+            + ", ".join(sorted(missing)))
+    slots = int(model["serving"].get("slots", 1))
+    geometry = parse_geometry(model["model"])
+
+    # prefill: the analytic roof is the pure forward pass (what the
+    # compute roofline prices); the measured serving prefill rate
+    # sits below it by the sampling/host overhead the model omits.
+    fwd = float(model["fwd_tokens_per_s"])
+    prefill_measured = float(model["prefill_tokens_per_s"])
+
+    decode: Dict[str, dict] = {}
+    for dtype, roof_key, rate_key in (
+            ("bf16", "decode_roofline", "decode_tokens_per_s"),
+            ("int8", "decode_int8_roofline",
+             "decode_int8_tokens_per_s")):
+        roof = model[roof_key]
+        measured = float(model[rate_key])
+        # bytes/step is the whole batch's read set; one step emits
+        # one token per slot, so the analytic aggregate rate is
+        # slots x achieved bytes/s over bytes/step
+        analytic = (slots * float(roof["achieved_gbps"]) * 1e9
+                    / (float(roof["bytes_per_step_mb"]) * 1e6))
+        decode[dtype] = {
+            "achieved_gbps": float(roof["achieved_gbps"]),
+            "analytic_tokens_per_s": round(analytic, 3),
+            "bytes_per_step_mb": float(roof["bytes_per_step_mb"]),
+            "error_frac": _error_frac(analytic, measured),
+            "kv_mb": float(roof["kv_mb"]),
+            "measured_tokens_per_s": measured,
+            "roof_gbps": float(roof["roof_gbps"]),
+            "weight_mb": float(roof["weight_mb"]),
+        }
+
+    return {
+        "schema": CALIBRATION_SCHEMA,
+        "backend": str(model["backend"]),
+        "chip": str(model["chip"]),
+        "model": str(model["model"]),
+        "geometry": geometry,
+        "slots": slots,
+        "prefill": {
+            "analytic_tokens_per_s": fwd,
+            "measured_tokens_per_s": prefill_measured,
+            "error_frac": _error_frac(fwd, prefill_measured),
+        },
+        "decode": decode,
+    }
+
+
+def load_calibration(path: Optional[str] = None) -> dict:
+    """Load a calibration file: explicit path > the
+    ``KIND_TPU_SIM_CALIBRATION`` knob > the checked-in r05 file."""
+    if path is None:
+        path = knobs.get(CALIBRATION_ENV)
+    if path is None:
+        path = str(DEFAULT_CALIBRATION)
+    with open(path, encoding="utf-8") as fh:
+        cal = json.load(fh)
+    if cal.get("schema") != CALIBRATION_SCHEMA:
+        raise ValueError(
+            f"calibration file {path} has schema "
+            f"{cal.get('schema')!r}; this build expects "
+            f"{CALIBRATION_SCHEMA} — regenerate with "
+            "`kind-tpu-sim fleet calibrate`")
+    return cal
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestCost:
+    """One request priced end to end (virtual seconds + the KV bytes
+    a disaggregated handoff would ship)."""
+
+    prefill_s: float
+    decode_s: float
+    kv_bytes: int
+
+    @property
+    def total_s(self) -> float:
+        return self.prefill_s + self.decode_s
+
+    def as_dict(self) -> dict:
+        return {
+            "prefill_s": round(self.prefill_s, 9),
+            "decode_s": round(self.decode_s, 9),
+            "kv_bytes": self.kv_bytes,
+            "total_s": round(self.total_s, 9),
+        }
+
+
+class CostModel:
+    """Analytic per-request pricing over one calibration dict. The
+    public methods are all pure functions of their arguments, and
+    prefill time is monotone in prompt tokens / decode time monotone
+    in KV bytes by construction (the property tests pin both)."""
+
+    def __init__(self, calibration: Optional[dict] = None):
+        self.cal = (calibration if calibration is not None
+                    else load_calibration())
+        self.geometry = self.cal["geometry"]
+
+    def kv_bytes(self, prompt_tokens: int,
+                 dtype: str = "bf16") -> int:
+        """The KV cache a prefilled prompt occupies — what a
+        prefill->decode handoff ships over ICI/DCN."""
+        return (max(0, int(prompt_tokens))
+                * kv_bytes_per_token(self.geometry, dtype))
+
+    def prefill_s(self, prompt_tokens: int, batch: int = 1,
+                  dtype: str = "bf16") -> float:
+        """Compute-bound: tokens over the MFU-capped forward rate.
+        Batching doesn't change aggregate prefill throughput (the
+        pass is already compute-saturated), so per-request time is
+        batch-independent; dtype rides the same systolic path."""
+        del batch, dtype
+        rate = float(self.cal["prefill"]["analytic_tokens_per_s"])
+        return max(0, int(prompt_tokens)) / rate
+
+    def decode_step_s(self, context_tokens: int, batch: int = 1,
+                      dtype: str = "bf16") -> float:
+        """Byte-bound: one token per slot costs the weight read
+        (amortized over the batch) plus this request's KV read,
+        over the achieved HBM bandwidth."""
+        d = self.cal["decode"][dtype]
+        step_bytes = (d["weight_mb"] * 1e6 / max(1, batch)
+                      + self.kv_bytes(context_tokens, dtype))
+        return step_bytes / (d["achieved_gbps"] * 1e9)
+
+    def decode_s(self, gen_tokens: int, context_tokens: int,
+                 batch: int = 1, dtype: str = "bf16") -> float:
+        """Whole-generation decode time at a fixed context (the KV
+        growth over a short generation is second-order against the
+        weight read; the monotonicity properties hold either way)."""
+        return (max(0, int(gen_tokens))
+                * self.decode_step_s(context_tokens, batch=batch,
+                                     dtype=dtype))
+
+    def request_cost(self, prompt_tokens: int, gen_tokens: int,
+                     batch: int = 1,
+                     dtype: str = "bf16") -> RequestCost:
+        return RequestCost(
+            prefill_s=self.prefill_s(prompt_tokens, batch=batch,
+                                     dtype=dtype),
+            decode_s=self.decode_s(gen_tokens, prompt_tokens,
+                                   batch=batch, dtype=dtype),
+            kv_bytes=self.kv_bytes(prompt_tokens, dtype))
+
+    def errors(self) -> Dict[str, float]:
+        """Per-phase analytic-vs-measured error fractions on the
+        calibration points — the bench extra the ≤15% bound pins."""
+        return {
+            "prefill": self.cal["prefill"]["error_frac"],
+            "decode_bf16": self.cal["decode"]["bf16"]["error_frac"],
+            "decode_int8": self.cal["decode"]["int8"]["error_frac"],
+        }

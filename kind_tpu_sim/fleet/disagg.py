@@ -1,0 +1,209 @@
+"""Disaggregated prefill/decode serving (docs/DISAGG.md).
+
+The fleet's replicas grow a *phase* role: a ``prefill`` replica runs
+prompts through prefill only and hands the finished request — really
+its KV cache — to a ``decode`` replica over a modeled interconnect
+transfer; a ``decode`` replica runs token generation only; the default
+``unified`` role is the pre-existing monolithic engine, byte-identical
+to every historical replay. This mirrors the production TPU serving
+architecture (PAPERS.md: separately scaled prefill and decode pools
+with KV-cache handoff) at fleet-sim granularity.
+
+Three pieces live here:
+
+* :class:`DisaggConfig` — declares the pool split (``P:D``), the
+  KV-transfer interconnect tier (``ici`` intra-cell / ``dcn``
+  cross-cell, priced off ``parallel.collectives.TIER_LINK_GBPS``),
+  and the serving dtype (``bf16`` / ``int8`` — int8 halves both the
+  decode byte roof and the shipped KV bytes, as r05 measured).
+* :class:`KvHandoff` — the unit of work in flight between pools: the
+  original request plus its prefill outcome (dispatch/first-token
+  stamps, KV bytes). It duck-types the ``TraceRequest`` fields the
+  router needs so decode-pool placement reuses the same machinery.
+* :func:`calibrated_sim_config` — derives a ``SimReplicaConfig`` from
+  a :mod:`costmodel` calibration file, so the analytic replicas price
+  prefill and decode at the measured r05 rates instead of the
+  hand-tuned defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import ClassVar, Optional, Tuple
+
+from kind_tpu_sim.analysis import knobs
+from kind_tpu_sim.fleet.loadgen import TraceRequest
+from kind_tpu_sim.parallel.collectives import TIER_LINK_GBPS
+
+PHASES = ("prefill", "decode", "unified")
+KV_TIERS = tuple(sorted(TIER_LINK_GBPS))
+
+DISAGG_TIER_ENV = knobs.DISAGG_TIER
+DISAGG_DTYPE_ENV = knobs.DISAGG_DTYPE
+
+
+def resolve_tier(value: Optional[str] = None) -> str:
+    """Explicit value > env (KIND_TPU_SIM_DISAGG_TIER) > ici."""
+    tier = value if value is not None else knobs.get(DISAGG_TIER_ENV)
+    if tier not in TIER_LINK_GBPS:
+        raise ValueError(
+            f"unknown KV-transfer tier {tier!r}; known: "
+            f"{', '.join(KV_TIERS)}")
+    return tier
+
+
+def resolve_dtype(value: Optional[str] = None) -> str:
+    """Explicit value > env (KIND_TPU_SIM_DISAGG_DTYPE) > bf16."""
+    from kind_tpu_sim.fleet.costmodel import DTYPE_BYTES, DTYPES
+
+    dtype = (value if value is not None
+             else knobs.get(DISAGG_DTYPE_ENV))
+    if dtype not in DTYPE_BYTES:
+        raise ValueError(
+            f"unknown serving dtype {dtype!r}; known: "
+            f"{', '.join(DTYPES)}")
+    return dtype
+
+
+def kv_transfer_s(kv_bytes: int, tier: str,
+                  factor: float = 1.0) -> float:
+    """Time to ship one request's KV cache between pools over the
+    named interconnect tier. ``factor`` is the chaos lever
+    (``kv_transfer_degrade``): effective bandwidth scales by it, so
+    0.2 means the link runs at a fifth of nominal."""
+    if tier not in TIER_LINK_GBPS:
+        raise ValueError(
+            f"unknown KV-transfer tier {tier!r}; known: "
+            f"{', '.join(KV_TIERS)}")
+    # TIER_LINK_GBPS is gigaBITS/s (the collectives convention)
+    bytes_per_s = TIER_LINK_GBPS[tier] * 1e9 / 8.0 * factor
+    return max(0, int(kv_bytes)) / bytes_per_s
+
+
+@dataclasses.dataclass(frozen=True)
+class DisaggConfig:
+    """The phase-split declaration a fleet run opts into.
+
+    ``prefill_replicas : decode_replicas`` is the pool ratio (the
+    ``--disagg P:D`` CLI flag); the fleet's total replica count is
+    their sum. ``calibrated`` derives the analytic replicas' service
+    rates from the checked-in r05 calibration instead of the
+    hand-tuned ``SimReplicaConfig`` defaults."""
+
+    enabled: bool = True
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    tier: str = "ici"
+    dtype: str = "bf16"
+    calibrated: bool = True
+
+    def __post_init__(self):
+        if self.prefill_replicas < 1 or self.decode_replicas < 1:
+            raise ValueError(
+                "disagg needs at least one replica per pool "
+                f"(got {self.prefill_replicas}:"
+                f"{self.decode_replicas})")
+        resolve_tier(self.tier)
+        resolve_dtype(self.dtype)
+
+    @classmethod
+    def parse(cls, spec: str, *, tier: Optional[str] = None,
+              dtype: Optional[str] = None) -> "DisaggConfig":
+        """Build from the CLI's ``P:D`` ratio string."""
+        parts = spec.split(":")
+        if len(parts) != 2:
+            raise ValueError(
+                f"--disagg wants P:D (e.g. 2:2), got {spec!r}")
+        try:
+            p, d = int(parts[0]), int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"--disagg wants integer P:D, got {spec!r}") from None
+        return cls(prefill_replicas=p, decode_replicas=d,
+                   tier=resolve_tier(tier),
+                   dtype=resolve_dtype(dtype))
+
+    def as_dict(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "prefill_replicas": self.prefill_replicas,
+            "decode_replicas": self.decode_replicas,
+            "tier": self.tier,
+            "dtype": self.dtype,
+            "calibrated": self.calibrated,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class KvHandoff:
+    """One prefilled request in flight from the prefill pool to the
+    decode pool. Carries the prefill outcome (dispatch and
+    first-token stamps survive the transfer — TTFT is a property of
+    the request, not of the decode replica) and the KV bytes the
+    transfer ships. Duck-types the ``TraceRequest`` surface the
+    router's placement path reads, so decode-pool dispatch reuses
+    the ordinary machinery."""
+
+    is_kv_handoff: ClassVar[bool] = True
+
+    request: TraceRequest
+    dispatch_s: float
+    first_s: float
+    tokens: int
+    kv_bytes: int
+    from_replica: int
+
+    @property
+    def request_id(self) -> str:
+        return self.request.request_id
+
+    @property
+    def arrival_s(self) -> float:
+        return self.request.arrival_s
+
+    @property
+    def deadline_s(self) -> Optional[float]:
+        return self.request.deadline_s
+
+    @property
+    def prefix_group(self) -> int:
+        return self.request.prefix_group
+
+    @property
+    def prompt(self) -> Tuple[int, ...]:
+        return self.request.prompt
+
+    @property
+    def max_new(self) -> int:
+        return self.request.max_new
+
+    @property
+    def seed(self) -> int:
+        return self.request.seed
+
+
+def calibrated_sim_config(cal: dict, dtype: str = "bf16",
+                          max_slots: int = 8,
+                          max_queue: int = 64,
+                          prefix_cache_entries: int = 8):
+    """A ``SimReplicaConfig`` priced off a calibration file: prefill
+    per-token time from the measured forward rate, TPOT from the
+    decode byte roofline at this slot count (weight read amortized
+    over the batch, plus the calibration point's per-request KV
+    read, over achieved HBM bytes/s)."""
+    from kind_tpu_sim.fleet.router import SimReplicaConfig
+
+    prefill_rate = float(cal["prefill"]["analytic_tokens_per_s"])
+    d = cal["decode"][dtype]
+    slots = max(1, int(max_slots))
+    kv_per_req_bytes = d["kv_mb"] * 1e6 / max(1, int(cal["slots"]))
+    step_bytes = (d["weight_mb"] * 1e6 / slots + kv_per_req_bytes)
+    tpot = step_bytes / (d["achieved_gbps"] * 1e9)
+    return SimReplicaConfig(
+        max_slots=slots,
+        prefill_base_s=0.0,
+        prefill_per_tok_s=1.0 / prefill_rate,
+        tpot_s=round(tpot, 9),
+        max_queue=max_queue,
+        prefix_cache_entries=prefix_cache_entries,
+    )

@@ -49,9 +49,11 @@ LANE_CHAOS = 2
 LANE_HEALTH_PROBE = 3
 LANE_AUTOSCALER = 4
 LANE_PLANNER = 5
+LANE_KV_TRANSFER = 6
 
 LANES = (LANE_ARRIVAL, LANE_COMPLETION, LANE_CHAOS,
-         LANE_HEALTH_PROBE, LANE_AUTOSCALER, LANE_PLANNER)
+         LANE_HEALTH_PROBE, LANE_AUTOSCALER, LANE_PLANNER,
+         LANE_KV_TRANSFER)
 
 
 def resolve_event_core(value: Optional[bool] = None) -> bool:

@@ -80,9 +80,22 @@ class SimReplica:
     boundaries, like the engine's chunk-boundary scheduling."""
 
     def __init__(self, replica_id: int,
-                 cfg: SimReplicaConfig = SimReplicaConfig()):
+                 cfg: SimReplicaConfig = SimReplicaConfig(),
+                 phase: str = "unified"):
+        if phase not in ("prefill", "decode", "unified"):
+            raise ValueError(
+                f"unknown replica phase {phase!r}; known: "
+                "prefill, decode, unified")
         self.replica_id = replica_id
         self.cfg = cfg
+        # disaggregated serving role (docs/DISAGG.md): a ``prefill``
+        # replica completes requests at the first-token event (reason
+        # ``prefill_done`` — the fleet driver turns it into a KV
+        # transfer); a ``decode`` replica admits KvHandoffs whose
+        # prefill already happened elsewhere; ``unified`` is the
+        # historical monolithic engine, byte-identical to every
+        # pre-disagg replay.
+        self.phase = phase
         self.healthy = True
         # gray-failure lever (docs/HEALTH.md): a multiplicative
         # service-time inflation — 1.0 is nominal; the slow_replica
@@ -226,6 +239,10 @@ class SimReplica:
             if slot["first_s"] is None:
                 # prefill event, then >= max(max_new - 1, 1) decodes
                 k = max(req.max_new - 1, 1)
+                if self.phase == "prefill":
+                    # the prefill event itself is terminal for a
+                    # prefill-pool replica — no decode tail to bound
+                    k = 0
             else:
                 k = max(req.max_new - slot["tokens"], 1) - 1
             lb = slot["next_s"] + k * step
@@ -256,8 +273,11 @@ class SimReplica:
             for req in self.queue:
                 if (req.deadline_s is not None
                         and now >= req.arrival_s + req.deadline_s):
+                    base = (req.request
+                            if getattr(req, "is_kv_handoff", False)
+                            else req)
                     done.append(ReplicaCompletion(
-                        request=req, dispatch_s=now, first_s=None,
+                        request=base, dispatch_s=now, first_s=None,
                         finish_s=round(
                             req.arrival_s + req.deadline_s, 9),
                         tokens=0, tokens_crc=0,
@@ -269,6 +289,22 @@ class SimReplica:
             for i, slot in enumerate(self._slots):
                 if slot is None and self.queue:
                     req = self.queue.pop(0)
+                    if getattr(req, "is_kv_handoff", False):
+                        # decode-pool admission: the KV arrived
+                        # prefilled, so the slot resumes at the
+                        # handoff's token count with the next decode
+                        # step scheduled from this boundary; the
+                        # dispatch/first-token stamps survive the
+                        # transfer (TTFT belongs to the request)
+                        self._slots[i] = {
+                            "req": req.request,
+                            "dispatch_s": req.dispatch_s,
+                            "next_s": now + (self.cfg.tpot_s
+                                             * self.slowdown),
+                            "first_s": req.first_s,
+                            "tokens": req.tokens,
+                        }
+                        continue
                     self._slots[i] = {
                         "req": req,
                         "dispatch_s": now,
@@ -292,6 +328,16 @@ class SimReplica:
                     # prefill done: the first token lands at t
                     slot["first_s"] = t
                     slot["tokens"] = 1
+                    if self.phase == "prefill":
+                        # a prefill-pool replica stops here: the
+                        # request's KV leaves for the decode pool
+                        # (the fleet driver turns this completion
+                        # into a LANE_KV_TRANSFER event)
+                        done.append(self._complete(
+                            slot, finish_s=t,
+                            reason="prefill_done"))
+                        self._slots[i] = None
+                        break
                 else:
                     slot["tokens"] += 1
                     if slot["tokens"] >= req.max_new:
@@ -352,6 +398,8 @@ class SimReplica:
             "healthy": self.healthy,
             "outstanding": self.outstanding(),
         }
+        if self.phase != "unified":
+            out["phase"] = self.phase
         if self.slowdown != 1.0:
             out["slowdown"] = round(self.slowdown, 6)
         if self.prefix_hits or self.prefix_misses:
@@ -502,7 +550,7 @@ class Router:
 
     def __init__(self, replicas: Sequence, policy: str = "round-robin",
                  max_queue: int = 0, affinity_spill: int = 8,
-                 health=None, overload=None):
+                 health=None, overload=None, disagg: bool = False):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r}; known: "
@@ -510,6 +558,17 @@ class Router:
         self.replicas: List = list(replicas)
         self.policy = policy
         self.max_queue = max_queue
+        # disaggregated mode (docs/DISAGG.md): arrivals route to the
+        # prefill pool, KV handoffs to the decode pool, each pool
+        # picked least-outstanding within itself. Handoffs wait in
+        # their OWN queue — a blocked prefill head must never starve
+        # prefilled work out of the decode pool (that isolation IS
+        # the disagg claim), and a handoff is never shed: its prefill
+        # compute is already spent.
+        self.disagg = disagg
+        self.kv_queue: List = []
+        self.kv_routed = 0
+        self.kv_expired = 0
         # optional fleet.overload.OverloadState: per-replica circuit
         # breakers gate the candidate set (an OPEN breaker sheds
         # fast — its replica leaves the ordering until the half-open
@@ -544,8 +603,18 @@ class Router:
 
     # -- policy ------------------------------------------------------
 
-    def _healthy(self, now: float = 0.0) -> List:
-        out = [r for r in self.replicas if r.healthy]
+    def _pool(self, need: str) -> List:
+        """The phase-eligible replica set: pool members plus any
+        ``unified`` stragglers (a mixed fleet routes everywhere)."""
+        if not self.disagg:
+            return self.replicas
+        return [r for r in self.replicas
+                if getattr(r, "phase", "unified") in ("unified", need)]
+
+    def _healthy(self, now: float = 0.0,
+                 pool: Optional[List] = None) -> List:
+        base = self.replicas if pool is None else pool
+        out = [r for r in base if r.healthy]
         if self.health is not None:
             unquarantined = [r for r in out
                              if not self.health.quarantined(
@@ -578,10 +647,23 @@ class Router:
     def _pick_order(self, req: TraceRequest,
                     now: float = 0.0) -> List:
         """Candidate replicas, best first, per policy. Ties break on
-        replica_id — determinism over cleverness."""
-        healthy = self._healthy(now)
+        replica_id — determinism over cleverness. In disagg mode the
+        candidate set narrows to the request's phase pool FIRST, so
+        the health/breaker never-empty fallbacks stay per-pool —
+        routing an arrival to a decode replica would silently
+        re-unify the fleet."""
+        is_handoff = getattr(req, "is_kv_handoff", False)
+        pool = self._pool("decode" if is_handoff else "prefill")
+        healthy = self._healthy(now, pool)
         if not healthy:
             return []
+        if is_handoff:
+            # handoff placement is least-outstanding within the
+            # decode pool under every policy: the prefix cohort's
+            # locality was already spent at prefill
+            return sorted(
+                healthy, key=lambda r: (self._load_key(r),
+                                        r.replica_id))
         if self.policy == "round-robin":
             start = self._rr % len(healthy)
             return healthy[start:] + healthy[:start]
@@ -597,7 +679,7 @@ class Router:
         if req.prefix_group < 0:
             return by_load
         key = zlib.crc32(f"group:{req.prefix_group}".encode("utf-8"))
-        home = self.replicas[key % len(self.replicas)]
+        home = pool[key % len(pool)]
         if not home.healthy or (
                 self.health is not None
                 and self.health.quarantined(
@@ -632,19 +714,68 @@ class Router:
         self.queue.append(req)
         return None
 
+    def offer_handoff(self, handoff) -> None:
+        """Admit one delivered KV handoff into the decode lane. No
+        admission control here by design: the handoff's prefill
+        compute is already spent, so shedding it would burn capacity
+        twice — backpressure belongs at the arrival edge."""
+        self.kv_queue.append(handoff)
+
     def requeue_front(self, displaced: Sequence[TraceRequest]) -> None:
         """Displaced requests (a failed replica's) go back to the
-        queue HEAD in their original arrival order."""
-        ordered = sorted(displaced,
-                         key=lambda r: (r.arrival_s, r.request_id))
+        queue HEAD in their original arrival order. A displaced KV
+        handoff unwraps to its base request — the KV cache died with
+        the replica, so the request re-prefills from scratch."""
+        ordered = sorted(
+            (r.request if getattr(r, "is_kv_handoff", False) else r
+             for r in displaced),
+            key=lambda r: (r.arrival_s, r.request_id))
         self.queue[:0] = ordered
         self.requeues += len(ordered)
         metrics.fleet_board().incr("fleet_requeues", len(ordered))
 
     def dispatch(self, now: float) -> List[ReplicaCompletion]:
         """One placement pass; returns terminal outcomes decided AT
-        THE ROUTER (queue-deadline expiries)."""
+        THE ROUTER (queue-deadline expiries). The KV lane drains
+        BEFORE the arrival queue: prefilled work is the most
+        expensive work in the system to lose to queueing."""
         out: List[ReplicaCompletion] = []
+        if self.kv_queue:
+            still_kv: List = []
+            for h in self.kv_queue:
+                if (h.deadline_s is not None
+                        and now >= h.arrival_s + h.deadline_s):
+                    self.kv_expired += 1
+                    metrics.disagg_board().incr("kv_expired_queued")
+                    out.append(ReplicaCompletion(
+                        request=h.request, dispatch_s=now,
+                        first_s=None,
+                        finish_s=round(
+                            h.arrival_s + h.deadline_s, 9),
+                        tokens=0, tokens_crc=0,
+                        finish_reason="deadline_exceeded"))
+                else:
+                    still_kv.append(h)
+            self.kv_queue = still_kv
+            while self.kv_queue:
+                h = self.kv_queue[0]
+                placed = False
+                for replica in self._pick_order(h, now):
+                    if replica.submit(h, now):
+                        self.kv_queue.pop(0)
+                        self.kv_routed += 1
+                        self.per_replica[replica.replica_id] = (
+                            self.per_replica.get(
+                                replica.replica_id, 0) + 1)
+                        metrics.disagg_board().incr(
+                            "kv_handoffs_routed")
+                        placed = True
+                        break
+                if not placed:
+                    # head blocks: the decode pool is saturated (or
+                    # gone — the disagg-pool-loss scenario); the
+                    # handoff waits rather than sheds
+                    break
         still: List[TraceRequest] = []
         for req in self.queue:
             if (req.deadline_s is not None
@@ -697,4 +828,8 @@ class Router:
         if self.policy == "prefix-affinity":
             out["affinity"] = {"hits": self.affinity_hits,
                                "spills": self.affinity_spills}
+        if self.disagg:
+            out["kv"] = {"routed": self.kv_routed,
+                         "expired": self.kv_expired,
+                         "queued": len(self.kv_queue)}
         return out

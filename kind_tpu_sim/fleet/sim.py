@@ -42,11 +42,18 @@ from kind_tpu_sim.fleet.autoscaler import (
     AutoscalerConfig,
     resolve_warmup_s,
 )
+from kind_tpu_sim.fleet.disagg import (
+    DisaggConfig,
+    KvHandoff,
+    kv_transfer_s,
+    calibrated_sim_config,
+)
 from kind_tpu_sim.fleet.events import (
     LANE_ARRIVAL,
     LANE_AUTOSCALER,
     LANE_CHAOS,
     LANE_COMPLETION,
+    LANE_KV_TRANSFER,
     DueSet,
     EventHeap,
     resolve_event_core,
@@ -224,6 +231,11 @@ class FleetConfig:
     # gangs co-scheduled UNDER serving on the same inventory under
     # strict priority — requires a scheduler-backed fleet (sched)
     training: Optional[TrainingConfig] = None
+    # disaggregated prefill/decode serving (docs/DISAGG.md): a
+    # DisaggConfig splits the fleet into phase pools with modeled
+    # KV-cache handoff between them. None (the default) keeps every
+    # replica unified and every historical replay byte-identical.
+    disagg: Optional[DisaggConfig] = None
     # idle-gap fast-forward (None -> resolve_fast_forward()). An
     # execution strategy, not workload config: reports are
     # byte-identical either way, so it deliberately stays OUT of
@@ -261,6 +273,8 @@ class FleetConfig:
             out["overload"] = self.overload.as_dict()
         if self.training is not None:
             out["training"] = self.training.as_dict()
+        if self.disagg is not None:
+            out["disagg"] = self.disagg.as_dict()
         return out
 
 
@@ -281,10 +295,61 @@ class FleetSim:
         self.clock = clock or VirtualClock()
         self.trace = sorted(trace,
                             key=lambda r: (r.arrival_s, r.request_id))
+        # disaggregated serving (docs/DISAGG.md): phase-split pools
+        # with modeled KV handoff. Incompatible with scheduler-backed
+        # placement by design — gang rebind/migration would need the
+        # whole phase lifecycle threaded through sched, and the
+        # disagg questions (pool ratios, transfer cost, pool-loss
+        # survival) don't need it.
+        self._disagg = (cfg.disagg
+                        if cfg.disagg is not None
+                        and cfg.disagg.enabled else None)
+        self._cost = None
+        self._disagg_sim_cfg = cfg.sim
+        if self._disagg is not None:
+            from kind_tpu_sim.fleet.costmodel import (
+                CostModel,
+                kv_bytes_per_token,
+                load_calibration,
+            )
+
+            dis = self._disagg
+            if cfg.sched is not None:
+                raise ValueError(
+                    "FleetConfig.disagg is incompatible with a "
+                    "scheduler-backed fleet (FleetConfig.sched)")
+            want = dis.prefill_replicas + dis.decode_replicas
+            if cfg.replicas != want:
+                raise ValueError(
+                    f"FleetConfig.replicas={cfg.replicas} must equal "
+                    f"the disagg pool sum {dis.prefill_replicas}+"
+                    f"{dis.decode_replicas}={want}")
+            if replica_factory is not None:
+                raise ValueError(
+                    "a disagg fleet builds its own phased replicas; "
+                    "replica_factory is not supported")
+            cal = load_calibration()
+            self._cost = CostModel(cal)
+            self._kv_per_tok = kv_bytes_per_token(
+                cal["geometry"], dis.dtype)
+            if dis.calibrated:
+                self._disagg_sim_cfg = calibrated_sim_config(
+                    cal, dis.dtype,
+                    max_slots=cfg.sim.max_slots,
+                    max_queue=cfg.sim.max_queue,
+                    prefix_cache_entries=cfg.sim
+                    .prefix_cache_entries)
         self.factory = replica_factory or (
             lambda rid: SimReplica(rid, cfg.sim))
-        self.replicas = [self.factory(i)
-                         for i in range(cfg.replicas)]
+        if self._disagg is not None:
+            p = self._disagg.prefill_replicas
+            self.replicas = [
+                SimReplica(i, self._disagg_sim_cfg,
+                           phase="prefill" if i < p else "decode")
+                for i in range(cfg.replicas)]
+        else:
+            self.replicas = [self.factory(i)
+                             for i in range(cfg.replicas)]
         self.health = (FailureDetector(cfg.health)
                        if cfg.health is not None else None)
         self.overload = (OverloadState(cfg.overload)
@@ -292,14 +357,48 @@ class FleetSim:
         self.router = Router(self.replicas, policy=cfg.policy,
                              max_queue=cfg.max_queue,
                              health=self.health,
-                             overload=self.overload)
+                             overload=self.overload,
+                             disagg=self._disagg is not None)
         if self.overload is not None:
             self.router.on_place = self._on_place
         self.chaos_events = sorted(chaos_events,
                                    key=lambda e: (e.at_s, e.target))
-        self.tracker = SloTracker(cfg.slo)
+        self.tracker = SloTracker(
+            cfg.slo, track_itl=self._disagg is not None)
         self.autoscaler = (Autoscaler(cfg.autoscaler)
-                           if cfg.autoscale else None)
+                           if cfg.autoscale
+                           and self._disagg is None else None)
+        # phase-pool autoscaling: each pool scales on its OWN signal
+        # (TTFT breach -> prefill, ITL/queue-depth breach -> decode),
+        # floored at its declared size
+        self._pool_scalers: Optional[Dict[str, Autoscaler]] = None
+        if self._disagg is not None and cfg.autoscale:
+            dis = self._disagg
+            self._pool_scalers = {
+                "prefill": Autoscaler(dataclasses.replace(
+                    cfg.autoscaler,
+                    min_replicas=dis.prefill_replicas)),
+                "decode": Autoscaler(dataclasses.replace(
+                    cfg.autoscaler,
+                    min_replicas=dis.decode_replicas)),
+            }
+        # KV transfers in flight between the pools: an EventHeap of
+        # (deliver_at_s, LANE_KV_TRANSFER, seq, KvHandoff); the
+        # kv_transfer_degrade chaos lever scales the link bandwidth
+        # for transfers scheduled AFTER it fires
+        self._kv_heap = EventHeap()
+        self._kv_factor = 1.0
+        self._prefill_done_ids: set = set()
+        # hedge/failover-cancelled ids whose KV transfer is still on
+        # the wire: the heap has no removal, so cancellation is lazy
+        # — the handoff is dropped at delivery (globe/cell.py)
+        self._kv_cancelled: set = set()
+        self._kv_handoffs = 0
+        self._kv_bytes_total = 0
+        self._kv_transfer_s_total = 0.0
+        # per-phase SLO windows driving the pool scalers
+        self._recent_ttft = deque(maxlen=64)
+        self._recent_itl = deque(maxlen=64)
         self.log: List[dict] = []
         # cell-embedding hook (docs/GLOBE.md): the globe driver sets
         # this to stream every completion entry out of the cell as it
@@ -452,7 +551,7 @@ class FleetSim:
         now = self._now
         if victim is not None and victim.healthy:
             displaced = victim.fail(now)
-            self.router.requeue_front(displaced)
+            self._requeue_front(displaced)
             self.preemptions += 1
             metrics.fleet_board().incr("replica_preemptions")
             metrics.recovery_log().record(
@@ -766,6 +865,190 @@ class FleetSim:
                 self._hedge_dropped.add(rid)
         self._record(comp, replica.replica_id)
 
+    # -- disaggregated serving (docs/DISAGG.md) -----------------------
+
+    def _on_prefill_done(self, replica, comp: ReplicaCompletion,
+                         now: float) -> None:
+        """A prefill-pool replica finished a prompt: price the KV
+        transfer off the prompt length and ship it to the decode
+        pool as a LANE_KV_TRANSFER event. Hedge duplicates dedupe
+        here — one request ships exactly one KV cache."""
+        rid = comp.request.request_id
+        ov = self.overload
+        if ov is not None and rid in self._hedge_dropped:
+            self._hedge_dropped.discard(rid)
+            ov.incr("hedge_late_drops")
+            return
+        if rid in self._prefill_done_ids or rid in self._completed_ids:
+            return
+        self._prefill_done_ids.add(rid)
+        if ov is not None:
+            pair = self._hedges.pop(rid, None)
+            if pair is not None:
+                loser = (pair["hedge"] if replica is pair["primary"]
+                         else pair["primary"])
+                if replica is pair["hedge"]:
+                    ov.incr("hedge_wins")
+                if (hasattr(loser, "cancel")
+                        and loser.cancel(rid)):
+                    ov.incr("hedge_cancels")
+                else:
+                    self._hedge_dropped.add(rid)
+        if (not rid.startswith("__probe-")
+                and self.cfg.slo.ttft_s is not None
+                and comp.first_s is not None):
+            # the prefill pool's scaling signal: TTFT attainment
+            self._recent_ttft.append(
+                comp.first_s - comp.request.arrival_s
+                <= self.cfg.slo.ttft_s)
+        kv_bytes = len(comp.request.prompt) * self._kv_per_tok
+        transfer = kv_transfer_s(kv_bytes, self._disagg.tier,
+                                 self._kv_factor)
+        handoff = KvHandoff(
+            request=comp.request, dispatch_s=comp.dispatch_s,
+            first_s=comp.first_s, tokens=comp.tokens,
+            kv_bytes=kv_bytes, from_replica=replica.replica_id)
+        self._kv_heap.push(round(comp.finish_s + transfer, 9),
+                           LANE_KV_TRANSFER, handoff)
+        self._kv_handoffs += 1
+        self._kv_bytes_total += kv_bytes
+        self._kv_transfer_s_total += transfer
+        metrics.disagg_board().incr("prefills_done")
+
+    def _apply_disagg_chaos(self, ev: "ChaosEvent",
+                            now: float) -> None:
+        if ev.action == "prefill_pool_loss":
+            displaced: List[TraceRequest] = []
+            lost = 0
+            for r in self.replicas:
+                if (getattr(r, "phase", "unified") == "prefill"
+                        and r.healthy):
+                    displaced.extend(r.fail(now))
+                    lost += 1
+            self._requeue_front(displaced)
+            self.preemptions += lost
+            metrics.disagg_board().incr("prefill_pool_losses")
+            metrics.recovery_log().record(
+                "fleet_prefill_pool_loss", replicas=lost,
+                displaced=len(displaced), at_s=round(now, 6))
+        elif ev.action == "prefill_pool_restore":
+            healed = 0
+            for r in self.replicas:
+                if (getattr(r, "phase", "unified") == "prefill"
+                        and not r.healthy):
+                    r.restore(now)
+                    healed += 1
+            metrics.recovery_log().record(
+                "fleet_prefill_pool_restore", replicas=healed,
+                at_s=round(now, 6))
+        elif ev.action == "kv_degrade":
+            # future transfers only: an in-flight transfer keeps its
+            # scheduled delivery (the fault hits the link, not the
+            # bytes already on the wire)
+            self._kv_factor = max(1e-3, ev.param)
+            metrics.disagg_board().incr("kv_degrades")
+            metrics.recovery_log().record(
+                "fleet_kv_degrade", factor=ev.param,
+                at_s=round(now, 6))
+        elif ev.action == "kv_restore":
+            self._kv_factor = 1.0
+            metrics.recovery_log().record(
+                "fleet_kv_restore", at_s=round(now, 6))
+
+    def _pool_members(self, phase: str) -> List:
+        return [r for r in self.router.replicas
+                if getattr(r, "phase", "unified") == phase]
+
+    def _autoscale_pools(self, now: float) -> None:
+        """One evaluation per pool per cadence: prefill scales on
+        TTFT attainment + arrival backlog, decode on ITL attainment
+        (when the policy sets ``itl_s``; queue-depth otherwise) +
+        KV-lane backlog. Scale-down drains the pool's highest-id
+        healthy replica, never below the declared floor."""
+        for replica, reason in self._warming.pop_due(now):
+            self.replicas.append(replica)
+            self.router.replicas.append(replica)
+            phase = getattr(replica, "phase", "unified")
+            self._pool_scalers[phase].note_ready(
+                now, len(self._pool_members(phase)), reason=reason)
+        for phase in ("prefill", "decode"):
+            scaler = self._pool_scalers[phase]
+            members = self._pool_members(phase)
+            routable = sum(
+                1 for r in members
+                if r.healthy and (self.health is None
+                                  or not self.health.quarantined(
+                                      f"replica-{r.replica_id}")))
+            healthy_out = sum(r.outstanding() for r in members
+                              if r.healthy)
+            if phase == "prefill":
+                backlog = len(self.router.queue) + healthy_out
+                recent = list(self._recent_ttft)
+            else:
+                backlog = (len(self.router.kv_queue)
+                           + len(self._kv_heap) + healthy_out)
+                recent = list(self._recent_itl)
+            attainment = (sum(recent) / len(recent)
+                          if recent else None)
+            action = scaler.evaluate(
+                now, routable=routable, backlog=backlog,
+                attainment=attainment)
+            if action == "scale_up":
+                rid = self._next_replica_id
+                self._next_replica_id += 1
+                self._warming.push(
+                    now + scaler.warmup_s, LANE_AUTOSCALER,
+                    (SimReplica(rid, self._disagg_sim_cfg,
+                                phase=phase),
+                     f"{phase} warmup complete"))
+                metrics.disagg_board().incr(
+                    f"{phase}_scale_ups")
+            elif action == "scale_down":
+                victims = [r for r in members if r.healthy]
+                if not victims:
+                    continue
+                victim = max(victims, key=lambda r: r.replica_id)
+                self.router.replicas.remove(victim)
+                self.replicas.remove(victim)
+                self._draining.append(victim)
+                metrics.disagg_board().incr(
+                    f"{phase}_scale_downs")
+
+    def displace_disagg(self) -> List[TraceRequest]:
+        """Drain the whole KV lane — queued handoffs AND in-flight
+        transfers — back to base requests (each re-prefills from
+        scratch). The cell-loss displacement path (globe/cell.py)
+        calls this so a failed disagg cell loses zero work."""
+        out: List[TraceRequest] = []
+        for h in self._kv_heap.pop_due(float("inf")):
+            rid = h.request.request_id
+            if rid in self._kv_cancelled:
+                # lazily-cancelled transfer: the hedge winner
+                # already owns this request — do not resurrect it
+                self._kv_cancelled.discard(rid)
+                continue
+            out.append(h.request)
+        out.extend(h.request for h in self.router.kv_queue)
+        self.router.kv_queue = []
+        for r in out:
+            self._prefill_done_ids.discard(r.request_id)
+        return out
+
+    def _requeue_front(self, displaced: List) -> None:
+        """The displacement funnel: a request heading back to the
+        arrival queue must be allowed to prefill AGAIN, so drop its
+        id from the prefill dedupe set (which exists to absorb
+        hedge duplicates, not legitimate re-prefills — without this
+        a request displaced mid-decode would re-prefill, hit the
+        dedupe, and vanish)."""
+        if self._disagg is not None:
+            for req in displaced:
+                base = (req.request
+                        if getattr(req, "is_kv_handoff", False)
+                        else req)
+                self._prefill_done_ids.discard(base.request_id)
+        self.router.requeue_front(displaced)
+
     def _maybe_retry(self, comp: ReplicaCompletion,
                      now: float) -> None:
         """The client retry model: a shed or deadline-expired
@@ -824,6 +1107,13 @@ class FleetSim:
                 and comp.finish_reason not in
                 ("shed", "deadline_exceeded")):
             self._observe_health(replica_id, comp, self._now)
+        if (self._disagg is not None
+                and self.cfg.slo.itl_s is not None
+                and comp.first_s is not None and comp.tokens >= 2):
+            # the decode pool's scaling signal: ITL attainment
+            itl = ((comp.finish_s - comp.first_s)
+                   / (comp.tokens - 1))
+            self._recent_itl.append(itl <= self.cfg.slo.itl_s)
         if self.overload is not None:
             self._completed_ids.add(req.request_id)
             if brownout_observe:
@@ -859,6 +1149,15 @@ class FleetSim:
                         f"{ev.action} chaos needs a training "
                         "tenancy (FleetConfig.training)")
                 self.trainer.apply_chaos(ev.action, ev.target, now)
+                continue
+            if ev.action in ("prefill_pool_loss",
+                             "prefill_pool_restore",
+                             "kv_degrade", "kv_restore"):
+                if self._disagg is None:
+                    raise ValueError(
+                        f"{ev.action} chaos needs a disaggregated "
+                        "fleet (FleetConfig.disagg)")
+                self._apply_disagg_chaos(ev, now)
                 continue
             if ev.action.startswith("node_"):
                 if self.sched is None:
@@ -898,7 +1197,7 @@ class FleetSim:
                     at_s=round(now, 6))
             elif ev.action == "preempt" and victim.healthy:
                 displaced = victim.fail(now)
-                self.router.requeue_front(displaced)
+                self._requeue_front(displaced)
                 self.preemptions += 1
                 metrics.fleet_board().incr("replica_preemptions")
                 metrics.recovery_log().record(
@@ -1001,6 +1300,17 @@ class FleetSim:
         if self.overload is not None:
             for req in self._retry_heap.pop_due(now):
                 self._offer_arrival(req, now, fresh=False)
+        if self._disagg is not None:
+            # KV transfers that finished by this boundary land in
+            # the router's decode lane, dispatched this same pass
+            for handoff in self._kv_heap.pop_due(now):
+                if self._kv_cancelled:
+                    rid = handoff.request.request_id
+                    if rid in self._kv_cancelled:
+                        self._kv_cancelled.discard(rid)
+                        continue
+                metrics.disagg_board().incr("kv_handoffs_delivered")
+                self.router.offer_handoff(handoff)
         if self.health is not None and (pending
                                         or self.router.queue):
             # probe only while user traffic still flows — an
@@ -1021,9 +1331,19 @@ class FleetSim:
                     self._observe_health(
                         replica.replica_id, comp, now)
                     continue
+                if comp.finish_reason == "prefill_done":
+                    # not a terminal outcome: the request's KV
+                    # leaves for the decode pool; only the decode
+                    # side's completion enters the log (one entry
+                    # per request — the no-lost-work contract)
+                    self._on_prefill_done(replica, comp, now)
+                    continue
                 self._handle_completion(replica, comp, now)
         for replica in list(self._draining):
             for comp in replica.tick(now, tick):
+                if comp.finish_reason == "prefill_done":
+                    self._on_prefill_done(replica, comp, now)
+                    continue
                 self._handle_completion(replica, comp, now)
             if replica.idle():
                 self._draining.remove(replica)
@@ -1034,6 +1354,8 @@ class FleetSim:
         if self._ticks % self._eval_ticks == 0:
             if self.autoscaler is not None:
                 self._autoscale(now)
+            if self._pool_scalers is not None:
+                self._autoscale_pools(now)
             if self.overload is not None:
                 self.overload.brownout.evaluate(now)
             if self.trainer is not None:
@@ -1050,6 +1372,7 @@ class FleetSim:
             pending = self._pending
         return bool(
             not pending and not self.router.queue
+            and not self._kv_heap and not self.router.kv_queue
             and not self._warming
             and all(r.idle() for r in self.replicas
                     if r.healthy)
@@ -1068,12 +1391,15 @@ class FleetSim:
         (autoscaler evaluations and health probes are tick-cadenced
         events, so their presence disqualifies the gap)."""
         if (self.autoscaler is not None or self.health is not None
-                or self.overload is not None):
+                or self.overload is not None
+                or self._pool_scalers is not None):
             return False
         if (self.trainer is not None
                 and not self.trainer.quiescent()):
             return False
         if (self.router.queue or self._warming or self._draining):
+            return False
+        if self._kv_heap or self.router.kv_queue:
             return False
         # slowdown != 1 disqualifies even an idle replica: an
         # EngineReplica's stride counter advances per tick() call,
@@ -1121,11 +1447,16 @@ class FleetSim:
         # applies at its backoff expiry, a hedge at its delay expiry
         due.at(self._retry_heap.peek_time())
         due.at(self._hedge_heap.peek_time())
+        # a finished KV transfer applies at its delivery instant; a
+        # queued handoff needs every boundary until the decode pool
+        # takes it
+        due.at(self._kv_heap.peek_time())
         if self.trainer is not None:
             # gang arrivals and segment completions are boundary-
             # condition events; mid-segment progress is closed form
             self.trainer.due(due)
-        if self.router.queue or self._draining:
+        if (self.router.queue or self.router.kv_queue
+                or self._draining):
             return due.need_now()
         if self.sched is not None and (
                 self.sched.pending or self._gang_requested
@@ -1186,6 +1517,7 @@ class FleetSim:
             return
         evals_away = -1
         if (self.autoscaler is not None
+                or self._pool_scalers is not None
                 or self.overload is not None
                 or (self.trainer is not None
                     and self.trainer.wants_evals())):
@@ -1249,6 +1581,7 @@ class FleetSim:
     def run(self) -> Dict[str, object]:
         board_before = metrics.fleet_board().counts()
         health_before = metrics.health_board().counts()
+        disagg_before = metrics.disagg_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
         pending = self._pending
         while True:
@@ -1300,6 +1633,35 @@ class FleetSim:
             }
         if self.autoscaler is not None:
             report["autoscaler"] = self.autoscaler.report()
+        if self._disagg is not None:
+            pools: Dict[str, dict] = {}
+            for phase in ("prefill", "decode"):
+                members = [
+                    r for r in self.replicas + self._draining
+                    if getattr(r, "phase", "unified") == phase]
+                pools[phase] = {
+                    "replicas": len(members),
+                    "healthy": sum(1 for r in members
+                                   if r.healthy),
+                }
+            report["disagg"] = {
+                "config": self._disagg.as_dict(),
+                "pools": pools,
+                "kv": {
+                    "handoffs": self._kv_handoffs,
+                    "bytes_total": self._kv_bytes_total,
+                    "transfer_s_total": round(
+                        self._kv_transfer_s_total, 6),
+                    "tier": self._disagg.tier,
+                },
+                "calibration_errors": self._cost.errors(),
+                "counters": metrics.disagg_board()
+                .snapshot_since(disagg_before),
+            }
+            if self._pool_scalers is not None:
+                report["disagg"]["autoscalers"] = {
+                    p: s.report() for p, s in
+                    sorted(self._pool_scalers.items())}
         if self.sched is not None:
             ttrs = self.time_to_routable
             warmup = (self.autoscaler.warmup_s

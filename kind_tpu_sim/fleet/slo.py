@@ -55,13 +55,17 @@ class FixedBucketHistogram:
         self._max = 0.0
         self._sum = 0.0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, count: int = 1) -> None:
+        """Record ``count`` occurrences of ``value`` (one bisect
+        either way — the weighted form is how the ITL histogram
+        ingests a request's ``tokens - 1`` identical gaps without a
+        per-token loop)."""
         if value < 0 or math.isnan(value):
             raise ValueError(f"bad latency sample {value!r}")
         idx = bisect.bisect_left(self.bounds, value)
-        self.counts[idx] += 1
-        self.total += 1
-        self._sum += value
+        self.counts[idx] += count
+        self.total += count
+        self._sum += value * count
         if value > self._max:
             self._max = value
 
@@ -111,6 +115,12 @@ class SloPolicy:
     ttft_s: Optional[float] = None
     tpot_s: Optional[float] = None
     e2e_s: Optional[float] = None
+    # inter-token latency target — the decode-pool autoscaling
+    # signal (docs/DISAGG.md). Per-request it is the same quantity
+    # as tpot (the mean post-first gap), so it does NOT double-count
+    # in attained(); it gates the disagg driver's ITL breach window
+    # and the token-weighted itl histogram instead.
+    itl_s: Optional[float] = None
 
     def attained(self, ttft: float, tpot: Optional[float],
                  e2e: float) -> bool:
@@ -133,11 +143,20 @@ class SloTracker:
     bounded: three histograms plus a handful of counters."""
 
     def __init__(self, policy: SloPolicy,
-                 hist_lo: float = 1e-4, hist_hi: float = 1e3):
+                 hist_lo: float = 1e-4, hist_hi: float = 1e3,
+                 track_itl: bool = False):
         self.policy = policy
         self.ttft = FixedBucketHistogram(hist_lo, hist_hi)
         self.tpot = FixedBucketHistogram(hist_lo, hist_hi)
         self.e2e = FixedBucketHistogram(hist_lo, hist_hi)
+        # first-class ITL histogram (docs/DISAGG.md): the tpot
+        # histogram weights every REQUEST equally; this one weights
+        # every TOKEN GAP equally (a 100-token answer contributes 99
+        # observations), which is what a decode pool's smoothness
+        # actually looks like to a streaming client. Opt-in —
+        # reports without it stay byte-identical to pre-disagg runs.
+        self.track_itl = track_itl
+        self.itl = FixedBucketHistogram(hist_lo, hist_hi)
         self.completed = 0
         self.attained = 0
         self.shed = 0
@@ -167,6 +186,8 @@ class SloTracker:
         self.e2e.observe(e2e)
         if tpot is not None:
             self.tpot.observe(tpot)
+            if self.track_itl:
+                self.itl.observe(tpot, count=tokens - 1)
         self.completed += 1
         self.tokens_total += tokens
         if deadline_exceeded:
@@ -202,6 +223,8 @@ class SloTracker:
             "tpot": self.tpot.report(),
             "e2e": self.e2e.report(),
         }
+        if self.track_itl:
+            out["itl"] = self.itl.report()
         if span and span > 0:
             out["throughput_tok_s"] = round(
                 self.tokens_total / span, 3)

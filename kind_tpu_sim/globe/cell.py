@@ -83,12 +83,14 @@ class Cell:
 
     def outstanding(self) -> int:
         """Everything the cell owes: queued at the router, in flight
-        on replicas, admitted but unticked, and still in DCN
-        flight."""
+        on replicas, admitted but unticked, still in DCN flight, and
+        (phase-split cells) riding the KV lane between the pools."""
         return (len(self.sim.router.queue)
                 + sum(r.outstanding()
                       for r in self.sim.replicas if r.healthy)
-                + len(self.pending) + len(self.delivery))
+                + len(self.pending) + len(self.delivery)
+                + len(self.sim.router.kv_queue)
+                + len(self.sim._kv_heap))
 
     def routable(self) -> bool:
         return (self.alive and not self.draining
@@ -129,6 +131,18 @@ class Cell:
         for replica in self.sim.replicas:
             if (hasattr(replica, "cancel")
                     and replica.cancel(request_id)):
+                return True
+        kv_queue = self.sim.router.kv_queue
+        for i, handoff in enumerate(kv_queue):
+            if handoff.request.request_id == request_id:
+                del kv_queue[i]
+                self.sim._prefill_done_ids.discard(request_id)
+                return True
+        for entry in self.sim._kv_heap._heap:
+            # a KV transfer on the wire cancels lazily, like a
+            # request in DCN flight: the sim drops it at delivery
+            if entry[3].request.request_id == request_id:
+                self.sim._kv_cancelled.add(request_id)
                 return True
         for entry in self.delivery._heap:
             if entry[3].request_id == request_id:
@@ -193,7 +207,18 @@ class Cell:
             self.sim.trainer.evict_all(now, reason="cell failed")
         for replica in self.sim.replicas:
             if replica.healthy:
-                displaced.extend(replica.fail(now))
+                for req in replica.fail(now):
+                    # a decode replica's queue may hold KV handoffs:
+                    # the front door re-admits TraceRequests, so
+                    # unwrap to the base request (full re-prefill on
+                    # the failover cell)
+                    base = (req.request
+                            if getattr(req, "is_kv_handoff", False)
+                            else req)
+                    self.sim._prefill_done_ids.discard(
+                        base.request_id)
+                    displaced.append(base)
+        displaced.extend(self.sim.displace_disagg())
         displaced.extend(self.sim.router.queue)
         self.sim.router.queue = []
         displaced.extend(self.pending)

@@ -282,6 +282,18 @@ def train_board() -> CounterBoard:
     return _TRAIN_BOARD
 
 
+_DISAGG_BOARD = CounterBoard()
+
+
+def disagg_board() -> CounterBoard:
+    """The process-global disaggregated-serving counter board
+    (prefills completed, KV handoffs scheduled/delivered/routed,
+    KV bytes shipped, pool scale events, transfer degrades —
+    kind_tpu_sim.fleet.{router,sim} record into it; fleet reports,
+    chaos scenario reports, and bench disagg extras snapshot it)."""
+    return _DISAGG_BOARD
+
+
 def parse_k8s_time(stamp: str) -> float:
     """RFC3339 (kubernetes) timestamp -> unix seconds."""
     import datetime

@@ -17,6 +17,7 @@ not), keeping the canonical report timer-free.
 
 from __future__ import annotations
 
+import dataclasses
 import random
 import zlib
 from typing import Dict, List, Optional
@@ -55,6 +56,9 @@ def _eligible_kinds(topo: TopologySpec, training_gangs: int,
             continue
         if "overload" in schema.needs and not overload:
             continue
+        if "disagg" in schema.needs and not getattr(
+                topo, "disagg", False):
+            continue
         out.append(kind)
     return out
 
@@ -74,6 +78,15 @@ def draw_spec(seed: int, index: int,
                             replicas=2,
                             zones=rng.randint(2, 3),
                             cells_per_zone=rng.randint(1, 2))
+    # disagg comes from a SEPARATE stream so every existing draw
+    # (and thus every pre-disagg fuzz report for non-disagg specs)
+    # stays byte-identical — pulling this bit from `rng` would
+    # shift all downstream draws
+    if topo.kind == "fleet" and not topo.sched:
+        disagg_rng = random.Random(zlib.crc32(
+            f"fuzz:disagg:{seed}:{index}".encode()))
+        if disagg_rng.random() < 0.4:
+            topo = dataclasses.replace(topo, disagg=True)
     overload = rng.random() < 0.7
     training_gangs = 0
     if topo.kind == "fleet" and topo.sched:

@@ -63,6 +63,8 @@ _LEGACY: Dict[str, tuple] = {
     "train-globe-spot": (
         ("zone_loss",),
         ("verdict-ok", "no-lost-work", "ledger-clean"), True),
+    "disagg-pool-loss": (
+        ("prefill_pool_loss", "kv_transfer_degrade"), _FLEETV, True),
 }
 
 _SPECS: Optional[Dict[str, ScenarioSpec]] = None

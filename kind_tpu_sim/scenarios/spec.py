@@ -76,6 +76,7 @@ class TopologySpec:
     sched: bool = False          # fleet only (globe cells always are)
     zones: int = 2               # globe only
     cells_per_zone: int = 1      # globe only
+    disagg: bool = False         # fleet only; phase-split pools
 
     def as_dict(self) -> dict:
         return {
@@ -84,13 +85,15 @@ class TopologySpec:
             "sched": self.sched,
             "zones": self.zones,
             "cells_per_zone": self.cells_per_zone,
+            "disagg": self.disagg,
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "TopologySpec":
         return cls(kind=d["kind"], replicas=int(d["replicas"]),
                    sched=bool(d["sched"]), zones=int(d["zones"]),
-                   cells_per_zone=int(d["cells_per_zone"]))
+                   cells_per_zone=int(d["cells_per_zone"]),
+                   disagg=bool(d.get("disagg", False)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -259,12 +262,24 @@ def spec_problems(spec: ScenarioSpec) -> List[str]:
         if "overload" in schema.needs and not spec.overload:
             problems.append(
                 f"fault kind {f.kind!r} needs overload controls on")
+        if "disagg" in schema.needs and not (topo.kind == "fleet"
+                                             and topo.disagg):
+            problems.append(
+                f"fault kind {f.kind!r} needs a disaggregated "
+                "fleet (topology.disagg)")
         if schema.exclusive:
             exclusive += 1
     if exclusive > 1:
         problems.append(
             "at most one exclusive fault kind (zone_loss / "
             "herd_failover / demand_surge) per spec")
+    if topo.disagg and topo.kind != "fleet":
+        problems.append(
+            "topology.disagg only applies to fleet topologies")
+    if topo.disagg and topo.sched:
+        problems.append(
+            "topology.disagg is incompatible with a scheduler-"
+            "backed fleet (phased pools pin their own placements)")
     if spec.training_gangs and topo.kind == "fleet" and not topo.sched:
         problems.append(
             "training_gangs need a scheduler-backed fleet")
@@ -337,6 +352,15 @@ def _fleet_events(spec: ScenarioSpec, span: float):
             events.append(fleet.ChaosEvent(
                 t0, "link_degrade", 0, max(0.01, f.param)))
             events.append(fleet.ChaosEvent(t1, "link_restore", 0))
+        elif f.kind == "prefill_pool_loss":
+            events.append(fleet.ChaosEvent(
+                t0, "prefill_pool_loss", 0))
+            events.append(fleet.ChaosEvent(
+                t1, "prefill_pool_restore", 0))
+        elif f.kind == "kv_transfer_degrade":
+            events.append(fleet.ChaosEvent(
+                t0, "kv_degrade", 0, max(0.01, f.param)))
+            events.append(fleet.ChaosEvent(t1, "kv_restore", 0))
         elif f.kind == "train_preempt":
             gang = f.target % max(1, spec.training_gangs)
             events.append(fleet.ChaosEvent(t0, "train_preempt",
@@ -432,13 +456,23 @@ def _run_fleet_spec(spec: ScenarioSpec, seed: int,
         trace = base
     sched = (fleet.FleetSchedConfig() if spec.topology.sched
              else None)
+    disagg = None
+    if spec.topology.disagg:
+        # even split, prefill-heavy remainder; spec_problems already
+        # rejected disagg x sched
+        p = max(1, spec.topology.replicas // 2)
+        d = max(1, spec.topology.replicas - p)
+        disagg = fleet.DisaggConfig(prefill_replicas=p,
+                                    decode_replicas=d)
     cfg = fleet.FleetConfig(
-        replicas=spec.topology.replicas,
+        replicas=(disagg.prefill_replicas + disagg.decode_replicas
+                  if disagg else spec.topology.replicas),
         policy="least-outstanding",
         sched=sched,
         overload=(fleet.OverloadConfig() if spec.overload
                   else None),
         training=_training_config(spec),
+        disagg=disagg,
         max_virtual_s=spec.max_virtual_s,
         event_core=event_core)
     events = _fleet_events(spec, span)
