@@ -2322,13 +2322,19 @@ def disagg_smoke() -> dict | None:
 
 def fleet_scale() -> dict | None:
     """The sim-speed headline (ROADMAP item 1, docs/PERFORMANCE.md
-    "The event core"): a seeded 100k-request compressed diurnal day
-    through the fleet simulator with the event-heap core on vs off —
-    events/s, sim-seconds-per-wall-second, boundaries stepped vs
-    skipped, and the byte-identity verdict between the two modes
-    (the contract the speed is not allowed to cost). With
+    "The event core" / "Round three"): a seeded 100k-request
+    compressed diurnal day through the fleet simulator with the
+    event-heap core on vs off — events/s,
+    sim-seconds-per-wall-second, boundaries stepped vs skipped, and
+    the byte-identity verdict between the two modes (the contract
+    the speed is not allowed to cost) — plus the ISSUE 16
+    first-class headline: a 1,000-replica 200k-request columnar
+    smoke whose ``events_per_s`` is published at the top level. With
     KIND_TPU_SIM_BENCH_SLOW=1 the 1M-request 24h trace with
-    autoscaling and chaos rides along as the slow extra."""
+    autoscaling and chaos rides along, and the 1k-replica run is
+    re-run with the columnar mirror forced OFF for the byte-identity
+    + speedup A/B (minutes of wall — the row path really is that
+    much slower at 1k replicas; that asymmetry is the headline)."""
     try:
         import json as _json
 
@@ -2375,6 +2381,43 @@ def fleet_scale() -> dict | None:
             "event_core_off": off,
             "speedup": round(off["wall_s"] / on["wall_s"], 2),
         }
+
+        # the ISSUE 16 headline: flat per-event cost at fleet scale.
+        # 1,000 columnar replicas, 200k diurnal requests; the
+        # top-level events_per_s below is THE number the PR claims.
+        spec1k = fleet.WorkloadSpec(
+            process="diurnal", rps=120.0, n_requests=200_000,
+            diurnal_period_s=8640.0, prompt_len=(8, 24),
+            max_new=(4, 12))
+        t0 = time.monotonic()
+        trace1k = fleet.generate_trace(spec1k, seed=7)
+        gen1k_s = time.monotonic() - t0
+        cfg1k = dict(replicas=1000, policy="least-outstanding",
+                     max_queue=65536, max_virtual_s=1e9,
+                     event_core=True)
+        rep1k, one_k = run_once(
+            trace1k, fleet.FleetConfig(**cfg1k))
+        one_k["replicas"] = 1000
+        one_k["trace_gen_s"] = round(gen1k_s, 3)
+        out["columnar_1k_replicas"] = one_k
+        out["events_per_s"] = one_k["events_per_s"]
+        out["ok"] = bool(out["ok"] and one_k["ok"])
+
+        if _knobs.get(_knobs.BENCH_SLOW):
+            # columnar A/B at 1k replicas: byte identity (the
+            # contract) and the speedup (the point). The row path
+            # takes minutes here — slow tier only.
+            rep1k_off, one_k_off = run_once(
+                trace1k, fleet.FleetConfig(columnar=False, **cfg1k))
+            identical_1k = (
+                _json.dumps(rep1k, sort_keys=True)
+                == _json.dumps(rep1k_off, sort_keys=True))
+            out["columnar_1k_off"] = one_k_off
+            out["replay_identical_columnar_on_vs_off"] = identical_1k
+            out["columnar_speedup"] = round(
+                one_k_off["wall_s"] / one_k["wall_s"], 2)
+            out["ok"] = bool(out["ok"] and one_k_off["ok"]
+                             and identical_1k)
         if _knobs.get(_knobs.BENCH_SLOW):
             # the acceptance headline: 1M requests, a 24h diurnal
             # day, autoscaling and chaos — tens of seconds of wall

@@ -147,6 +147,28 @@ FLEET_EVENT_CORE = _register(
     "Event-heap simulation core: the fleet/globe drivers step only "
     "the tick boundaries where an event lands (replay-identical); "
     "`0` forces the plain per-tick loop.")
+FLEET_COLUMNAR = _register(
+    "KIND_TPU_SIM_FLEET_COLUMNAR", True, "bool", "fleet",
+    "Columnar (struct-of-arrays) replica state for all-analytic "
+    "fleets: wake scans, tick fan-out, and least-outstanding "
+    "routing run over numpy arrays instead of per-object scans "
+    "(replay-identical); `0` reverts to the per-object paths.")
+POOL_SHM = _register(
+    "KIND_TPU_SIM_POOL_SHM", True, "bool", "runtime",
+    "Worker-pool bulk transport over multiprocessing shared_memory "
+    "segments (length-prefixed JSON stays for control frames); `0` "
+    "reverts every payload to the in-band pipe framing.")
+POOL_SHM_SEGS = _register(
+    "KIND_TPU_SIM_POOL_SHM_SEGS", "", "str", "runtime",
+    "INTERNAL: `parent_to_worker:worker_to_parent` shared-memory "
+    "segment names a pool parent hands its protocol worker at "
+    "spawn; never set by hand — the parent owns segment lifetime.")
+GLOBE_SHARDS = _register(
+    "KIND_TPU_SIM_GLOBE_SHARDS", 0, "int", "globe",
+    "Default worker-shard count for the globe driver: cells "
+    "partition across N pool workers with conservative time "
+    "windows and a deterministic merge (replay-identical); 0 runs "
+    "the single-process lockstep loop.")
 
 # disaggregated prefill/decode serving (docs/DISAGG.md)
 DISAGG_TIER = _register(

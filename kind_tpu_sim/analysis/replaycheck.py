@@ -195,6 +195,30 @@ def _run_globe(seed: int, inject: bool) -> dict:
     return globe.GlobeSim(cfg, traces=traces, seed=seed).run()
 
 
+# alternates the drivers call by call (run 0 single-process, run 1
+# sharded, ...) so replay()'s byte-identity verdict IS the
+# cross-driver referee — any mixed sequence must agree anyway
+_GLOBE_SHARD_FLIP = [1]
+
+
+def _run_globe_sharded(seed: int, inject: bool) -> dict:
+    from kind_tpu_sim import globe
+
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b"), cells_per_zone=2,
+        replicas_per_cell=2,
+        workload=globe.GlobeWorkloadSpec(process="poisson",
+                                         rps=30.0, n_per_zone=60))
+    traces = globe.generate_globe_traces(cfg, seed)
+    if inject:
+        _inject_trace(traces[sorted(traces)[0]])
+    _GLOBE_SHARD_FLIP[0] ^= 1
+    if _GLOBE_SHARD_FLIP[0]:
+        return globe.ShardedGlobeSim(cfg, traces=traces,
+                                     seed=seed, shards=2).run()
+    return globe.GlobeSim(cfg, traces=traces, seed=seed).run()
+
+
 def _scenario_runner(name: str):
     def run(seed: int, inject: bool) -> dict:
         if inject:
@@ -205,6 +229,15 @@ def _scenario_runner(name: str):
 
         return chaos.run_scenario(name, seed=seed)
     return run
+
+
+# driver-level targets: direct sim runs and cross-driver referees,
+# not chaos scenarios. Everything in REPLAY_TARGETS outside this
+# tuple MUST come from the scenario registry's replayable set — the
+# bijection test in tests/test_scenarios.py pins that, so a new
+# driver target belongs here, not in an ad-hoc test exclusion.
+DRIVER_TARGETS = ("fleet-run", "sched-run", "globe-run",
+                  "globe-sharded")
 
 
 def _targets() -> Dict[str, ReplayTarget]:
@@ -226,6 +259,10 @@ def _targets() -> Dict[str, ReplayTarget]:
         "globe-run": ReplayTarget(
             "globe-run", "direct GlobeSim run (2 zones)",
             _run_globe, injectable=True),
+        "globe-sharded": ReplayTarget(
+            "globe-sharded", "GlobeSim vs ShardedGlobeSim(2) on "
+            "one seed — the cross-driver byte-identity referee",
+            _run_globe_sharded, slow=True, injectable=True),
     }
     for name in registry.replayable_names():
         out[name] = ReplayTarget(

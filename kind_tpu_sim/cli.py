@@ -387,6 +387,13 @@ def build_parser() -> argparse.ArgumentParser:
              "event-heap core (byte-identical, just slower; "
              "default: KIND_TPU_SIM_FLEET_EVENT_CORE or on)")
     fl.add_argument(
+        "--profile", action="store_true",
+        help="run under cProfile and attach a 'profile' section to "
+             "the report: wall events/s, per-event-lane counts and "
+             "self-time costs, top functions by cumulative time. "
+             "Opt-in: without it the report (and so the replay "
+             "digest) is byte-identical to an unprofiled run")
+    fl.add_argument(
         "--trace-file", default=None,
         help="replay this JSONL trace instead of generating one")
     fl.add_argument(
@@ -518,6 +525,11 @@ def build_parser() -> argparse.ArgumentParser:
     gl.add_argument(
         "--max-virtual-s", type=float, default=600.0,
         help="virtual-time runaway backstop")
+    gl.add_argument(
+        "--shards", type=int, default=None,
+        help="partition the cells across this many worker "
+             "processes (byte-identical report; default: "
+             "KIND_TPU_SIM_GLOBE_SHARDS or 0 = single-process)")
     gl.add_argument(
         "--trace-file", default=None,
         help="replay this JSONL globe trace instead of generating")
@@ -1123,10 +1135,23 @@ def run_fleet(args: argparse.Namespace) -> int:
                 f"{len(bad)} trace request(s) exceed the serving "
                 f"engine's vocab={vocab}/max_len={sc.max_len} "
                 "envelope; regenerate the trace within it")
-    report = fleet.FleetSim(fc, trace, replica_factory=factory,
-                            clock=clock).run()
+    sim = fleet.FleetSim(fc, trace, replica_factory=factory,
+                         clock=clock)
+    profile = None
+    if args.profile:
+        from kind_tpu_sim import profiling
+
+        profiled = profiling.profile_fleet_run(sim)
+        report = profiled.pop("report")
+        profile = profiled
+    else:
+        report = sim.run()
     report["seed"] = seed
     report["engine"] = args.engine
+    if profile is not None:
+        # opt-in wall-clock extras: present ONLY under --profile, so
+        # the replay digest of an unprofiled run never sees them
+        report["profile"] = profile
     text = json.dumps(report, sort_keys=True)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fh:
@@ -1187,6 +1212,20 @@ def run_fleet(args: argparse.Namespace) -> int:
                   f"all_done {t['all_done']}  ledger_ok "
                   f"{t['ledger_ok']}  lost {t['lost_steps']}  "
                   f"checkpoints {t['checkpoint_writes']}")
+        if "profile" in report:
+            p = report["profile"]
+            print(f"  profile: {p['wall_s']}s wall  "
+                  f"{p['events_per_s']} events/s")
+            for name, lane in sorted(
+                    p["lanes"].items(),
+                    key=lambda kv: -kv[1]["self_s"]):
+                if lane["events"] or lane["self_s"]:
+                    print(f"    lane {name}: {lane['events']} "
+                          f"event(s)  self {lane['self_s']}s")
+            for row in p["top_functions"][:5]:
+                print(f"    hot {row['function']}  "
+                      f"cum {row['cumulative_s']}s  "
+                      f"self {row['self_s']}s  x{row['calls']}")
         if args.out:
             print(f"  report -> {args.out}")
         print("FLEET RUN " + ("OK" if report["ok"] else "FAILED"))
@@ -1462,7 +1501,13 @@ def run_globe(args: argparse.Namespace) -> int:
                   f"{args.save_trace}")
         return 0
 
-    report = globe.GlobeSim(cfg, traces=traces, seed=seed).run()
+    n_shards = globe.resolve_shards(args.shards)
+    if n_shards > 1:
+        sim = globe.ShardedGlobeSim(cfg, traces=traces, seed=seed,
+                                    shards=n_shards)
+    else:
+        sim = globe.GlobeSim(cfg, traces=traces, seed=seed)
+    report = sim.run()
     text = json.dumps(report, sort_keys=True)
     if args.out:
         with open(args.out, "w", encoding="utf-8") as fh:

@@ -1,0 +1,185 @@
+"""Columnar (struct-of-arrays) mirror of an all-analytic fleet.
+
+The event core (PR 8) made wall time scale with EVENT count, but
+each stepped boundary still paid O(replicas) in Python: the wake
+scan called ``next_due()`` on every replica, the tick fan-out
+called ``tick()`` on every replica (almost all of them no-ops),
+and every routed request sorted the whole replica list. At 10k
+replicas those per-object scans dominate the per-event cost.
+
+:class:`FleetColumns` keeps the scheduling-relevant state of every
+:class:`~kind_tpu_sim.fleet.router.SimReplica` in numpy arrays —
+wake bounds (the ``(ge, cover)`` pair ``next_due()`` computes),
+queue length, outstanding count, health — refreshed lazily through
+a dirty set the replicas themselves maintain (every mutating
+replica method calls ``_touch()``). The three hot paths become
+array reductions:
+
+* the wake scan is ``min()`` over the ge/cover columns,
+* the tick fan-out visits only replicas that can act in the
+  window — queued work, in-flight slots, or a covering bound
+  inside it; skipping the idle rest is exactly the event core's
+  partition-invariance argument applied per replica (an idle
+  replica's tick is a strict no-op, and busy replicas are visited
+  every stepped boundary so their token chains materialize at the
+  same rate the per-object path fixes them — load-bearing when
+  gray chaos changes a replica's service rate mid-run),
+* least-outstanding routing is one masked ``argmin`` over the key
+  ``outstanding * K + replica_id`` (the same (load, id) tiebreak
+  the sorted path uses).
+
+Reports stay byte-identical with the columns on or off — the knob
+``KIND_TPU_SIM_FLEET_COLUMNAR`` (default on) reverts to the
+per-object paths, and the A/B identity is pinned by tests.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from kind_tpu_sim.analysis import knobs
+
+COLUMNAR_ENV = knobs.FLEET_COLUMNAR
+
+_INF = float("inf")
+# default-engagement floor: below this many replicas the per-object
+# scans are already cheap and the per-boundary numpy call overhead
+# (flush + wake + fan-out masks) costs more than it saves — the
+# default (knob-driven) path only engages at or above it. Purely a
+# cost heuristic: reports are byte-identical either side, and an
+# explicit FleetConfig.columnar=True engages at any size (the A/B
+# identity tests rely on that).
+COLUMNAR_MIN_REPLICAS = 32
+# masked-out (unhealthy) entries in the routing argmin key: far
+# above any reachable outstanding*K+id value, still int64-safe
+_MASKED = np.int64(1) << np.int64(62)
+
+
+def resolve_columnar(value: Optional[bool] = None) -> bool:
+    """Explicit value > env (KIND_TPU_SIM_FLEET_COLUMNAR) > on."""
+    if value is not None:
+        return bool(value)
+    return bool(knobs.get(COLUMNAR_ENV))
+
+
+class FleetColumns:
+    """The struct-of-arrays mirror. Indexed by LIST POSITION in the
+    fleet's replica list (which the driver keeps id-sorted), so the
+    tick fan-out preserves the per-object loop's iteration order —
+    completion observation order is part of the replay contract."""
+
+    __slots__ = ("replicas", "n", "ge", "cover", "qlen", "out",
+                 "healthy", "ids", "_key_base", "dirty")
+
+    def __init__(self, replicas: Sequence):
+        self.replicas: List = []
+        self.rebuild(replicas)
+
+    def rebuild(self, replicas: Sequence) -> None:
+        """Re-mirror after a membership change (scale events). Rare
+        by construction — the autoscaler acts at eval cadence."""
+        new = list(replicas)
+        keep = {id(r) for r in new}
+        for r in self.replicas:
+            if id(r) not in keep:
+                r._cols = None
+        self.replicas = new
+        n = len(new)
+        self.n = n
+        self.ge = np.full(n, _INF)
+        self.cover = np.full(n, _INF)
+        self.qlen = np.zeros(n, dtype=np.int64)
+        self.out = np.zeros(n, dtype=np.int64)
+        self.healthy = np.zeros(n, dtype=bool)
+        self.ids = np.array([r.replica_id for r in new],
+                            dtype=np.int64).reshape(n)
+        self._key_base = (int(self.ids.max()) + 1) if n else 1
+        for i, r in enumerate(new):
+            r._cols = self
+            r._idx = i
+        self.dirty = set(range(n))
+
+    def flush(self) -> None:
+        """Refresh every dirty row from its replica — O(touched),
+        not O(replicas): the lazy half of the design."""
+        d = self.dirty
+        if not d:
+            return
+        reps = self.replicas
+        ge, cover = self.ge, self.cover
+        qlen, out, healthy = self.qlen, self.out, self.healthy
+        for i in d:
+            r = reps[i]
+            g, c = r.next_due()
+            ge[i] = _INF if g is None else g
+            cover[i] = _INF if c is None else c
+            qlen[i] = len(r.queue)
+            out[i] = r.outstanding()
+            healthy[i] = r.healthy
+        d.clear()
+
+    # -- the vectorized hot paths -------------------------------------
+
+    def wake(self) -> tuple:
+        """(ge_min, cover_min) across the fleet — the replica leg of
+        the event core's wake scan, as two array reductions."""
+        self.flush()
+        if not self.n:
+            return (None, None)
+        g = float(self.ge.min())
+        c = float(self.cover.min())
+        return (None if g == _INF else g,
+                None if c == _INF else c)
+
+    def active_indices(self, end: float) -> Sequence[int]:
+        """List positions (ascending — the fan-out order contract)
+        of replicas whose ``tick()`` over a window ending at ``end``
+        is not provably a no-op: queued work acts at every boundary
+        (admission / deadline reaping), in-flight slots materialize
+        their token chain boundary-by-boundary, and a covering bound
+        inside the window means an externally visible slot event may
+        land. Only an IDLE replica's visit is provably a no-op —
+        deferring a busy replica's internal token events is safe
+        only while its service rate is constant, and the gray-chaos
+        ``slow``/``unslow`` (and degraded-link) actions change the
+        rate mid-run: a deferred link would then be scheduled at the
+        new factor where the per-object path already fixed it at the
+        old one."""
+        self.flush()
+        if not self.n:
+            return ()
+        mask = ((self.qlen > 0) | (self.out > 0)
+                | (self.healthy & (self.cover <= end)))
+        return np.nonzero(mask)[0]
+
+    def all_idle(self) -> bool:
+        """Quiescence's replica leg: no healthy replica holds work."""
+        self.flush()
+        if not self.n:
+            return True
+        return not bool((self.out[self.healthy] > 0).any())
+
+    def healthy_outstanding(self) -> int:
+        """Sum of outstanding over healthy replicas (the autoscaler
+        backlog term)."""
+        self.flush()
+        if not self.n:
+            return 0
+        return int(self.out[self.healthy].sum())
+
+    def pick_least_outstanding(self):
+        """The routing fast path: the healthy replica minimizing
+        (outstanding, replica_id) — identical to the sorted path's
+        first candidate — or None when no replica is healthy."""
+        self.flush()
+        if not self.n:
+            return None
+        key = np.where(self.healthy,
+                       self.out * self._key_base + self.ids,
+                       _MASKED)
+        i = int(key.argmin())
+        if key[i] >= _MASKED:
+            return None
+        return self.replicas[i]

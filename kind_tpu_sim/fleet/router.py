@@ -108,6 +108,16 @@ class SimReplica:
         self._prefix_seen: Dict[int, bool] = {}
         self.prefix_hits = 0
         self.prefix_misses = 0
+        # columnar mirror back-pointer (fleet/columnar.py): every
+        # mutating method marks its row dirty so the fleet's arrays
+        # refresh lazily; None outside a columnar fleet
+        self._cols = None
+        self._idx = -1
+
+    def _touch(self) -> None:
+        c = self._cols
+        if c is not None:
+            c.dirty.add(self._idx)
 
     def set_slowdown(self, factor: float) -> None:
         """Inflate (or restore, factor=1) this replica's service
@@ -118,6 +128,7 @@ class SimReplica:
         remainder-carry semantics the gray scenarios were built on);
         every subsequent token picks up the new factor."""
         self.slowdown = max(1.0, float(factor))
+        self._touch()
 
     def cancel(self, request_id: str) -> bool:
         """First-completion-wins cancellation (the hedging layer's
@@ -130,6 +141,7 @@ class SimReplica:
         for i, req in enumerate(self.queue):
             if req.request_id == request_id:
                 del self.queue[i]
+                self._touch()
                 return True
         for i, slot in enumerate(self._slots):
             if (slot is not None
@@ -138,6 +150,7 @@ class SimReplica:
                 # stream is discarded (the winner's stream is the
                 # request's one true output)
                 self._slots[i] = None
+                self._touch()
                 return True
         return False
 
@@ -169,6 +182,7 @@ class SimReplica:
                 and len(self.queue) >= self.cfg.max_queue):
             return False
         self.queue.append(req)
+        self._touch()
         return True
 
     def _prefill_cost(self, req: TraceRequest) -> float:
@@ -358,6 +372,7 @@ class SimReplica:
                 slot["next_s"] = nxt
         # a slot that finished mid-tick stays empty until the next
         # tick's admission pass — the chunk-boundary contract
+        self._touch()
         return done
 
     def _complete(self, slot: dict, finish_s: float,
@@ -387,10 +402,12 @@ class SimReplica:
         self._slots = [None] * self.cfg.max_slots
         self._prefix_seen.clear()
         self.healthy = False
+        self._touch()
         return displaced
 
     def restore(self, now: float) -> None:
         self.healthy = True
+        self._touch()
 
     def report(self) -> Dict[str, object]:
         out: Dict[str, object] = {
@@ -593,6 +610,9 @@ class Router:
         self.affinity_spill = affinity_spill
         self.queue: List[TraceRequest] = []
         self._rr = 0
+        # columnar mirror (fleet/columnar.py), set by a columnar
+        # FleetSim: enables the argmin routing fast path
+        self._columns = None
         self.routed = 0
         self.shed = 0
         self.expired_queued = 0
@@ -696,6 +716,28 @@ class Router:
         self.affinity_hits += 1
         return [home] + [r for r in by_load if r is not home]
 
+    def _fast_pick(self, req: TraceRequest):
+        """The columnar routing fast path (fleet/columnar.py): the
+        load-ordered policies' first candidate — the healthy replica
+        minimizing (outstanding, replica_id) — via one masked argmin
+        instead of a full sort. Engages only where the ordering is
+        EXACTLY that key: least-outstanding (and prefix-affinity's
+        ungrouped fallback), no detector weighting, no breaker
+        filtering, no phase pools. Anything else answers None and
+        the sorted path runs unchanged; a refused submit also falls
+        back to it (refusal mutates nothing, so re-offering to the
+        same first candidate is a no-op)."""
+        cols = self._columns
+        if (cols is None or self.disagg
+                or self.health is not None
+                or self.overload is not None):
+            return None
+        if self.policy == "round-robin":
+            return None
+        if self.policy == "prefix-affinity" and req.prefix_group >= 0:
+            return None
+        return cols.pick_least_outstanding()
+
     # -- surface -----------------------------------------------------
 
     def offer(self, req: TraceRequest,
@@ -793,26 +835,36 @@ class Router:
         while self.queue:
             req = self.queue[0]
             placed = False
-            for replica in self._pick_order(req, now):
-                if replica.submit(req, now):
-                    self.queue.pop(0)
-                    self.routed += 1
-                    self.per_replica[replica.replica_id] = (
-                        self.per_replica.get(replica.replica_id, 0)
-                        + 1)
-                    metrics.fleet_board().incr("requests_routed")
-                    if self.policy == "round-robin":
-                        self._rr += 1
-                    if self.overload is not None:
-                        self.overload.breaker_dispatch(
-                            f"replica-{replica.replica_id}")
-                    if self.on_place is not None:
-                        self.on_place(req, replica, now)
-                    placed = True
-                    break
+            fast = self._fast_pick(req)
+            if fast is not None and fast.submit(req, now):
+                self._note_place(req, fast, now)
+                placed = True
+            else:
+                for replica in self._pick_order(req, now):
+                    if replica.submit(req, now):
+                        self._note_place(req, replica, now)
+                        placed = True
+                        break
             if not placed:
                 break  # head blocks: FCFS, retry next pass
         return out
+
+    def _note_place(self, req: TraceRequest, replica,
+                    now: float) -> None:
+        """Shared bookkeeping for a successful placement (both the
+        sorted path and the columnar fast path land here)."""
+        self.queue.pop(0)
+        self.routed += 1
+        self.per_replica[replica.replica_id] = (
+            self.per_replica.get(replica.replica_id, 0) + 1)
+        metrics.fleet_board().incr("requests_routed")
+        if self.policy == "round-robin":
+            self._rr += 1
+        if self.overload is not None:
+            self.overload.breaker_dispatch(
+                f"replica-{replica.replica_id}")
+        if self.on_place is not None:
+            self.on_place(req, replica, now)
 
     def report(self) -> Dict[str, object]:
         out: Dict[str, object] = {

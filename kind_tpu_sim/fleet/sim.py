@@ -48,6 +48,11 @@ from kind_tpu_sim.fleet.disagg import (
     kv_transfer_s,
     calibrated_sim_config,
 )
+from kind_tpu_sim.fleet.columnar import (
+    COLUMNAR_MIN_REPLICAS,
+    FleetColumns,
+    resolve_columnar,
+)
 from kind_tpu_sim.fleet.events import (
     LANE_ARRIVAL,
     LANE_AUTOSCALER,
@@ -247,6 +252,14 @@ class FleetConfig:
     # diff clean on vs off, so it stays OUT of as_dict() too.
     # contractlint: ok(drift) -- execution strategy: heap-core on vs off reports must diff clean
     event_core: Optional[bool] = None
+    # columnar replica state (None -> resolve_columnar(), default
+    # on): keeps the analytic fleet's wake scans / tick fan-out /
+    # least-outstanding routing in numpy struct-of-arrays
+    # (fleet/columnar.py). Same contract again: an execution
+    # strategy, byte-identical on or off, so it stays OUT of
+    # as_dict().
+    # contractlint: ok(drift) -- execution strategy: columnar on vs off reports must diff clean
+    columnar: Optional[bool] = None
 
     def as_dict(self) -> dict:
         out = {
@@ -361,6 +374,17 @@ class FleetSim:
                              disagg=self._disagg is not None)
         if self.overload is not None:
             self.router.on_place = self._on_place
+        # columnar mirror: engages only on all-analytic fleets (no
+        # replica_factory means every replica is a SimReplica with a
+        # closed-form next_due — disagg included); engine-backed
+        # fleets keep the per-object paths
+        self._cols: Optional[FleetColumns] = None
+        if replica_factory is None and (
+                cfg.columnar is True
+                or (resolve_columnar(cfg.columnar)
+                    and cfg.replicas >= COLUMNAR_MIN_REPLICAS)):
+            self._cols = FleetColumns(self.replicas)
+            self.router._columns = self._cols
         self.chaos_events = sorted(chaos_events,
                                    key=lambda e: (e.at_s, e.target))
         self.tracker = SloTracker(
@@ -965,9 +989,11 @@ class FleetSim:
         (when the policy sets ``itl_s``; queue-depth otherwise) +
         KV-lane backlog. Scale-down drains the pool's highest-id
         healthy replica, never below the declared floor."""
+        changed = False
         for replica, reason in self._warming.pop_due(now):
             self.replicas.append(replica)
             self.router.replicas.append(replica)
+            changed = True
             phase = getattr(replica, "phase", "unified")
             self._pool_scalers[phase].note_ready(
                 now, len(self._pool_members(phase)), reason=reason)
@@ -1011,8 +1037,11 @@ class FleetSim:
                 self.router.replicas.remove(victim)
                 self.replicas.remove(victim)
                 self._draining.append(victim)
+                changed = True
                 metrics.disagg_board().incr(
                     f"{phase}_scale_downs")
+        if changed and self._cols is not None:
+            self._cols.rebuild(self.replicas)
 
     def displace_disagg(self) -> List[TraceRequest]:
         """Drain the whole KV lane — queued handoffs AND in-flight
@@ -1136,6 +1165,9 @@ class FleetSim:
             self.on_complete(self.log[-1], comp)
 
     def _backlog(self) -> int:
+        if self._cols is not None:
+            return (len(self.router.queue)
+                    + self._cols.healthy_outstanding())
         return (len(self.router.queue)
                 + sum(r.outstanding() for r in self.replicas
                       if r.healthy))
@@ -1212,10 +1244,12 @@ class FleetSim:
 
     def _autoscale(self, now: float) -> None:
         scaler = self.autoscaler
+        changed = False
         # warming replicas come online first
         for replica, reason in self._warming.pop_due(now):
             self.replicas.append(replica)
             self.router.replicas.append(replica)
+            changed = True
             scaler.note_ready(now, len(self.router.replicas),
                               reason=reason)
         # quarantined capacity is MISSING capacity: the autoscaler
@@ -1254,6 +1288,9 @@ class FleetSim:
             self.router.replicas.remove(victim)
             self.replicas.remove(victim)
             self._draining.append(victim)
+            changed = True
+        if changed and self._cols is not None:
+            self._cols.rebuild(self.replicas)
 
     # -- the loop ------------------------------------------------------
 
@@ -1321,7 +1358,16 @@ class FleetSim:
             self._record(comp, -1)
         if self.overload is not None:
             self._fire_hedges(now)
-        for replica in list(self.replicas):
+        if self._cols is not None:
+            # columnar fan-out: visit only replicas that can act in
+            # this window, in the same ascending list order — the
+            # skipped ones are provable no-ops (fleet/columnar.py)
+            reps = self._cols.replicas
+            targets = [reps[i] for i in
+                       self._cols.active_indices(now + tick)]
+        else:
+            targets = list(self.replicas)
+        for replica in targets:
             for comp in replica.tick(now, tick):
                 if comp.request.request_id.startswith(
                         "__probe-"):
@@ -1374,8 +1420,9 @@ class FleetSim:
             not pending and not self.router.queue
             and not self._kv_heap and not self.router.kv_queue
             and not self._warming
-            and all(r.idle() for r in self.replicas
-                    if r.healthy)
+            and (self._cols.all_idle() if self._cols is not None
+                 else all(r.idle() for r in self.replicas
+                          if r.healthy))
             and not self._draining
             and not self.chaos_events
             and not self._retry_heap and not self._hedge_heap
@@ -1464,6 +1511,11 @@ class FleetSim:
             return due.need_now()
         due.at(self._warming.peek_time())
         due.at(self._rebinding.peek_time())
+        if self._cols is not None:
+            ge, cover = self._cols.wake()
+            due.at(ge)
+            due.covering(cover)
+            return self._wake_probes(due, pending)
         for replica in self.replicas:
             nd = getattr(replica, "next_due", None)
             if nd is None:
@@ -1479,6 +1531,9 @@ class FleetSim:
             ge, cover = nd()
             due.at(ge)
             due.covering(cover)
+        return self._wake_probes(due, pending)
+
+    def _wake_probes(self, due: DueSet, pending: deque) -> DueSet:
         if self.health is not None and pending:
             # probes fire while user traffic still flows, one per
             # suspect-or-quarantined alive replica per interval

@@ -43,3 +43,8 @@ from kind_tpu_sim.globe.sim import (  # noqa: F401
     save_globe_trace,
     zone_seed,
 )
+from kind_tpu_sim.globe.shard import (  # noqa: F401
+    CellProxy,
+    ShardedGlobeSim,
+    resolve_shards,
+)

@@ -290,6 +290,40 @@ def load_globe_trace(path: str) -> Dict[str, List[TraceRequest]]:
     return out
 
 
+def fleet_config_for(cfg: GlobeConfig, zone: str,
+                     training: bool = False) -> FleetConfig:
+    """The embedded FleetConfig one cell of ``cfg`` runs in ``zone``.
+    Module-level (not a GlobeSim method) so shard workers
+    (globe/shard.py) build byte-identical cells from the wire copy
+    of the config without a parent driver object."""
+    return FleetConfig(
+        training=(cfg.training if training else None),
+        replicas=cfg.replicas_per_cell, policy=cfg.policy,
+        tick_s=cfg.tick_s,
+        # the FRONT DOOR is the admission layer: its per-cell
+        # hard limit keeps cell queues bounded, so the cell
+        # router never sheds on its own (max_queue=0 = no bound)
+        max_queue=0,
+        max_virtual_s=cfg.max_virtual_s,
+        autoscale=cfg.autoscale,
+        slo=cfg.slo, sim=cfg.sim,
+        autoscaler=cfg.autoscaler,
+        sched=(FleetSchedConfig(policy=cfg.sched_policy,
+                                zone=zone,
+                                **({"pods": cfg.cell_pods}
+                                   if cfg.cell_pods is not None
+                                   else {}))
+               if cfg.sched else None),
+        # cells keep the replica-tier controls (breakers,
+        # brownout) but the CLIENT lives at the front door:
+        # cell-level retries and hedges stay off
+        overload=(dataclasses.replace(cfg.overload,
+                                      max_attempts=1,
+                                      hedge=False)
+                  if cfg.overload is not None else None),
+        fast_forward=False)  # the globe fast-forwards, not cells
+
+
 # -- the driver --------------------------------------------------------
 
 
@@ -329,15 +363,8 @@ class GlobeSim:
                 "GlobeConfig.training needs scheduler-backed cells "
                 "(sched=True): training gangs are scheduler-placed "
                 "workloads")
-        self.cells = [
-            Cell(CellConfig(name=name, zone=name.split("/")[0],
-                            fleet=self._fleet_config(
-                                name.split("/")[0],
-                                training=name in training_cells)),
-                 self.clock)
-            for name in cfg.cell_names()]
-        for cell in self.cells:
-            cell.sim.on_complete = self._completion_hook(cell)
+        self.cells = self._build_cells(training_cells)
+        self._wire_cells()
         self._cell_by_name = {c.name: c for c in self.cells}
         # overload containment at the client tier (docs/OVERLOAD.md):
         # per-origin retry budgets, per-cell breakers, cross-cell
@@ -380,35 +407,28 @@ class GlobeSim:
         self._scan_holdoff = 0
         self._scan_backoff = 1
 
+    def _build_cells(self, training_cells: set) -> List[Cell]:
+        """Cell construction, factored so the sharded driver
+        (globe/shard.py) can override it with worker-resident cells
+        behind parent-side proxies."""
+        return [
+            Cell(CellConfig(name=name, zone=name.split("/")[0],
+                            fleet=fleet_config_for(
+                                self.cfg, name.split("/")[0],
+                                training=name in training_cells)),
+                 self.clock)
+            for name in self.cfg.cell_names()]
+
+    def _wire_cells(self) -> None:
+        """Hook every cell's completion stream into the globe log /
+        trackers — a no-op in the sharded driver, where the hook
+        runs on the parent against streamed completion records."""
+        for cell in self.cells:
+            cell.sim.on_complete = self._completion_hook(cell)
+
     def _fleet_config(self, zone: str,
                       training: bool = False) -> FleetConfig:
-        cfg = self.cfg
-        return FleetConfig(
-            training=(cfg.training if training else None),
-            replicas=cfg.replicas_per_cell, policy=cfg.policy,
-            tick_s=cfg.tick_s,
-            # the FRONT DOOR is the admission layer: its per-cell
-            # hard limit keeps cell queues bounded, so the cell
-            # router never sheds on its own (max_queue=0 = no bound)
-            max_queue=0,
-            max_virtual_s=cfg.max_virtual_s,
-            autoscale=cfg.autoscale,
-            slo=cfg.slo, sim=cfg.sim,
-            autoscaler=cfg.autoscaler,
-            sched=(FleetSchedConfig(policy=cfg.sched_policy,
-                                    zone=zone,
-                                    **({"pods": cfg.cell_pods}
-                                       if cfg.cell_pods is not None
-                                       else {}))
-                   if cfg.sched else None),
-            # cells keep the replica-tier controls (breakers,
-            # brownout) but the CLIENT lives at the front door:
-            # cell-level retries and hedges stay off
-            overload=(dataclasses.replace(cfg.overload,
-                                          max_attempts=1,
-                                          hedge=False)
-                      if cfg.overload is not None else None),
-            fast_forward=False)  # the globe fast-forwards, not cells
+        return fleet_config_for(self.cfg, zone, training=training)
 
     # -- DCN model ----------------------------------------------------
 

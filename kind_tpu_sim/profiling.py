@@ -93,6 +93,96 @@ def capture(fn, *args, log_dir, warmup: int = 1,
     }
 
 
+# fleet-sim event lanes -> the driver methods that process them
+# (fleet/events.py LANE_* order). Costs are attributed by summing
+# cProfile SELF time (tottime) over each lane's handlers — exclusive
+# time never double-counts a lane even where handlers nest (e.g.
+# _handle_completion -> _maybe_retry).
+_FLEET_LANE_FNS = {
+    "arrival": ("_offer_arrival", "_on_place"),
+    "completion": ("_handle_completion", "_record", "_fire_hedges",
+                   "_maybe_retry", "_on_prefill_done"),
+    "chaos": ("_apply_chaos", "_apply_node_chaos",
+              "_apply_link_chaos", "_apply_disagg_chaos"),
+    "health_probe": ("_probe_quarantined", "_observe_health",
+                     "_drain_migrations", "_refresh_link_slowdowns"),
+    "autoscaler": ("_autoscale", "_autoscale_pools", "_sched_step"),
+    "kv_transfer": ("displace_disagg", "_requeue_front"),
+    "core": ("step", "run", "_skip_uninteresting", "_advance",
+             "_next_wake", "quiescent"),
+}
+
+
+def profile_fleet_run(sim, top: int = 25) -> Dict[str, Any]:
+    """Run ``sim.run()`` under cProfile; returns ``{"report", ...}``
+    plus the opt-in `fleet run --profile` extras: wall seconds,
+    events/s, the top functions by cumulative time, per-event-lane
+    push counts (summed over the sim's EventHeap lanes — retry,
+    hedge, KV, warm-up, rebind), and per-lane self-time costs
+    attributed via :data:`_FLEET_LANE_FNS`. Wall-clock by design:
+    nothing here feeds the seeded report, which stays byte-identical
+    to an unprofiled run."""
+    import cProfile
+    import pstats
+
+    from kind_tpu_sim.fleet import events as _ev
+
+    prof = cProfile.Profile()
+    t0 = time.monotonic()
+    prof.enable()
+    report = sim.run()
+    prof.disable()
+    wall = max(time.monotonic() - t0, 1e-9)
+
+    stats = pstats.Stats(prof)
+    lane_self_s = {lane: 0.0 for lane in _FLEET_LANE_FNS}
+    fn_to_lane = {fn: lane for lane, fns in _FLEET_LANE_FNS.items()
+                  for fn in fns}
+    rows = []
+    for (fname, lineno, func), (cc, nc, tt, ct, _callers) \
+            in stats.stats.items():
+        if "kind_tpu_sim" in fname:
+            lane = fn_to_lane.get(func)
+            if lane is not None:
+                lane_self_s[lane] += tt
+        rows.append({"function": f"{os.path.basename(fname)}:"
+                                 f"{lineno}({func})",
+                     "calls": nc, "self_s": round(tt, 4),
+                     "cumulative_s": round(ct, 4)})
+    rows.sort(key=lambda r: -r["cumulative_s"])
+
+    lane_names = {_ev.LANE_ARRIVAL: "arrival",
+                  _ev.LANE_COMPLETION: "completion",
+                  _ev.LANE_CHAOS: "chaos",
+                  _ev.LANE_HEALTH_PROBE: "health_probe",
+                  _ev.LANE_AUTOSCALER: "autoscaler",
+                  _ev.LANE_PLANNER: "planner",
+                  _ev.LANE_KV_TRANSFER: "kv_transfer"}
+    pushes = {name: 0 for name in lane_names.values()}
+    for heap in (sim._retry_heap, sim._hedge_heap, sim._kv_heap,
+                 sim._warming, sim._rebinding):
+        for lane, seq in enumerate(heap._seq):
+            pushes[lane_names[lane]] += seq
+    # the two lanes that never ride a heap: offered arrivals and
+    # delivered completions come straight off the trace/replicas
+    pushes["arrival"] += report.get("requests", 0)
+    pushes["completion"] += len(report.get("completions", ()))
+
+    lanes = {
+        name: {"events": pushes.get(name, 0),
+               "self_s": round(lane_self_s.get(name, 0.0), 4)}
+        for name in sorted(set(pushes) | set(lane_self_s))
+    }
+    return {
+        "report": report,
+        "wall_s": round(wall, 3),
+        "events_per_s": round(len(report.get("completions", ()))
+                              / wall),
+        "lanes": lanes,
+        "top_functions": rows[:top],
+    }
+
+
 def _trace_files(log_dir) -> List[str]:
     return sorted(
         glob.glob(str(pathlib.Path(log_dir) /

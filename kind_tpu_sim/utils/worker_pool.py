@@ -82,6 +82,19 @@ CHAOS_FAULT_ENV = knobs.CHAOS_FAULT
 # A frame bigger than this is protocol corruption, not data.
 MAX_FRAME_BYTES = 64 * 1024 * 1024
 
+# Bulk-payload transport (KIND_TPU_SIM_POOL_SHM, default on): each
+# worker gets two parent-OWNED multiprocessing.shared_memory
+# segments (one per direction). A payload at least SHM_MIN_BYTES
+# long travels as raw bytes in the segment plus a tiny {"shm_len": N}
+# control frame; smaller payloads (and anything when the knob is
+# off, the segment is missing, or the payload outgrows the segment)
+# stay in-band. The request/response protocol is strictly serialized
+# per worker, so one segment per direction needs no further locking,
+# and the PARENT creates and unlinks both segments — a crashed or
+# deadline-killed worker can never leak one.
+POOL_SHM_BYTES = 32 * 1024 * 1024
+SHM_MIN_BYTES = 64 * 1024
+
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
 
 
@@ -103,8 +116,24 @@ class WorkerCancelled(RuntimeError):
     original dispatch) — not a worker failure."""
 
 
+class FrameError(RuntimeError):
+    """Length-prefix framing violation (an implausible declared
+    length) — protocol corruption, not data."""
+
+
 # ---------------------------------------------------------------------
-# framing
+# framing — ONE parser for both sides of the pipe: the blocking
+# stream reader (worker side) and the incremental buffer splitter
+# (parent side) share frame_length below, so the protocol has a
+# single point of truth for the header format and the size bound.
+
+
+def frame_length(header: bytes) -> int:
+    """Decode and validate a 4-byte big-endian frame header."""
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME_BYTES:
+        raise FrameError(f"implausible frame length {length}")
+    return length
 
 
 def write_frame(stream, obj) -> None:
@@ -120,9 +149,10 @@ def read_frame(stream):
         return None
     if len(header) < 4:
         raise EOFError("truncated frame header")
-    (length,) = struct.unpack(">I", header)
-    if length > MAX_FRAME_BYTES:
-        raise EOFError(f"implausible frame length {length}")
+    try:
+        length = frame_length(header)
+    except FrameError as exc:
+        raise EOFError(str(exc)) from exc
     payload = b""
     while len(payload) < length:
         chunk = stream.read(length - len(payload))
@@ -205,6 +235,16 @@ def _job_call(target: str, kwargs: Optional[dict] = None):
     return obj(**(kwargs or {}))
 
 
+def _job_call_batch(target: str,
+                    kwargs_list: Sequence[dict]) -> list:
+    """N generic calls in one protocol round trip — the batched cell
+    dispatch the grid schedulers use to amortize framing + dispatch
+    overhead when cells are cheap. Each call is the same pure
+    function of its kwargs as a lone ``call``, so results are
+    position-identical to N single dispatches."""
+    return [_job_call(target, kw) for kw in kwargs_list]
+
+
 def _job_psum_cache_probe(topology: str = "2x4") -> dict:
     """psum smoke + XLA persistent-cache hit/miss counters.
 
@@ -251,9 +291,29 @@ JOBS = {
     "psum_cache_probe": _job_psum_cache_probe,
     "collectives_suite": _job_collectives_suite,
     "call": _job_call,
+    "call_batch": _job_call_batch,
     "crash": _job_crash,
     "hang": _job_hang,
 }
+
+
+def _attach_shm(name: str):
+    """Attach a parent-owned segment by name; None when anything is
+    off (the knob, the platform, a stale name) — the pipe framing is
+    always a complete fallback. The attachment is unregistered from
+    the child's resource_tracker: the PARENT owns segment lifetime,
+    and a tracked attachment would double-unlink at child exit."""
+    try:
+        from multiprocessing import resource_tracker, shared_memory
+
+        seg = shared_memory.SharedMemory(name=name)
+        try:
+            resource_tracker.unregister(seg._name, "shared_memory")
+        except Exception:
+            pass
+        return seg
+    except Exception:
+        return None
 
 
 def _parse_fault(spec: Optional[str]):
@@ -287,6 +347,24 @@ def _serve() -> int:
     out = os.fdopen(proto_fd, "wb")
     inp = sys.stdin.buffer
 
+    # bulk transport: parent-owned segments, one per direction
+    shm_in = shm_out = None
+    segs = str(knobs.get(knobs.POOL_SHM_SEGS) or "")
+    if segs and ":" in segs:
+        in_name, _, out_name = segs.partition(":")
+        shm_in = _attach_shm(in_name)
+        shm_out = _attach_shm(out_name)
+
+    def send(obj) -> None:
+        payload = json.dumps(obj, sort_keys=True).encode("utf-8")
+        if (shm_out is not None and len(payload) >= SHM_MIN_BYTES
+                and len(payload) <= shm_out.size):
+            shm_out.buf[:len(payload)] = payload
+            write_frame(out, {"shm_len": len(payload)})
+            return
+        out.write(struct.pack(">I", len(payload)) + payload)
+        out.flush()
+
     hello = {"hello": True, "pid": os.getpid()}
     if knobs.get(WARM_ENV):
         t0 = time.monotonic()
@@ -304,6 +382,11 @@ def _serve() -> int:
             req = read_frame(inp)
         except EOFError:
             return 1
+        if (isinstance(req, dict) and "shm_len" in req
+                and shm_in is not None):
+            # bulk request: the control frame only carries the length
+            req = json.loads(
+                bytes(shm_in.buf[:req["shm_len"]]).decode("utf-8"))
         if req is None or req.get("op") == "shutdown":
             return 0
         req_no += 1
@@ -332,7 +415,7 @@ def _serve() -> int:
             resp["error"] = f"{type(exc).__name__}: {exc}"[:2000]
             resp["traceback"] = traceback.format_exc()[-2000:]
         resp["elapsed_s"] = round(time.monotonic() - t0, 6)
-        write_frame(out, resp)
+        send(resp)
 
 
 # ---------------------------------------------------------------------
@@ -370,6 +453,24 @@ class _WorkerProc:
         self._buf = b""
         self.hello: Optional[dict] = None
         self.spawned_at = time.monotonic()
+        # bulk transport: the parent CREATES (and later unlinks) one
+        # segment per direction and hands the worker the names — a
+        # worker that crashes, hangs, or is deadline-killed cannot
+        # leak a segment because it never owns one
+        self._shm_in = self._shm_out = None
+        if bool(knobs.get(knobs.POOL_SHM)):
+            try:
+                from multiprocessing import shared_memory
+
+                self._shm_in = shared_memory.SharedMemory(
+                    create=True, size=POOL_SHM_BYTES)
+                self._shm_out = shared_memory.SharedMemory(
+                    create=True, size=POOL_SHM_BYTES)
+                env = dict(env)
+                env[knobs.POOL_SHM_SEGS] = (
+                    f"{self._shm_in.name}:{self._shm_out.name}")
+            except Exception:  # no /dev/shm etc. — pipe fallback
+                self._close_shm()
         if stderr_path is None:
             fd, name = tempfile.mkstemp(prefix="tpu-sim-worker-",
                                         suffix=".err")
@@ -398,7 +499,9 @@ class _WorkerProc:
         try:
             self._stderr_file.flush()
             return self.stderr_path.read_text(errors="replace")[-n:]
-        except OSError:
+        except (OSError, ValueError):
+            # ValueError: file already closed by kill()/close_files()
+            # while a reader was still draining the stdout pipe
             return ""
 
     def read_frame(self, deadline: float, cancel=None):
@@ -415,6 +518,14 @@ class _WorkerProc:
             while True:
                 frame, self._buf = _try_parse(self._buf)
                 if frame is not None:
+                    if (isinstance(frame, dict)
+                            and "shm_len" in frame
+                            and self._shm_out is not None):
+                        # bulk response: payload sits in the
+                        # worker->parent segment
+                        n = frame["shm_len"]
+                        frame = json.loads(bytes(
+                            self._shm_out.buf[:n]).decode("utf-8"))
                     return frame
                 if cancel is not None and cancel.is_set():
                     raise WorkerCancelled(
@@ -445,15 +556,31 @@ class _WorkerProc:
             self.hello = self.read_frame(deadline)
         return self.hello
 
-    def request(self, req: dict, deadline: float,
-                cancel=None) -> dict:
-        self.ensure_ready(deadline)
+    def send(self, req: dict) -> None:
+        """One request toward the worker: big payloads go through
+        the parent->worker segment, everything else in-band."""
         try:
-            write_frame(self.proc.stdin, req)
+            payload = json.dumps(
+                req, sort_keys=True).encode("utf-8")
+            if (self._shm_in is not None
+                    and len(payload) >= SHM_MIN_BYTES
+                    and len(payload) <= self._shm_in.size):
+                self._shm_in.buf[:len(payload)] = payload
+                write_frame(self.proc.stdin,
+                            {"shm_len": len(payload)})
+                return
+            self.proc.stdin.write(
+                struct.pack(">I", len(payload)) + payload)
+            self.proc.stdin.flush()
         except (BrokenPipeError, OSError) as exc:
             raise WorkerCrash(
                 f"worker {self.pid} pipe closed: {exc}; "
                 f"{self.stderr_tail()}") from exc
+
+    def request(self, req: dict, deadline: float,
+                cancel=None) -> dict:
+        self.ensure_ready(deadline)
+        self.send(req)
         return self.read_frame(deadline, cancel=cancel)
 
     def kill(self) -> None:
@@ -476,6 +603,7 @@ class _WorkerProc:
         self.kill()
 
     def close_files(self) -> None:
+        self._close_shm()
         try:
             self._stderr_file.close()
         except OSError:  # pragma: no cover
@@ -486,15 +614,39 @@ class _WorkerProc:
             except OSError:  # pragma: no cover
                 pass
 
+    def _close_shm(self) -> None:
+        for seg in (self._shm_in, self._shm_out):
+            if seg is None:
+                continue
+            try:
+                seg.close()
+            except Exception:  # pragma: no cover
+                pass
+            try:
+                seg.unlink()
+            except Exception:  # pragma: no cover
+                pass
+        self._shm_in = self._shm_out = None
+
+
+# the single-worker surface other drivers build on (globe/shard.py
+# runs its own session-oriented protocol over raw workers instead
+# of the job-queue WorkerPool)
+PoolWorker = _WorkerProc
+pool_child_env = _pool_child_env
+
 
 def _try_parse(buf: bytes):
     """(frame, rest) if ``buf`` holds a complete frame, else
-    (None, buf)."""
+    (None, buf). Corruption surfaces as WorkerCrash — on the parent
+    side a worker talking garbage is indistinguishable from one
+    dying mid-frame."""
     if len(buf) < 4:
         return None, buf
-    (length,) = struct.unpack(">I", buf[:4])
-    if length > MAX_FRAME_BYTES:
-        raise WorkerCrash(f"implausible frame length {length}")
+    try:
+        length = frame_length(buf[:4])
+    except FrameError as exc:
+        raise WorkerCrash(str(exc)) from exc
     if len(buf) < 4 + length:
         return None, buf
     return json.loads(buf[4:4 + length].decode("utf-8")), buf[4 + length:]
@@ -767,7 +919,7 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
     from kind_tpu_sim import metrics
 
     def send_job(proc: _WorkerProc, worker: int) -> None:
-        write_frame(proc.proc.stdin, {
+        proc.send({
             "id": worker, "job": "call",
             "kwargs": {
                 "target": target,
@@ -788,7 +940,7 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
             for worker, proc in enumerate(procs):
                 try:
                     send_job(proc, worker)
-                except (BrokenPipeError, OSError):
+                except WorkerCrash:
                     raise RuntimeError(
                         f"slice worker {worker} crashed at spawn "
                         f"(rc={proc.proc.poll()}):\n"
@@ -834,7 +986,7 @@ def run_grid(worker_envs: Sequence[Dict[str, str]], target: str,
                             rc, retry_no, max_respawns)
                         try:
                             send_job(fresh, worker)
-                        except (BrokenPipeError, OSError):
+                        except WorkerCrash:
                             raise RuntimeError(
                                 f"slice worker {worker} crashed at "
                                 f"respawn (rc={fresh.proc.poll()}):\n"
@@ -867,7 +1019,8 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
               max_respawns: int = 1,
               fault: Optional[tuple] = None,
               detect: bool = False,
-              health_cfg=None):
+              health_cfg=None,
+              batch: int = 1):
     """Dynamic grid-cell scheduler over COLD protocol workers: every
     worker pulls the next unclaimed cell, so the grid drains at the
     speed of the survivors even when a worker dies.
@@ -907,6 +1060,16 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
       worker — first result wins (cells are pure functions, so the
       copies are identical by construction).
 
+    ``batch`` > 1 pulls up to that many cells per protocol round
+    trip (one ``call_batch`` job) — the framing/dispatch
+    amortization for grids of cheap cells. Results stay
+    position-identical to single dispatch (each cell is the same
+    pure function of its kwargs); a crashed batch requeues every
+    unfinished member. Batching auto-disables under ``fault`` /
+    ``detect``: the chaos and gray-failure contracts are specified
+    per-request, and changing the request stream would change which
+    requests a planted fault hits.
+
     Returns ``(results, stats)``: results in cell order, stats with
     requeue/respawn/quarantine/speculation counts plus
     ``makespan_s`` (first dispatch -> last completion) — also
@@ -925,6 +1088,9 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
     gray_fault = (fault if fault is not None
                   and fault[0] in ("straggler", "flaky") else None)
     cell_fault = fault if gray_fault is None else None
+    if fault is not None or detect:
+        batch = 1
+    batch = max(1, int(batch))
 
     deadline = time.monotonic() + timeout
     cond = threading.Condition()
@@ -944,25 +1110,27 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
              "quarantines": 0, "speculative": 0}
     fault_budget = [1 if cell_fault else 0]
 
-    def next_cell() -> Optional[int]:
+    def next_cells() -> Optional[List[int]]:
         with cond:
             while True:
                 if fatal or time.monotonic() > deadline:
                     return None
                 if todo:
-                    idx = todo.pop(0)
-                    inflight.add(idx)
+                    picked = todo[:batch]
+                    del todo[:len(picked)]
                     now = time.monotonic()
-                    dispatch_t.setdefault(idx, now)
+                    for idx in picked:
+                        inflight.add(idx)
+                        dispatch_t.setdefault(idx, now)
                     if span[0] is None:
                         span[0] = now
-                    return idx
+                    return picked
                 if not inflight:
                     return None
                 if detector is not None:
                     idx = _pick_speculative()
                     if idx is not None:
-                        return idx
+                        return [idx]
                 cond.wait(0.05)
 
     def _pick_speculative() -> Optional[int]:
@@ -1032,6 +1200,47 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
             "cell_worker_respawn", worker=worker, pid=fresh.pid)
         return fresh
 
+    def _drive_batch(proc: "_WorkerProc", worker: int,
+                     idxs: List[int]) -> str:
+        """One batched dispatch (fault/detect off by construction).
+        Returns "ok", "crash" (requeued — caller may respawn), or
+        "stop" (fatal job error / cancellation)."""
+        cell_deadline = deadline
+        if cell_timeout is not None:
+            cell_deadline = min(
+                deadline,
+                time.monotonic() + cell_timeout * len(idxs))
+        req = {"id": idxs[0], "job": "call_batch",
+               "kwargs": {"target": target,
+                          "kwargs_list": [dict(cells[i])
+                                          for i in idxs]}}
+        try:
+            resp = proc.request(req, cell_deadline,
+                                cancel=all_done)
+        except WorkerCancelled:
+            proc.kill()
+            return "stop"
+        except (WorkerCrash, TimeoutError) as exc:
+            for idx in idxs:
+                finish(idx, False)
+            metrics.recovery_log().record(
+                "cell_requeued", cell=idxs[0], worker=worker,
+                cause=type(exc).__name__, batch=len(idxs))
+            proc.kill()
+            return "crash"
+        if not resp.get("ok"):
+            with cond:
+                fatal.append(RuntimeError(
+                    f"cells {idxs} failed on worker {worker}: "
+                    f"{resp.get('error')}\n"
+                    f"{resp.get('traceback', '')[-1000:]}"))
+                cond.notify_all()
+            return "stop"
+        for pos, idx in enumerate(idxs):
+            results[idx] = resp["result"][pos]
+            finish(idx, True)
+        return "ok"
+
     def drive(worker: int) -> None:
         env = _pool_child_env(worker_envs[worker], warm=False)
         if (gray_fault is not None
@@ -1061,9 +1270,21 @@ def run_cells(worker_envs: Sequence[Dict[str, str]], target: str,
                         detector.restore(comp, time.monotonic(),
                                          reason="respawned")
             while True:
-                idx = next_cell()
-                if idx is None:
+                idxs = next_cells()
+                if idxs is None:
                     return
+                if len(idxs) > 1:
+                    status = _drive_batch(proc, worker, idxs)
+                    if status == "ok":
+                        continue
+                    if status == "crash":
+                        if respawns_left <= 0:
+                            return  # survivors drain the requeue
+                        respawns_left -= 1
+                        proc = respawn(dict(env), proc, worker)
+                        continue
+                    return
+                idx = idxs[0]
                 cell_deadline = deadline
                 if cell_timeout is not None:
                     cell_deadline = min(

@@ -36,7 +36,7 @@ from kind_tpu_sim.scenarios.spec import (
 pytestmark = pytest.mark.disagg
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-R05_BENCH = REPO / "BENCH_LOCAL_r05_run4.json"
+R05_BENCH = REPO / "bench_history" / "BENCH_LOCAL_r05_run4.json"
 
 # Per-phase analytic-vs-measured error bound (ISSUE 15): a cost-model
 # change that walks away from the r05 measurement fails here.

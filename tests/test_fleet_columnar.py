@@ -1,0 +1,89 @@
+"""Columnar fleet state: byte-identity and revertibility (ISSUE 16).
+
+The columnar mirror (fleet/columnar.py) is an execution strategy,
+like the event core and fast-forward before it: the report must be
+byte-identical with the mirror on vs off, for every config shape the
+fleet supports, and one knob (KIND_TPU_SIM_FLEET_COLUMNAR /
+FleetConfig.columnar) must revert the whole path.
+"""
+
+import json
+
+import pytest
+
+from kind_tpu_sim import fleet
+from kind_tpu_sim.fleet.columnar import (
+    COLUMNAR_MIN_REPLICAS,
+    resolve_columnar,
+)
+
+pytestmark = pytest.mark.fleet
+
+
+def _report(columnar, *, chaos=(), **cfg_kw):
+    spec = fleet.WorkloadSpec(process="diurnal", rps=80.0,
+                              n_requests=600,
+                              shared_prefix_frac=0.25)
+    trace = fleet.generate_trace(spec, 7)
+    cfg = fleet.FleetConfig(columnar=columnar, max_queue=4096,
+                            **cfg_kw)
+    sim = fleet.FleetSim(cfg, trace, chaos_events=list(chaos))
+    rep = sim.run()
+    assert (sim._cols is not None) is bool(columnar)
+    return json.dumps(rep, sort_keys=True)
+
+
+_CONFIGS = {
+    "least-outstanding": dict(replicas=48,
+                              policy="least-outstanding"),
+    "round-robin": dict(replicas=48, policy="round-robin"),
+    "prefix-affinity": dict(replicas=48, policy="prefix-affinity"),
+    "autoscale": dict(replicas=8, policy="least-outstanding",
+                      autoscale=True,
+                      autoscaler=fleet.AutoscalerConfig(
+                          min_replicas=8, max_replicas=16)),
+}
+
+_CHAOS = (fleet.ChaosEvent(at_s=1.0, action="preempt", target=3),
+          fleet.ChaosEvent(at_s=2.0, action="slow", target=1,
+                           param=2.0),
+          fleet.ChaosEvent(at_s=2.5, action="restore", target=3),
+          fleet.ChaosEvent(at_s=4.0, action="unslow", target=1))
+
+
+@pytest.mark.parametrize("name", sorted(_CONFIGS))
+def test_columnar_identity(name):
+    kw = _CONFIGS[name]
+    assert _report(True, **kw) == _report(False, **kw)
+
+
+def test_columnar_identity_under_chaos():
+    kw = _CONFIGS["least-outstanding"]
+    assert (_report(True, chaos=_CHAOS, **kw)
+            == _report(False, chaos=_CHAOS, **kw))
+
+
+def test_columnar_engages_by_replica_count():
+    """Default (columnar=None): on at >= COLUMNAR_MIN_REPLICAS
+    replicas, off below; an explicit True forces it on even for a
+    tiny fleet, an explicit False always wins."""
+    trace = fleet.generate_trace(
+        fleet.WorkloadSpec(rps=50.0, n_requests=50), 7)
+
+    def cols(columnar, replicas):
+        cfg = fleet.FleetConfig(replicas=replicas, columnar=columnar)
+        return fleet.FleetSim(cfg, trace)._cols
+
+    assert cols(None, COLUMNAR_MIN_REPLICAS) is not None
+    assert cols(None, COLUMNAR_MIN_REPLICAS - 1) is None
+    assert cols(True, 2) is not None
+    assert cols(False, COLUMNAR_MIN_REPLICAS) is None
+
+
+def test_resolve_columnar_env(monkeypatch):
+    monkeypatch.setenv("KIND_TPU_SIM_FLEET_COLUMNAR", "0")
+    assert resolve_columnar(None) is False
+    assert resolve_columnar(True) is True
+    monkeypatch.setenv("KIND_TPU_SIM_FLEET_COLUMNAR", "1")
+    assert resolve_columnar(None) is True
+    assert resolve_columnar(False) is False

@@ -1,5 +1,5 @@
-"""CI perf gate for the event-heap core (docs/PERFORMANCE.md "The
-event core").
+"""CI perf gates for the event-heap core and the columnar fleet
+(docs/PERFORMANCE.md "The event core" and "Round three").
 
 The tentpole claim of ISSUE 8 is that fleet wall time scales with
 EVENT COUNT, not with simulated seconds. This gate pins that claim:
@@ -10,6 +10,15 @@ that silently reintroduces per-tick scaling (or quietly disables the
 skip machinery) fails CI instead of rotting the headline. The budget
 is ~15x the measured dev-host wall (≈4 s), roomy enough for slow CI
 runners, tight enough to catch a return to per-tick scaling.
+
+The ``scale``-marked tests (ISSUE 16) are the down-scaled stand-ins
+for the 10k-replica / 100M-request headline: a 1k-replica
+1M-request columnar fleet day with an **events/s floor**, and a
+10-cell sharded-GlobeSim smoke. The floor (3,000 events/s) sits ~5x
+below the measured columnar rate (≈16,600 on the dev host) and ~2x
+above the measured pre-columnar rate (≈1,300) — it fails if the
+columnar path regresses to per-replica scans, and tolerates slow CI
+runners without flaking.
 """
 
 import time
@@ -21,6 +30,11 @@ from kind_tpu_sim import fleet
 pytestmark = [pytest.mark.fleet, pytest.mark.slow]
 
 WALL_BUDGET_S = 60.0
+
+# events/s floor for the 1k-replica scale smoke: columnar measures
+# ~16,600/s, the pre-columnar row path ~1,300/s — 3,000 separates
+# a real regression from runner noise.
+SCALE_EVENTS_PER_S_FLOOR = 3_000.0
 
 
 def test_event_core_100k_diurnal_under_wall_budget():
@@ -43,3 +57,53 @@ def test_event_core_100k_diurnal_under_wall_budget():
     # the core must actually be skipping boundaries, not just
     # fitting the budget on a fast host
     assert sim.ev_skipped > 100_000, sim.ev_skipped
+
+
+@pytest.mark.scale
+@pytest.mark.timeout(900)
+def test_scale_fleet_1k_replicas_1m_requests_events_floor():
+    """The down-scaled headline run: 1,000 columnar replicas,
+    1M diurnal requests, gated on completions/s of sim wall time
+    (trace generation excluded — it is workload prep, not the
+    per-event cost the PR optimises)."""
+    spec = fleet.WorkloadSpec(
+        process="diurnal", rps=120.0, n_requests=1_000_000,
+        diurnal_period_s=8640.0, prompt_len=(8, 24),
+        max_new=(4, 12))
+    trace = fleet.generate_trace(spec, 7)
+    cfg = fleet.FleetConfig(
+        replicas=1000, policy="least-outstanding",
+        max_queue=65536, max_virtual_s=1e9, event_core=True)
+    sim = fleet.FleetSim(cfg, trace)
+    t0 = time.monotonic()
+    rep = sim.run()
+    wall = time.monotonic() - t0
+    assert rep["ok"] and rep["completed"] == len(trace)
+    events_per_s = rep["completed"] / wall
+    assert events_per_s > SCALE_EVENTS_PER_S_FLOOR, (
+        f"{events_per_s:,.0f} events/s at 1k replicas (floor "
+        f"{SCALE_EVENTS_PER_S_FLOOR:,.0f}) — columnar fleet state "
+        "regressed to per-replica scans?")
+
+
+@pytest.mark.scale
+@pytest.mark.globe
+@pytest.mark.timeout(900)
+def test_scale_globe_10_cells_sharded_smoke():
+    """10-cell sharded GlobeSim smoke: the partitioned driver must
+    complete a multi-zone day and agree with the single-process
+    report cardinality (full byte-identity is pinned per-config in
+    tests/test_globe_shard.py; here the gate is that sharding holds
+    up at the cell count the satellite names)."""
+    from kind_tpu_sim import globe
+
+    cfg = globe.GlobeConfig(
+        zones=("zone-a", "zone-b"), cells_per_zone=5,
+        replicas_per_cell=4, max_virtual_s=300.0,
+        workload=globe.GlobeWorkloadSpec(process="diurnal",
+                                         rps=40.0, n_per_zone=400))
+    sim = globe.ShardedGlobeSim(cfg, seed=7, shards=2)
+    rep = sim.run()
+    assert rep["ok"]
+    assert len(rep["cells"]) == 10
+    assert rep["completed"] == 800

@@ -57,8 +57,13 @@ def test_replay_targets_derive_from_registry():
 
     scenario_targets = sorted(
         n for n in replaycheck.REPLAY_TARGETS
-        if n not in ("fleet-run", "sched-run", "globe-run"))
+        if n not in replaycheck.DRIVER_TARGETS)
     assert scenario_targets == registry.replayable_names()
+    # and the driver tuple itself stays honest: every name in it is
+    # a real target, and none shadows a registered scenario
+    for name in replaycheck.DRIVER_TARGETS:
+        assert name in replaycheck.REPLAY_TARGETS
+        assert name not in registry.names()
 
 
 def test_unknown_scenario_still_raises():

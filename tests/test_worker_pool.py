@@ -130,6 +130,31 @@ def test_injected_fault_env_heals_on_respawn():
         assert pool.respawns >= 1
 
 
+@pytest.mark.parametrize("shm", ["1", "0"])
+def test_bulk_payload_identical_shm_on_vs_off(shm, monkeypatch):
+    """The zero-copy transport is an execution strategy: a payload
+    big enough to ride the shared-memory segments (>= SHM_MIN_BYTES
+    each way) must come back byte-identical to the pipe path, and
+    the knob (KIND_TPU_SIM_POOL_SHM) must actually select the
+    path it claims to."""
+    monkeypatch.setenv("KIND_TPU_SIM_POOL_SHM", shm)
+    # both request and response clear SHM_MIN_BYTES as JSON
+    big = list(range(40_000))
+    with wp.WorkerPool(size=1, warm=False) as pool:
+        proc = pool._procs[0]
+        got = pool.submit("call", timeout=60,
+                          target="json:dumps",
+                          kwargs={"obj": big})
+        if shm == "1":
+            assert proc._shm_in is not None, (
+                "POOL_SHM=1 but the worker fell back to pipes")
+        else:
+            assert proc._shm_in is None
+    import json
+
+    assert got == json.dumps(big)
+
+
 @pytest.mark.chaos
 def test_check_health_and_heartbeat_respawn():
     """check_health reports per-slot liveness; the heartbeat sweep

@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Relocate root ``BENCH_LOCAL_*.json`` captures into ``bench_history/``.
+
+The bench harness historically wrote per-round capture files straight
+into the repo root (``BENCH_LOCAL_r04_run3.json`` and friends), which
+over five calibration rounds grew into seventeen top-level artifacts
+drowning the actual sources. This script is the one-time (but
+idempotent, rerun-safe) migration: every root ``BENCH_LOCAL_*.json``
+moves to ``bench_history/`` with ``git mv`` when the file is tracked
+(preserving history) and a plain rename otherwise.
+
+Collisions are an error, not an overwrite: a capture file is
+measurement evidence, and silently replacing one with a same-named
+newcomer would falsify the record. Rerunning after a partial failure
+just moves whatever is still in the root.
+
+Usage::
+
+    python tools/move_bench_history.py [--dry-run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DEST = REPO / "bench_history"
+
+
+def _tracked(path: pathlib.Path) -> bool:
+    proc = subprocess.run(
+        ["git", "-C", str(REPO), "ls-files", "--error-unmatch",
+         str(path.relative_to(REPO))],
+        capture_output=True, text=True)
+    return proc.returncode == 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the plan without moving anything")
+    args = ap.parse_args(argv)
+
+    captures = sorted(REPO.glob("BENCH_LOCAL_*.json"))
+    if not captures:
+        print("nothing to move: repo root holds no BENCH_LOCAL_*.json")
+        return 0
+
+    clashes = [c.name for c in captures if (DEST / c.name).exists()]
+    if clashes:
+        print("refusing to overwrite existing bench_history entries: "
+              + ", ".join(clashes), file=sys.stderr)
+        return 1
+
+    if not args.dry_run:
+        DEST.mkdir(exist_ok=True)
+    for cap in captures:
+        target = DEST / cap.name
+        verb = "git mv" if _tracked(cap) else "mv"
+        print(f"{verb} {cap.name} -> bench_history/{cap.name}"
+              + (" (dry run)" if args.dry_run else ""))
+        if args.dry_run:
+            continue
+        if verb == "git mv":
+            subprocess.run(
+                ["git", "-C", str(REPO), "mv",
+                 str(cap.relative_to(REPO)),
+                 str(target.relative_to(REPO))],
+                check=True)
+        else:
+            cap.rename(target)
+    print(f"moved {len(captures)} capture(s)"
+          + (" (dry run: none actually moved)" if args.dry_run else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
