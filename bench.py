@@ -2320,6 +2320,92 @@ def disagg_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def tenant_smoke() -> dict | None:
+    """Multi-tenancy extras (docs/TENANCY.md): one seeded
+    heavy-tailed tenant trace with a bronze aggressor surge, run
+    twice through the same fleet — QoS isolation ON (per-tenant
+    quotas, weighted-fair queuing, KV budgets) and isolation OFF
+    (FIFO, quotas still metered). The headline observables are the
+    victim (gold) p99 ratio on-vs-off, the aggressor quota sheds
+    that never reached a replica, per-tier goodput, and the
+    byte-identical replay verdict the tenancy layer inherits from
+    the rest of the sim stack."""
+    try:
+        import dataclasses as _dc
+        import json as _json
+
+        from kind_tpu_sim import fleet
+
+        t0 = time.monotonic()
+        ten = fleet.default_tenancy()
+        spec = fleet.WorkloadSpec(
+            process="poisson", rps=90.0, n_requests=360,
+            prompt_len=(4, 16), max_new=(4, 10), deadline_s=0.8,
+            tenancy=ten)
+        base = fleet.generate_trace(spec, seed=11)
+        span = max(r.arrival_s for r in base)
+        s0, s1 = round(span * 0.3, 6), round(span * 0.7, 6)
+        trace = fleet.tenant_surge_trace(spec, 11, s0, s1, 4.0,
+                                         "bronze")
+        # enforcement tenancy: same traffic model, tighter bronze
+        # admission + unit DRR quantum (the docs/TENANCY.md
+        # noisy-neighbor recipe)
+        enforce = fleet.TenancyConfig(
+            tenants=tuple(
+                (_dc.replace(t, quota_rps=30.0, quota_burst=5.0)
+                 if t.name == "bronze" else t)
+                for t in ten.tenants),
+            drr_quantum=1.0)
+        slo = fleet.SloPolicy(ttft_s=0.25, e2e_s=0.8)
+
+        def run(cfg_tenancy):
+            cfg = fleet.FleetConfig(
+                replicas=3, policy="least-outstanding", slo=slo,
+                tenancy=cfg_tenancy)
+            return fleet.FleetSim(cfg, trace).run()
+
+        on = run(enforce)
+        off = run(_dc.replace(enforce, isolation=False))
+        replay = run(enforce)
+        identical = (_json.dumps(on, sort_keys=True)
+                     == _json.dumps(replay, sort_keys=True))
+
+        def victim_p99(rep):
+            return rep["tenancy"]["slo"]["gold"]["e2e"].get(
+                "p99_s")
+
+        def tier_goodput(rep):
+            return {
+                name: rep["tenancy"]["slo"][name].get(
+                    "goodput_tok_s")
+                for name in sorted(rep["tenancy"]["slo"])}
+
+        p99_on, p99_off = victim_p99(on), victim_p99(off)
+        bronze = on["tenancy"]["tenants"]["bronze"]
+        sheds = bronze["quota_shed"] + bronze["token_shed"]
+        ratio = (round(p99_on / p99_off, 4)
+                 if p99_on and p99_off else None)
+        return {
+            "ok": bool(on["ok"] and identical and sheds > 0
+                       and ratio is not None and ratio <= 1.0),
+            "seconds": round(time.monotonic() - t0, 3),
+            "requests": len(trace),
+            "surge_multiplier": 4.0,
+            "victim_p99_isolation_on_s": p99_on,
+            "victim_p99_isolation_off_s": p99_off,
+            "victim_p99_ratio_on_vs_off": ratio,
+            "aggressor_quota_sheds": sheds,
+            "aggressor_admitted": bronze["admitted"],
+            "goodput_by_tier_isolation_on": tier_goodput(on),
+            "goodput_by_tier_isolation_off": tier_goodput(off),
+            "fair_queue_rounds":
+                on["router"]["fair_queue"]["rounds"],
+            "replay_identical": identical,
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def fleet_scale() -> dict | None:
     """The sim-speed headline (ROADMAP item 1, docs/PERFORMANCE.md
     "The event core" / "Round three"): a seeded 100k-request
@@ -3120,6 +3206,10 @@ def main(argv=None) -> int:
             disagg_rep = disagg_smoke()
         if disagg_rep:
             phases["disagg"] = disagg_rep
+        with stopwatch("tenant"):
+            tenant_rep = tenant_smoke()
+        if tenant_rep:
+            phases["tenant"] = tenant_rep
         with stopwatch("train"):
             train_rep = train_smoke()
         if train_rep:
@@ -3190,6 +3280,11 @@ def main(argv=None) -> int:
     if isinstance(dg, dict):
         compact_extra["disagg_ok"] = dg.get("ok")
         compact_extra["disagg_best_ratio"] = dg.get("best_ratio")
+    tn = phases.get("tenant")
+    if isinstance(tn, dict):
+        compact_extra["tenant_ok"] = tn.get("ok")
+        compact_extra["tenant_victim_p99_ratio"] = tn.get(
+            "victim_p99_ratio_on_vs_off")
     emit_result(out, out_path, compact_extra)
     return 0
 

@@ -520,7 +520,7 @@ _DYNAMIC_CONTAINERS = frozenset((
     "fleet_counters", "gangs", "globe_counters", "hard_limits",
     "health_counters", "peak_outstanding", "per_replica",
     "replicas", "retry_budget", "sched_counters",
-    "sched_event_counts",
+    "sched_event_counts", "tenants", "hedge_budget_by_tenant",
     "train_counters", "zones",
 ))
 
@@ -620,10 +620,25 @@ def collect_report_schema(
     disagg_report = fleet.FleetSim(
         dcfg, fleet.generate_trace(dspec, 7)).run()
 
+    # tenancy keys (per-tenant books / fair_queue / per-tenant
+    # overload budgets) only exist on a tenanted fleet — its own
+    # pinned run too. Tenant names come from the pinned
+    # default_tenancy population, so tenancy.slo's per-tier keys
+    # stay a pure function of the code.
+    tten = fleet.default_tenancy()
+    tspec = fleet.WorkloadSpec(
+        process="poisson", rps=40.0, n_requests=40, tenancy=tten)
+    tcfg = fleet.FleetConfig(
+        replicas=2, policy="least-outstanding",
+        overload=fleet.OverloadConfig(), tenancy=tten)
+    tenant_report = fleet.FleetSim(
+        tcfg, fleet.generate_trace(tspec, 9)).run()
+
     return {
         "boards": board_counter_keys(root),
         "fleet": sorted(_key_paths(fleet_report)),
         "fleet_disagg": sorted(_key_paths(disagg_report)),
+        "fleet_tenant": sorted(_key_paths(tenant_report)),
         "globe": sorted(_key_paths(globe_report)),
     }
 

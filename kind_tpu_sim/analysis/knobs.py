@@ -246,6 +246,19 @@ OVERLOAD_BROWNOUT = _register(
     "disable hedging, shed low tiers); `0` keeps replicas serving "
     "full requests all the way into queue collapse.")
 
+# multi-tenancy (docs/TENANCY.md)
+TENANT_ISOLATION = _register(
+    "KIND_TPU_SIM_TENANT_ISOLATION", True, "bool", "tenant",
+    "Tenant isolation machinery (admission quotas, deficit-round-"
+    "robin queuing, decode-pool KV budgets) on tenancy-declaring "
+    "runs; `0` keeps the tenant traffic model but serves it FCFS "
+    "and unmetered — the noisy-neighbor contrast mode.")
+TENANT_DRR_QUANTUM = _register(
+    "KIND_TPU_SIM_TENANT_DRR_QUANTUM", 4.0, "float", "tenant",
+    "Deficit-round-robin quantum: requests credited per router "
+    "visit per unit of tenant weight (larger = coarser fairness, "
+    "fewer tenant switches).")
+
 # health / gray-failure detection (docs/HEALTH.md)
 HEALTH_ALPHA = _register(
     "KIND_TPU_SIM_HEALTH_ALPHA", 0.25, "float", "health",
@@ -312,8 +325,8 @@ BENCH_SLOW = _register(
 # Display order of layers in docs/KNOBS.md — pipeline order, not
 # alphabetical, so the page reads like the architecture diagram.
 LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "disagg",
-               "sched", "train", "globe", "overload", "health",
-               "fuzz", "bench")
+               "sched", "train", "globe", "overload", "tenant",
+               "health", "fuzz", "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -327,6 +340,7 @@ LAYER_DOCS = {
     "train": "TRAINING.md",
     "globe": "GLOBE.md",
     "overload": "OVERLOAD.md",
+    "tenant": "TENANCY.md",
     "health": "HEALTH.md",
     "fuzz": "FUZZ.md",
     "bench": "PERFORMANCE.md",

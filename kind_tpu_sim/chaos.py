@@ -92,6 +92,10 @@ FAULT_KINDS = (
     # phase split itself — the pool boundary and the KV link
     "prefill_pool_loss",   # every prefill replica preempted at once
     "kv_transfer_degrade",  # KV link at param x nominal bandwidth
+    # multi-tenancy (docs/TENANCY.md): the noisy neighbor IS the
+    # fault — one tenant misbehaves, isolation must hold for the rest
+    "noisy_neighbor",    # one tenant's arrivals x param
+    "tenant_surge",      # windowed surge confined to one tenant
 )
 
 
@@ -105,7 +109,8 @@ def resolve_seed(seed: Optional[int] = None) -> int:
 # Layers a fault schema may claim (the docs/CHAOS.md recovery
 # matrix's row owners).
 FAULT_LAYERS = ("runtime", "grid", "cluster", "engine", "fleet",
-                "sched", "health", "globe", "overload", "train")
+                "sched", "health", "globe", "overload", "train",
+                "tenant")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -225,6 +230,16 @@ FAULT_SCHEMAS: Dict[str, FaultSchema] = {s.kind: s for s in (
                 param_doc="KV-transfer link bandwidth factor",
                 scopes=("fleet",), needs=("disagg",),
                 fuzzable=True),
+    FaultSchema("noisy_neighbor", "tenant",
+                param=("uniform", 3.0, 6.0),
+                param_doc="aggressor-tenant arrival multiplier",
+                scopes=("fleet",), needs=("tenancy",),
+                fuzzable=True, exclusive=True),
+    FaultSchema("tenant_surge", "tenant",
+                param=("uniform", 2.0, 4.0),
+                param_doc="one tenant's windowed rate multiplier",
+                scopes=("fleet",), needs=("tenancy",),
+                fuzzable=True, exclusive=True),
 )}
 
 
@@ -883,6 +898,88 @@ def _scenario_disagg_pool_loss(seed: int) -> dict:
                    and survivors > 0
                    and tokens(faulted) == tokens(clean)
                    and recovered),
+    }
+
+
+@_scenario("tenant-noisy-neighbor",
+           "the batch tenant floods a tenanted fleet mid-window; "
+           "per-tenant quotas throttle the aggressor, weighted-fair "
+           "queuing holds the interactive victim's p99 near its "
+           "alone-run, zero requests are lost, and the isolation-off "
+           "contrast is reported alongside")
+def _scenario_tenant_noisy_neighbor(seed: int) -> dict:
+    from kind_tpu_sim import fleet
+    from kind_tpu_sim.fleet import tenancy as tenancy_mod
+
+    plan = ChaosSchedule(seed).plan(kinds=("noisy_neighbor",),
+                                    n_faults=1, horizon=8, targets=1)
+    mult = plan.events[0].param
+    ten = tenancy_mod.default_tenancy()
+    spec = fleet.WorkloadSpec(process="poisson", rps=90.0,
+                              n_requests=240, prompt_len=(4, 16),
+                              max_new=(4, 10), tenancy=ten)
+    base = fleet.generate_trace(spec, seed)
+    span = max(r.arrival_s for r in base)
+    t0 = round(span * 0.3, 6)
+    t1 = round(span * 0.7, 6)
+    flood = tenancy_mod.tenant_surge_trace(spec, seed, t0, t1,
+                                           mult, "bronze")
+    slo = fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0)
+    # enforcement config: same tenant population (the traffic
+    # signature covers only traffic-shaping fields, so the trace is
+    # unchanged) but a tighter batch quota and a finer DRR quantum —
+    # the admission bursts the stock burst allows are exactly the
+    # slot-occupancy spikes that would bleed into the victim's p99
+    enforce = tenancy_mod.TenancyConfig(
+        tenants=tuple(
+            (dataclasses.replace(t, quota_rps=22.0, quota_burst=3.0)
+             if t.name == "bronze" else t)
+            for t in ten.tenants),
+        drr_quantum=1.0)
+    cfg = fleet.FleetConfig(replicas=3, policy="least-outstanding",
+                            slo=slo, tenancy=enforce)
+    # the victim's alone-run: the interactive tenant's own trace on
+    # the same fleet, nobody else admitted — its entitled latency
+    alone = fleet.FleetSim(
+        cfg, [r for r in base if r.tenant == "gold"]).run()
+    noisy = fleet.FleetSim(cfg, flood).run()
+    replay = fleet.FleetSim(cfg, tenancy_mod.tenant_surge_trace(
+        spec, seed, t0, t1, mult, "bronze")).run()
+    # the contrast column: same flood, isolation off (FIFO router,
+    # no quotas enforced at admission) — reported, not gated
+    off_cfg = fleet.FleetConfig(
+        replicas=3, policy="least-outstanding", slo=slo,
+        tenancy=tenancy_mod.TenancyConfig(tenants=enforce.tenants,
+                                          isolation=False))
+    off = fleet.FleetSim(off_cfg, flood).run()
+
+    def victim_p99(rep: dict) -> Optional[float]:
+        gold = rep["tenancy"]["slo"].get("gold", {})
+        return gold.get("e2e", {}).get("p99_s")
+
+    p99_alone = victim_p99(alone)
+    p99_noisy = victim_p99(noisy)
+    p99_off = victim_p99(off)
+    ratio = (round(p99_noisy / p99_alone, 6)
+             if p99_alone and p99_noisy is not None else None)
+    bronze = noisy["tenancy"]["tenants"]["bronze"]
+    return {
+        "plan": plan.as_dict(),
+        "requests": len(flood),
+        "multiplier": mult,
+        "victim_p99_alone_s": p99_alone,
+        "victim_p99_noisy_s": p99_noisy,
+        "victim_p99_isolation_off_s": p99_off,
+        "victim_p99_ratio": ratio,
+        "aggressor_quota_shed": bronze["quota_shed"],
+        "aggressor_admitted": bronze["admitted"],
+        "fair_queue_rounds":
+            noisy["router"]["fair_queue"]["rounds"],
+        "replay_identical": noisy == replay,
+        "ok": bool(noisy["ok"] and alone["ok"]
+                   and noisy == replay
+                   and bronze["quota_shed"] >= 1
+                   and ratio is not None and ratio <= 1.25),
     }
 
 

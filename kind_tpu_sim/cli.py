@@ -338,6 +338,21 @@ def build_parser() -> argparse.ArgumentParser:
              "KIND_TPU_SIM_OVERLOAD_*; report gains an 'overload' "
              "section")
     fl.add_argument(
+        "--tenancy", action="store_true",
+        help="enable serving multi-tenancy (docs/TENANCY.md): the "
+             "heavy-tailed seeded user model (Zipf users, "
+             "sessions, prefix cohorts), per-tenant admission "
+             "quotas and token-metered rate limits, "
+             "weighted-fair (deficit-round-robin) queuing, and "
+             "per-tenant KV/prefix budgets; knobs "
+             "KIND_TPU_SIM_TENANT_*; report gains a 'tenancy' "
+             "section")
+    fl.add_argument(
+        "--no-tenant-isolation", action="store_true",
+        help="with --tenancy: keep the tenant traffic model but "
+             "disable QoS isolation (FIFO dispatch, no per-tenant "
+             "KV budgets) — the noisy-neighbor contrast run")
+    fl.add_argument(
         "--train", type=int, default=0, metavar="N",
         help="co-schedule N LLM training gangs under the serving "
              "fleet (docs/TRAINING.md; requires --sched): gangs "
@@ -513,6 +528,14 @@ def build_parser() -> argparse.ArgumentParser:
              "hedging at the front door, per-cell circuit "
              "breakers, breaker+brownout inside every cell; knobs "
              "KIND_TPU_SIM_OVERLOAD_*")
+    gl.add_argument(
+        "--tenancy", action="store_true",
+        help="enable serving multi-tenancy (docs/TENANCY.md): "
+             "per-zone heavy-tailed tenant traffic, quotas charged "
+             "once at the global front door, weighted-fair queuing "
+             "+ KV budgets inside every cell, per-(origin, tenant) "
+             "retry/hedge budgets under --overload; report gains a "
+             "'tenancy' section")
     gl.add_argument(
         "--tick-s", type=float, default=None,
         help="virtual scheduling quantum "
@@ -1043,12 +1066,22 @@ def run_fleet(args: argparse.Namespace) -> int:
     if args.action == "calibrate":
         return _fleet_calibrate(args)
     seed = fleet.resolve_seed(args.seed)
+    if args.no_tenant_isolation and not args.tenancy:
+        raise SystemExit("--no-tenant-isolation needs --tenancy")
+    tenancy = None
+    if args.tenancy:
+        tenancy = fleet.default_tenancy()
+        if args.no_tenant_isolation:
+            import dataclasses as _dc
+
+            tenancy = _dc.replace(tenancy, isolation=False)
     spec = fleet.WorkloadSpec(
         process=args.process, rps=args.rps,
         n_requests=args.requests,
         shared_prefix_frac=args.shared_prefix_frac,
         prefix_groups=args.prefix_groups,
-        deadline_s=args.deadline_s)
+        deadline_s=args.deadline_s,
+        tenancy=tenancy)
     if args.trace_file:
         trace = fleet.load_trace(args.trace_file)
     else:
@@ -1104,6 +1137,7 @@ def run_fleet(args: argparse.Namespace) -> int:
                   if args.overload else None),
         training=_fleet_training_config(args),
         disagg=disagg,
+        tenancy=tenancy,
         event_core=(False if args.no_event_core else None))
     clock = fleet.VirtualClock()
     factory = None
@@ -1206,6 +1240,23 @@ def run_fleet(args: argparse.Namespace) -> int:
                   f"{ttr['mean_s']}/{ttr['max_s']} s over "
                   f"{ttr['count']} placement(s) "
                   f"(flat warmup {s['flat_warmup_s']}s)")
+        if "tenancy" in report:
+            ten = report["tenancy"]
+            sheds = sum(t["quota_shed"] + t["token_shed"]
+                        for t in ten["tenants"].values())
+            fq = report["router"].get("fair_queue", {})
+            print(f"  tenancy: {len(ten['tenants'])} tenant(s)  "
+                  f"isolation {ten['isolation']}  "
+                  f"quota/token sheds {sheds}  "
+                  f"drr rounds {fq.get('rounds', 0)}")
+            for name in sorted(ten["tenants"]):
+                t = ten["tenants"][name]
+                e2e = ten["slo"].get(name, {}).get("e2e", {})
+                p99 = e2e.get("p99_s") if e2e.get("count") else None
+                print(f"    {name} ({t['qos']}): "
+                      f"admitted {t['admitted']}  "
+                      f"shed {t['quota_shed'] + t['token_shed']}  "
+                      f"e2e p99 {p99} s")
         if "training" in report:
             t = report["training"]
             print(f"  training: {len(t['gangs'])} gang(s)  "
@@ -1454,6 +1505,7 @@ def run_globe(args: argparse.Namespace) -> int:
     the JSON report (sorted keys) is byte-identical across runs of
     the same seed+config — the `KIND_TPU_SIM_GLOBE_SEED` contract."""
     from kind_tpu_sim import globe
+    from kind_tpu_sim.fleet.tenancy import default_tenancy
 
     seed = globe.resolve_seed(args.seed)
     if args.zones < 1 or args.zones > 26:
@@ -1477,6 +1529,8 @@ def run_globe(args: argparse.Namespace) -> int:
         planner=planner,
         overload=(globe.OverloadConfig()
                   if args.overload else None),
+        tenancy=(default_tenancy()
+                 if args.tenancy else None),
         workload=globe.GlobeWorkloadSpec(
             process=args.process, rps=args.rps,
             n_per_zone=args.requests,
@@ -1535,6 +1589,13 @@ def run_globe(args: argparse.Namespace) -> int:
                   f"spilled-out {z['spilled_out']}  "
                   f"attainment {z['slo']['attainment']}  "
                   f"ttft p99 {ttft.get('p99_s')} s")
+        if "tenancy" in report:
+            ten = report["tenancy"]
+            sheds = sum(t["quota_shed"] + t["token_shed"]
+                        for t in ten["tenants"].values())
+            print(f"  tenancy: {len(ten['tenants'])} tenant(s)  "
+                  f"isolation {ten['isolation']}  "
+                  f"front-door quota/token sheds {sheds}")
         if "planner" in report:
             p = report["planner"]
             print(f"  planner: spot budget {p['spot_budget']} "

@@ -12,7 +12,8 @@ KIND_TPU_SIM_FLEET_TICK_S (sim.resolve_tick_s),
 KIND_TPU_SIM_FLEET_WARMUP_S (autoscaler.resolve_warmup_s),
 KIND_TPU_SIM_HEALTH_* (health.DetectorConfig — the gray-failure
 detection layer, docs/HEALTH.md), KIND_TPU_SIM_TRAIN_* (the
-training tenancy, docs/TRAINING.md).
+training tenancy, docs/TRAINING.md), KIND_TPU_SIM_TENANT_* (the
+serving multi-tenancy layer, docs/TENANCY.md).
 """
 
 from kind_tpu_sim.health import (  # noqa: F401
@@ -95,6 +96,19 @@ from kind_tpu_sim.fleet.sim import (  # noqa: F401
     attainment_over,
     resolve_fast_forward,
     resolve_tick_s,
+)
+from kind_tpu_sim.fleet.tenancy import (  # noqa: F401
+    QOS_TIERS,
+    RateBucket,
+    TenancyConfig,
+    TenancyState,
+    TenantSpec,
+    default_tenancy,
+    generate_tenant_trace,
+    resolve_drr_quantum,
+    resolve_isolation,
+    tenant_of,
+    tenant_surge_trace,
 )
 from kind_tpu_sim.fleet.training import (  # noqa: F401
     TRAIN_KINDS,

@@ -181,6 +181,14 @@ class KvHandoff:
     def seed(self) -> int:
         return self.request.seed
 
+    @property
+    def tenant(self) -> str:
+        return self.request.tenant
+
+    @property
+    def user_id(self) -> int:
+        return self.request.user_id
+
 
 def calibrated_sim_config(cal: dict, dtype: str = "bf16",
                           max_slots: int = 8,

@@ -31,9 +31,12 @@ import json
 import math
 import random
 import zlib
-from typing import List, Optional, Sequence
+from typing import TYPE_CHECKING, List, Optional, Sequence
 
 from kind_tpu_sim.analysis import knobs
+
+if TYPE_CHECKING:  # import cycle: tenancy builds TraceRequests
+    from kind_tpu_sim.fleet.tenancy import TenancyConfig
 
 FLEET_SEED_ENV = knobs.FLEET_SEED
 
@@ -80,10 +83,20 @@ class TraceRequest:
     seed: int
     prefix_group: int = -1
     deadline_s: Optional[float] = None
+    # multi-tenancy (docs/TENANCY.md): the declared tenant and the
+    # user rank inside it; empty/-1 on every untenanted trace
+    tenant: str = ""
+    user_id: int = -1
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["prompt"] = list(self.prompt)
+        # default-valued tenancy fields stay OFF the wire so every
+        # untenanted trace file and replay stays byte-identical
+        if not self.tenant:
+            d.pop("tenant")
+        if self.user_id < 0:
+            d.pop("user_id")
         return d
 
     @classmethod
@@ -118,6 +131,10 @@ class WorkloadSpec:
     # peak-follows-the-sun lever the globe layer staggers its
     # per-zone diurnal demand with (docs/GLOBE.md)
     phase_s: float = 0.0
+    # multi-tenant population (docs/TENANCY.md): when set,
+    # generation delegates to tenancy.generate_tenant_trace — the
+    # heavy-tailed user model; None keeps the anonymous streams
+    tenancy: Optional["TenancyConfig"] = None
 
     PROCESSES = ("poisson", "bursty", "diurnal")
 
@@ -134,6 +151,11 @@ def _spec_rng(spec: WorkloadSpec, seed: int) -> random.Random:
     # byte-identity contract every scenario report rests on)
     if spec.phase_s:
         sig = sig + (spec.phase_s,)
+    # tenancy joins the same way: untenanted specs keep their
+    # streams, and the key carries only the traffic-shaping tenant
+    # fields (quota/weight changes compare on identical traces)
+    if spec.tenancy is not None:
+        sig = sig + (spec.tenancy.signature(),)
     return random.Random(zlib.crc32(repr(sig).encode("utf-8")))
 
 
@@ -177,6 +199,12 @@ def generate_trace(spec: WorkloadSpec,
     if spec.rps <= 0:
         raise ValueError(f"rps must be > 0 (got {spec.rps})")
     seed = resolve_seed(seed)
+    if spec.tenancy is not None:
+        # the multi-tenant population (docs/TENANCY.md); lazy import
+        # breaks the loadgen <-> tenancy cycle
+        from kind_tpu_sim.fleet.tenancy import generate_tenant_trace
+
+        return generate_tenant_trace(spec, seed)
     rng = _spec_rng(spec, seed)
     # thinning envelope: each process's peak instantaneous rate
     if spec.process == "bursty":

@@ -453,8 +453,12 @@ class OverloadState:
         self.retry_ratio = resolve_retry_budget(
             cfg.retry_budget_ratio)
         self._retry_buckets: Dict[str, TokenBucket] = {}
-        self.hedge_budget = TokenBucket(cfg.hedge_budget_ratio,
-                                        cfg.hedge_budget_burst)
+        # hedge budgets keyed by tenant, "" the anonymous default:
+        # untenanted layers only ever touch "", so their stream (and
+        # report shape) is exactly the historical single bucket
+        self._hedge_buckets: Dict[str, TokenBucket] = {
+            "": TokenBucket(cfg.hedge_budget_ratio,
+                            cfg.hedge_budget_burst)}
         self.latency = LatencyQuantile(
             resolve_hedge_quantile(cfg.hedge_quantile),
             cfg.hedge_min_delay_s, cfg.hedge_warm_count)
@@ -465,21 +469,30 @@ class OverloadState:
     def incr(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
 
+    @staticmethod
+    def _key(origin: str, tenant: str) -> str:
+        """Bucket key: per-origin, or per-(origin, tenant) when the
+        caller passes a tenant (docs/TENANCY.md) — one tenant's retry
+        storm then drains its own budget, nobody else's."""
+        return f"{origin}/{tenant}" if tenant else origin
+
     # -- retry budget -------------------------------------------------
 
-    def retry_bucket(self, origin: str) -> TokenBucket:
-        bucket = self._retry_buckets.get(origin)
+    def retry_bucket(self, origin: str,
+                     tenant: str = "") -> TokenBucket:
+        key = self._key(origin, tenant)
+        bucket = self._retry_buckets.get(key)
         if bucket is None:
             bucket = TokenBucket(self.retry_ratio,
                                  self.cfg.retry_budget_burst)
-            self._retry_buckets[origin] = bucket
+            self._retry_buckets[key] = bucket
         return bucket
 
-    def earn_retry(self, origin: str) -> None:
-        self.retry_bucket(origin).earn()
+    def earn_retry(self, origin: str, tenant: str = "") -> None:
+        self.retry_bucket(origin, tenant).earn()
 
-    def spend_retry(self, origin: str) -> bool:
-        ok = self.retry_bucket(origin).spend()
+    def spend_retry(self, origin: str, tenant: str = "") -> bool:
+        ok = self.retry_bucket(origin, tenant).spend()
         if ok:
             self.incr("retries_scheduled")
         else:
@@ -488,21 +501,35 @@ class OverloadState:
 
     # -- hedging ------------------------------------------------------
 
+    @property
+    def hedge_budget(self) -> TokenBucket:
+        """The anonymous hedge bucket (the pre-tenancy surface)."""
+        return self._hedge_buckets[""]
+
+    def hedge_bucket(self, tenant: str = "") -> TokenBucket:
+        bucket = self._hedge_buckets.get(tenant)
+        if bucket is None:
+            bucket = TokenBucket(self.cfg.hedge_budget_ratio,
+                                 self.cfg.hedge_budget_burst)
+            self._hedge_buckets[tenant] = bucket
+        return bucket
+
     def hedge_delay_s(self) -> float:
         return self.latency.delay_s()
 
     def hedge_enabled(self) -> bool:
         return self.cfg.hedge and self.brownout.hedging_allowed()
 
-    def spend_hedge(self) -> bool:
-        ok = self.hedge_budget.spend()
+    def spend_hedge(self, tenant: str = "") -> bool:
+        ok = self.hedge_bucket(tenant).spend()
         if not ok:
             self.incr("hedges_suppressed")
         return ok
 
-    def observe_service(self, service_s: float) -> None:
+    def observe_service(self, service_s: float,
+                        tenant: str = "") -> None:
         self.latency.observe(service_s)
-        self.hedge_budget.earn()
+        self.hedge_bucket(tenant).earn()
 
     # -- breakers -----------------------------------------------------
 
@@ -540,6 +567,10 @@ class OverloadState:
             "hedge_budget": self.hedge_budget.report(),
             "brownout": self.brownout.report(),
         }
+        if len(self._hedge_buckets) > 1:
+            out["hedge_budget_by_tenant"] = {
+                tenant: bucket.report() for tenant, bucket in
+                sorted(self._hedge_buckets.items()) if tenant}
         if self.cfg.breaker:
             out["breakers"] = {
                 name: b.report() for name, b in

@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Sequence
 
 from kind_tpu_sim import metrics
 from kind_tpu_sim.fleet.loadgen import TraceRequest
+from kind_tpu_sim.fleet.tenancy import tenant_of
 
 POLICIES = ("round-robin", "least-outstanding", "prefix-affinity")
 
@@ -106,6 +107,12 @@ class SimReplica:
         # group id -> True, LRU-bounded: the PrefixCache stand-in
         # (a hit skips the group prefix's share of prefill time)
         self._prefix_seen: Dict[int, bool] = {}
+        # tenancy (docs/TENANCY.md): group id -> owning tenant, plus
+        # the per-tenant entry caps the fleet driver installs when a
+        # tenant declares kv_budget_frac < 1 — a hot tenant then
+        # evicts its OWN oldest cohort before touching a neighbor's
+        self._prefix_owner: Dict[int, str] = {}
+        self.tenant_prefix_caps: Optional[Dict[str, int]] = None
         self.prefix_hits = 0
         self.prefix_misses = 0
         # columnar mirror back-pointer (fleet/columnar.py): every
@@ -164,7 +171,9 @@ class SimReplica:
         self._prefix_seen.pop(group, None)
         self._prefix_seen[group] = True
         while len(self._prefix_seen) > self.cfg.prefix_cache_entries:
-            self._prefix_seen.pop(next(iter(self._prefix_seen)))
+            evicted = next(iter(self._prefix_seen))
+            self._prefix_seen.pop(evicted)
+            self._prefix_owner.pop(evicted, None)
 
     # -- replica interface -------------------------------------------
 
@@ -202,10 +211,28 @@ class SimReplica:
             else:
                 self.prefix_misses += 1
                 self._prefix_seen[req.prefix_group] = True
+                caps = self.tenant_prefix_caps
+                if caps is not None:
+                    # tenant-budgeted insertion: charge the cohort to
+                    # its tenant and evict that tenant's own LRU
+                    # entries past its cap — isolation means a noisy
+                    # tenant thrashes only its own cache share
+                    owner = tenant_of(req)
+                    self._prefix_owner[req.prefix_group] = owner
+                    cap = caps.get(owner)
+                    if cap is not None:
+                        owned = [g for g in self._prefix_seen
+                                 if self._prefix_owner.get(g)
+                                 == owner]
+                        while len(owned) > cap:
+                            g = owned.pop(0)
+                            self._prefix_seen.pop(g, None)
+                            self._prefix_owner.pop(g, None)
                 while (len(self._prefix_seen)
                        > self.cfg.prefix_cache_entries):
-                    self._prefix_seen.pop(
-                        next(iter(self._prefix_seen)))
+                    evicted = next(iter(self._prefix_seen))
+                    self._prefix_seen.pop(evicted)
+                    self._prefix_owner.pop(evicted, None)
         return (self.cfg.prefill_base_s
                 + self.cfg.prefill_per_tok_s * toks) * self.slowdown
 
@@ -401,6 +428,7 @@ class SimReplica:
         self.queue = []
         self._slots = [None] * self.cfg.max_slots
         self._prefix_seen.clear()
+        self._prefix_owner.clear()
         self.healthy = False
         self._touch()
         return displaced
@@ -567,7 +595,8 @@ class Router:
 
     def __init__(self, replicas: Sequence, policy: str = "round-robin",
                  max_queue: int = 0, affinity_spill: int = 8,
-                 health=None, overload=None, disagg: bool = False):
+                 health=None, overload=None, disagg: bool = False,
+                 tenancy=None):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r}; known: "
@@ -586,6 +615,17 @@ class Router:
         self.kv_queue: List = []
         self.kv_routed = 0
         self.kv_expired = 0
+        # optional fleet.tenancy.TenancyState: with isolation on the
+        # central queue drains by QoS-ranked deficit round robin
+        # (docs/TENANCY.md) — strict priority across tiers, weighted
+        # fair shares within one, FIFO within a tenant — and the KV
+        # lane defers handoffs whose tenant is over its decode-pool
+        # budget instead of head-blocking everyone behind them
+        self.tenancy = tenancy
+        self._drr_deficit: Dict[str, float] = {}
+        self._drr_pos: Dict[int, int] = {}
+        self.drr_rounds = 0
+        self.kv_deferred = 0
         # optional fleet.overload.OverloadState: per-replica circuit
         # breakers gate the candidate set (an OPEN breaker sheds
         # fast — its replica leaves the ordering until the half-open
@@ -799,25 +839,17 @@ class Router:
                 else:
                     still_kv.append(h)
             self.kv_queue = still_kv
-            while self.kv_queue:
-                h = self.kv_queue[0]
-                placed = False
-                for replica in self._pick_order(h, now):
-                    if replica.submit(h, now):
-                        self.kv_queue.pop(0)
-                        self.kv_routed += 1
-                        self.per_replica[replica.replica_id] = (
-                            self.per_replica.get(
-                                replica.replica_id, 0) + 1)
-                        metrics.disagg_board().incr(
-                            "kv_handoffs_routed")
-                        placed = True
+            if self.tenancy is not None and self.tenancy.isolation:
+                self._drain_kv_tenanted(now)
+            else:
+                while self.kv_queue:
+                    h = self.kv_queue[0]
+                    if not self._place_handoff(h, now):
+                        # head blocks: the decode pool is saturated
+                        # (or gone — the disagg-pool-loss scenario);
+                        # the handoff waits rather than sheds
                         break
-                if not placed:
-                    # head blocks: the decode pool is saturated (or
-                    # gone — the disagg-pool-loss scenario); the
-                    # handoff waits rather than sheds
-                    break
+                    self.kv_queue.pop(0)
         still: List[TraceRequest] = []
         for req in self.queue:
             if (req.deadline_s is not None
@@ -832,28 +864,149 @@ class Router:
             else:
                 still.append(req)
         self.queue = still
-        while self.queue:
-            req = self.queue[0]
-            placed = False
-            fast = self._fast_pick(req)
-            if fast is not None and fast.submit(req, now):
-                self._note_place(req, fast, now)
-                placed = True
-            else:
-                for replica in self._pick_order(req, now):
-                    if replica.submit(req, now):
-                        self._note_place(req, replica, now)
-                        placed = True
-                        break
-            if not placed:
-                break  # head blocks: FCFS, retry next pass
+        if self.tenancy is not None and self.tenancy.isolation:
+            self._dispatch_drr(now)
+        else:
+            while self.queue:
+                if not self._try_place(self.queue[0], now):
+                    break  # head blocks: FCFS, retry next pass
         return out
+
+    def _try_place(self, req: TraceRequest, now: float) -> bool:
+        """One placement attempt (fast path, then the sorted path);
+        bookkeeping via :meth:`_note_place` on success. The columnar
+        argmin picks WHERE a request lands; which request goes next
+        is the queue discipline's call (FCFS or DRR) — the two
+        compose, so tenancy never forces the sorted path."""
+        fast = self._fast_pick(req)
+        if fast is not None and fast.submit(req, now):
+            self._note_place(req, fast, now)
+            return True
+        for replica in self._pick_order(req, now):
+            if replica.submit(req, now):
+                self._note_place(req, replica, now)
+                return True
+        return False
+
+    def _dispatch_drr(self, now: float) -> None:
+        """Deficit round robin over tenants (docs/TENANCY.md): serve
+        the best QoS rank present (strict priority — interactive
+        never waits behind batch), rotate tenants within the rank,
+        top each visit up by ``quantum x weight`` (capped at 2x so an
+        idle tenant banks one round, not history), and serve the
+        tenant's FIFO head while credit lasts. A blocked tenant head
+        skips to the next tenant instead of head-blocking the rank —
+        THE fairness move FCFS cannot make. Deficit resets when a
+        tenant's backlog empties (classic DRR, no credit hoarding);
+        all state advances only on placements, so replay identity
+        holds under any tick partition."""
+        ten = self.tenancy
+        progress = True
+        while progress and self.queue:
+            progress = False
+            fifos: Dict[str, List[TraceRequest]] = {}
+            for req in self.queue:
+                fifos.setdefault(tenant_of(req), []).append(req)
+            rank = min(ten.qos_rank(n) for n in fifos)
+            names = sorted(n for n in fifos
+                           if ten.qos_rank(n) == rank)
+            pos = self._drr_pos.get(rank, 0) % len(names)
+            for name in names[pos:] + names[:pos]:
+                fifo = fifos[name]
+                topup = ten.drr_quantum * ten.weight(name)
+                deficit = min(
+                    self._drr_deficit.get(name, 0.0) + topup,
+                    2.0 * topup)
+                while fifo and deficit >= 1.0:
+                    if not self._try_place(fifo[0], now):
+                        break
+                    fifo.pop(0)
+                    deficit -= 1.0
+                    progress = True
+                self._drr_deficit[name] = (
+                    deficit if fifo else 0.0)
+            if len(names) > 1:
+                self._drr_pos[rank] = (pos + 1) % len(names)
+            if progress:
+                self.drr_rounds += 1
+
+    def _place_handoff(self, h, now: float) -> bool:
+        """Submit one KV handoff into the decode pool; bookkeeping on
+        success (the KV lane's analog of :meth:`_note_place`)."""
+        for replica in self._pick_order(h, now):
+            if replica.submit(h, now):
+                self.kv_routed += 1
+                self.per_replica[replica.replica_id] = (
+                    self.per_replica.get(
+                        replica.replica_id, 0) + 1)
+                metrics.disagg_board().incr("kv_handoffs_routed")
+                return True
+        return False
+
+    def _drain_kv_tenanted(self, now: float) -> None:
+        """The KV lane under isolation: a handoff whose tenant is at
+        its decode-pool occupancy budget DEFERS (stays queued — its
+        prefill is spent, shedding would burn it twice) without
+        head-blocking other tenants' handoffs; pool saturation still
+        head-blocks everyone, same as the untenanted lane."""
+        ten = self.tenancy
+        pool = self._pool("decode")
+        capacity = self._pool_capacity(pool)
+        kept: List = []
+        blocked = False
+        for h in self.kv_queue:
+            if blocked:
+                kept.append(h)
+                continue
+            name = tenant_of(h)
+            budget = ten.kv_budget(name, capacity)
+            if (budget is not None
+                    and self._tenant_pool_load(name, pool)
+                    >= budget):
+                ten.note_kv_deferred(name)
+                self.kv_deferred += 1
+                kept.append(h)
+                continue
+            if not self._place_handoff(h, now):
+                kept.append(h)
+                blocked = True
+        self.kv_queue = kept
+
+    @staticmethod
+    def _pool_capacity(pool) -> int:
+        """Total concurrency slots across a pool (the KV budget's
+        denominator); engine replicas answer via their engine."""
+        total = 0
+        for r in pool:
+            cfg = getattr(r, "cfg", None)
+            if cfg is not None and hasattr(cfg, "max_slots"):
+                total += cfg.max_slots
+            else:
+                total += r.engine.serving.max_slots
+        return total
+
+    @staticmethod
+    def _tenant_pool_load(name: str, pool) -> int:
+        """One tenant's current decode-pool occupancy: its requests
+        queued at or running on the pool's replicas."""
+        n = 0
+        for r in pool:
+            for req in getattr(r, "queue", ()):
+                if tenant_of(req) == name:
+                    n += 1
+            for slot in getattr(r, "_slots", ()):
+                if (slot is not None
+                        and tenant_of(slot["req"]) == name):
+                    n += 1
+        return n
 
     def _note_place(self, req: TraceRequest, replica,
                     now: float) -> None:
         """Shared bookkeeping for a successful placement (both the
-        sorted path and the columnar fast path land here)."""
-        self.queue.pop(0)
+        sorted path and the columnar fast path land here). DRR may
+        place from mid-queue; request ids are unique, so remove() is
+        unambiguous (and identical to pop(0) for an FCFS head)."""
+        self.queue.remove(req)
         self.routed += 1
         self.per_replica[replica.replica_id] = (
             self.per_replica.get(replica.replica_id, 0) + 1)
@@ -880,8 +1033,15 @@ class Router:
         if self.policy == "prefix-affinity":
             out["affinity"] = {"hits": self.affinity_hits,
                                "spills": self.affinity_spills}
+        if self.tenancy is not None and self.tenancy.isolation:
+            out["fair_queue"] = {
+                "quantum": round(self.tenancy.drr_quantum, 6),
+                "rounds": self.drr_rounds,
+            }
         if self.disagg:
             out["kv"] = {"routed": self.kv_routed,
                          "expired": self.kv_expired,
                          "queued": len(self.kv_queue)}
+            if self.kv_deferred:
+                out["kv"]["deferred"] = self.kv_deferred
         return out

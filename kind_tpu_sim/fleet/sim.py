@@ -76,6 +76,11 @@ from kind_tpu_sim.fleet.router import (
     SimReplicaConfig,
 )
 from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
+from kind_tpu_sim.fleet.tenancy import (
+    TenancyConfig,
+    TenancyState,
+    tenant_of,
+)
 from kind_tpu_sim.fleet.training import (
     TrainingConfig,
     TrainingTenant,
@@ -241,6 +246,13 @@ class FleetConfig:
     # KV-cache handoff between them. None (the default) keeps every
     # replica unified and every historical replay byte-identical.
     disagg: Optional[DisaggConfig] = None
+    # multi-tenant isolation (docs/TENANCY.md): a TenancyConfig turns
+    # on per-tenant admission quotas, deficit-round-robin fair
+    # queuing at the router, declared-tier brownout shedding,
+    # per-tenant KV/prefix budgets, and the per-tenant SLO board.
+    # None keeps the anonymous fleet and every historical replay
+    # byte-identical.
+    tenancy: Optional[TenancyConfig] = None
     # idle-gap fast-forward (None -> resolve_fast_forward()). An
     # execution strategy, not workload config: reports are
     # byte-identical either way, so it deliberately stays OUT of
@@ -288,6 +300,8 @@ class FleetConfig:
             out["training"] = self.training.as_dict()
         if self.disagg is not None:
             out["disagg"] = self.disagg.as_dict()
+        if self.tenancy is not None:
+            out["tenancy"] = self.tenancy.as_dict()
         return out
 
 
@@ -367,11 +381,19 @@ class FleetSim:
                        if cfg.health is not None else None)
         self.overload = (OverloadState(cfg.overload)
                          if cfg.overload is not None else None)
+        # multi-tenancy (docs/TENANCY.md): quota buckets + the
+        # weights/tiers the router's DRR and brownout read
+        self.tenancy = (TenancyState(cfg.tenancy)
+                        if cfg.tenancy is not None else None)
+        self._tenant_trackers: Dict[str, SloTracker] = {}
         self.router = Router(self.replicas, policy=cfg.policy,
                              max_queue=cfg.max_queue,
                              health=self.health,
                              overload=self.overload,
-                             disagg=self._disagg is not None)
+                             disagg=self._disagg is not None,
+                             tenancy=self.tenancy)
+        for replica in self.replicas:
+            self._install_tenant_caps(replica)
         if self.overload is not None:
             self.router.on_place = self._on_place
         # columnar mirror: engages only on all-analytic fleets (no
@@ -789,6 +811,39 @@ class FleetSim:
         if transition is not None:
             self._on_health_transition(rid, transition, now)
 
+    # -- multi-tenancy (docs/TENANCY.md) ------------------------------
+
+    def _install_tenant_caps(self, replica) -> None:
+        """Give an analytic replica its per-tenant prefix-cache caps
+        (the KV budget applied to the cache stand-in). A no-op
+        without isolation, on engine replicas, or when no tenant
+        declares a budget fraction below 1."""
+        ten = self.tenancy
+        if ten is None or not ten.isolation:
+            return
+        rcfg = getattr(replica, "cfg", None)
+        if rcfg is None or not hasattr(rcfg, "prefix_cache_entries"):
+            return
+        entries = rcfg.prefix_cache_entries
+        if entries <= 0:
+            return
+        caps: Dict[str, int] = {}
+        for t in ten.cfg.tenants:
+            cap = ten.kv_budget(t.name, entries)
+            if cap is not None:
+                caps[t.name] = cap
+        if caps:
+            replica.tenant_prefix_caps = caps
+
+    def _tenant_key(self, req) -> str:
+        """The overload layer's tenant dimension: the request's
+        tenant under isolation, '' otherwise — so untenanted runs
+        keep the PR 9 per-origin bucket stream untouched."""
+        ten = self.tenancy
+        if ten is None or not ten.isolation:
+            return ""
+        return tenant_of(req)
+
     # -- overload containment (docs/OVERLOAD.md) ----------------------
 
     def _offer_arrival(self, req: TraceRequest, now: float,
@@ -797,13 +852,35 @@ class FleetSim:
         budget, the brownout ladder sheds low tiers and caps
         ``max_new`` at its admission gate, and the router takes what
         survives (its own shed path handles a full central queue)."""
+        ten = self.tenancy
+        if ten is not None and fresh:
+            # tenant quota admission happens BEFORE the retry-budget
+            # earn: a quota-refused request never entered the system,
+            # so it must not fund anyone's retries. Quota sheds are
+            # deliberate policy, not breach — they stay out of the
+            # brownout window.
+            if ten.admit(req, now) is not None:
+                metrics.tenant_board().incr("tenant_quota_shed")
+                self._record(ReplicaCompletion(
+                    request=req, dispatch_s=now, first_s=None,
+                    finish_s=now, tokens=0, tokens_crc=0,
+                    finish_reason="shed"), -1,
+                    brownout_observe=False)
+                return
         ov = self.overload
         if ov is not None:
             if fresh:
-                ov.earn_retry("local")
+                ov.earn_retry("local", self._tenant_key(req))
             bo = ov.brownout
-            if bo.sheds_tier(request_tier(
-                    req.request_id, ov.cfg.low_tier_frac)):
+            if ten is not None and ten.isolation:
+                # brownout sheds by DECLARED tier when tenancy is
+                # on: the batch scavenger browns out first, never a
+                # pseudo-random id-hash slice of everyone
+                tier = ten.tier(tenant_of(req))
+            else:
+                tier = request_tier(req.request_id,
+                                    ov.cfg.low_tier_frac)
+            if bo.sheds_tier(tier):
                 metrics.fleet_board().incr("brownout_shed")
                 self._record(ReplicaCompletion(
                     request=req, dispatch_s=now, first_s=None,
@@ -845,7 +922,7 @@ class FleetSim:
                 continue
             if not ov.hedge_enabled():
                 continue
-            if not ov.spend_hedge():
+            if not ov.spend_hedge(self._tenant_key(req)):
                 continue
             for cand in self.router._pick_order(req, now):
                 if cand is primary:
@@ -993,6 +1070,7 @@ class FleetSim:
         for replica, reason in self._warming.pop_due(now):
             self.replicas.append(replica)
             self.router.replicas.append(replica)
+            self._install_tenant_caps(replica)
             changed = True
             phase = getattr(replica, "phase", "unified")
             self._pool_scalers[phase].note_ready(
@@ -1097,7 +1175,7 @@ class FleetSim:
         if attempt >= ov.cfg.max_attempts:
             ov.incr("retries_exhausted")
             return
-        if not ov.spend_retry("local"):
+        if not ov.spend_retry("local", self._tenant_key(req)):
             return
         self._attempts[base] = attempt + 1
         delay = ov.cfg.retry_backoff_s * (2 ** (attempt - 1))
@@ -1118,7 +1196,7 @@ class FleetSim:
             deadline_exceeded=comp.finish_reason
             == "deadline_exceeded")
         self._recent.append(ok)
-        self.log.append({
+        entry = {
             "request_id": req.request_id,
             "replica": replica_id,
             "prefix_group": req.prefix_group,
@@ -1131,7 +1209,24 @@ class FleetSim:
             "tokens_crc": comp.tokens_crc,
             "finish_reason": comp.finish_reason,
             "slo_ok": ok,
-        })
+        }
+        if getattr(req, "tenant", ""):
+            # conditional, like the TraceRequest wire format: every
+            # untenanted completion log stays byte-identical
+            entry["tenant"] = req.tenant
+        self.log.append(entry)
+        if self.tenancy is not None:
+            name = tenant_of(req)
+            tracker = self._tenant_trackers.get(name)
+            if tracker is None:
+                tracker = SloTracker(self.cfg.slo)
+                self._tenant_trackers[name] = tracker
+            tracker.observe(
+                arrival_s=req.arrival_s, first_s=comp.first_s,
+                finish_s=comp.finish_s, tokens=comp.tokens,
+                shed=comp.finish_reason == "shed",
+                deadline_exceeded=comp.finish_reason
+                == "deadline_exceeded")
         if (self.health is not None and replica_id >= 0
                 and comp.finish_reason not in
                 ("shed", "deadline_exceeded")):
@@ -1159,7 +1254,8 @@ class FleetSim:
                     and comp.finish_reason
                     not in ("shed", "deadline_exceeded")):
                 self.overload.observe_service(
-                    comp.finish_s - comp.dispatch_s)
+                    comp.finish_s - comp.dispatch_s,
+                    self._tenant_key(req))
             self._maybe_retry(comp, self._now)
         if self.on_complete is not None:
             self.on_complete(self.log[-1], comp)
@@ -1249,6 +1345,7 @@ class FleetSim:
         for replica, reason in self._warming.pop_due(now):
             self.replicas.append(replica)
             self.router.replicas.append(replica)
+            self._install_tenant_caps(replica)
             changed = True
             scaler.note_ready(now, len(self.router.replicas),
                               reason=reason)
@@ -1637,6 +1734,7 @@ class FleetSim:
         board_before = metrics.fleet_board().counts()
         health_before = metrics.health_board().counts()
         disagg_before = metrics.disagg_board().counts()
+        tenant_before = metrics.tenant_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
         pending = self._pending
         while True:
@@ -1678,6 +1776,16 @@ class FleetSim:
             tr = self.trainer.report()
             report["training"] = tr
             report["ok"] = bool(report["ok"] and tr["ledger_ok"])
+        if self.tenancy is not None:
+            ten_report = self.tenancy.report()
+            ten_report["slo"] = {
+                name: tracker.report(span_s=self.clock.now())
+                for name, tracker in
+                sorted(self._tenant_trackers.items())}
+            ten_report["counters"] = (
+                metrics.tenant_board().snapshot_since(
+                    tenant_before))
+            report["tenancy"] = ten_report
         if self.preemptions:
             report["preemptions"] = self.preemptions
         if self.health is not None:

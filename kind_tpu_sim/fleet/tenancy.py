@@ -1,0 +1,563 @@
+"""Multi-tenant serving: the millions-of-users realism layer.
+
+The north star's "heavy traffic from millions of users" needs more
+than anonymous poisson arrivals: real fleets serve a heavy-tailed
+POPULATION — a few tenants (and a few users inside each tenant)
+produce most of the traffic, users issue multi-request sessions, and
+each user's requests share prompt prefixes. This module owns that
+model plus the isolation machinery that keeps one tenant's burst from
+becoming another tenant's p99 (docs/TENANCY.md):
+
+* :class:`TenantSpec` / :class:`TenancyConfig` — the declared tenant
+  population: QoS tier (``interactive`` / ``standard`` / ``batch``),
+  weighted-fair share, user count with Zipf per-user rates, session
+  shape, and admission quotas (request-rate and token-metered).
+* :func:`generate_tenant_trace` — the seeded heavy-tailed workload:
+  Lewis thinning for arrivals (the untenanted algorithm), tenants
+  drawn by ``rps_share``, users by Zipf, sessions of think-time-
+  spaced requests, per-(tenant, user) prefix cohorts. A pure
+  function of (spec, seed); plain untenanted specs never reach this
+  path, so every pre-tenancy stream is byte-identical.
+* :class:`RateBucket` — the PR 9 :class:`TokenBucket` refilled by
+  VIRTUAL TIME instead of per-event earns: quotas are rates, and the
+  refill is closed-form in ``now`` so event-core boundary skipping
+  cannot change an admission verdict.
+* :class:`TenancyState` — one sim's live tenancy state: per-tenant
+  quota buckets, admission verdicts, per-tenant shed counters, and
+  the weights/tiers the router's deficit-round-robin queuing and the
+  brownout ladder read.
+* :func:`tenant_surge_trace` — the ``noisy_neighbor`` /
+  ``tenant_surge`` fault kinds' trace transform: extra arrivals from
+  ONE tenant confined to a window, drawn from a sub-seed the
+  ChaosSchedule way so the surge is byte-stable and the base trace
+  untouched.
+
+Determinism: every draw comes from the spec-keyed stream or a
+crc32-derived sub-stream; quota refills are pure functions of the
+virtual clock; DRR state advances only on placements. Same (config,
+seed) twice — byte-identical reports, isolation on or off.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import random
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from kind_tpu_sim.analysis import knobs
+from kind_tpu_sim.fleet.overload import TokenBucket
+
+TENANT_ISOLATION_ENV = knobs.TENANT_ISOLATION
+TENANT_DRR_QUANTUM_ENV = knobs.TENANT_DRR_QUANTUM
+
+# QoS ladder, best first. Rank is strict priority at the router (an
+# ``interactive`` request never waits behind ``batch`` backlog);
+# within one rank tenants share by deficit round robin. ``batch`` is
+# the scavenger tier: brownout sheds it first (the declared-tier
+# unification of the request_tier ladder, docs/OVERLOAD.md).
+QOS_TIERS = ("interactive", "standard", "batch")
+
+
+def resolve_isolation(value: Optional[bool] = None) -> bool:
+    """Explicit value > env (KIND_TPU_SIM_TENANT_ISOLATION) > on."""
+    if value is not None:
+        return bool(value)
+    return bool(knobs.get(TENANT_ISOLATION_ENV))
+
+
+def resolve_drr_quantum(value: Optional[float] = None) -> float:
+    """Explicit value > env (KIND_TPU_SIM_TENANT_DRR_QUANTUM) >
+    4.0 (requests credited per DRR visit per unit weight)."""
+    if value is not None:
+        return float(value)
+    return float(knobs.get(TENANT_DRR_QUANTUM_ENV))
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's declaration: who they are to the traffic model
+    (share, users, sessions) and to the isolation machinery (QoS
+    tier, weight, quotas, KV budget).
+
+    Quotas of 0 mean unlimited (the bucket is disabled — every
+    admission succeeds, the controls-off shape ``TokenBucket``
+    already has). ``kv_budget_frac`` >= 1 means no decode-pool KV
+    cap."""
+
+    name: str
+    qos: str = "standard"
+    # deficit-round-robin weight within the tenant's QoS rank
+    weight: float = 1.0
+    # share of the spec's aggregate rps this tenant contributes
+    rps_share: float = 1.0
+    # user population: per-user request rates are Zipf(zipf_a) over
+    # ranks, the heavy tail that makes "millions of users" mostly a
+    # few thousand hot ones
+    users: int = 100
+    zipf_a: float = 1.1
+    # each drawn arrival opens a session of [lo, hi] requests spaced
+    # think_time_s apart (closed-loop structure inside an open-loop
+    # trace)
+    session_len: Tuple[int, int] = (1, 3)
+    think_time_s: float = 0.2
+    # admission quotas (enforced at the front door / fleet edge):
+    # request-rate and token-metered (prompt + max_new) rates with
+    # burst capacity; 0 disables
+    quota_rps: float = 0.0
+    quota_burst: float = 8.0
+    token_quota_per_s: float = 0.0
+    token_quota_burst: float = 512.0
+    # share of a decode replica's prefix/KV capacity this tenant may
+    # occupy (docs/DISAGG.md); >= 1 uncapped
+    kv_budget_frac: float = 1.0
+
+    def __post_init__(self):
+        if self.qos not in QOS_TIERS:
+            raise ValueError(
+                f"unknown qos tier {self.qos!r}; known: "
+                f"{', '.join(QOS_TIERS)}")
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.name!r} weight must be > 0 "
+                f"(got {self.weight})")
+        if self.rps_share <= 0:
+            raise ValueError(
+                f"tenant {self.name!r} rps_share must be > 0 "
+                f"(got {self.rps_share})")
+        if self.users < 1:
+            raise ValueError(
+                f"tenant {self.name!r} needs at least one user")
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["session_len"] = list(self.session_len)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantSpec":
+        d = dict(d)
+        d["session_len"] = tuple(d["session_len"])
+        return cls(**d)
+
+
+# Requests arriving without a declared tenant under a tenancy-on sim
+# (hand-built traces, surge extras from pre-tenancy transforms) fall
+# back to this spec: standard tier, weight 1, no quotas.
+DEFAULT_TENANT = TenantSpec(name="default")
+
+
+@dataclasses.dataclass(frozen=True)
+class TenancyConfig:
+    """The declared tenant population plus the isolation switches.
+    ``isolation=False`` keeps the traffic model but turns OFF quotas,
+    DRR, and KV budgets — the contrast run the noisy-neighbor
+    scenario proves the controls against."""
+
+    tenants: Tuple[TenantSpec, ...] = ()
+    isolation: Optional[bool] = None
+    drr_quantum: Optional[float] = None
+
+    def __post_init__(self):
+        if not self.tenants:
+            raise ValueError("TenancyConfig needs >= 1 tenant")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"duplicate tenant names: {sorted(names)}")
+
+    def lookup(self, name: str) -> TenantSpec:
+        for t in self.tenants:
+            if t.name == name:
+                return t
+        return DEFAULT_TENANT
+
+    def qos_rank(self, name: str) -> int:
+        return QOS_TIERS.index(self.lookup(name).qos)
+
+    def weight(self, name: str) -> float:
+        return self.lookup(name).weight
+
+    def tier(self, name: str) -> int:
+        """The brownout ladder's DECLARED tier: batch is the
+        sheddable tier 1, everything else tier 0 — replacing the
+        id-hash ``request_tier`` when tenancy is on."""
+        return 1 if self.lookup(name).qos == "batch" else 0
+
+    def signature(self) -> tuple:
+        """The loadgen identity key contribution: only the fields
+        that shape the TRAFFIC join (share, users, sessions), so
+        changing a quota or weight compares policies on the byte-
+        identical trace."""
+        return tuple(
+            (t.name, t.rps_share, t.users, t.zipf_a,
+             tuple(t.session_len), t.think_time_s)
+            for t in self.tenants)
+
+    def without_quotas(self) -> "TenancyConfig":
+        """The cell-tier copy for globe runs: quotas live at the
+        front door (the client tier) and must not be charged twice,
+        while DRR and KV budgets stay with the cell routers."""
+        return dataclasses.replace(self, tenants=tuple(
+            dataclasses.replace(t, quota_rps=0.0,
+                                token_quota_per_s=0.0)
+            for t in self.tenants))
+
+    def as_dict(self) -> dict:
+        out: Dict[str, object] = {
+            "tenants": [t.as_dict() for t in self.tenants],
+            "isolation": resolve_isolation(self.isolation),
+            "drr_quantum": resolve_drr_quantum(self.drr_quantum),
+        }
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenancyConfig":
+        return cls(
+            tenants=tuple(TenantSpec.from_dict(t)
+                          for t in d["tenants"]),
+            isolation=d.get("isolation"),
+            drr_quantum=d.get("drr_quantum"))
+
+
+def default_tenancy() -> TenancyConfig:
+    """The stock three-tenant population the fuzzer and declarative
+    specs use when a drawn spec turns tenancy on: one interactive
+    tenant, one standard, one quota-bounded batch scavenger."""
+    return TenancyConfig(tenants=(
+        TenantSpec(name="gold", qos="interactive", weight=4.0,
+                   rps_share=0.3, users=50, zipf_a=1.2),
+        TenantSpec(name="silver", qos="standard", weight=2.0,
+                   rps_share=0.4, users=200),
+        TenantSpec(name="bronze", qos="batch", weight=1.0,
+                   rps_share=0.3, users=1000,
+                   quota_rps=40.0, quota_burst=20.0),
+    ))
+
+
+def tenant_of(req) -> str:
+    """The request's declared tenant, ``default`` when absent — so
+    hand-built untenanted traces still run under a tenancy-on sim."""
+    return getattr(req, "tenant", "") or "default"
+
+
+# -- quota buckets -----------------------------------------------------
+
+
+class RateBucket(TokenBucket):
+    """The PR 9 :class:`TokenBucket` as a RATE limiter: tokens refill
+    continuously at ``rate_per_s`` of VIRTUAL time (closed-form in
+    ``now``, so boundary skipping never changes a verdict) and a
+    ``take`` may spend a fractional ``cost`` — the token-metered
+    quota charges ``prompt + max_new`` per request. ``rate_per_s``
+    <= 0 disables the bucket (every take succeeds), the same
+    controls-off shape as the parent."""
+
+    __slots__ = ("rate_per_s", "_last_s")
+
+    def __init__(self, rate_per_s: float, burst: float):
+        super().__init__(
+            ratio=(1.0 if rate_per_s > 0 else 0.0), burst=burst)
+        self.rate_per_s = float(rate_per_s)
+        self._last_s = 0.0
+
+    def refill(self, now: float) -> None:
+        if self.disabled:
+            return
+        dt = now - self._last_s
+        if dt > 0:
+            self.tokens = min(self.burst,
+                              self.tokens + self.rate_per_s * dt)
+            self._last_s = now
+
+    def take(self, now: float, cost: float = 1.0) -> bool:
+        if self.disabled:
+            self.spent += 1
+            return True
+        self.refill(now)
+        if self.tokens >= cost:
+            self.tokens -= cost
+            self.spent += 1
+            return True
+        self.suppressed += 1
+        return False
+
+    def report(self) -> Dict[str, object]:
+        out = super().report()
+        out["rate_per_s"] = self.rate_per_s
+        return out
+
+
+class TenancyState:
+    """One sim's live tenancy state: per-tenant admission quotas
+    (request-rate and token-metered), per-tenant admission/shed
+    counters, and the declared weights/ranks/tiers the router and
+    brownout read. Buckets are created lazily per tenant OBSERVED —
+    a pure function of the trace, so reports stay byte-identical."""
+
+    def __init__(self, cfg: TenancyConfig):
+        self.cfg = cfg
+        self.isolation = resolve_isolation(cfg.isolation)
+        self.drr_quantum = resolve_drr_quantum(cfg.drr_quantum)
+        self._quota: Dict[str, RateBucket] = {}
+        self._token_quota: Dict[str, RateBucket] = {}
+        self.admitted: Dict[str, int] = {}
+        self.quota_shed: Dict[str, int] = {}
+        self.token_shed: Dict[str, int] = {}
+        self.kv_deferred: Dict[str, int] = {}
+
+    # -- declared-policy accessors ------------------------------------
+
+    def qos_rank(self, name: str) -> int:
+        return self.cfg.qos_rank(name)
+
+    def weight(self, name: str) -> float:
+        return self.cfg.weight(name)
+
+    def tier(self, name: str) -> int:
+        return self.cfg.tier(name)
+
+    def kv_budget(self, name: str, capacity: int) -> Optional[int]:
+        """The tenant's decode-pool occupancy cap out of
+        ``capacity`` units (slots or cache entries); None = uncapped
+        (frac >= 1 or isolation off)."""
+        if not self.isolation:
+            return None
+        frac = self.cfg.lookup(name).kv_budget_frac
+        if frac >= 1.0:
+            return None
+        return max(1, int(frac * capacity))
+
+    # -- admission ----------------------------------------------------
+
+    def quota_bucket(self, name: str) -> RateBucket:
+        b = self._quota.get(name)
+        if b is None:
+            ts = self.cfg.lookup(name)
+            b = RateBucket(ts.quota_rps, ts.quota_burst)
+            self._quota[name] = b
+        return b
+
+    def token_bucket(self, name: str) -> RateBucket:
+        b = self._token_quota.get(name)
+        if b is None:
+            ts = self.cfg.lookup(name)
+            b = RateBucket(ts.token_quota_per_s,
+                           ts.token_quota_burst)
+            self._token_quota[name] = b
+        return b
+
+    def admit(self, req, now: float) -> Optional[str]:
+        """Quota verdict for one FRESH arrival: None admits, else
+        the shed reason. Isolation off admits everything (the
+        contrast mode); retries and hedges are internal traffic and
+        are never re-metered — the quota charges demand, not
+        recovery."""
+        name = tenant_of(req)
+        if not self.isolation:
+            self.admitted[name] = self.admitted.get(name, 0) + 1
+            return None
+        if not self.quota_bucket(name).take(now):
+            self.quota_shed[name] = (
+                self.quota_shed.get(name, 0) + 1)
+            return "tenant_quota"
+        cost = float(len(req.prompt) + req.max_new)
+        if not self.token_bucket(name).take(now, cost):
+            self.token_shed[name] = (
+                self.token_shed.get(name, 0) + 1)
+            return "tenant_token_quota"
+        self.admitted[name] = self.admitted.get(name, 0) + 1
+        return None
+
+    def note_kv_deferred(self, name: str) -> None:
+        self.kv_deferred[name] = self.kv_deferred.get(name, 0) + 1
+
+    # -- reporting ----------------------------------------------------
+
+    def report(self) -> Dict[str, object]:
+        tenants: Dict[str, object] = {}
+        names = sorted(set(self.admitted) | set(self.quota_shed)
+                       | set(self.token_shed) | set(self.kv_deferred)
+                       | {t.name for t in self.cfg.tenants})
+        for name in names:
+            ts = self.cfg.lookup(name)
+            row: Dict[str, object] = {
+                "qos": ts.qos,
+                "weight": ts.weight,
+                "admitted": self.admitted.get(name, 0),
+                "quota_shed": self.quota_shed.get(name, 0),
+                "token_shed": self.token_shed.get(name, 0),
+            }
+            if name in self._quota:
+                row["quota"] = self._quota[name].report()
+            if name in self._token_quota:
+                row["token_quota"] = (
+                    self._token_quota[name].report())
+            if name in self.kv_deferred:
+                row["kv_deferred"] = self.kv_deferred[name]
+            tenants[name] = row
+        return {
+            "isolation": self.isolation,
+            "drr_quantum": self.drr_quantum,
+            "tenants": tenants,
+        }
+
+
+# -- the heavy-tailed tenant workload ----------------------------------
+
+
+def _zipf_cum(users: int, a: float) -> List[float]:
+    """Cumulative Zipf(a) weights over user ranks 0..users-1 — the
+    heavy tail (rank 0 is the hottest user)."""
+    w = [(u + 1) ** -a for u in range(users)]
+    total = sum(w)
+    cum: List[float] = []
+    acc = 0.0
+    for x in w:
+        acc += x
+        cum.append(acc / total)
+    return cum
+
+
+def _user_cohort(seed: int, tenant: str, user: int,
+                 prefix_len: int, vocab: int) -> tuple:
+    """A (tenant, user)'s stable prefix cohort: group id and shared
+    prompt prefix, from a crc32 sub-stream — same (seed, tenant,
+    user), same cohort, independent of draw order."""
+    sub = random.Random(zlib.crc32(
+        f"tenant-prefix:{seed}:{tenant}:{user}".encode("utf-8")))
+    group = sub.randrange(2 ** 31)
+    prefix = tuple(sub.randrange(vocab)
+                   for _ in range(max(1, prefix_len)))
+    return group, prefix
+
+
+def generate_tenant_trace(spec, seed: int) -> list:
+    """The tenancy-on trace (``loadgen.generate_trace`` delegates
+    here when ``spec.tenancy`` is set): Lewis thinning against the
+    process's peak rate — the untenanted arrival algorithm — then
+    each accepted arrival opens a session from a (tenant, user) pair
+    drawn by share and Zipf rank. Session requests are think-time
+    spaced, share the user's prefix cohort (at the spec's
+    ``shared_prefix_frac``), and the merged trace is sorted by
+    (arrival, draw order) with ids assigned in final order —
+    byte-stable through save/load like every other trace."""
+    from kind_tpu_sim.fleet.loadgen import (
+        TraceRequest,
+        _rate_at,
+        _spec_rng,
+    )
+
+    tn: TenancyConfig = spec.tenancy
+    rng = _spec_rng(spec, seed)
+    if spec.process == "bursty":
+        peak = spec.rps * max(1.0, spec.burst_factor)
+    elif spec.process == "diurnal":
+        peak = 2.0 * spec.rps
+    else:
+        peak = spec.rps
+    share_total = sum(t.rps_share for t in tn.tenants)
+    share_cum: List[float] = []
+    acc = 0.0
+    for t in tn.tenants:
+        acc += t.rps_share / share_total
+        share_cum.append(acc)
+    zipf_cum = {t.name: _zipf_cum(t.users, t.zipf_a)
+                for t in tn.tenants}
+    cohorts: Dict[tuple, tuple] = {}
+    entries: List[tuple] = []
+    t_now = 0.0
+    gen = 0
+    while len(entries) < spec.n_requests:
+        t_now += rng.expovariate(peak)
+        if rng.random() * peak > _rate_at(spec, t_now):
+            continue
+        ts = tn.tenants[min(
+            bisect.bisect_left(share_cum, rng.random()),
+            len(tn.tenants) - 1)]
+        user = bisect.bisect_left(zipf_cum[ts.name], rng.random())
+        user = min(user, ts.users - 1)
+        n_sess = rng.randint(*ts.session_len)
+        for k in range(n_sess):
+            at = round(t_now + k * ts.think_time_s, 6)
+            p_len = rng.randint(*spec.prompt_len)
+            grouped = (spec.shared_prefix_frac > 0
+                       and rng.random() < spec.shared_prefix_frac)
+            if grouped:
+                key = (ts.name, user)
+                if key not in cohorts:
+                    cohorts[key] = _user_cohort(
+                        seed, ts.name, user, spec.prefix_len,
+                        spec.vocab)
+                group, prefix = cohorts[key]
+                body_len = max(1, p_len - len(prefix))
+                prompt = prefix + tuple(
+                    rng.randrange(spec.vocab)
+                    for _ in range(body_len))
+            else:
+                group = -1
+                prompt = tuple(rng.randrange(spec.vocab)
+                               for _ in range(max(1, p_len)))
+            entries.append((
+                at, gen, prompt, rng.randint(*spec.max_new),
+                rng.randrange(2 ** 31), group, ts.name, user))
+            gen += 1
+    entries.sort(key=lambda e: (e[0], e[1]))
+    entries = entries[:spec.n_requests]
+    out = []
+    for i, (at, _gen, prompt, max_new, req_seed, group, tname,
+            user) in enumerate(entries):
+        out.append(TraceRequest(
+            request_id=f"t{i:05d}",
+            arrival_s=at,
+            prompt=prompt,
+            max_new=max_new,
+            seed=req_seed,
+            prefix_group=group,
+            deadline_s=spec.deadline_s,
+            tenant=tname,
+            user_id=user,
+        ))
+    return out
+
+
+# -- the noisy_neighbor / tenant_surge trace transforms ----------------
+
+
+def tenant_surge_trace(spec, seed: int, t0: float, t1: float,
+                       multiplier: float, tenant: str) -> list:
+    """The ``noisy_neighbor`` / ``tenant_surge`` fault kinds'
+    workload: the base tenant trace plus a step of extra arrivals
+    from ONE tenant at ``(multiplier - 1) x`` its nominal rate,
+    confined to ``[t0, t1)`` and drawn from a crc32 sub-seed (the
+    ``surge_trace`` recipe) — same (spec, seed, window, multiplier,
+    tenant), same surge, byte for byte. Surge ids are ``s``-prefixed
+    so the merged trace stays id-unique."""
+    from kind_tpu_sim.fleet.loadgen import generate_trace
+
+    tn: TenancyConfig = spec.tenancy
+    ts = tn.lookup(tenant)
+    share = ts.rps_share / sum(t.rps_share for t in tn.tenants)
+    extra_rps = spec.rps * share * max(0.0, multiplier - 1.0)
+    n_extra = int(extra_rps * max(0.0, t1 - t0))
+    merged = list(generate_trace(spec, seed))
+    if n_extra > 0:
+        sub_seed = zlib.crc32(repr(
+            ("tenant-surge", seed, tenant, round(t0, 6),
+             round(t1, 6), round(multiplier, 6))).encode("utf-8"))
+        surge_spec = dataclasses.replace(
+            spec, process="poisson", rps=extra_rps,
+            n_requests=n_extra,
+            tenancy=TenancyConfig(tenants=(ts,),
+                                  isolation=tn.isolation,
+                                  drr_quantum=tn.drr_quantum))
+        for req in generate_trace(surge_spec, sub_seed):
+            at = round(t0 + req.arrival_s, 6)
+            if at >= t1:
+                break
+            merged.append(dataclasses.replace(
+                req, request_id=f"s{req.request_id}",
+                arrival_s=at))
+    merged.sort(key=lambda r: (r.arrival_s, r.request_id))
+    return merged

@@ -102,8 +102,8 @@ def resolve_shards(value: Optional[int] = None) -> int:
 # -- the wire copy of the config ---------------------------------------
 #
 # Only the fields cell construction consumes (fleet_config_for +
-# cell naming); planner / overload / training are rejected up front
-# (v1) and the front door never leaves the parent.
+# cell naming); planner / overload / training / tenancy are rejected
+# up front (v1) and the front door never leaves the parent.
 
 
 def config_to_wire(cfg: GlobeConfig) -> dict:
@@ -432,7 +432,8 @@ class ShardedGlobeSim(GlobeSim):
                  _test_kill: Optional[Tuple[int, int]] = None):
         for field, label in ((cfg.overload, "overload"),
                              (cfg.planner, "planner"),
-                             (cfg.training, "training")):
+                             (cfg.training, "training"),
+                             (cfg.tenancy, "tenancy")):
             if field is not None:
                 raise ValueError(
                     f"sharded GlobeSim does not support "

@@ -57,6 +57,11 @@ from kind_tpu_sim.fleet.sim import (
     resolve_fast_forward,
     resolve_tick_s,
 )
+from kind_tpu_sim.fleet.tenancy import (
+    TenancyConfig,
+    TenancyState,
+    tenant_of,
+)
 from kind_tpu_sim.fleet.training import TrainingConfig
 from kind_tpu_sim.fleet.slo import SloPolicy, SloTracker
 from kind_tpu_sim.globe.cell import Cell, CellConfig
@@ -153,6 +158,11 @@ class GlobeConfig:
     # Requires scheduler-backed cells (sched=True).
     training: Optional[TrainingConfig] = None
     training_cells: Tuple[str, ...] = ()
+    # multi-tenancy (docs/TENANCY.md): per-zone traces draw the
+    # tenant/user model, quotas are charged ONCE at the front door
+    # (cells inherit the tenancy minus quotas — weighted-fair
+    # queuing and KV budgets, no double metering)
+    tenancy: Optional[TenancyConfig] = None
     workload: GlobeWorkloadSpec = GlobeWorkloadSpec()
     # one-way DCN latency unit between adjacent zones; zone pairs
     # farther apart in the zone list cost proportionally more
@@ -214,6 +224,8 @@ class GlobeConfig:
         }
         if self.overload is not None:
             out["overload"] = self.overload.as_dict()
+        if self.tenancy is not None:
+            out["tenancy"] = self.tenancy.as_dict()
         if self.training is not None:
             out["training"] = self.training.as_dict()
             out["training_cells"] = sorted(
@@ -255,7 +267,8 @@ def generate_globe_traces(
             prefix_groups=w.prefix_groups,
             deadline_s=w.deadline_s,
             diurnal_period_s=w.diurnal_period_s,
-            phase_s=phase)
+            phase_s=phase,
+            tenancy=cfg.tenancy)
         out[zone] = [
             dataclasses.replace(r,
                                 request_id=f"{zone}/{r.request_id}")
@@ -321,6 +334,10 @@ def fleet_config_for(cfg: GlobeConfig, zone: str,
                                       max_attempts=1,
                                       hedge=False)
                   if cfg.overload is not None else None),
+        # cells keep weighted-fair queuing + KV budgets but NOT the
+        # quotas — those are charged once, at the front door
+        tenancy=(cfg.tenancy.without_quotas()
+                 if cfg.tenancy is not None else None),
         fast_forward=False)  # the globe fast-forwards, not cells
 
 
@@ -371,6 +388,10 @@ class GlobeSim:
         # hedging — all timers on EventHeaps, never wall clock
         self.overload = (OverloadState(cfg.overload)
                          if cfg.overload is not None else None)
+        # front-door tenancy (docs/TENANCY.md): quotas metered here,
+        # once, on fresh arrivals — the cells run without_quotas()
+        self.tenancy = (TenancyState(cfg.tenancy)
+                        if cfg.tenancy is not None else None)
         self._g_retry = EventHeap()    # (due, ARRIVAL, (req, origin))
         self._g_hedge = EventHeap()    # (due, COMPLETION, ...)
         self._g_attempts: Dict[str, int] = {}
@@ -503,8 +524,17 @@ class GlobeSim:
                     self._g_maybe_retry(req, origin, now)
                 elif comp.first_s is not None:
                     ov.observe_service(comp.finish_s
-                                       - comp.dispatch_s)
+                                       - comp.dispatch_s,
+                                       self._tenant_key(req))
         return hook
+
+    def _tenant_key(self, req) -> str:
+        """Per-(origin, tenant) budget key: the declared tenant when
+        isolation is on, else "" (the shared per-origin buckets —
+        untenanted globes keep their historical streams)."""
+        if self.tenancy is not None and self.tenancy.isolation:
+            return tenant_of(req)
+        return ""
 
     # -- overload containment at the front door (docs/OVERLOAD.md) ----
 
@@ -533,7 +563,7 @@ class GlobeSim:
                 continue
             if not ov.hedge_enabled():
                 continue
-            if not ov.spend_hedge():
+            if not ov.spend_hedge(self._tenant_key(req)):
                 continue
             for cand in self.frontdoor._candidates(origin, now):
                 if cand.name == primary:
@@ -560,7 +590,7 @@ class GlobeSim:
         if attempt >= ov.cfg.max_attempts:
             ov.incr("retries_exhausted")
             return
-        if not ov.spend_retry(origin):
+        if not ov.spend_retry(origin, self._tenant_key(req)):
             return
         self._g_attempts[base] = attempt + 1
         delay = ov.cfg.retry_backoff_s * (2 ** (attempt - 1))
@@ -571,8 +601,9 @@ class GlobeSim:
         self._g_retry.push(at, LANE_ARRIVAL, (retry, origin))
 
     def _record_frontdoor_shed(self, req: TraceRequest,
-                               origin: str, now: float) -> None:
-        self.log.append({
+                               origin: str, now: float,
+                               retryable: bool = True) -> None:
+        entry = {
             "request_id": req.request_id,
             "cell": None, "serving_zone": None, "origin": origin,
             "replica": -1, "prefix_group": req.prefix_group,
@@ -581,7 +612,10 @@ class GlobeSim:
             "finish_s": round(now, 6), "tokens": 0,
             "tokens_crc": 0, "finish_reason": "shed",
             "slo_ok": False,
-        })
+        }
+        if getattr(req, "tenant", ""):
+            entry["tenant"] = req.tenant
+        self.log.append(entry)
         self.tracker.observe(
             arrival_s=req.arrival_s, first_s=None, finish_s=now,
             tokens=0, shed=True)
@@ -590,7 +624,8 @@ class GlobeSim:
             tokens=0, shed=True)
         if self.overload is not None:
             self._g_completed.add(req.request_id)
-            self._g_maybe_retry(req, origin, now)
+            if retryable:
+                self._g_maybe_retry(req, origin, now)
 
     # -- blast-radius chaos -------------------------------------------
 
@@ -782,6 +817,7 @@ class GlobeSim:
 
     def run(self) -> Dict[str, object]:
         board_before = metrics.globe_board().counts()
+        self._tenant_before = metrics.tenant_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
         # origin map first: displaced requests keep their origin
         # wherever they complete
@@ -802,10 +838,20 @@ class GlobeSim:
             while (self._arrivals
                    and self._arrivals[0][0].arrival_s <= now):
                 req, origin = self._arrivals.popleft()
+                if self.tenancy is not None:
+                    # quota check FIRST: a quota-refused request
+                    # never funds a retry budget nor retries itself
+                    if self.tenancy.admit(req, now) is not None:
+                        metrics.tenant_board().incr(
+                            "tenant_quota_shed")
+                        self._record_frontdoor_shed(
+                            req, origin, now, retryable=False)
+                        continue
                 if self.overload is not None:
                     # first-attempt admissions fund the origin's
                     # retry budget
-                    self.overload.earn_retry(origin)
+                    self.overload.earn_retry(
+                        origin, self._tenant_key(req))
                 shed = self.frontdoor.offer(req, origin, now)
                 if shed is not None:
                     self._record_frontdoor_shed(req, origin, now)
@@ -878,6 +924,11 @@ class GlobeSim:
                 req.request_id in base_done
                 for reqs in self.traces.values() for req in reqs)
             report["overload"] = self.overload.report()
+        if self.tenancy is not None:
+            ten_report = self.tenancy.report()
+            ten_report["counters"] = metrics.tenant_board(
+                ).snapshot_since(self._tenant_before)
+            report["tenancy"] = ten_report
         trainers = {c.name: c.sim.trainer for c in self.cells
                     if c.sim.trainer is not None}
         if trainers:

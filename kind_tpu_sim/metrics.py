@@ -294,6 +294,18 @@ def disagg_board() -> CounterBoard:
     return _DISAGG_BOARD
 
 
+_TENANT_BOARD = CounterBoard()
+
+
+def tenant_board() -> CounterBoard:
+    """The process-global multi-tenancy counter board (quota and
+    token-quota sheds, DRR rounds, KV-handoff deferrals, surge
+    injections — kind_tpu_sim.fleet.{tenancy,sim} and the globe
+    front door record into it; fleet/globe reports, chaos scenario
+    reports, and bench tenant extras snapshot it)."""
+    return _TENANT_BOARD
+
+
 def parse_k8s_time(stamp: str) -> float:
     """RFC3339 (kubernetes) timestamp -> unix seconds."""
     import datetime

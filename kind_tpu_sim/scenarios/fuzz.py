@@ -59,6 +59,9 @@ def _eligible_kinds(topo: TopologySpec, training_gangs: int,
         if "disagg" in schema.needs and not getattr(
                 topo, "disagg", False):
             continue
+        if "tenancy" in schema.needs and not getattr(
+                topo, "tenancy", False):
+            continue
         out.append(kind)
     return out
 
@@ -87,6 +90,14 @@ def draw_spec(seed: int, index: int,
             f"fuzz:disagg:{seed}:{index}".encode()))
         if disagg_rng.random() < 0.4:
             topo = dataclasses.replace(topo, disagg=True)
+    # tenancy rides its own stream too (the disagg precedent): the
+    # shared `rng` draw sequence — and with it every pre-tenancy
+    # fuzz report for untenanted specs — stays byte-identical
+    if topo.kind == "fleet":
+        tenant_rng = random.Random(zlib.crc32(
+            f"fuzz:tenant:{seed}:{index}".encode()))
+        if tenant_rng.random() < 0.35:
+            topo = dataclasses.replace(topo, tenancy=True)
     overload = rng.random() < 0.7
     training_gangs = 0
     if topo.kind == "fleet" and topo.sched:

@@ -17,6 +17,9 @@ they are properties of the simulator itself, not of one experiment:
 * ``containment`` — overload controls stay inside their budgets:
   token-bucket arithmetic holds (spent <= burst + ratio * earned)
   and the scheduled/suppressed counters reconcile with the buckets.
+* ``tenant-accounting`` — tenancy conservation: per-tenant admission
+  books (admitted + quota/token sheds) reconcile with the distinct
+  base ids reaching terminal outcomes.
 * ``recovery`` — after the faults lift, the control planes let go:
   no breaker still open, brownout back at level 0.
 * ``replay-identical`` — a second run of the same (spec, seed) is
@@ -185,6 +188,16 @@ def _check_containment(ctx: InvariantContext) -> Optional[str]:
             return (f"{path or 'report'}: hedge budget overspent "
                     f"({hedge['spent']} > burst {hedge_burst} + "
                     f"ratio x {hedge.get('earned', 0)} earned)")
+        # per-tenant hedge buckets (docs/TENANCY.md): each tenant's
+        # bucket is bound by the same burst — isolation means no
+        # tenant can borrow another's hedge headroom
+        for tenant in sorted(d.get("hedge_budget_by_tenant", {})):
+            bucket = d["hedge_budget_by_tenant"][tenant]
+            if _bucket_over(bucket, hedge_burst):
+                return (f"{path or 'report'}: tenant {tenant!r} "
+                        f"hedge budget overspent ({bucket['spent']}"
+                        f" > burst {hedge_burst} + ratio x "
+                        f"{bucket.get('earned', 0)} earned)")
         counters = d["counters"]
         if not disabled and counters.get(
                 "retries_scheduled", 0) != spent:
@@ -197,6 +210,36 @@ def _check_containment(ctx: InvariantContext) -> Optional[str]:
             return (f"{path or 'report'}: retries_suppressed="
                     f"{counters.get('retries_suppressed', 0)} but "
                     f"buckets suppressed {suppressed}")
+    return None
+
+
+def _check_tenant_accounting(ctx: InvariantContext) -> Optional[str]:
+    """Tenancy conservation (docs/TENANCY.md): every fresh arrival a
+    tenanted sim booked at admission (admitted + quota sheds + token
+    sheds) corresponds to exactly one distinct base request id in the
+    completion log — quota enforcement may refuse work but never
+    lose or invent it."""
+    for path, d in _sim_reports(ctx.report):
+        ten = d.get("tenancy")
+        if not isinstance(ten, dict) or "tenants" not in ten:
+            continue
+        tallies: Dict[str, set] = {}
+        for e in d["completions"]:
+            if not isinstance(e, dict):
+                continue
+            name = e.get("tenant", "") or "default"
+            tallies.setdefault(name, set()).add(
+                str(e.get("request_id")).split("~", 1)[0])
+        for name in sorted(ten["tenants"]):
+            t = ten["tenants"][name]
+            booked = (t.get("admitted", 0) + t.get("quota_shed", 0)
+                      + t.get("token_shed", 0))
+            seen = len(tallies.get(name, ()))
+            if booked != seen:
+                return (f"{path or 'report'}: tenant {name!r} "
+                        f"booked {booked} fresh arrivals (admitted "
+                        f"+ quota/token sheds) but {seen} distinct "
+                        "base ids reached a terminal outcome")
     return None
 
 
@@ -289,6 +332,10 @@ CATALOG: Dict[str, Invariant] = {inv.name: inv for inv in (
               "retry/hedge token-bucket arithmetic holds and the "
               "counters reconcile with the buckets",
               _check_containment),
+    Invariant("tenant-accounting",
+              "per-tenant admission books (admitted + quota/token "
+              "sheds) reconcile with distinct completed base ids",
+              _check_tenant_accounting),
     Invariant("recovery",
               "after quiesce no breaker is open and brownout is "
               "back at level 0",

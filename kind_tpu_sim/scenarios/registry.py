@@ -65,6 +65,8 @@ _LEGACY: Dict[str, tuple] = {
         ("verdict-ok", "no-lost-work", "ledger-clean"), True),
     "disagg-pool-loss": (
         ("prefill_pool_loss", "kv_transfer_degrade"), _FLEETV, True),
+    "tenant-noisy-neighbor": (
+        ("noisy_neighbor",), _FLEETV, True),
 }
 
 _SPECS: Optional[Dict[str, ScenarioSpec]] = None

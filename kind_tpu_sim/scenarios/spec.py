@@ -77,6 +77,7 @@ class TopologySpec:
     zones: int = 2               # globe only
     cells_per_zone: int = 1      # globe only
     disagg: bool = False         # fleet only; phase-split pools
+    tenancy: bool = False        # fleet only; default_tenancy() pop
 
     def as_dict(self) -> dict:
         return {
@@ -86,6 +87,7 @@ class TopologySpec:
             "zones": self.zones,
             "cells_per_zone": self.cells_per_zone,
             "disagg": self.disagg,
+            "tenancy": self.tenancy,
         }
 
     @classmethod
@@ -93,7 +95,8 @@ class TopologySpec:
         return cls(kind=d["kind"], replicas=int(d["replicas"]),
                    sched=bool(d["sched"]), zones=int(d["zones"]),
                    cells_per_zone=int(d["cells_per_zone"]),
-                   disagg=bool(d.get("disagg", False)))
+                   disagg=bool(d.get("disagg", False)),
+                   tenancy=bool(d.get("tenancy", False)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -267,12 +270,22 @@ def spec_problems(spec: ScenarioSpec) -> List[str]:
             problems.append(
                 f"fault kind {f.kind!r} needs a disaggregated "
                 "fleet (topology.disagg)")
+        if "tenancy" in schema.needs and not (topo.kind == "fleet"
+                                              and topo.tenancy):
+            problems.append(
+                f"fault kind {f.kind!r} needs a tenanted fleet "
+                "(topology.tenancy)")
         if schema.exclusive:
             exclusive += 1
     if exclusive > 1:
         problems.append(
             "at most one exclusive fault kind (zone_loss / "
-            "herd_failover / demand_surge) per spec")
+            "herd_failover / demand_surge / noisy_neighbor / "
+            "tenant_surge) per spec")
+    if topo.tenancy and topo.kind != "fleet":
+        problems.append(
+            "topology.tenancy only applies to fleet topologies "
+            "(globe tenancy runs through GlobeConfig.tenancy)")
     if topo.disagg and topo.kind != "fleet":
         problems.append(
             "topology.disagg only applies to fleet topologies")
@@ -439,19 +452,40 @@ def _run_fleet_spec(spec: ScenarioSpec, seed: int,
                     event_core: Optional[bool]) -> Dict[str, object]:
     from kind_tpu_sim import fleet
 
+    tenancy = None
+    if spec.topology.tenancy:
+        from kind_tpu_sim.fleet.tenancy import default_tenancy
+        tenancy = default_tenancy()
     wl = fleet.WorkloadSpec(
         process=spec.workload.process, rps=spec.workload.rps,
         n_requests=spec.workload.n_requests,
         prompt_len=_PROMPT_LEN, max_new=_MAX_NEW,
-        deadline_s=spec.workload.deadline_s)
+        deadline_s=spec.workload.deadline_s,
+        tenancy=tenancy)
     base = fleet.generate_trace(wl, seed)
     span = _trace_span(base)
     surges = [f for f in spec.faults if f.kind == "demand_surge"]
+    tsurges = [f for f in spec.faults
+               if f.kind in ("noisy_neighbor", "tenant_surge")]
     if surges:
         s = surges[0]
         trace = fleet.surge_trace(
             wl, seed, round(span * s.start_frac, 6),
             round(span * s.end_frac, 6), max(1.0, s.param))
+    elif tsurges:
+        # the tenant-scoped surge transforms (docs/TENANCY.md):
+        # noisy_neighbor floods from the batch scavenger, a
+        # tenant_surge strikes the tenant the target indexes
+        from kind_tpu_sim.fleet.tenancy import tenant_surge_trace
+        s = tsurges[0]
+        names = sorted(t.name for t in tenancy.tenants)
+        batch = [t.name for t in tenancy.tenants
+                 if t.qos == "batch"]
+        who = (batch[0] if s.kind == "noisy_neighbor" and batch
+               else names[s.target % len(names)])
+        trace = tenant_surge_trace(
+            wl, seed, round(span * s.start_frac, 6),
+            round(span * s.end_frac, 6), max(1.0, s.param), who)
     else:
         trace = base
     sched = (fleet.FleetSchedConfig() if spec.topology.sched
@@ -473,6 +507,7 @@ def _run_fleet_spec(spec: ScenarioSpec, seed: int,
                   else None),
         training=_training_config(spec),
         disagg=disagg,
+        tenancy=tenancy,
         max_virtual_s=spec.max_virtual_s,
         event_core=event_core)
     events = _fleet_events(spec, span)

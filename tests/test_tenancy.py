@@ -1,0 +1,383 @@
+"""Serving multi-tenancy (docs/TENANCY.md).
+
+The load-bearing properties (ISSUE 17 acceptance): the seeded
+heavy-tailed user model is deterministic and save/replay
+byte-identical; per-tenant quotas shed deterministically at
+admission and the books always reconcile (the tenant-accounting
+invariant); weighted-fair (DRR) queuing bounds the victim's p99
+against a flooding aggressor where FIFO does not; untenanted specs
+keep their exact pre-tenancy streams (pinned by the replay digests
+in test_disagg.py); and the tenanted path itself is byte-identical
+under replay, event-core on/off, and the columnar mirror.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from kind_tpu_sim import chaos, fleet, globe
+from kind_tpu_sim.fleet.overload import OverloadState
+from kind_tpu_sim.fleet.tenancy import (
+    QOS_TIERS,
+    RateBucket,
+    TenancyConfig,
+    TenancyState,
+    default_tenancy,
+    tenant_of,
+    tenant_surge_trace,
+)
+from kind_tpu_sim.scenarios import invariants, registry
+
+pytestmark = pytest.mark.tenant
+
+
+def _tenanted_spec(**kw):
+    base = dict(process="poisson", rps=60.0, n_requests=240,
+                prompt_len=(4, 16), max_new=(4, 10),
+                tenancy=default_tenancy())
+    base.update(kw)
+    return fleet.WorkloadSpec(**base)
+
+
+# -- the seeded heavy-tailed user model --------------------------------
+
+
+def test_tenant_trace_deterministic_and_replayable(tmp_path):
+    """Same (spec, seed) => byte-identical trace, and the JSONL
+    save/load round trip preserves every tenant field."""
+    spec = _tenanted_spec()
+    a = fleet.generate_trace(spec, 7)
+    b = fleet.generate_trace(spec, 7)
+    assert ([r.as_dict() for r in a] == [r.as_dict() for r in b])
+    path = tmp_path / "trace.jsonl"
+    fleet.save_trace(str(path), a)
+    loaded = fleet.load_trace(str(path))
+    assert [r.as_dict() for r in loaded] == [r.as_dict() for r in a]
+    assert all(r.tenant and r.user_id >= 0 for r in loaded)
+
+
+def test_tenant_trace_heavy_tail():
+    """Zipf user popularity: the busiest decile of users of the
+    biggest tenant carries well more than its proportional share."""
+    spec = _tenanted_spec(n_requests=600)
+    trace = fleet.generate_trace(spec, 3)
+    by_user: dict = {}
+    for r in trace:
+        if r.tenant == "bronze":
+            by_user[r.user_id] = by_user.get(r.user_id, 0) + 1
+    counts = sorted(by_user.values(), reverse=True)
+    top = counts[:max(1, len(counts) // 10)]
+    assert sum(top) > 0.25 * sum(counts)
+
+
+def test_untenanted_spec_has_no_tenant_fields():
+    """Tenancy=None keeps the legacy generator: no tenant ever set,
+    and the serialized lines carry no tenant/user keys at all."""
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=50)
+    trace = fleet.generate_trace(spec, 7)
+    for r in trace:
+        assert r.tenant == "" and r.user_id == -1
+        d = r.as_dict()
+        assert "tenant" not in d and "user_id" not in d
+
+
+def test_surge_trace_floods_only_the_target_window():
+    spec = _tenanted_spec()
+    base = fleet.generate_trace(spec, 5)
+    span = max(r.arrival_s for r in base)
+    t0, t1 = round(span * 0.3, 6), round(span * 0.7, 6)
+    flood = tenant_surge_trace(spec, 5, t0, t1, 4.0, "bronze")
+    assert len(flood) > len(base)
+    base_ids = {r.request_id for r in base}
+    extra = [r for r in flood if r.request_id not in base_ids]
+    assert extra
+    for r in extra:
+        assert r.tenant == "bronze"
+        assert t0 <= r.arrival_s <= t1
+
+
+# -- quotas and QoS ----------------------------------------------------
+
+
+def test_default_tenancy_tiers():
+    ten = default_tenancy()
+    names = sorted(t.name for t in ten.tenants)
+    assert names == ["bronze", "gold", "silver"]
+    assert [ten.lookup(n).qos for n in
+            ("gold", "silver", "bronze")] == list(QOS_TIERS)
+    assert ten.weight("gold") > ten.weight("bronze")
+
+
+def test_quota_rejection_deterministic():
+    """Admission is a pure function of (config, arrival sequence):
+    two states fed the same arrivals make identical decisions."""
+    spec = _tenanted_spec(rps=200.0)
+    trace = fleet.generate_trace(spec, 9)
+    cfg = TenancyConfig(
+        tenants=tuple(
+            dataclasses.replace(t, quota_rps=20.0, quota_burst=4.0)
+            for t in default_tenancy().tenants))
+
+    def decisions():
+        st = TenancyState(cfg)
+        out = [st.admit(r, r.arrival_s) for r in trace]
+        return out, st.report()
+
+    a, ra = decisions()
+    b, rb = decisions()
+    assert a == b
+    assert ra == rb
+    assert "tenant_quota" in a  # the tight quota actually bites
+    booked = sum(t["admitted"] + t["quota_shed"] + t["token_shed"]
+                 for t in ra["tenants"].values())
+    assert booked == len(trace)
+
+
+def test_isolation_off_admits_everything():
+    spec = _tenanted_spec(rps=200.0)
+    trace = fleet.generate_trace(spec, 9)
+    st = TenancyState(TenancyConfig(
+        tenants=default_tenancy().tenants, isolation=False))
+    assert all(st.admit(r, r.arrival_s) is None for r in trace)
+
+
+def test_rate_bucket_arithmetic():
+    b = RateBucket(10.0, 5.0)
+    assert all(b.take(0.0) for _ in range(5))
+    assert not b.take(0.0)          # burst exhausted
+    assert b.take(0.5)              # 0.5s refills 5 tokens
+    rep = b.report()
+    assert rep["rate_per_s"] == 10.0
+
+
+def test_kv_budget_caps_only_under_isolation():
+    capped = TenancyConfig(tenants=tuple(
+        (dataclasses.replace(t, kv_budget_frac=0.25)
+         if t.name == "bronze" else t)
+        for t in default_tenancy().tenants))
+    st = TenancyState(capped)
+    cap = st.kv_budget("bronze", 8)
+    assert cap is not None and 1 <= cap < 8
+    # frac >= 1 (the stock config) and isolation-off both mean
+    # uncapped
+    assert TenancyState(default_tenancy()).kv_budget(
+        "bronze", 8) is None
+    off = TenancyState(dataclasses.replace(capped, isolation=False))
+    assert off.kv_budget("bronze", 8) is None
+
+
+# -- weighted-fair queuing vs FIFO -------------------------------------
+
+
+def _noisy_run(isolation: bool):
+    ten = default_tenancy()
+    spec = _tenanted_spec(rps=90.0, n_requests=240, deadline_s=0.8)
+    base = fleet.generate_trace(spec, 11)
+    span = max(r.arrival_s for r in base)
+    trace = tenant_surge_trace(spec, 11, round(span * 0.3, 6),
+                               round(span * 0.7, 6), 4.0, "bronze")
+    enforce = TenancyConfig(
+        tenants=tuple(
+            (dataclasses.replace(t, quota_rps=30.0, quota_burst=5.0)
+             if t.name == "bronze" else t)
+            for t in ten.tenants),
+        drr_quantum=1.0, isolation=isolation)
+    cfg = fleet.FleetConfig(
+        replicas=3, policy="least-outstanding",
+        slo=fleet.SloPolicy(ttft_s=0.25, e2e_s=0.8),
+        tenancy=enforce)
+    return fleet.FleetSim(cfg, trace).run()
+
+
+def test_drr_bounds_victim_delay_vs_fifo():
+    """The headline isolation property: with quotas + DRR the gold
+    victim's p99 under a bronze flood stays strictly below the FIFO
+    (isolation-off) run of the identical trace."""
+    on = _noisy_run(isolation=True)
+    off = _noisy_run(isolation=False)
+    p99_on = on["tenancy"]["slo"]["gold"]["e2e"]["p99_s"]
+    p99_off = off["tenancy"]["slo"]["gold"]["e2e"]["p99_s"]
+    assert p99_on < p99_off
+    bronze = on["tenancy"]["tenants"]["bronze"]
+    assert bronze["quota_shed"] + bronze["token_shed"] > 0
+    assert on["router"]["fair_queue"]["rounds"] > 0
+    assert "fair_queue" not in off["router"]
+
+
+def test_tenanted_fleet_replay_and_event_core_identity():
+    spec = _tenanted_spec()
+    trace = fleet.generate_trace(spec, 7)
+    cfg = fleet.FleetConfig(replicas=2, policy="least-outstanding",
+                            tenancy=spec.tenancy,
+                            overload=fleet.OverloadConfig())
+
+    def run(event_core=None):
+        c = (dataclasses.replace(cfg, event_core=event_core)
+             if event_core is not None else cfg)
+        return json.dumps(fleet.FleetSim(c, trace).run(),
+                          sort_keys=True)
+
+    assert run() == run()
+    assert run(event_core=True) == run(event_core=False)
+
+
+def test_tenanted_columnar_identity():
+    spec = _tenanted_spec(process="diurnal", rps=80.0,
+                          n_requests=400)
+    trace = fleet.generate_trace(spec, 7)
+
+    def run(columnar):
+        cfg = fleet.FleetConfig(replicas=48,
+                                policy="least-outstanding",
+                                max_queue=4096, columnar=columnar,
+                                tenancy=spec.tenancy)
+        sim = fleet.FleetSim(cfg, trace)
+        rep = sim.run()
+        assert (sim._cols is not None) is bool(columnar)
+        return json.dumps(rep, sort_keys=True)
+
+    assert run(True) == run(False)
+
+
+# -- per-(origin, tenant) overload budgets -----------------------------
+
+
+def test_per_origin_tenant_retry_buckets_are_distinct():
+    ov = OverloadState(fleet.OverloadConfig())
+    assert ov.retry_bucket("zone-a") is ov.retry_bucket("zone-a", "")
+    assert (ov.retry_bucket("zone-a", "gold")
+            is not ov.retry_bucket("zone-a", "bronze"))
+    assert (ov.retry_bucket("zone-a", "gold")
+            is not ov.retry_bucket("zone-b", "gold"))
+    for _ in range(40):
+        ov.earn_retry("zone-a", "gold")
+    assert ov.spend_retry("zone-a", "gold")
+    rep = ov.report()
+    assert "zone-a/gold" in rep["retry_budget"]
+
+
+def test_hedge_budget_by_tenant_report_is_conditional():
+    """Untenanted runs must not grow a new report key (byte-identity
+    of every historical replay); tenanted runs get the per-tenant
+    breakdown."""
+    ov = OverloadState(fleet.OverloadConfig())
+    ov.observe_service(0.05)
+    assert "hedge_budget_by_tenant" not in ov.report()
+    ov.observe_service(0.05, "gold")
+    rep = ov.report()
+    assert set(rep["hedge_budget_by_tenant"]) == {"gold"}
+    assert ov.hedge_bucket("gold") is not ov.hedge_budget
+
+
+# -- the tenant-accounting invariant -----------------------------------
+
+
+def _accounting_report(shed: int):
+    return {
+        "requests": 2,
+        "completions": [
+            {"request_id": "r1", "outcome": "completed",
+             "tenant": "gold"},
+            {"request_id": "r2", "outcome": "shed",
+             "tenant": "gold"},
+        ],
+        "tenancy": {
+            "isolation": True,
+            "tenants": {"gold": {"admitted": 1, "quota_shed": shed,
+                                 "token_shed": 0}},
+        },
+    }
+
+
+def test_tenant_accounting_invariant_fires_on_mismatch():
+    inv = invariants.CATALOG["tenant-accounting"]
+    ok_ctx = invariants.InvariantContext(
+        None, _accounting_report(shed=1), None)
+    assert inv.check(ok_ctx) is None
+    bad_ctx = invariants.InvariantContext(
+        None, _accounting_report(shed=2), None)
+    detail = inv.check(bad_ctx)
+    assert detail is not None and "gold" in detail
+
+
+def test_tenant_accounting_holds_on_a_real_run():
+    rep = _noisy_run(isolation=True)
+    ctx = invariants.InvariantContext(None, rep, None)
+    assert invariants.CATALOG["tenant-accounting"].check(ctx) is None
+    assert invariants.CATALOG["containment"].check(ctx) is None
+
+
+# -- the chaos scenario ------------------------------------------------
+
+
+def test_tenant_noisy_neighbor_scenario():
+    rep = chaos.run_scenario("tenant-noisy-neighbor", seed=7)
+    assert rep["ok"] is True
+    assert rep["replay_identical"] is True
+    assert rep["aggressor_quota_shed"] >= 1
+    assert rep["victim_p99_ratio"] <= 1.25
+
+
+def test_tenant_scenario_registered_everywhere():
+    from kind_tpu_sim.analysis import replaycheck
+
+    assert "tenant-noisy-neighbor" in registry.names()
+    assert "tenant-noisy-neighbor" in registry.soak_names()
+    assert "tenant-noisy-neighbor" in replaycheck.REPLAY_TARGETS
+    kinds, _, replayable = registry._LEGACY["tenant-noisy-neighbor"]
+    assert kinds == ("noisy_neighbor",) and replayable
+
+
+# -- globe: quotas at the front door -----------------------------------
+
+
+def _globe_cfg():
+    return globe.GlobeConfig(
+        zones=("zone-a", "zone-b"), sched=False,
+        overload=globe.OverloadConfig(),
+        tenancy=dataclasses.replace(
+            default_tenancy(),
+            tenants=tuple(
+                (dataclasses.replace(t, quota_rps=15.0,
+                                     quota_burst=4.0)
+                 if t.name == "bronze" else t)
+                for t in default_tenancy().tenants)),
+        workload=globe.GlobeWorkloadSpec(
+            process="poisson", rps=60.0, n_per_zone=120))
+
+
+def test_globe_tenancy_front_door_quotas():
+    cfg = _globe_cfg()
+    traces = globe.generate_globe_traces(cfg, 5)
+    a = globe.GlobeSim(cfg, traces=traces, seed=5).run()
+    b = globe.GlobeSim(cfg, traces=traces, seed=5).run()
+    assert (json.dumps(a, sort_keys=True)
+            == json.dumps(b, sort_keys=True))
+    assert a["ok"] is True
+    ten = a["tenancy"]
+    bronze = ten["tenants"]["bronze"]
+    assert bronze["quota_shed"] > 0
+    # per-(origin, tenant) retry buckets at the front door
+    keys = a["overload"]["retry_budget"]
+    assert any("/" in k for k in keys)
+    # quota-refused arrivals never retried: every trace id reaches
+    # exactly one terminal outcome (checked by no-lost-work in fuzz;
+    # here just the books)
+    booked = sum(t["admitted"] + t["quota_shed"] + t["token_shed"]
+                 for t in ten["tenants"].values())
+    assert booked == sum(len(t) for t in traces.values())
+
+
+def test_sharded_globe_rejects_tenancy():
+    cfg = dataclasses.replace(_globe_cfg(), overload=None)
+    with pytest.raises(ValueError, match="tenancy"):
+        globe.ShardedGlobeSim(cfg, traces={}, seed=5, shards=2)
+
+
+def test_tenant_of_defaults():
+    spec = fleet.WorkloadSpec(process="poisson", rps=10.0,
+                              n_requests=4)
+    req = fleet.generate_trace(spec, 1)[0]
+    assert tenant_of(req) == "default"
