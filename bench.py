@@ -74,6 +74,26 @@ def have(binary: str) -> bool:
     return shutil.which(binary) is not None
 
 
+def bench_artifact_path(name: str) -> pathlib.Path:
+    """Resolve a bench capture (``BENCH_*.json``) by name or path.
+
+    PR 16 relocated committed captures from the repo root into
+    ``bench_history/``; any reader that assumes root-only paths
+    breaks on the moved files. Search order: the name as given
+    (absolute or cwd-relative), then repo root, then
+    ``bench_history/``. Raises FileNotFoundError naming every
+    location tried."""
+    cand = pathlib.Path(name)
+    tried = []
+    for path in (cand, REPO / name, REPO / "bench_history" / name):
+        if path.is_file():
+            return path.resolve()
+        tried.append(str(path))
+    raise FileNotFoundError(
+        f"bench artifact {name!r} not found; tried: "
+        + ", ".join(tried))
+
+
 def cpu_child_env() -> dict:
     """CPU-only child env with TPU-tunnel startup hooks stripped."""
     from kind_tpu_sim.utils.shell import cpu_subprocess_env
@@ -2259,9 +2279,10 @@ def disagg_smoke() -> dict | None:
     model. The headline observable is that the two traces pick
     DIFFERENT optimal ratios (by e2e p50) — the economic argument
     for phase-split pools — plus the per-phase analytic-vs-measured
-    calibration error the ≤15% test bound pins."""
+    calibration error the ≤15% test bound pins. The sweep itself
+    runs through the tune driver's grid engine (docs/TUNE.md)."""
     try:
-        from kind_tpu_sim import fleet
+        from kind_tpu_sim import fleet, tune
         from kind_tpu_sim import metrics as _metrics
 
         ratios = ((1, 3), (2, 2), (3, 1))
@@ -2273,32 +2294,28 @@ def disagg_smoke() -> dict | None:
                 process="poisson", rps=800.0, n_requests=300,
                 prompt_len=(8, 16), max_new=(64, 96)),
         }
+        labels = [f"{p}:{d}" for p, d in ratios]
+        space = tune.ratio_space(labels)
+        candidates = [{"pool_ratio": r,
+                       "policy": "least-outstanding"}
+                      for r in labels]
+        slo = fleet.SloPolicy(ttft_s=0.5, e2e_s=2.0)
         t0 = time.monotonic()
         board_before = _metrics.disagg_board().counts()
         sweeps: dict = {}
         best: dict = {}
         for name, spec in workloads.items():
-            trace = fleet.generate_trace(spec, seed=11)
+            results = tune.evaluate_candidates(
+                space, candidates, spec, slo, seed=11)
             rows: dict = {}
-            for p, d in ratios:
-                rep = fleet.FleetSim(
-                    fleet.FleetConfig(
-                        replicas=p + d,
-                        policy="least-outstanding",
-                        disagg=fleet.DisaggConfig(
-                            prefill_replicas=p,
-                            decode_replicas=d),
-                        slo=fleet.SloPolicy(ttft_s=0.5,
-                                            e2e_s=2.0)),
-                    trace).run()
-                rows[f"{p}:{d}"] = {
-                    "ok": rep["ok"],
-                    "e2e_p50_s": rep["slo"]["e2e"].get("p50_s"),
-                    "ttft_p50_s": rep["slo"]["ttft"].get("p50_s"),
-                    "goodput_tok_s": rep["slo"].get(
-                        "goodput_tok_s"),
-                    "attainment": rep["slo"]["attainment"],
-                    "kv_handoffs": rep["disagg"]["kv"]["handoffs"],
+            for label, m in zip(labels, results):
+                rows[label] = {
+                    "ok": m["ok"],
+                    "e2e_p50_s": m["e2e_p50_s"],
+                    "ttft_p50_s": m["ttft_p50_s"],
+                    "goodput_tok_s": m["goodput_tok_s"],
+                    "attainment": m["attainment"],
+                    "kv_handoffs": m["kv_handoffs"],
                 }
             sweeps[name] = rows
             best[name] = min(
@@ -2315,6 +2332,59 @@ def disagg_smoke() -> dict | None:
             "calibration_error": fleet.CostModel().errors(),
             "counters": _metrics.disagg_board().snapshot_since(
                 board_before),
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
+def tune_smoke() -> dict | None:
+    """Design-search extras (docs/TUNE.md): seeded successive-halving
+    search over the P:D ratio space against the SAME two traces
+    disagg_smoke sweeps — but the search is given no hint which
+    ratio wins. The headline observable is rediscovery: the tune
+    driver's knee-point winner lands on PR 14's workload-dependent
+    optimum (2:2 prefix-heavy, 1:3 decode-heavy) from the seed
+    stream alone, plus search economics (candidates/s, the
+    screen-vs-final wall split successive halving buys)."""
+    try:
+        from kind_tpu_sim import fleet, tune
+
+        workloads = {
+            "prefill_heavy": fleet.WorkloadSpec(
+                process="poisson", rps=2000.0, n_requests=300,
+                prompt_len=(512, 768), max_new=(1, 2)),
+            "decode_heavy": fleet.WorkloadSpec(
+                process="poisson", rps=800.0, n_requests=300,
+                prompt_len=(8, 16), max_new=(64, 96)),
+        }
+        expected = {"prefill_heavy": "2:2", "decode_heavy": "1:3"}
+        space = tune.ratio_space(("1:3", "2:2", "3:1"))
+        slo = fleet.SloPolicy(ttft_s=0.5, e2e_s=2.0)
+        t0 = time.monotonic()
+        searches: dict = {}
+        for name, spec in workloads.items():
+            rep = tune.tune(space, spec, slo, seed=7, budget=6,
+                            workload_seed=11, timer=time.monotonic)
+            winner = rep.get("winner") or {}
+            searches[name] = {
+                "ok": rep["ok"],
+                "winner_ratio": (winner.get("candidate") or {}).get(
+                    "pool_ratio"),
+                "expected_ratio": expected[name],
+                "evaluations": rep["evaluations"],
+                "finalists": len(rep["finalists"]),
+                "pareto_front": len(rep["pareto"]["front"]),
+                "timings": rep["timings"],
+            }
+        rediscovered = all(
+            s["winner_ratio"] == s["expected_ratio"]
+            for s in searches.values())
+        return {
+            "ok": (all(s["ok"] for s in searches.values())
+                   and rediscovered),
+            "seconds": round(time.monotonic() - t0, 3),
+            "rediscovered_optimum": rediscovered,
+            "searches": searches,
         }
     except Exception as exc:  # pragma: no cover - best effort
         return {"ok": False, "error": str(exc)[:200]}
@@ -3206,6 +3276,10 @@ def main(argv=None) -> int:
             disagg_rep = disagg_smoke()
         if disagg_rep:
             phases["disagg"] = disagg_rep
+        with stopwatch("tune"):
+            tune_rep = tune_smoke()
+        if tune_rep:
+            phases["tune"] = tune_rep
         with stopwatch("tenant"):
             tenant_rep = tenant_smoke()
         if tenant_rep:

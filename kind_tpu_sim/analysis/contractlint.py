@@ -516,12 +516,12 @@ SCHEMA_PATH = pathlib.Path(__file__).with_name("report_schema.json")
 # gang names, event types, counter names): the child segment is
 # collapsed to `*` so the schema pins structure, not instance names.
 _DYNAMIC_CONTAINERS = frozenset((
-    "breakers", "cells", "components", "event_counts",
-    "fleet_counters", "gangs", "globe_counters", "hard_limits",
-    "health_counters", "peak_outstanding", "per_replica",
-    "replicas", "retry_budget", "sched_counters",
-    "sched_event_counts", "tenants", "hedge_budget_by_tenant",
-    "train_counters", "zones",
+    "breakers", "candidates", "cells", "components",
+    "event_counts", "finalists", "fleet_counters", "gangs",
+    "globe_counters", "hard_limits", "health_counters",
+    "peak_outstanding", "per_replica", "replicas", "retry_budget",
+    "sched_counters", "sched_event_counts", "tenants",
+    "hedge_budget_by_tenant", "train_counters", "zones",
 ))
 
 
@@ -634,12 +634,27 @@ def collect_report_schema(
     tenant_report = fleet.FleetSim(
         tcfg, fleet.generate_trace(tspec, 9)).run()
 
+    # tune keys (search trace / pareto front / chaos rescoring): a
+    # pinned tiny search over the disagg-ratio space. The
+    # candidate-index keyed containers ("candidates", chaos
+    # "finalists") are dynamic — their child segments collapse to `*`
+    from kind_tpu_sim import tune
+
+    tune_report = tune.tune(
+        tune.ratio_space(("1:3", "2:2", "3:1")),
+        fleet.WorkloadSpec(process="poisson", rps=50.0,
+                           n_requests=40, prompt_len=(8, 16),
+                           max_new=(4, 8)),
+        fleet.SloPolicy(ttft_s=0.5, e2e_s=2.0),
+        seed=0, budget=4, chaos_budget=1)
+
     return {
         "boards": board_counter_keys(root),
         "fleet": sorted(_key_paths(fleet_report)),
         "fleet_disagg": sorted(_key_paths(disagg_report)),
         "fleet_tenant": sorted(_key_paths(tenant_report)),
         "globe": sorted(_key_paths(globe_report)),
+        "tune": sorted(_key_paths(tune_report)),
     }
 
 

@@ -312,6 +312,22 @@ FUZZ_MAX_FAULTS = _register(
     "Upper bound on concurrent fault kinds composed into one "
     "drawn scenario (each draws 2..max).")
 
+# tune
+TUNE_SEED = _register(
+    "KIND_TPU_SIM_TUNE_SEED", 0, "int", "tune",
+    "Default search-stream seed for `fleet tune` / `globe tune`; "
+    "candidate i is drawn from its own crc32(seed, i) sub-stream, "
+    "so the same seed replays the byte-identical search trace.")
+TUNE_BUDGET = _register(
+    "KIND_TPU_SIM_TUNE_BUDGET", 16, "int", "tune",
+    "Default number of candidates one tune search draws and "
+    "screens (successive halving keeps ~half for the full-trace "
+    "final rung).")
+TUNE_CHAOS_BUDGET = _register(
+    "KIND_TPU_SIM_TUNE_CHAOS_BUDGET", 0, "int", "tune",
+    "Default chaos-rescoring budget: finalists are re-scored under "
+    "this many fuzzer-drawn fault schedules (0 = chaos mode off).")
+
 # bench
 SKIP_MODEL_BENCH = _register(
     "KIND_TPU_SIM_SKIP_MODEL_BENCH", False, "bool", "bench",
@@ -326,7 +342,7 @@ BENCH_SLOW = _register(
 # alphabetical, so the page reads like the architecture diagram.
 LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "disagg",
                "sched", "train", "globe", "overload", "tenant",
-               "health", "fuzz", "bench")
+               "health", "fuzz", "tune", "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -343,6 +359,7 @@ LAYER_DOCS = {
     "tenant": "TENANCY.md",
     "health": "HEALTH.md",
     "fuzz": "FUZZ.md",
+    "tune": "TUNE.md",
     "bench": "PERFORMANCE.md",
 }
 

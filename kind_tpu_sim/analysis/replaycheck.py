@@ -219,6 +219,26 @@ def _run_globe_sharded(seed: int, inject: bool) -> dict:
     return globe.GlobeSim(cfg, traces=traces, seed=seed).run()
 
 
+def _run_tune(seed: int, inject: bool) -> dict:
+    """A small in-process tune search (docs/TUNE.md): the whole
+    search trace rides in the report's ``runs`` stream, so the
+    bisector localizes a divergence to one candidate evaluation."""
+    if inject:
+        raise ValueError("tune does not support injection; the "
+                         "search consumes generated traces — use "
+                         "fleet-run")
+    from kind_tpu_sim import fleet, tune
+
+    space = tune.ratio_space(("1:3", "2:2", "3:1"))
+    workload = fleet.WorkloadSpec(process="poisson", rps=50.0,
+                                  n_requests=40,
+                                  prompt_len=(8, 16),
+                                  max_new=(4, 8))
+    slo = fleet.SloPolicy(ttft_s=0.5, e2e_s=2.0)
+    return tune.tune(space, workload, slo, seed=seed, budget=4,
+                     chaos_budget=1)
+
+
 def _scenario_runner(name: str):
     def run(seed: int, inject: bool) -> dict:
         if inject:
@@ -237,7 +257,7 @@ def _scenario_runner(name: str):
 # bijection test in tests/test_scenarios.py pins that, so a new
 # driver target belongs here, not in an ad-hoc test exclusion.
 DRIVER_TARGETS = ("fleet-run", "sched-run", "globe-run",
-                  "globe-sharded")
+                  "globe-sharded", "tune")
 
 
 def _targets() -> Dict[str, ReplayTarget]:
@@ -263,6 +283,10 @@ def _targets() -> Dict[str, ReplayTarget]:
             "globe-sharded", "GlobeSim vs ShardedGlobeSim(2) on "
             "one seed — the cross-driver byte-identity referee",
             _run_globe_sharded, slow=True, injectable=True),
+        "tune": ReplayTarget(
+            "tune", "in-process tune search over the disagg-ratio "
+            "space (budget 4, chaos 1), full search trace",
+            _run_tune),
     }
     for name in registry.replayable_names():
         out[name] = ReplayTarget(

@@ -38,6 +38,45 @@ from kind_tpu_sim.utils.shell import (
 log = logging.getLogger("kind-tpu-sim")
 
 
+def _add_tune_args(parser, target: str) -> None:
+    """The `tune` action's flags, shared by the fleet and globe
+    subparsers (docs/TUNE.md)."""
+    parser.add_argument(
+        "--budget", type=int, default=None,
+        help="tune: candidates to draw and screen (default: "
+             "KIND_TPU_SIM_TUNE_BUDGET or 16)")
+    parser.add_argument(
+        "--tune-seed", type=int, default=None,
+        help="tune: search-stream seed (default: "
+             "KIND_TPU_SIM_TUNE_SEED or 0); distinct from --seed, "
+             "which stays the workload seed")
+    parser.add_argument(
+        "--chaos-budget", type=int, default=None,
+        help="tune: re-score finalists under this many fuzzer-drawn "
+             "fault schedules and prefer all-schedule survivors "
+             "(default: KIND_TPU_SIM_TUNE_CHAOS_BUDGET or 0 = off)")
+    parser.add_argument(
+        "--tune-workers", type=int, default=0,
+        help="tune: evaluate candidates across this many worker-"
+             "pool processes (0 = in-process; the search trace is "
+             "byte-identical either way)")
+    parser.add_argument(
+        "--spec-out", default=None,
+        help="tune: write the winner's runnable sorted-keys JSON "
+             "spec to this file (replay it with --replay-spec)")
+    parser.add_argument(
+        "--replay-spec", default=None, metavar="PATH",
+        help="tune: skip the search and re-score this winner spec "
+             "standalone (prints the metrics row)")
+    if target == "fleet":
+        parser.add_argument(
+            "--ratios", default=None, metavar="P:D,...",
+            help="tune: restrict the search space to these disagg "
+                 "pool ratios at the --policy placement (e.g. "
+                 "1:3,2:2,3:1); default searches the full fleet "
+                 "design space")
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="kind-tpu-sim",
@@ -271,7 +310,8 @@ def build_parser() -> argparse.ArgumentParser:
             "same seed, byte-identical report (docs/FLEET.md)"
         ),
     )
-    fl.add_argument("action", choices=["run", "trace", "calibrate"])
+    fl.add_argument("action",
+                    choices=["run", "trace", "calibrate", "tune"])
     fl.add_argument(
         "--seed", type=int, default=None,
         help="workload seed (default: KIND_TPU_SIM_FLEET_SEED or 0)")
@@ -418,6 +458,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--out", default=None,
         help="write the full JSON report to this file")
     fl.add_argument("--json", action="store_true", dest="as_json")
+    _add_tune_args(fl, "fleet")
 
     sd = sub.add_parser(
         "sched",
@@ -477,7 +518,7 @@ def build_parser() -> argparse.ArgumentParser:
             "byte-identical report (docs/GLOBE.md)"
         ),
     )
-    gl.add_argument("action", choices=["run", "trace"])
+    gl.add_argument("action", choices=["run", "trace", "tune"])
     gl.add_argument(
         "--seed", type=int, default=None,
         help="workload seed (default: KIND_TPU_SIM_GLOBE_SEED or 0)")
@@ -564,6 +605,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--out", default=None,
         help="write the full JSON report to this file")
     gl.add_argument("--json", action="store_true", dest="as_json")
+    _add_tune_args(gl, "globe")
 
     he = sub.add_parser(
         "health",
@@ -1037,7 +1079,20 @@ def _fleet_calibrate(args: argparse.Namespace) -> int:
             "fleet calibrate requires --bench "
             "BENCH_LOCAL_<host>.json (a `bench local` artifact "
             "with the serving roofline block)")
-    with open(args.bench, encoding="utf-8") as fh:
+    # committed captures moved to bench_history/ (PR 16): accept a
+    # bare artifact name from either location, not just a root path
+    import pathlib as _pl
+
+    repo = _pl.Path(__file__).resolve().parents[1]
+    bench_path = next(
+        (p for p in (_pl.Path(args.bench), repo / args.bench,
+                     repo / "bench_history" / args.bench)
+         if p.is_file()), None)
+    if bench_path is None:
+        raise SystemExit(
+            f"bench artifact {args.bench!r} not found (looked in "
+            f"cwd, {repo} and {repo / 'bench_history'})")
+    with open(bench_path, encoding="utf-8") as fh:
         bench = json.load(fh)
     cal = costmodel.calibrate(bench)
     out_path = args.out or str(costmodel.DEFAULT_CALIBRATION)
@@ -1055,6 +1110,115 @@ def _fleet_calibrate(args: argparse.Namespace) -> int:
     return 0 if max(errors.values()) <= 0.15 else 1
 
 
+def _tune_output(report: dict, args: argparse.Namespace) -> int:
+    """Shared `fleet tune` / `globe tune` output path: report file,
+    winner spec file, JSON or human summary."""
+    from kind_tpu_sim import tune
+
+    text = json.dumps(report, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    if args.spec_out:
+        spec_text = tune.winner_spec_text(report)
+        if spec_text is None:
+            print("no winner to write", file=sys.stderr)
+        else:
+            with open(args.spec_out, "w", encoding="utf-8") as fh:
+                fh.write(spec_text + "\n")
+            print(f"winner spec -> {args.spec_out}")
+    if args.as_json:
+        print(text)
+    else:
+        front = report["pareto"]["front"]
+        print(f"tune[{report['target']}]: "
+              f"{report['distinct_candidates']} candidate(s) from "
+              f"budget {report['budget']}, seed {report['seed']}, "
+              f"{report['evaluations']} evaluation(s)")
+        print(f"  finalists {report['finalists']}  "
+              f"pareto front {len(front)} point(s)")
+        for p in front:
+            print(f"    #{p['index']}: cost {p['cost_chip_s']} "
+                  f"chip-s  goodput {p['goodput_tok_s']} tok/s  "
+                  f"attainment {p['attainment']}")
+        if "chaos" in report:
+            ch = report["chaos"]
+            print(f"  chaos: {ch['budget']} schedule(s), front "
+                  f"survivors {ch['front_survivors']}")
+        winner = report.get("winner")
+        if winner is not None:
+            cand = json.dumps(winner["candidate"], sort_keys=True)
+            print(f"  winner #{winner['index']}: {cand}")
+        print("TUNE " + ("OK" if report["ok"] else "FAILED"))
+    return 0 if report["ok"] else 1
+
+
+def _replay_tune_spec(path: str) -> int:
+    from kind_tpu_sim import tune
+
+    with open(path, "r", encoding="utf-8") as fh:
+        spec = json.load(fh)
+    metrics = tune.replay(spec)
+    print(json.dumps(metrics, sort_keys=True))
+    return 0 if metrics.get("ok") else 1
+
+
+def _fleet_tune(args: argparse.Namespace) -> int:
+    """`fleet tune`: seeded successive-halving search over the fleet
+    design space against this workload + SLO (docs/TUNE.md). The
+    report is byte-identical across runs AND worker counts of the
+    same tune seed."""
+    from kind_tpu_sim import fleet, tune
+
+    if args.replay_spec:
+        return _replay_tune_spec(args.replay_spec)
+    seed = fleet.resolve_seed(args.seed)
+    tenancy = fleet.default_tenancy() if args.tenancy else None
+    workload = fleet.WorkloadSpec(
+        process=args.process, rps=args.rps,
+        n_requests=args.requests,
+        shared_prefix_frac=args.shared_prefix_frac,
+        prefix_groups=args.prefix_groups,
+        deadline_s=args.deadline_s,
+        tenancy=tenancy)
+    slo = fleet.SloPolicy(ttft_s=args.ttft_slo,
+                          e2e_s=args.e2e_slo,
+                          itl_s=args.itl_slo)
+    if args.ratios:
+        space = tune.ratio_space(
+            tuple(args.ratios.split(",")), policy=args.policy)
+    else:
+        space = tune.default_fleet_space()
+    report = tune.tune(
+        space, workload, slo, seed=args.tune_seed,
+        budget=args.budget, workers=args.tune_workers,
+        chaos_budget=args.chaos_budget, workload_seed=seed)
+    return _tune_output(report, args)
+
+
+def _globe_tune(args: argparse.Namespace) -> int:
+    """`globe tune`: seeded successive-halving search over the global
+    design space (zones, cells, replicas, spill headroom) against
+    this workload (docs/TUNE.md)."""
+    from kind_tpu_sim import globe, tune
+
+    if args.replay_spec:
+        return _replay_tune_spec(args.replay_spec)
+    seed = globe.resolve_seed(args.seed)
+    workload = globe.GlobeWorkloadSpec(
+        process=args.process, rps=args.rps,
+        n_per_zone=args.requests,
+        diurnal_period_s=args.diurnal_period_s)
+    slo = globe.GlobeConfig().slo
+    space = tune.default_globe_space()
+    report = tune.tune(
+        space, workload, slo, seed=args.tune_seed,
+        budget=args.budget, workers=args.tune_workers,
+        chaos_budget=args.chaos_budget, workload_seed=seed,
+        max_virtual_s=args.max_virtual_s)
+    return _tune_output(report, args)
+
+
 def run_fleet(args: argparse.Namespace) -> int:
     """`fleet run` / `fleet trace`: the deterministic multi-replica
     serving simulator (docs/FLEET.md). Everything advances on a
@@ -1065,6 +1229,8 @@ def run_fleet(args: argparse.Namespace) -> int:
 
     if args.action == "calibrate":
         return _fleet_calibrate(args)
+    if args.action == "tune":
+        return _fleet_tune(args)
     seed = fleet.resolve_seed(args.seed)
     if args.no_tenant_isolation and not args.tenancy:
         raise SystemExit("--no-tenant-isolation needs --tenancy")
@@ -1507,6 +1673,8 @@ def run_globe(args: argparse.Namespace) -> int:
     from kind_tpu_sim import globe
     from kind_tpu_sim.fleet.tenancy import default_tenancy
 
+    if args.action == "tune":
+        return _globe_tune(args)
     seed = globe.resolve_seed(args.seed)
     if args.zones < 1 or args.zones > 26:
         raise SystemExit("--zones must be in [1, 26]")

@@ -86,3 +86,39 @@ def test_paged_tier_micro_tiny(bench):
     assert "gather_ms_per_chunk" in out
     assert "kernel_ms_per_chunk" in out
     assert out["gather_over_kernel"] > 0
+
+
+def test_bench_artifact_path_searches_root_and_history(bench):
+    """PR 16 moved committed captures into bench_history/; a reader
+    handed a bare artifact name must find it in either location
+    (root-only path assumptions broke on the move)."""
+    # a history-dir capture resolves by bare name
+    p = bench.bench_artifact_path("BENCH_LOCAL_r05_run4.json")
+    assert p.is_file()
+    assert p.parent.name == "bench_history"
+    # a root capture still resolves by bare name
+    p = bench.bench_artifact_path("BENCH_r05.json")
+    assert p.is_file()
+    assert p.parent == bench.REPO
+    # an explicit path wins as given
+    assert bench.bench_artifact_path(str(p)) == p
+    # a miss names every location tried
+    with pytest.raises(FileNotFoundError) as err:
+        bench.bench_artifact_path("BENCH_nope.json")
+    assert "bench_history" in str(err.value)
+
+
+def test_fleet_calibrate_accepts_history_relative_bench(tmp_path):
+    """`fleet calibrate --bench <bare name>` must work after the
+    bench_history/ move — the CLI reader searches both locations."""
+    import subprocess
+    import sys as _sys
+
+    out = tmp_path / "cal.json"
+    proc = subprocess.run(
+        [_sys.executable, "-m", "kind_tpu_sim", "fleet",
+         "calibrate", "--bench", "BENCH_LOCAL_r05_run4.json",
+         "--out", str(out), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert json.loads(out.read_text())["schema"] >= 1

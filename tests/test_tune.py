@@ -1,0 +1,352 @@
+"""Tune layer: seeded design search, halving, Pareto, chaos-aware
+ranking.
+
+The load-bearing properties (ISSUE 18 acceptance): same seed =>
+byte-identical search trace + Pareto front, across runs AND across
+worker-pool sizes; the exact Pareto front matches a brute-force
+oracle; successive halving can never drop a candidate that dominates
+a survivor (the top-half-union-screen-front construction); the winner
+spec replays standalone to byte-identical metrics; chaos-aware
+re-scoring is deterministic and ranks a zone-loss-surviving config
+above a cheaper non-surviving one on the pinned scenario; and
+`fleet tune` rediscovers PR 14's workload-dependent disagg optimum
+(2:2 prefix-heavy, 1:3 decode-heavy) with no hint in the prompt.
+"""
+
+import json
+import random
+
+import pytest
+
+from kind_tpu_sim import fleet, globe, tune
+from kind_tpu_sim.tune import driver as tune_driver
+from kind_tpu_sim.tune import pareto as tune_pareto
+
+pytestmark = pytest.mark.tune
+
+
+SLO = fleet.SloPolicy(ttft_s=0.5, e2e_s=2.0)
+
+# a small, fast workload for the determinism/structure tests (the
+# rediscovery tests use the PR 14 trace shapes below)
+SMALL = fleet.WorkloadSpec(process="poisson", rps=50.0,
+                           n_requests=40, prompt_len=(8, 16),
+                           max_new=(4, 8))
+
+PREFILL_HEAVY = fleet.WorkloadSpec(process="poisson", rps=2000.0,
+                                   n_requests=120,
+                                   prompt_len=(512, 768),
+                                   max_new=(1, 2))
+DECODE_HEAVY = fleet.WorkloadSpec(process="poisson", rps=800.0,
+                                  n_requests=120,
+                                  prompt_len=(8, 16),
+                                  max_new=(64, 96))
+
+RATIOS = ("1:3", "2:2", "3:1")
+
+
+def dumps(obj) -> str:
+    return json.dumps(obj, sort_keys=True)
+
+
+# -- space draws -------------------------------------------------------
+
+
+def test_draw_is_pure_function_of_space_seed_index():
+    space = tune.default_fleet_space()
+    a = [space.draw(3, i) for i in range(6)]
+    b = [space.draw(3, i) for i in range(6)]
+    assert dumps(a) == dumps(b)
+    # per-index sub-seeding: candidate 5 does not depend on 0..4
+    assert dumps(space.draw(3, 5)) == dumps(a[5])
+    # a different seed is a different stream
+    assert dumps([space.draw(4, i) for i in range(6)]) != dumps(a)
+
+
+def test_space_roundtrips_through_dict():
+    space = tune.default_globe_space()
+    back = tune.TuneSpace.from_dict(
+        json.loads(dumps(space.as_dict())))
+    assert back == space
+    assert dumps(back.draw(9, 2)) == dumps(space.draw(9, 2))
+
+
+def test_candidates_render_runnable_configs():
+    space = tune.default_fleet_space()
+    for i in range(8):
+        cand = space.draw(0, i)
+        cfg = tune.render_fleet(cand, SLO)
+        assert cfg.replicas == tune.candidate_replicas(cand)
+        assert cfg.slo is SLO
+    gspace = tune.default_globe_space()
+    for i in range(8):
+        cand = gspace.draw(0, i)
+        cfg = tune.render_globe(
+            cand, globe.GlobeConfig().slo,
+            globe.GlobeWorkloadSpec(n_per_zone=10))
+        assert len(cfg.zones) == cand["zones"]
+        assert not cfg.sched
+
+
+# -- search-trace determinism ------------------------------------------
+
+
+def test_report_byte_identical_across_runs():
+    space = tune.default_fleet_space()
+    a = tune.tune(space, SMALL, SLO, seed=5, budget=6)
+    b = tune.tune(space, SMALL, SLO, seed=5, budget=6)
+    assert dumps(a) == dumps(b)
+    assert a["ok"]
+
+
+def test_report_byte_identical_across_worker_counts():
+    """The acceptance bar: the whole search trace (runs, front,
+    winner — every byte) is invariant under the worker-pool size the
+    evals were sharded over."""
+    space = tune.ratio_space(RATIOS)
+    inproc = tune.tune(space, SMALL, SLO, seed=5, budget=4,
+                       workers=0)
+    pooled = tune.tune(space, SMALL, SLO, seed=5, budget=4,
+                       workers=2)
+    assert dumps(inproc) == dumps(pooled)
+
+
+def test_distinct_candidate_draws_cover_small_spaces():
+    """Random draws over a 3-point space would miss values a budget
+    of 6 can afford; the distinct-draw stream must yield all three,
+    each index still `space.draw(seed, index)`-replayable."""
+    space = tune.ratio_space(RATIOS)
+    rep = tune.tune(space, SMALL, SLO, seed=7, budget=6)
+    cands = rep["candidates"]
+    assert rep["distinct_candidates"] == 3
+    assert {c["pool_ratio"] for c in cands.values()} == set(RATIOS)
+    for idx, cand in cands.items():
+        assert dumps(space.draw(7, int(idx))) == dumps(cand)
+
+
+# -- pareto vs brute force ---------------------------------------------
+
+
+def oracle_front(points):
+    out = []
+    for p in points:
+        if not any(tune.dominates(q, p) for q in points):
+            out.append(p)
+    return sorted(out, key=lambda p: (p["cost_chip_s"],
+                                      -p["goodput_tok_s"],
+                                      p["index"]))
+
+
+def test_pareto_front_matches_bruteforce_oracle():
+    for seed in range(20):
+        rng = random.Random(seed)
+        points = [{
+            "index": i,
+            "cost_chip_s": round(rng.uniform(1, 10), 2),
+            "goodput_tok_s": round(rng.uniform(0, 1000), 1),
+            "attainment": round(rng.uniform(0, 1), 2),
+        } for i in range(rng.randint(1, 30))]
+        assert (dumps(tune.pareto_front(points))
+                == dumps(oracle_front(points)))
+
+
+def test_knee_point_is_on_front_and_deterministic():
+    rng = random.Random(0)
+    points = [{
+        "index": i,
+        "cost_chip_s": round(rng.uniform(1, 10), 2),
+        "goodput_tok_s": round(rng.uniform(0, 1000), 1),
+        "attainment": 1.0,
+    } for i in range(20)]
+    front = tune.pareto_front(points)
+    knee = tune.knee_point(front)
+    assert knee in front
+    assert dumps(tune.knee_point(list(reversed(front)))) \
+        == dumps(knee)
+    assert tune.knee_point([]) is None
+    # singleton fronts degrade to "the only point"
+    assert tune.knee_point(front[:1]) == front[0]
+
+
+# -- halving dominance safety ------------------------------------------
+
+
+def synthetic_screen(seed, n):
+    rng = random.Random(seed)
+    return [{
+        "index": i,
+        "cost_chip_s": round(rng.uniform(1, 10), 2),
+        "goodput_tok_s": round(rng.uniform(0, 1000), 1),
+        "attainment": round(rng.choice([0.25, 0.5, 1.0]), 2),
+        "e2e_p50_s": round(rng.uniform(0.01, 2.0), 3),
+        "ok": True,
+    } for i in range(n)]
+
+
+def test_halving_never_drops_a_dominating_candidate():
+    """Property, over seeded synthetic screen rungs: any candidate
+    that dominates a survivor is itself a survivor — so promoting
+    only survivors can never lose the best point of the final-rung
+    front to the screen cut."""
+    for seed in range(50):
+        rng = random.Random(1000 + seed)
+        screen = synthetic_screen(seed, rng.randint(2, 24))
+        survivors = set(tune.survivors_of(screen))
+        rows = {m["index"]: m for m in screen}
+        for c in screen:
+            if c["index"] in survivors:
+                continue
+            for s in survivors:
+                assert not tune.dominates(c, rows[s]), (
+                    f"seed {seed}: dropped candidate "
+                    f"{c['index']} dominates survivor {s}")
+
+
+def test_halving_property_holds_on_a_real_search():
+    space = tune.default_fleet_space()
+    rep = tune.tune(space, SMALL, SLO, seed=3, budget=8)
+    screen = [r["metrics"] for r in rep["runs"]
+              if r["rung"] == "screen"]
+    survivors = set(rep["finalists"])
+    assert survivors == set(tune_driver.survivors_of(screen))
+    rows = {m["index"]: m for m in screen}
+    for c in screen:
+        if c["index"] not in survivors:
+            for s in survivors:
+                assert not tune.dominates(c, rows[s])
+
+
+# -- winner spec replay ------------------------------------------------
+
+
+def test_winner_spec_roundtrips_and_replays_byte_identical():
+    space = tune.ratio_space(RATIOS)
+    rep = tune.tune(space, SMALL, SLO, seed=7, budget=4)
+    text = tune.winner_spec_text(rep)
+    assert text is not None
+    spec = json.loads(text)
+    assert dumps(spec) == dumps(rep["winner"]["spec"])
+    # the spec is self-contained: replay from the parsed JSON alone
+    metrics = tune.replay(spec)
+    assert dumps(metrics) == dumps(rep["winner"]["metrics"])
+    # and the embedded candidate is draw-replayable from the space
+    back = tune.TuneSpace.from_dict(spec["space"])
+    assert dumps(back.draw(rep["seed"], spec["index"])) \
+        == dumps(spec["candidate"])
+
+
+def test_workload_seed_is_what_winner_specs_carry():
+    space = tune.ratio_space(RATIOS)
+    rep = tune.tune(space, SMALL, SLO, seed=7, budget=4,
+                    workload_seed=11)
+    assert rep["seed"] == 7
+    assert rep["workload_seed"] == 11
+    assert rep["winner"]["spec"]["seed"] == 11
+
+
+# -- rediscovery (the PR 14 optimum, no hints) -------------------------
+
+
+def test_rediscovers_workload_dependent_disagg_optimum():
+    """`fleet tune` over the bare ratio space — the search is never
+    told which ratio wins — must land on 2:2 for the prefix-heavy
+    trace and 1:3 for the decode-heavy trace (the PR 14 sweep's
+    workload-dependent optimum)."""
+    space = tune.ratio_space(RATIOS)
+    winners = {}
+    for name, wl in (("prefill_heavy", PREFILL_HEAVY),
+                     ("decode_heavy", DECODE_HEAVY)):
+        rep = tune.tune(space, wl, SLO, seed=7, budget=6,
+                        workload_seed=11)
+        assert rep["ok"]
+        winners[name] = rep["winner"]["candidate"]["pool_ratio"]
+    assert winners == {"prefill_heavy": "2:2",
+                       "decode_heavy": "1:3"}
+
+
+# -- chaos-aware mode --------------------------------------------------
+
+
+def test_fault_schedules_are_pure_and_candidate_independent():
+    a = tune.draw_fault_schedule("globe", 0, 0)
+    b = tune.draw_fault_schedule("globe", 0, 0)
+    assert a == b
+    assert tune.draw_fault_schedule("globe", 0, 1) != a
+    assert tune.draw_fault_schedule("fleet", 0, 0) != a
+    for w in a:
+        assert w.kind in tune.GLOBE_CHAOS_KINDS
+        assert 0.0 < w.start_frac < w.end_frac <= 0.75
+
+
+def test_chaos_mode_is_deterministic():
+    space = tune.ratio_space(RATIOS)
+    a = tune.tune(space, SMALL, SLO, seed=7, budget=4,
+                  chaos_budget=2)
+    b = tune.tune(space, SMALL, SLO, seed=7, budget=4,
+                  chaos_budget=2)
+    assert dumps(a) == dumps(b)
+    ch = a["chaos"]
+    assert ch["budget"] == 2
+    for entry in ch["finalists"].values():
+        assert len(entry["schedules"]) == 2
+
+
+def zone_loss_space():
+    """The pinned scenario's design space: a 2-zone single-cell
+    planet where the only question is 1 or 3 replicas per cell."""
+    return tune.TuneSpace(
+        name="zone-loss-pin", target="globe",
+        dims=(
+            tune.TuneDim("zones", "choice", choices=(2,)),
+            tune.TuneDim("cells_per_zone", "choice", choices=(1,)),
+            tune.TuneDim("replicas_per_cell", "choice",
+                         choices=(1, 3)),
+            tune.TuneDim("policy", "choice",
+                         choices=("least-outstanding",)),
+        ))
+
+
+def test_chaos_ranks_zone_loss_survivor_above_cheaper_config():
+    """The pinned acceptance scenario: under a fuzzer-drawn schedule
+    that includes a zone loss, the chaos-aware winner must be the
+    surviving (3 replicas/cell) config even though a cheaper
+    (1 replica/cell) config sits on the fault-free Pareto front."""
+    wl = globe.GlobeWorkloadSpec(process="poisson", rps=150.0,
+                                 n_per_zone=200)
+    rep = tune.tune(zone_loss_space(), wl, SLO, seed=0, budget=4,
+                    chaos_budget=1)
+    by_rpc = {c["replicas_per_cell"]: int(i)
+              for i, c in rep["candidates"].items()}
+    assert set(by_rpc) == {1, 3}
+    # the drawn schedule actually contains a zone loss
+    kinds = {w.kind for w in
+             tune.draw_fault_schedule("globe", 0, 0)}
+    assert "zone_loss" in kinds
+    # both configs reach the fault-free front; the cheap one is
+    # genuinely cheaper
+    front = {int(p["index"]): p for p in rep["pareto"]["front"]}
+    assert set(front) == set(by_rpc.values())
+    assert (front[by_rpc[1]]["cost_chip_s"]
+            < front[by_rpc[3]]["cost_chip_s"])
+    # chaos verdicts: the cheap config dies in the zone loss, the
+    # provisioned one rides it out — and the winner is the survivor
+    finalists = rep["chaos"]["finalists"]
+    assert not finalists[str(by_rpc[1])]["survived_all"]
+    assert finalists[str(by_rpc[3])]["survived_all"]
+    assert rep["winner"]["index"] == by_rpc[3]
+    assert rep["winner"]["survived_all"]
+
+
+# -- knobs -------------------------------------------------------------
+
+
+def test_seed_budget_knobs_resolve(monkeypatch):
+    monkeypatch.setenv("KIND_TPU_SIM_TUNE_SEED", "13")
+    monkeypatch.setenv("KIND_TPU_SIM_TUNE_BUDGET", "5")
+    monkeypatch.setenv("KIND_TPU_SIM_TUNE_CHAOS_BUDGET", "2")
+    assert tune.resolve_seed() == 13
+    assert tune.resolve_budget() == 5
+    assert tune.resolve_chaos_budget() == 2
+    assert tune.resolve_seed(1) == 1
+    assert tune.resolve_budget(2) == 2
+    assert tune.resolve_chaos_budget(0) == 0
