@@ -2390,6 +2390,77 @@ def tune_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def zoo_smoke() -> dict | None:
+    """Heterogeneous-fleet extras (docs/ZOO.md): seeded successive-
+    halving search over the zoo design space — which accelerator
+    generations to buy and where the zoo's 60 GB model should live —
+    against a three-model trace, with every candidate paying its
+    generation-weighted chip-second price. The headline observable
+    is placement discovery: the knee-point winner puts the large
+    model on the big-HBM generation (v5p is the ONLY generation it
+    fits) while buying mostly cheap v5e capacity, and an all-v5e
+    fleet is visibly shedding the models it cannot hold. The winner
+    spec replays byte-identically (docs/TUNE.md's contract)."""
+    try:
+        import hashlib as _hashlib
+        import json as _json
+
+        from kind_tpu_sim import fleet, tune
+
+        t0 = time.monotonic()
+        spec = fleet.WorkloadSpec(
+            process="poisson", rps=60.0, n_requests=240,
+            prompt_len=(4, 16), max_new=(8, 24),
+            zoo=fleet.default_zoo())
+        slo = fleet.SloPolicy(ttft_s=1.0, e2e_s=8.0)
+        rep = tune.tune(tune.zoo_space(), spec, slo, seed=0,
+                        budget=12, timer=time.monotonic)
+        winner = rep.get("winner") or {}
+        cand = winner.get("candidate") or {}
+        metrics = winner.get("metrics") or {}
+        replayed = (tune.replay(_json.loads(_json.dumps(
+            winner["spec"]))) if winner else None)
+        replay_identical = (
+            replayed is not None
+            and _hashlib.sha256(_json.dumps(
+                replayed, sort_keys=True).encode()).hexdigest()
+            == _hashlib.sha256(_json.dumps(
+                metrics, sort_keys=True).encode()).hexdigest())
+        finals = {
+            run["index"]: run for run in rep["runs"]
+            if run["rung"] == "final"}
+        all_v5e = [
+            {"generation_split": "v5e",
+             "attainment": r["metrics"].get("attainment"),
+             "shed": r["metrics"].get("shed")}
+            for r in finals.values()
+            if r["candidate"].get("generation_split") == "v5e"]
+        split = str(cand.get("generation_split", ""))
+        placed_big_hbm = (cand.get("large_model_gen") == "v5p"
+                          and "v5p" in split.split("+"))
+        return {
+            "ok": (rep["ok"] and placed_big_hbm
+                   and replay_identical),
+            "seconds": round(time.monotonic() - t0, 3),
+            "winner": {
+                "candidate": cand,
+                "attainment": metrics.get("attainment"),
+                "goodput_tok_s": metrics.get("goodput_tok_s"),
+                "cost_chip_s": metrics.get("cost_chip_s"),
+                "generation_cost_factor": metrics.get(
+                    "generation_cost_factor"),
+            },
+            "placed_large_on_v5p": placed_big_hbm,
+            "replay_identical": replay_identical,
+            "all_v5e_finalists": all_v5e,
+            "evaluations": rep["evaluations"],
+            "finalists": len(rep["finalists"]),
+            "timings": rep["timings"],
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def tenant_smoke() -> dict | None:
     """Multi-tenancy extras (docs/TENANCY.md): one seeded
     heavy-tailed tenant trace with a bronze aggressor surge, run
@@ -3280,6 +3351,10 @@ def main(argv=None) -> int:
             tune_rep = tune_smoke()
         if tune_rep:
             phases["tune"] = tune_rep
+        with stopwatch("zoo"):
+            zoo_rep = zoo_smoke()
+        if zoo_rep:
+            phases["zoo"] = zoo_rep
         with stopwatch("tenant"):
             tenant_rep = tenant_smoke()
         if tenant_rep:

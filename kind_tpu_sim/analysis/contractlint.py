@@ -120,6 +120,7 @@ CANONICAL_LANES: Tuple[Tuple[str, int], ...] = (
     ("LANE_AUTOSCALER", 4),
     ("LANE_PLANNER", 5),
     ("LANE_KV_TRANSFER", 6),
+    ("LANE_MODEL_SWAP", 7),
 )
 LANE_NAMES = frozenset(name for name, _ in CANONICAL_LANES)
 
@@ -361,7 +362,7 @@ def lane_order_problems() -> List[str]:
             problems.append(
                 f"{name} is {have}, canonical order says {value} "
                 "(arrival < completion < chaos < probe < "
-                "autoscaler < planner < kv-transfer)")
+                "autoscaler < planner < kv-transfer < model-swap)")
     lanes = getattr(events, "LANES", ())
     want = tuple(v for _, v in CANONICAL_LANES)
     if tuple(lanes) != want:
@@ -491,6 +492,102 @@ def cli_flag_problems(root: RootLike = None) -> List[str]:
     return problems
 
 
+def generation_coverage_problems(root: RootLike = None) -> List[str]:
+    """Generation registry <-> configs/manifests, every direction
+    (docs/ZOO.md): each registered generation must resolve to a
+    loadable ``fleet/calibration/<gen>.json``; the accelerator-label
+    maps (costmodel.ACCELERATOR_GENERATIONS and its inverse, the
+    sched-topology table) must stay in bijection with
+    ``topology.ACCELERATORS``; and every accelerator nodeSelector in
+    ``pods/*.yaml`` must name a label that prices against a
+    registered generation. Catches the add-a-generation-forget-the-
+    calibration (and label-rename) drift before a sim prices against
+    a file that is not there."""
+    import yaml
+
+    from kind_tpu_sim import topology
+    from kind_tpu_sim.fleet import costmodel
+
+    root = _resolve_root(root)
+    problems: List[str] = []
+
+    for gen in costmodel.GENERATIONS:
+        path = (root / "kind_tpu_sim" / "fleet" / "calibration"
+                / f"{gen}.json")
+        if not path.is_file():
+            problems.append(
+                f"generation {gen!r} is registered but "
+                f"{path.relative_to(root)} does not exist — run "
+                "`kind-tpu-sim fleet calibrate`")
+            continue
+        try:
+            costmodel.load_generation(gen)
+        except Exception as exc:
+            problems.append(
+                f"generation {gen!r} calibration does not load: "
+                f"{exc}")
+    for gen in sorted(costmodel.GENERATION_FACTS):
+        if gen not in costmodel.GENERATIONS:
+            problems.append(
+                f"GENERATION_FACTS names unregistered generation "
+                f"{gen!r}")
+
+    for accel in sorted(topology.ACCELERATORS):
+        if accel not in costmodel.ACCELERATOR_GENERATIONS:
+            problems.append(
+                f"accelerator {accel!r} has no generation mapping "
+                "(costmodel.ACCELERATOR_GENERATIONS) — sched fleets "
+                "of it cannot be priced")
+        if accel not in costmodel.GENERATION_SCHED_TOPOLOGY:
+            problems.append(
+                f"accelerator {accel!r} has no sched-topology entry "
+                "(costmodel.GENERATION_SCHED_TOPOLOGY)")
+    for accel, gen in sorted(costmodel.ACCELERATOR_GENERATIONS
+                             .items()):
+        if accel not in topology.ACCELERATORS:
+            problems.append(
+                f"ACCELERATOR_GENERATIONS names unknown accelerator "
+                f"{accel!r} (topology.ACCELERATORS)")
+        if gen not in costmodel.GENERATIONS:
+            problems.append(
+                f"accelerator {accel!r} maps to unregistered "
+                f"generation {gen!r}")
+
+    def _labels(obj) -> List[str]:
+        found: List[str] = []
+        if isinstance(obj, dict):
+            for key, value in obj.items():
+                if (key == topology.LABEL_ACCELERATOR
+                        and isinstance(value, str)):
+                    found.append(value)
+                else:
+                    found.extend(_labels(value))
+        elif isinstance(obj, list):
+            for value in obj:
+                found.extend(_labels(value))
+        return found
+
+    pods = root / "pods"
+    for manifest in sorted(pods.glob("*.yaml")):
+        try:
+            docs = list(yaml.safe_load_all(
+                manifest.read_text(encoding="utf-8")))
+        except Exception as exc:
+            problems.append(
+                f"{manifest.relative_to(root)}: unparseable yaml: "
+                f"{exc}")
+            continue
+        for label in _labels(docs):
+            try:
+                costmodel.generation_of_accelerator(label)
+            except ValueError:
+                problems.append(
+                    f"{manifest.relative_to(root)}: accelerator "
+                    f"label {label!r} resolves to no registered "
+                    "generation")
+    return problems
+
+
 def cross_check_problems(root: RootLike = None) -> Dict[str, List[str]]:
     """All registry bijections the contract gate holds, by family.
     fault-schemas and scenario-registry checks are shared with
@@ -502,6 +599,7 @@ def cross_check_problems(root: RootLike = None) -> Dict[str, List[str]]:
     return {
         "cli_flags": cli_flag_problems(root),
         "fault_schemas": fault_schema_problems(),
+        "generation_coverage": generation_coverage_problems(root),
         "knob_coverage": knob_coverage_problems(root),
         "lane_order": lane_order_problems(),
         "scenario_registry": registry.registry_problems(),
@@ -518,10 +616,11 @@ SCHEMA_PATH = pathlib.Path(__file__).with_name("report_schema.json")
 _DYNAMIC_CONTAINERS = frozenset((
     "breakers", "candidates", "cells", "components",
     "event_counts", "finalists", "fleet_counters", "gangs",
-    "globe_counters", "hard_limits", "health_counters",
-    "peak_outstanding", "per_replica", "replicas", "retry_budget",
+    "generations", "globe_counters", "hard_limits",
+    "health_counters", "mix", "peak_outstanding", "per_model_slo",
+    "per_replica", "replicas", "residents", "retry_budget",
     "sched_counters", "sched_event_counts", "tenants",
-    "hedge_budget_by_tenant", "train_counters", "zones",
+    "hedge_budget_by_tenant", "train_counters", "warm", "zones",
 ))
 
 
@@ -634,6 +733,27 @@ def collect_report_schema(
     tenant_report = fleet.FleetSim(
         tcfg, fleet.generate_trace(tspec, 9)).run()
 
+    # zoo keys (per-model SLO boards / residents / swap ledger /
+    # per-replica generation labels; globe warm-model maps) only
+    # exist on a zoo fleet — pinned runs of their own
+    # (docs/ZOO.md). Model- and replica-keyed containers are
+    # dynamic, so their child segments collapse to `*`.
+    zzoo = fleet.default_zoo()
+    zspec = fleet.WorkloadSpec(
+        process="poisson", rps=40.0, n_requests=40, zoo=zzoo)
+    zcfg = fleet.FleetConfig(
+        replicas=2, policy="least-outstanding", zoo=zzoo,
+        generations=("v5e", "v5p"))
+    zoo_report = fleet.FleetSim(
+        zcfg, fleet.generate_trace(zspec, 11)).run()
+
+    gzcfg = globe.GlobeConfig(
+        zones=("us-a", "eu-b"), max_virtual_s=60.0,
+        workload=globe.GlobeWorkloadSpec(n_per_zone=20, rps=20.0),
+        sched=False, zoo=zzoo, generations=("v5e", "v5p"))
+    globe_zoo_report = globe.GlobeSim(
+        gzcfg, globe.generate_globe_traces(gzcfg, 13)).run()
+
     # tune keys (search trace / pareto front / chaos rescoring): a
     # pinned tiny search over the disagg-ratio space. The
     # candidate-index keyed containers ("candidates", chaos
@@ -653,7 +773,9 @@ def collect_report_schema(
         "fleet": sorted(_key_paths(fleet_report)),
         "fleet_disagg": sorted(_key_paths(disagg_report)),
         "fleet_tenant": sorted(_key_paths(tenant_report)),
+        "fleet_zoo": sorted(_key_paths(zoo_report)),
         "globe": sorted(_key_paths(globe_report)),
+        "globe_zoo": sorted(_key_paths(globe_zoo_report)),
         "tune": sorted(_key_paths(tune_report)),
     }
 

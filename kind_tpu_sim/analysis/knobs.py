@@ -259,6 +259,22 @@ TENANT_DRR_QUANTUM = _register(
     "visit per unit of tenant weight (larger = coarser fairness, "
     "fewer tenant switches).")
 
+# model zoo / generations (docs/ZOO.md)
+GENERATION = _register(
+    "KIND_TPU_SIM_GENERATION", "v5e", "str", "zoo",
+    "Default accelerator generation replicas price against when a "
+    "run declares none (`v5e` / `v4` / `v5p`); each name resolves "
+    "to a checked-in `fleet/calibration/<gen>.json`.")
+ZOO_MODELS = _register(
+    "KIND_TPU_SIM_ZOO_MODELS", 3, "int", "zoo",
+    "Default model count `fleet run --zoo` serves (clamped to the "
+    "checked-in default zoo's size).")
+ZOO_SWAP_FACTOR = _register(
+    "KIND_TPU_SIM_ZOO_SWAP_FACTOR", 1.0, "float", "zoo",
+    "Multiplier on the modeled weight-load (model swap) time — the "
+    "calibration's HBM-bandwidth load priced up for checkpoint "
+    "parse/reshard overhead; `0` makes every swap free.")
+
 # health / gray-failure detection (docs/HEALTH.md)
 HEALTH_ALPHA = _register(
     "KIND_TPU_SIM_HEALTH_ALPHA", 0.25, "float", "health",
@@ -342,7 +358,7 @@ BENCH_SLOW = _register(
 # alphabetical, so the page reads like the architecture diagram.
 LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "disagg",
                "sched", "train", "globe", "overload", "tenant",
-               "health", "fuzz", "tune", "bench")
+               "zoo", "health", "fuzz", "tune", "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -357,6 +373,7 @@ LAYER_DOCS = {
     "globe": "GLOBE.md",
     "overload": "OVERLOAD.md",
     "tenant": "TENANCY.md",
+    "zoo": "ZOO.md",
     "health": "HEALTH.md",
     "fuzz": "FUZZ.md",
     "tune": "TUNE.md",

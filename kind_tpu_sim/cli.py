@@ -393,6 +393,22 @@ def build_parser() -> argparse.ArgumentParser:
              "disable QoS isolation (FIFO dispatch, no per-tenant "
              "KV budgets) — the noisy-neighbor contrast run")
     fl.add_argument(
+        "--zoo", action="store_true",
+        help="serve the default three-model zoo (docs/ZOO.md): "
+             "every request targets a model, replicas hold one "
+             "model's weights warm, cold routes pay a modeled "
+             "weight-load on the swap lane, and routing is "
+             "warm-first; defaults --generations to v5e,v5p so "
+             "every model has a generation it fits; knobs "
+             "KIND_TPU_SIM_ZOO_*; report gains a 'zoo' section")
+    fl.add_argument(
+        "--generations", default=None, metavar="G1,G2",
+        help="heterogeneous accelerator generations cycled over "
+             "replica ids (docs/ZOO.md): each replica prices off "
+             "its generation's fleet/calibration/<gen>.json "
+             "roofline; under --sched the single generation "
+             "derives from the gangs' accelerator label instead")
+    fl.add_argument(
         "--train", type=int, default=0, metavar="N",
         help="co-schedule N LLM training gangs under the serving "
              "fleet (docs/TRAINING.md; requires --sched): gangs "
@@ -1174,17 +1190,23 @@ def _fleet_tune(args: argparse.Namespace) -> int:
         return _replay_tune_spec(args.replay_spec)
     seed = fleet.resolve_seed(args.seed)
     tenancy = fleet.default_tenancy() if args.tenancy else None
+    zoo = fleet.default_zoo() if args.zoo else None
     workload = fleet.WorkloadSpec(
         process=args.process, rps=args.rps,
         n_requests=args.requests,
         shared_prefix_frac=args.shared_prefix_frac,
         prefix_groups=args.prefix_groups,
         deadline_s=args.deadline_s,
-        tenancy=tenancy)
+        tenancy=tenancy, zoo=zoo)
     slo = fleet.SloPolicy(ttft_s=args.ttft_slo,
                           e2e_s=args.e2e_slo,
                           itl_s=args.itl_slo)
-    if args.ratios:
+    if args.zoo:
+        # the heterogeneous-fleet placement search (docs/ZOO.md):
+        # which generations to buy and where the largest model
+        # lives, priced by generation-weighted chip-seconds
+        space = tune.zoo_space()
+    elif args.ratios:
         space = tune.ratio_space(
             tuple(args.ratios.split(",")), policy=args.policy)
     else:
@@ -1241,13 +1263,32 @@ def run_fleet(args: argparse.Namespace) -> int:
             import dataclasses as _dc
 
             tenancy = _dc.replace(tenancy, isolation=False)
+    zoo = fleet.default_zoo() if args.zoo else None
+    generations = None
+    if args.generations:
+        generations = tuple(
+            g.strip() for g in args.generations.split(",")
+            if g.strip())
+    elif args.zoo:
+        # without an explicit cycle a zoo fleet buys one generation
+        # of each HBM class, so every default-zoo model has a
+        # replica it fits
+        generations = ("v5e", "v5p")
+    if zoo is not None:
+        if args.disagg:
+            raise SystemExit("--zoo does not compose with --disagg "
+                             "(phase pools price off the anchor)")
+        if args.engine == "serving":
+            raise SystemExit("--zoo needs the analytic sim engine "
+                             "(calibrated zoo replicas)")
     spec = fleet.WorkloadSpec(
         process=args.process, rps=args.rps,
         n_requests=args.requests,
         shared_prefix_frac=args.shared_prefix_frac,
         prefix_groups=args.prefix_groups,
         deadline_s=args.deadline_s,
-        tenancy=tenancy)
+        tenancy=tenancy,
+        zoo=zoo)
     if args.trace_file:
         trace = fleet.load_trace(args.trace_file)
     else:
@@ -1304,6 +1345,8 @@ def run_fleet(args: argparse.Namespace) -> int:
         training=_fleet_training_config(args),
         disagg=disagg,
         tenancy=tenancy,
+        zoo=zoo,
+        generations=generations,
         event_core=(False if args.no_event_core else None))
     clock = fleet.VirtualClock()
     factory = None

@@ -28,11 +28,16 @@ from kind_tpu_sim.fleet.autoscaler import (  # noqa: F401
     resolve_warmup_s,
 )
 from kind_tpu_sim.fleet.costmodel import (  # noqa: F401
+    DEFAULT_GENERATION,
+    GENERATION_FACTS,
+    GENERATIONS,
     CostModel,
     RequestCost,
     calibrate,
+    generation_of_accelerator,
     kv_bytes_per_token,
     load_calibration,
+    load_generation,
     parse_geometry,
 )
 from kind_tpu_sim.fleet.disagg import (  # noqa: F401
@@ -50,6 +55,7 @@ from kind_tpu_sim.fleet.events import (  # noqa: F401
     LANE_COMPLETION,
     LANE_HEALTH_PROBE,
     LANE_KV_TRANSFER,
+    LANE_MODEL_SWAP,
     LANE_PLANNER,
     LANES,
     DueSet,
@@ -109,6 +115,19 @@ from kind_tpu_sim.fleet.tenancy import (  # noqa: F401
     resolve_isolation,
     tenant_of,
     tenant_surge_trace,
+)
+from kind_tpu_sim.fleet.zoo import (  # noqa: F401
+    ModelSpec,
+    SwapEvent,
+    ZooConfig,
+    default_zoo,
+    fits,
+    model_sim_config,
+    placements,
+    resolve_generation,
+    stamp_models,
+    swap_s,
+    zoo_config_from_dict,
 )
 from kind_tpu_sim.fleet.training import (  # noqa: F401
     TRAIN_KINDS,

@@ -45,6 +45,60 @@ CALIBRATION_SCHEMA = 1
 
 DEFAULT_CALIBRATION = (pathlib.Path(__file__).parent
                        / "calibration" / "r05.json")
+CALIBRATION_DIR = DEFAULT_CALIBRATION.parent
+
+# -- the generation registry (docs/ZOO.md) ---------------------------
+#
+# One calibration file per accelerator generation
+# (``calibration/<gen>.json``). ``v5e`` IS the measured r05 anchor
+# (same numbers, plus the generation metadata block); ``v4`` and
+# ``v5p`` are derived from it by the public roofline ratios — scaling
+# the analytic AND measured rates by the same ratio preserves every
+# ``error_frac``, so the ≤15% calibration-error bound holds for the
+# derived files by construction (derive_generation is the one place
+# the scaling rule lives; the checked-in files are pinned against it
+# by the test suite).
+
+DEFAULT_GENERATION = "v5e"
+GENERATIONS = ("v5e", "v4", "v5p")
+
+# topology.ACCELERATORS label -> generation name: how a sched
+# inventory pool's accelerator label (pods/*.yaml nodeSelector,
+# kubeface) resolves to the calibration that prices its replicas.
+ACCELERATOR_GENERATIONS = {
+    "tpu-v5-lite-podslice": "v5e",
+    "tpu-v4-podslice": "v4",
+    "tpu-v5p-slice": "v5p",
+}
+
+# the inverse: generation name -> the accelerator label a sched
+# inventory pool of that generation requests (mixed-generation globe
+# cells build their FleetSchedConfig from it)
+GENERATION_ACCELERATORS = {
+    gen: accel for accel, gen in ACCELERATOR_GENERATIONS.items()}
+
+# sched inventory shapes per accelerator: (pod topology, replica
+# slice topology) — v5e pods are 2-D (4x8 hosts a 2x4 replica slice);
+# v4/v5p topologies are 3-D (topology.AcceleratorSpec.ndims)
+GENERATION_SCHED_TOPOLOGY = {
+    "tpu-v5-lite-podslice": ("4x8", "2x4"),
+    "tpu-v4-podslice": ("4x4x4", "2x2x2"),
+    "tpu-v5p-slice": ("4x4x4", "2x2x2"),
+}
+
+# Public per-chip facts vs the v5e anchor (197 bf16 TFLOPs, 819 GB/s
+# HBM, 16 GiB): v4 = 275 TFLOPs / 1228 GB/s / 32 GiB, v5p = 459
+# TFLOPs / 2765 GB/s / 95 GiB. chip_second_cost is the relative
+# on-demand price per chip-second (v5e = 1.0) the tune cost axis
+# weights mixed-generation fleets by.
+GENERATION_FACTS = {
+    "v5e": {"compute_ratio": 1.0, "bandwidth_ratio": 1.0,
+            "hbm_gib": 16.0, "chip_second_cost": 1.0},
+    "v4": {"compute_ratio": 1.396, "bandwidth_ratio": 1.499,
+           "hbm_gib": 32.0, "chip_second_cost": 2.7},
+    "v5p": {"compute_ratio": 2.33, "bandwidth_ratio": 3.376,
+            "hbm_gib": 95.0, "chip_second_cost": 3.5},
+}
 
 DTYPES = ("bf16", "int8")
 DTYPE_BYTES = {"bf16": 2, "int8": 1}
@@ -180,6 +234,92 @@ def load_calibration(path: Optional[str] = None) -> dict:
             f"{CALIBRATION_SCHEMA} — regenerate with "
             "`kind-tpu-sim fleet calibrate`")
     return cal
+
+
+def generation_path(name: str) -> pathlib.Path:
+    """Where generation ``name``'s calibration file lives."""
+    return CALIBRATION_DIR / f"{name}.json"
+
+
+def load_generation(name: str) -> dict:
+    """Load a registered generation's calibration by name. The file
+    must self-identify (``generation`` key matching its stem) so a
+    renamed or mis-derived file cannot silently misprice a fleet."""
+    if name not in GENERATIONS:
+        raise ValueError(
+            f"unknown generation {name!r}; registered: "
+            f"{', '.join(GENERATIONS)}")
+    cal = load_calibration(str(generation_path(name)))
+    if cal.get("generation") != name:
+        raise ValueError(
+            f"calibration file {generation_path(name)} declares "
+            f"generation {cal.get('generation')!r}, expected "
+            f"{name!r} — regenerate with `kind-tpu-sim fleet "
+            "calibrate`")
+    return cal
+
+
+def generation_of_accelerator(accelerator: str) -> str:
+    """The generation name a sched/kubeface accelerator label prices
+    against (``tpu-v5-lite-podslice`` -> ``v5e``)."""
+    try:
+        return ACCELERATOR_GENERATIONS[accelerator]
+    except KeyError:
+        raise ValueError(
+            f"accelerator {accelerator!r} maps to no registered "
+            f"generation; known: "
+            f"{', '.join(sorted(ACCELERATOR_GENERATIONS))}") from None
+
+
+def derive_generation(base: dict, name: str) -> dict:
+    """Scale the measured anchor calibration onto generation ``name``
+    by its public roofline ratios. Prefill (compute-bound) rates
+    scale by the compute ratio; decode (HBM-byte-bound) bandwidths
+    and rates scale by the bandwidth ratio. The analytic and measured
+    sides of each phase scale together, so every ``error_frac`` is
+    preserved — the derived file inherits the anchor's calibration
+    quality instead of inventing its own."""
+    facts = GENERATION_FACTS[name]
+    compute = facts["compute_ratio"]
+    bw = facts["bandwidth_ratio"]
+    slots = int(base["slots"])
+    prefill_analytic = round(
+        base["prefill"]["analytic_tokens_per_s"] * compute, 3)
+    prefill_measured = round(
+        base["prefill"]["measured_tokens_per_s"] * compute, 3)
+    decode: Dict[str, dict] = {}
+    for dtype, d in base["decode"].items():
+        achieved = round(d["achieved_gbps"] * bw, 3)
+        analytic = (slots * achieved * 1e9
+                    / (d["bytes_per_step_mb"] * 1e6))
+        measured = round(d["measured_tokens_per_s"] * bw, 3)
+        decode[dtype] = {
+            "achieved_gbps": achieved,
+            "analytic_tokens_per_s": round(analytic, 3),
+            "bytes_per_step_mb": d["bytes_per_step_mb"],
+            "error_frac": _error_frac(analytic, measured),
+            "kv_mb": d["kv_mb"],
+            "measured_tokens_per_s": measured,
+            "roof_gbps": round(d["roof_gbps"] * bw, 3),
+            "weight_mb": d["weight_mb"],
+        }
+    return {
+        "schema": CALIBRATION_SCHEMA,
+        "backend": base["backend"],
+        "chip": name,
+        "generation": name,
+        "hbm_gib": facts["hbm_gib"],
+        "chip_second_cost": facts["chip_second_cost"],
+        "model": base["model"],
+        "geometry": dict(base["geometry"]),
+        "slots": slots,
+        "prefill": {
+            "analytic_tokens_per_s": prefill_analytic,
+            "measured_tokens_per_s": prefill_measured,
+            "error_frac": base["prefill"]["error_frac"],
+        },
+        "decode": decode,
+    }
 
 
 @dataclasses.dataclass(frozen=True)

@@ -189,6 +189,10 @@ class KvHandoff:
     def user_id(self) -> int:
         return self.request.user_id
 
+    @property
+    def model(self) -> str:
+        return self.request.model
+
 
 def calibrated_sim_config(cal: dict, dtype: str = "bf16",
                           max_slots: int = 8,

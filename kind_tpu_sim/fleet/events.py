@@ -50,10 +50,11 @@ LANE_HEALTH_PROBE = 3
 LANE_AUTOSCALER = 4
 LANE_PLANNER = 5
 LANE_KV_TRANSFER = 6
+LANE_MODEL_SWAP = 7
 
 LANES = (LANE_ARRIVAL, LANE_COMPLETION, LANE_CHAOS,
          LANE_HEALTH_PROBE, LANE_AUTOSCALER, LANE_PLANNER,
-         LANE_KV_TRANSFER)
+         LANE_KV_TRANSFER, LANE_MODEL_SWAP)
 
 
 def resolve_event_core(value: Optional[bool] = None) -> bool:

@@ -87,16 +87,22 @@ class TraceRequest:
     # user rank inside it; empty/-1 on every untenanted trace
     tenant: str = ""
     user_id: int = -1
+    # model zoo (docs/ZOO.md): the named model this request targets;
+    # empty on every unzooed trace
+    model: str = ""
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["prompt"] = list(self.prompt)
-        # default-valued tenancy fields stay OFF the wire so every
-        # untenanted trace file and replay stays byte-identical
+        # default-valued tenancy/zoo fields stay OFF the wire so
+        # every untenanted, unzooed trace file and replay stays
+        # byte-identical
         if not self.tenant:
             d.pop("tenant")
         if self.user_id < 0:
             d.pop("user_id")
+        if not self.model:
+            d.pop("model")
         return d
 
     @classmethod
@@ -135,6 +141,12 @@ class WorkloadSpec:
     # generation delegates to tenancy.generate_tenant_trace — the
     # heavy-tailed user model; None keeps the anonymous streams
     tenancy: Optional["TenancyConfig"] = None
+    # model zoo (docs/ZOO.md): when set, every generated request is
+    # stamped with a model name drawn from the zoo's per-tenant
+    # mixes on a FRESH crc32 stream — the base trace (arrivals,
+    # prompts, seeds) comes off the unchanged spec stream, so every
+    # zoo-off trace and replay stays byte-identical
+    zoo: Optional[object] = None
 
     PROCESSES = ("poisson", "bursty", "diurnal")
 
@@ -204,7 +216,8 @@ def generate_trace(spec: WorkloadSpec,
         # breaks the loadgen <-> tenancy cycle
         from kind_tpu_sim.fleet.tenancy import generate_tenant_trace
 
-        return generate_tenant_trace(spec, seed)
+        return _stamp_zoo(spec, generate_tenant_trace(spec, seed),
+                          seed)
     rng = _spec_rng(spec, seed)
     # thinning envelope: each process's peak instantaneous rate
     if spec.process == "bursty":
@@ -247,7 +260,20 @@ def generate_trace(spec: WorkloadSpec,
             deadline_s=spec.deadline_s,
         ))
         i += 1
-    return out
+    return _stamp_zoo(spec, out, seed)
+
+
+def _stamp_zoo(spec: WorkloadSpec, trace: List[TraceRequest],
+               seed: int) -> List[TraceRequest]:
+    """Stamp a model on every request when the spec declares a zoo
+    (docs/ZOO.md). The draws come off a fresh crc32 sub-stream keyed
+    by the zoo's mix signature — the base trace's rng stream is
+    never touched, so zoo-off traces stay byte-identical."""
+    if spec.zoo is None:
+        return trace
+    from kind_tpu_sim.fleet.zoo import stamp_models
+
+    return stamp_models(spec.zoo, trace, seed)
 
 
 def save_trace(path: str, trace: Sequence[TraceRequest]) -> None:

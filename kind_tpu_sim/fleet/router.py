@@ -67,6 +67,32 @@ class SimReplicaConfig:
     tpot_s: float = 0.005
     max_queue: int = 64          # submit() refuses beyond this
     prefix_cache_entries: int = 8  # prefix groups remembered (0=off)
+    # model zoo (docs/ZOO.md): per-model pricing overrides as sorted
+    # (name, value) pairs — empty on every unzooed replica, which
+    # keeps the plain paths (and their floats) byte-identical. A
+    # model absent from the maps cannot be served here (it does not
+    # fit this generation's HBM). ``model_swap_s`` is the modeled
+    # weight-load time a cold admission pays; ``resident_model`` is
+    # the model warm at bring-up.
+    model_prefill_per_tok_s: tuple = ()
+    model_tpot_s: tuple = ()
+    model_swap_s: tuple = ()
+    resident_model: str = ""
+
+    def as_dict(self) -> dict:
+        """The config-report form: zoo fields join only when set, so
+        every unzooed report keeps its historical bytes (the same
+        conditional-wire-format contract as TraceRequest)."""
+        out = dataclasses.asdict(self)
+        if not self.model_tpot_s:
+            for key in ("model_prefill_per_tok_s", "model_tpot_s",
+                        "model_swap_s", "resident_model"):
+                del out[key]
+        else:
+            for key in ("model_prefill_per_tok_s", "model_tpot_s",
+                        "model_swap_s"):
+                out[key] = [list(pair) for pair in out[key]]
+        return out
 
 
 class SimReplica:
@@ -115,6 +141,20 @@ class SimReplica:
         self.tenant_prefix_caps: Optional[Dict[str, int]] = None
         self.prefix_hits = 0
         self.prefix_misses = 0
+        # model zoo (docs/ZOO.md): which model's weights are resident
+        # (the warm pool), the per-model pricing views, and the swap
+        # ledger. All empty/zero on an unzooed replica.
+        self.resident_model = cfg.resident_model
+        self._model_prefill = dict(cfg.model_prefill_per_tok_s)
+        self._model_tpot = dict(cfg.model_tpot_s)
+        self._model_swap = dict(cfg.model_swap_s)
+        self.swaps = 0
+        self.warm_hits = 0
+        # fleet-driver hook: called (SwapEvent) when an admission
+        # triggers a weight load — the driver schedules it on
+        # LANE_MODEL_SWAP (bookkeeping-only; the latency is already
+        # in the slot's closed-form timeline)
+        self.on_swap = None
         # columnar mirror back-pointer (fleet/columnar.py): every
         # mutating method marks its row dirty so the fleet's arrays
         # refresh lazily; None outside a columnar fleet
@@ -175,6 +215,43 @@ class SimReplica:
             self._prefix_seen.pop(evicted)
             self._prefix_owner.pop(evicted, None)
 
+    # -- model zoo (docs/ZOO.md) -------------------------------------
+
+    def can_serve(self, model: str) -> bool:
+        """Zoo placement constraint: a named model must appear in
+        this replica's pricing maps — absence means it does not fit
+        the replica's generation HBM. The empty model (every unzooed
+        request) and an unzooed replica (empty maps) serve
+        anywhere."""
+        return (not model or not self._model_tpot
+                or model in self._model_tpot)
+
+    def _swap_in(self, model: str, now: float) -> float:
+        """Charge the weight load when an admitted model differs
+        from the resident one. Residency flips AT admission (the
+        load starts immediately; same-model admissions behind it are
+        warm), and the driver hears about it through ``on_swap`` so
+        the swap lands on LANE_MODEL_SWAP — bookkeeping only, the
+        returned seconds are already folded into the slot's
+        closed-form timeline."""
+        if not model or not self._model_tpot:
+            return 0.0
+        if model == self.resident_model:
+            self.warm_hits += 1
+            return 0.0
+        cost = self._model_swap.get(model, 0.0) * self.slowdown
+        evicted = self.resident_model
+        self.resident_model = model
+        self.swaps += 1
+        self._touch()
+        if self.on_swap is not None:
+            from kind_tpu_sim.fleet.zoo import SwapEvent
+
+            self.on_swap(SwapEvent(
+                replica_id=self.replica_id, model=model,
+                evicted=evicted, ready_s=round(now + cost, 9)))
+        return cost
+
     # -- replica interface -------------------------------------------
 
     def outstanding(self) -> int:
@@ -186,6 +263,8 @@ class SimReplica:
 
     def submit(self, req: TraceRequest, now: float) -> bool:
         if not self.healthy:
+            return False
+        if not self.can_serve(getattr(req, "model", "")):
             return False
         if (self.cfg.max_queue
                 and len(self.queue) >= self.cfg.max_queue):
@@ -233,8 +312,12 @@ class SimReplica:
                     evicted = next(iter(self._prefix_seen))
                     self._prefix_seen.pop(evicted)
                     self._prefix_owner.pop(evicted, None)
+        # per-model prefill rate (docs/ZOO.md); the .get default IS
+        # the config float, so unzooed replicas keep identical math
+        per_tok = self._model_prefill.get(
+            req.model, self.cfg.prefill_per_tok_s)
         return (self.cfg.prefill_base_s
-                + self.cfg.prefill_per_tok_s * toks) * self.slowdown
+                + per_tok * toks) * self.slowdown
 
     @staticmethod
     def _group_prefix_len(req: TraceRequest) -> int:
@@ -272,10 +355,12 @@ class SimReplica:
                     if ge is None or d < ge:
                         ge = d
         cover = None
-        step = self.cfg.tpot_s * self.slowdown
         for slot in self._slots:
             if slot is None:
                 continue
+            # per-slot decode step: a zoo slot carries its model's
+            # TPOT; the .get default keeps unzooed floats identical
+            step = slot.get("tpot_s", self.cfg.tpot_s) * self.slowdown
             req = slot["req"]
             if slot["first_s"] is None:
                 # prefill event, then >= max(max_new - 1, 1) decodes
@@ -336,30 +421,47 @@ class SimReplica:
                         # handoff's token count with the next decode
                         # step scheduled from this boundary; the
                         # dispatch/first-token stamps survive the
-                        # transfer (TTFT belongs to the request)
-                        self._slots[i] = {
+                        # transfer (TTFT belongs to the request). A
+                        # zoo handoff whose model is cold here pays
+                        # the weight load before its first step.
+                        model = req.request.model
+                        swap = self._swap_in(model, now)
+                        step = (self._model_tpot.get(
+                            model, self.cfg.tpot_s) * self.slowdown)
+                        slot = {
                             "req": req.request,
                             "dispatch_s": req.dispatch_s,
-                            "next_s": now + (self.cfg.tpot_s
-                                             * self.slowdown),
+                            "next_s": now + swap + step,
                             "first_s": req.first_s,
                             "tokens": req.tokens,
                         }
+                        if model and model in self._model_tpot:
+                            slot["tpot_s"] = self._model_tpot[model]
+                        self._slots[i] = slot
                         continue
-                    self._slots[i] = {
+                    model = req.model
+                    # a cold model's swap precedes its prefill: both
+                    # land in the slot's closed-form timeline (zero
+                    # on every warm hit and every unzooed run)
+                    swap = self._swap_in(model, now)
+                    slot = {
                         "req": req,
                         "dispatch_s": now,
                         # absolute time of the slot's next event:
                         # first token at prefill end, then one event
                         # per decoded token
-                        "next_s": now + self._prefill_cost(req),
+                        "next_s": now + swap + self._prefill_cost(req),
                         "first_s": None,
                         "tokens": 0,
                     }
+                    if model and model in self._model_tpot:
+                        slot["tpot_s"] = self._model_tpot[model]
+                    self._slots[i] = slot
         end = now + dt
         for i, slot in enumerate(self._slots):
             if slot is None or slot["next_s"] > end:
                 continue
+            tpot = slot.get("tpot_s", self.cfg.tpot_s)
             req = slot["req"]
             deadline = (req.arrival_s + req.deadline_s
                         if req.deadline_s is not None else None)
@@ -389,7 +491,7 @@ class SimReplica:
                 # schedule the next token at the CURRENT slowdown;
                 # an overshooting deadline fires the moment it is
                 # provable, stamped at the deadline itself
-                nxt = t + self.cfg.tpot_s * self.slowdown
+                nxt = t + tpot * self.slowdown
                 if deadline is not None and nxt > deadline:
                     done.append(self._complete(
                         slot, finish_s=deadline,
@@ -429,6 +531,9 @@ class SimReplica:
         self._slots = [None] * self.cfg.max_slots
         self._prefix_seen.clear()
         self._prefix_owner.clear()
+        # the warm pool dies with the replica: restore() brings it
+        # back with its configured bring-up model resident
+        self.resident_model = self.cfg.resident_model
         self.healthy = False
         self._touch()
         return displaced
@@ -450,6 +555,10 @@ class SimReplica:
         if self.prefix_hits or self.prefix_misses:
             out["prefix"] = {"hits": self.prefix_hits,
                              "misses": self.prefix_misses}
+        if self._model_tpot:
+            out["zoo"] = {"resident": self.resident_model,
+                          "swaps": self.swaps,
+                          "warm_hits": self.warm_hits}
         return out
 
 
@@ -596,7 +705,7 @@ class Router:
     def __init__(self, replicas: Sequence, policy: str = "round-robin",
                  max_queue: int = 0, affinity_spill: int = 8,
                  health=None, overload=None, disagg: bool = False,
-                 tenancy=None):
+                 tenancy=None, zoo: bool = False):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r}; known: "
@@ -622,6 +731,14 @@ class Router:
         # lane defers handoffs whose tenant is over its decode-pool
         # budget instead of head-blocking everyone behind them
         self.tenancy = tenancy
+        # model zoo (docs/ZOO.md): model-named requests route
+        # warm-first (replicas whose resident model matches, then by
+        # load), a replica that cannot hold the model leaves the
+        # candidate set, and a model NO replica can hold sheds loudly
+        # instead of head-blocking the queue forever
+        self.zoo = zoo
+        self.warm_routes = 0
+        self.cold_routes = 0
         self._drr_deficit: Dict[str, float] = {}
         self._drr_pos: Dict[int, int] = {}
         self.drr_rounds = 0
@@ -717,6 +834,21 @@ class Router:
         healthy = self._healthy(now, pool)
         if not healthy:
             return []
+        model = getattr(req, "model", "") if self.zoo else ""
+        if model:
+            # model-aware routing (docs/ZOO.md): replicas that can
+            # hold the model, warm-resident first, then by load — a
+            # warm hit skips the weight-load entirely, so locality
+            # outranks every balancing policy for named models
+            serving = [r for r in healthy
+                       if getattr(r, "can_serve",
+                                  lambda m: True)(model)]
+            return sorted(
+                serving,
+                key=lambda r: (
+                    0 if getattr(r, "resident_model", "") == model
+                    else 1,
+                    self._load_key(r), r.replica_id))
         if is_handoff:
             # handoff placement is least-outstanding within the
             # decode pool under every policy: the prefix cohort's
@@ -768,7 +900,7 @@ class Router:
         back to it (refusal mutates nothing, so re-offering to the
         same first candidate is a no-op)."""
         cols = self._columns
-        if (cols is None or self.disagg
+        if (cols is None or self.disagg or self.zoo
                 or self.health is not None
                 or self.overload is not None):
             return None
@@ -861,6 +993,20 @@ class Router:
                     finish_s=round(req.arrival_s + req.deadline_s, 9),
                     tokens=0, tokens_crc=0,
                     finish_reason="deadline_exceeded"))
+            elif (self.zoo and req.model
+                  and not self._servable(req.model)):
+                # no replica in the fleet can EVER hold this model
+                # (it fits no present generation): shed loudly now
+                # rather than head-block FCFS until the heat death
+                # of the trace
+                self.shed += 1
+                metrics.fleet_board().incr("requests_shed")
+                metrics.recovery_log().record(
+                    "fleet_shed", request=req.request_id)
+                out.append(ReplicaCompletion(
+                    request=req, dispatch_s=now, first_s=None,
+                    finish_s=now, tokens=0, tokens_crc=0,
+                    finish_reason="shed"))
             else:
                 still.append(req)
         self.queue = still
@@ -929,6 +1075,12 @@ class Router:
                 self._drr_pos[rank] = (pos + 1) % len(names)
             if progress:
                 self.drr_rounds += 1
+
+    def _servable(self, model: str) -> bool:
+        """Can ANY replica (healthy or not — an outage is not
+        unservability) ever hold this model's weights?"""
+        return any(getattr(r, "can_serve", lambda m: True)(model)
+                   for r in self.replicas)
 
     def _place_handoff(self, h, now: float) -> bool:
         """Submit one KV handoff into the decode pool; bookkeeping on
@@ -1011,6 +1163,12 @@ class Router:
         self.per_replica[replica.replica_id] = (
             self.per_replica.get(replica.replica_id, 0) + 1)
         metrics.fleet_board().incr("requests_routed")
+        if self.zoo and req.model:
+            if (getattr(replica, "resident_model", "")
+                    == req.model):
+                self.warm_routes += 1
+            else:
+                self.cold_routes += 1
         if self.policy == "round-robin":
             self._rr += 1
         if self.overload is not None:
@@ -1044,4 +1202,7 @@ class Router:
                          "queued": len(self.kv_queue)}
             if self.kv_deferred:
                 out["kv"]["deferred"] = self.kv_deferred
+        if self.zoo:
+            out["zoo"] = {"warm_routes": self.warm_routes,
+                          "cold_routes": self.cold_routes}
         return out

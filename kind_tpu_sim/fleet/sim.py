@@ -59,6 +59,7 @@ from kind_tpu_sim.fleet.events import (
     LANE_CHAOS,
     LANE_COMPLETION,
     LANE_KV_TRANSFER,
+    LANE_MODEL_SWAP,
     DueSet,
     EventHeap,
     resolve_event_core,
@@ -253,6 +254,27 @@ class FleetConfig:
     # None keeps the anonymous fleet and every historical replay
     # byte-identical.
     tenancy: Optional[TenancyConfig] = None
+    # model zoo (docs/ZOO.md): a ZooConfig turns on multi-model
+    # serving — per-replica warm-pool state, modeled weight-load
+    # swap cost on the LANE_MODEL_SWAP event lane, model-aware
+    # warm-first routing, and the per-model SLO board. None keeps
+    # the single-model fleet and every historical replay
+    # byte-identical.
+    zoo: Optional[object] = None
+    # heterogeneous generations (docs/ZOO.md): the accelerator
+    # generation names (fleet/calibration/<gen>.json) replicas price
+    # against, cycled over replica ids — ("v5e", "v5p") alternates.
+    # A scheduler-backed fleet instead derives its single generation
+    # from FleetSchedConfig.replica_accelerator. None keeps the
+    # hand-tuned SimReplicaConfig defaults and every historical
+    # replay byte-identical.
+    generations: Optional[tuple] = None
+    # model-placement lever (docs/ZOO.md, docs/TUNE.md): force which
+    # generation's warm set carries the zoo's largest model (the
+    # tune `large_model_gen` dimension). None keeps the default
+    # largest-fitting-model placement. Ignored unless zoo is set;
+    # inert when the named generation is not in the cycle.
+    zoo_large_model_gen: Optional[str] = None
     # idle-gap fast-forward (None -> resolve_fast_forward()). An
     # execution strategy, not workload config: reports are
     # byte-identical either way, so it deliberately stays OUT of
@@ -284,7 +306,7 @@ class FleetConfig:
             "slo": {k: v for k, v in
                     dataclasses.asdict(self.slo).items()
                     if v is not None},
-            "sim": dataclasses.asdict(self.sim),
+            "sim": self.sim.as_dict(),
         }
         if self.eval_every_s is not None:
             out["eval_every_s"] = self.eval_every_s
@@ -302,6 +324,12 @@ class FleetConfig:
             out["disagg"] = self.disagg.as_dict()
         if self.tenancy is not None:
             out["tenancy"] = self.tenancy.as_dict()
+        if self.zoo is not None:
+            out["zoo"] = self.zoo.as_dict()
+        if self.generations is not None:
+            out["generations"] = list(self.generations)
+        if self.zoo_large_model_gen is not None:
+            out["zoo_large_model_gen"] = self.zoo_large_model_gen
         return out
 
 
@@ -366,8 +394,64 @@ class FleetSim:
                     max_queue=cfg.sim.max_queue,
                     prefix_cache_entries=cfg.sim
                     .prefix_cache_entries)
+        # model zoo / per-generation pricing (docs/ZOO.md): a
+        # ZooConfig and/or a generations tuple makes every replica a
+        # calibrated SimReplica priced off its generation's
+        # fleet/calibration/<gen>.json; a scheduler-backed fleet
+        # derives its (single) generation from the accelerator label
+        # its gangs request — FleetSchedConfig.replica_accelerator,
+        # finally consumed end to end. Both default to None, keeping
+        # every historical replay byte-identical.
+        self._zoo = cfg.zoo
+        self._generations: Optional[List[str]] = None
+        self._gen_cals: Dict[str, dict] = {}
+        self._gen_residents: Dict[str, str] = {}
+        self._swap_heap = EventHeap()
+        self._swap_log: List[dict] = []
+        self._model_trackers: Dict[str, SloTracker] = {}
+        if self._zoo is not None or cfg.generations is not None:
+            from kind_tpu_sim.fleet import zoo as zoo_mod
+            from kind_tpu_sim.fleet.costmodel import (
+                generation_of_accelerator,
+                load_generation,
+            )
+
+            if replica_factory is not None:
+                raise ValueError(
+                    "a zoo/generation fleet builds its own "
+                    "calibrated replicas; replica_factory is not "
+                    "supported")
+            if self._disagg is not None:
+                raise ValueError(
+                    "FleetConfig.zoo/generations do not compose "
+                    "with disagg phase pools yet (phase pools price "
+                    "off the r05 anchor)")
+            if cfg.sched is not None:
+                gens = (generation_of_accelerator(
+                    cfg.sched.replica_accelerator),)
+            elif cfg.generations:
+                gens = tuple(cfg.generations)
+            else:
+                gens = (zoo_mod.resolve_generation(),)
+            self._gen_cycle = gens
+            self._generations = [gens[i % len(gens)]
+                                 for i in range(cfg.replicas)]
+            self._gen_cals = {g: load_generation(g)
+                              for g in sorted(set(gens))}
+            if self._zoo is not None:
+                uniq = sorted(set(gens))
+                self._gen_residents = dict(zip(
+                    uniq, zoo_mod.placements(
+                        self._zoo, uniq,
+                        large_model_gen=cfg.zoo_large_model_gen)))
+        # NOTE: replica_factory stays None for zoo/generation fleets
+        # so the columnar-eligibility check below still sees an
+        # all-analytic fleet — calibrated replicas are plain
+        # SimReplicas with closed-form next_due.
         self.factory = replica_factory or (
             lambda rid: SimReplica(rid, cfg.sim))
+        if self._generations is not None:
+            self.factory = self._make_gen_replica
         if self._disagg is not None:
             p = self._disagg.prefill_replicas
             self.replicas = [
@@ -391,7 +475,8 @@ class FleetSim:
                              health=self.health,
                              overload=self.overload,
                              disagg=self._disagg is not None,
-                             tenancy=self.tenancy)
+                             tenancy=self.tenancy,
+                             zoo=self._zoo is not None)
         for replica in self.replicas:
             self._install_tenant_caps(replica)
         if self.overload is not None:
@@ -526,6 +611,49 @@ class FleetSim:
                     "fleet (set FleetConfig.sched): training gangs "
                     "are scheduler-placed workloads")
             self.trainer = TrainingTenant(cfg.training, self.sched)
+
+    # -- model zoo / per-generation pricing (docs/ZOO.md) -------------
+
+    def _gen_of(self, rid: int) -> str:
+        """The generation a replica id prices against — the declared
+        cycle, so scale-up replicas join it deterministically."""
+        return self._gen_cycle[rid % len(self._gen_cycle)]
+
+    def _make_gen_replica(self, rid: int) -> SimReplica:
+        """A replica priced off its generation's calibration; on zoo
+        fleets it also carries the per-model pricing maps, warms its
+        generation's placement, and reports swaps into the
+        LANE_MODEL_SWAP ledger."""
+        from kind_tpu_sim.fleet import zoo as zoo_mod
+
+        gen = self._gen_of(rid)
+        cal = self._gen_cals[gen]
+        sim = self.cfg.sim
+        if self._zoo is not None:
+            rcfg = zoo_mod.model_sim_config(
+                self._zoo, cal,
+                max_slots=sim.max_slots,
+                max_queue=sim.max_queue,
+                prefix_cache_entries=sim.prefix_cache_entries,
+                resident_model=self._gen_residents[gen])
+        else:
+            rcfg = calibrated_sim_config(
+                cal,
+                max_slots=sim.max_slots,
+                max_queue=sim.max_queue,
+                prefix_cache_entries=sim.prefix_cache_entries)
+        replica = SimReplica(rid, rcfg)
+        if self._zoo is not None:
+            replica.on_swap = self._on_swap
+        return replica
+
+    def _on_swap(self, ev) -> None:
+        """A replica started loading new model weights: the latency
+        already rides the admitted slot's closed-form timeline, so
+        the LANE_MODEL_SWAP event is pure bookkeeping — drained into
+        the swap ledger in deterministic (ready, lane, seq) order."""
+        self._swap_heap.push(ev.ready_s, LANE_MODEL_SWAP, ev)
+        metrics.zoo_board().incr("model_swaps")
 
     # -- scheduler-backed placement (docs/SCHED.md) -------------------
 
@@ -1214,7 +1342,22 @@ class FleetSim:
             # conditional, like the TraceRequest wire format: every
             # untenanted completion log stays byte-identical
             entry["tenant"] = req.tenant
+        if getattr(req, "model", ""):
+            # same contract: unzooed completion logs stay
+            # byte-identical
+            entry["model"] = req.model
         self.log.append(entry)
+        if self._zoo is not None and getattr(req, "model", ""):
+            mtracker = self._model_trackers.get(req.model)
+            if mtracker is None:
+                mtracker = SloTracker(self.cfg.slo)
+                self._model_trackers[req.model] = mtracker
+            mtracker.observe(
+                arrival_s=req.arrival_s, first_s=comp.first_s,
+                finish_s=comp.finish_s, tokens=comp.tokens,
+                shed=comp.finish_reason == "shed",
+                deadline_exceeded=comp.finish_reason
+                == "deadline_exceeded")
         if self.tenancy is not None:
             name = tenant_of(req)
             tracker = self._tenant_trackers.get(name)
@@ -1286,6 +1429,24 @@ class FleetSim:
                         f"{ev.action} chaos needs a disaggregated "
                         "fleet (FleetConfig.disagg)")
                 self._apply_disagg_chaos(ev, now)
+                continue
+            if ev.action == "model_swap_evict":
+                # one storm pulse (docs/ZOO.md model_swap_storm):
+                # every replica's resident model is dropped, so the
+                # next model-stamped request it serves pays the full
+                # weight-load — the swap lane's worst case
+                if self._zoo is None:
+                    raise ValueError(
+                        f"{ev.action} chaos needs a model zoo "
+                        "(FleetConfig.zoo)")
+                evicted = 0
+                for r in self.replicas:
+                    if getattr(r, "resident_model", ""):
+                        r.resident_model = ""
+                        evicted += 1
+                metrics.recovery_log().record(
+                    "fleet_model_swap_evict", evicted=evicted,
+                    at_s=round(now, 6))
                 continue
             if ev.action.startswith("node_"):
                 if self.sched is None:
@@ -1445,6 +1606,13 @@ class FleetSim:
                         continue
                 metrics.disagg_board().incr("kv_handoffs_delivered")
                 self.router.offer_handoff(handoff)
+        if self._zoo is not None:
+            # finished weight loads land in the swap ledger: pure
+            # bookkeeping — the load latency already rode the
+            # admitted slot's closed-form timeline at admission —
+            # drained in deterministic (ready, lane, seq) order
+            for ev in self._swap_heap.pop_due(now):
+                self._swap_log.append(ev.as_dict())
         if self.health is not None and (pending
                                         or self.router.queue):
             # probe only while user traffic still flows — an
@@ -1516,6 +1684,7 @@ class FleetSim:
         return bool(
             not pending and not self.router.queue
             and not self._kv_heap and not self.router.kv_queue
+            and not self._swap_heap
             and not self._warming
             and (self._cols.all_idle() if self._cols is not None
                  else all(r.idle() for r in self.replicas
@@ -1543,7 +1712,8 @@ class FleetSim:
             return False
         if (self.router.queue or self._warming or self._draining):
             return False
-        if self._kv_heap or self.router.kv_queue:
+        if (self._kv_heap or self.router.kv_queue
+                or self._swap_heap):
             return False
         # slowdown != 1 disqualifies even an idle replica: an
         # EngineReplica's stride counter advances per tick() call,
@@ -1595,6 +1765,9 @@ class FleetSim:
         # queued handoff needs every boundary until the decode pool
         # takes it
         due.at(self._kv_heap.peek_time())
+        # a finished model swap applies at its weight-load-ready
+        # instant (bookkeeping drain into the swap ledger)
+        due.at(self._swap_heap.peek_time())
         if self.trainer is not None:
             # gang arrivals and segment completions are boundary-
             # condition events; mid-segment progress is closed form
@@ -1735,6 +1908,7 @@ class FleetSim:
         health_before = metrics.health_board().counts()
         disagg_before = metrics.disagg_board().counts()
         tenant_before = metrics.tenant_board().counts()
+        zoo_before = metrics.zoo_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
         pending = self._pending
         while True:
@@ -1786,6 +1960,32 @@ class FleetSim:
                 metrics.tenant_board().snapshot_since(
                     tenant_before))
             report["tenancy"] = ten_report
+        if self._generations is not None:
+            # per-replica generation labels (the pricing each
+            # replica ran under) — conditional: generation-less
+            # fleets keep their historical report bytes
+            report["generations"] = {
+                str(r.replica_id): self._gen_of(r.replica_id)
+                for r in sorted(self.replicas + self._draining,
+                                key=lambda r: r.replica_id)}
+        if self._zoo is not None:
+            report["zoo"] = {
+                "per_model_slo": {
+                    name: tracker.report(span_s=self.clock.now())
+                    for name, tracker in
+                    sorted(self._model_trackers.items())},
+                "residents": {
+                    str(r.replica_id): getattr(
+                        r, "resident_model", "")
+                    for r in sorted(self.replicas + self._draining,
+                                    key=lambda r: r.replica_id)},
+                "swaps": {
+                    "completed": len(self._swap_log),
+                    "log": self._swap_log,
+                },
+                "counters": metrics.zoo_board().snapshot_since(
+                    zoo_before),
+            }
         if self.preemptions:
             report["preemptions"] = self.preemptions
         if self.health is not None:

@@ -1,0 +1,371 @@
+"""The model-zoo serving tenant (docs/ZOO.md).
+
+One fleet, many models: each replica holds exactly ONE model's
+weights resident in HBM (the warm pool), every request names the
+model it targets, and routing a request to a replica whose resident
+model differs costs a modeled weight-load — the **model swap** — the
+calibration's HBM bandwidth prices (weights stream from host DRAM /
+remote storage at a documented fraction of the HBM load rate; the
+``KIND_TPU_SIM_ZOO_SWAP_FACTOR`` knob scales it).
+
+Three pieces live here:
+
+* :class:`ModelSpec` / :class:`ZooConfig` — the declared model set
+  (distinct weight/KV footprints as multipliers over the calibration
+  anchor's geometry) and the per-tenant request mixes that drive
+  which model each generated request targets.
+* :func:`stamp_models` — the loadgen hook: stamps a model name on
+  every trace request by drawing from the mix on a FRESH crc32
+  sub-stream (``zoo:<sig>:<seed>``), so the base trace's RNG stream
+  is untouched and every zoo-off trace stays byte-identical.
+* The per-(model, generation) pricing surface —
+  :func:`model_sim_config` (a ``SimReplicaConfig`` whose per-model
+  overrides carry each model's prefill/TPOT/swap time on one
+  generation's calibration), :func:`swap_s` (the weight-load time),
+  and :func:`fits` (does this model's working set fit the
+  generation's HBM at all — the constraint that makes "which model
+  on which generation" a real placement question for ``tune``).
+
+Everything is pure float arithmetic over (config, calibration) — no
+clocks, no entropy outside the seeded stamp stream — so zoo runs
+keep the byte-identical-replay contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kind_tpu_sim.analysis import knobs
+from kind_tpu_sim.fleet.costmodel import (
+    DEFAULT_GENERATION,
+    GENERATION_FACTS,
+    load_generation,
+)
+
+ZOO_SWAP_FACTOR_ENV = knobs.ZOO_SWAP_FACTOR
+ZOO_MODELS_ENV = knobs.ZOO_MODELS
+GENERATION_ENV = knobs.GENERATION
+
+# Fraction of achieved HBM bandwidth the weight load streams at:
+# checkpoint bytes arrive over PCIe/DCN and reshard on the way in, so
+# a swap runs well below the on-chip read rate. One documented
+# constant (not a knob): the RATIO is a modeling assumption, the
+# overall scale is the ZOO_SWAP_FACTOR knob.
+SWAP_LOAD_FRACTION = 0.125
+
+
+def resolve_generation(value: Optional[str] = None) -> str:
+    """Explicit value > env (KIND_TPU_SIM_GENERATION) > v5e."""
+    from kind_tpu_sim.fleet.costmodel import GENERATIONS
+
+    gen = value if value is not None else knobs.get(GENERATION_ENV)
+    if gen not in GENERATIONS:
+        raise ValueError(
+            f"unknown generation {gen!r}; registered: "
+            f"{', '.join(GENERATIONS)}")
+    return gen
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """One zoo member. ``weight_mb`` is the resident footprint the
+    swap lane ships and the HBM-fit check charges;
+    ``compute_scale`` / ``kv_scale`` multiply the calibration
+    anchor's prefill time and per-token KV bytes (a bigger model
+    prefills slower and drags more KV per decode step)."""
+
+    name: str
+    weight_mb: float
+    compute_scale: float = 1.0
+    kv_scale: float = 1.0
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("zoo model needs a name")
+        if self.weight_mb <= 0:
+            raise ValueError(
+                f"model {self.name!r} weight_mb must be > 0 "
+                f"(got {self.weight_mb})")
+        if self.compute_scale <= 0 or self.kv_scale <= 0:
+            raise ValueError(
+                f"model {self.name!r} scales must be > 0")
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "weight_mb": self.weight_mb,
+            "compute_scale": self.compute_scale,
+            "kv_scale": self.kv_scale,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ZooConfig:
+    """The declared model set plus the request mixes.
+
+    ``mix`` is the default (model name -> weight) distribution every
+    request draws from; ``tenant_mixes`` overrides it per tenant
+    (the "per-tenant model mixes" the issue names — a batch tenant
+    hammering the large model while interactive traffic rides the
+    small one). Weights need not sum to 1; they normalize at draw
+    time."""
+
+    models: Tuple[ModelSpec, ...]
+    mix: Tuple[Tuple[str, float], ...] = ()
+    tenant_mixes: Tuple[Tuple[str, Tuple[Tuple[str, float], ...]],
+                        ...] = ()
+
+    def __post_init__(self):
+        if not self.models:
+            raise ValueError("zoo needs at least one model")
+        names = [m.name for m in self.models]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate zoo model names: {names}")
+        known = set(names)
+        for name, _ in self.mix:
+            if name not in known:
+                raise ValueError(
+                    f"mix references unknown model {name!r}")
+        for tenant, mix in self.tenant_mixes:
+            for name, _ in mix:
+                if name not in known:
+                    raise ValueError(
+                        f"tenant {tenant!r} mix references unknown "
+                        f"model {name!r}")
+
+    def model(self, name: str) -> ModelSpec:
+        for m in self.models:
+            if m.name == name:
+                return m
+        raise ValueError(
+            f"unknown zoo model {name!r}; known: "
+            f"{', '.join(m.name for m in self.models)}")
+
+    def names(self) -> List[str]:
+        return [m.name for m in self.models]
+
+    def mix_for(self, tenant: str) -> Tuple[Tuple[str, float], ...]:
+        """The (model, weight) mix one tenant's requests draw from:
+        its declared override, else the default mix, else uniform."""
+        for name, mix in self.tenant_mixes:
+            if name == tenant:
+                return mix
+        if self.mix:
+            return self.mix
+        return tuple((m.name, 1.0) for m in self.models)
+
+    def signature(self) -> tuple:
+        """The traffic-shaping identity the stamp stream is keyed by
+        (the ``TenancyConfig.signature()`` precedent): model names
+        and mixes only — pricing scales don't change which model a
+        request targets."""
+        return (tuple(m.name for m in self.models), self.mix,
+                self.tenant_mixes)
+
+    def as_dict(self) -> dict:
+        out: Dict[str, object] = {
+            "models": [m.as_dict() for m in self.models],
+        }
+        if self.mix:
+            out["mix"] = {k: v for k, v in self.mix}
+        if self.tenant_mixes:
+            out["tenant_mixes"] = {
+                t: {k: v for k, v in mix}
+                for t, mix in self.tenant_mixes}
+        return out
+
+
+def zoo_config_from_dict(d: dict) -> ZooConfig:
+    """Rebuild a ZooConfig from its :meth:`ZooConfig.as_dict` shape
+    (the tune winner-spec round-trip: searches over zoo workloads
+    must replay standalone)."""
+    return ZooConfig(
+        models=tuple(ModelSpec(**m) for m in d["models"]),
+        mix=tuple((k, float(v))
+                  for k, v in dict(d.get("mix", {})).items()),
+        tenant_mixes=tuple(
+            (t, tuple((k, float(v)) for k, v in dict(mix).items()))
+            for t, mix in dict(d.get("tenant_mixes", {})).items()),
+    )
+
+
+def default_zoo(n_models: Optional[int] = None) -> ZooConfig:
+    """The checked-in three-model zoo the CLI/scenarios serve:
+    ``small`` is the calibration anchor itself (~839 MB — fits every
+    generation), ``medium`` is a ~16 GB model (does NOT fit v5e's
+    16 GiB HBM once KV headroom is charged), ``large`` is a ~60 GB
+    model (fits only v5p's 95 GiB) — the footprint ladder that makes
+    generation placement a constrained choice, not a preference."""
+    if n_models is None:
+        n_models = int(knobs.get(ZOO_MODELS_ENV))
+    members = (
+        ModelSpec("small", weight_mb=838.9),
+        ModelSpec("medium", weight_mb=16000.0, compute_scale=8.0,
+                  kv_scale=4.0),
+        ModelSpec("large", weight_mb=60000.0, compute_scale=24.0,
+                  kv_scale=8.0),
+    )
+    n = max(1, min(int(n_models), len(members)))
+    return ZooConfig(
+        models=members[:n],
+        # small models dominate request volume (the production shape:
+        # cheap models take the traffic, big models take the bytes)
+        mix=tuple((m.name, w) for m, w in
+                  zip(members[:n], (8.0, 3.0, 1.0))),
+    )
+
+
+def stamp_models(zoo: ZooConfig, trace, seed: int):
+    """Stamp a model on every request of a generated trace. Draws
+    come from ``random.Random(crc32("zoo:<sig>:<seed>"))`` — a fresh
+    sub-stream independent of the trace's own RNG — and requests are
+    visited in trace order, so the stamping is a pure function of
+    (zoo, trace length + tenants, seed) and the underlying trace is
+    returned untouched when the zoo serves a single model."""
+    sig = repr(("zoo", zoo.signature(), int(seed)))
+    rng = random.Random(zlib.crc32(sig.encode("utf-8")))
+    out = []
+    for req in trace:
+        mix = zoo.mix_for(req.tenant)
+        names = [name for name, _ in mix]
+        weights = [max(0.0, float(w)) for _, w in mix]
+        if len(names) == 1 or sum(weights) <= 0:
+            choice = names[0]
+        else:
+            choice = rng.choices(names, weights=weights, k=1)[0]
+        out.append(dataclasses.replace(req, model=choice))
+    return out
+
+
+# -- per-(model, generation) pricing ---------------------------------
+
+
+def swap_s(model: ModelSpec, cal: dict, dtype: str = "bf16",
+           factor: Optional[float] = None) -> float:
+    """Modeled weight-load time: the model's resident bytes over the
+    generation's achieved HBM bandwidth derated by
+    ``SWAP_LOAD_FRACTION`` (weights arrive over the host path, not
+    the on-chip read path), scaled by the ZOO_SWAP_FACTOR knob."""
+    if factor is None:
+        factor = float(knobs.get(ZOO_SWAP_FACTOR_ENV))
+    if factor <= 0:
+        return 0.0
+    gbps = float(cal["decode"][dtype]["achieved_gbps"])
+    load_bytes_per_s = gbps * 1e9 * SWAP_LOAD_FRACTION
+    return round(model.weight_mb * 1e6 / load_bytes_per_s * factor, 9)
+
+
+def fits(model: ModelSpec, cal: dict, dtype: str = "bf16",
+         kv_headroom_frac: float = 0.2) -> bool:
+    """Does this model's working set fit the generation's HBM?
+    Weights plus a KV headroom fraction of the device must fit —
+    a model that fills HBM wall-to-wall can't serve a single
+    request. Generation HBM comes from the calibration's metadata
+    (the anchor r05 file predates it; fall back to the registry)."""
+    hbm_gib = cal.get("hbm_gib")
+    if hbm_gib is None:
+        gen = cal.get("generation", DEFAULT_GENERATION)
+        hbm_gib = GENERATION_FACTS[gen]["hbm_gib"]
+    budget_bytes = float(hbm_gib) * (1 << 30) * (1 - kv_headroom_frac)
+    return model.weight_mb * 1e6 <= budget_bytes
+
+
+def model_sim_config(zoo: ZooConfig, cal: dict, dtype: str = "bf16",
+                     max_slots: int = 8, max_queue: int = 64,
+                     prefix_cache_entries: int = 8,
+                     resident_model: str = ""):
+    """A ``SimReplicaConfig`` for one replica of one generation
+    serving the zoo: the base rates are the generation calibration's
+    (the ``calibrated_sim_config`` recipe), and the per-model
+    override maps carry each FITTING model's prefill/TPOT scaled by
+    its footprint, plus its swap time. A model that does not fit the
+    generation is absent from the maps — the router treats absence
+    as "cannot serve here"."""
+    from kind_tpu_sim.fleet.disagg import calibrated_sim_config
+
+    base = calibrated_sim_config(
+        cal, dtype=dtype, max_slots=max_slots, max_queue=max_queue,
+        prefix_cache_entries=prefix_cache_entries)
+    d = cal["decode"][dtype]
+    slots = base.max_slots
+    kv_per_req = d["kv_mb"] * 1e6 / max(1, int(cal["slots"]))
+    gbps = d["achieved_gbps"] * 1e9
+    prefill: Dict[str, float] = {}
+    tpot: Dict[str, float] = {}
+    swaps: Dict[str, float] = {}
+    for m in zoo.models:
+        if not fits(m, cal, dtype=dtype):
+            continue
+        prefill[m.name] = round(
+            base.prefill_per_tok_s * m.compute_scale, 12)
+        step_bytes = (m.weight_mb * 1e6 / slots
+                      + kv_per_req * m.kv_scale)
+        tpot[m.name] = round(step_bytes / gbps, 9)
+        swaps[m.name] = swap_s(m, cal, dtype=dtype)
+    if resident_model and resident_model not in swaps:
+        raise ValueError(
+            f"resident model {resident_model!r} does not fit "
+            f"generation {cal.get('generation', '?')!r}")
+    return dataclasses.replace(
+        base,
+        model_prefill_per_tok_s=tuple(sorted(prefill.items())),
+        model_tpot_s=tuple(sorted(tpot.items())),
+        model_swap_s=tuple(sorted(swaps.items())),
+        resident_model=resident_model,
+    )
+
+
+def placements(zoo: ZooConfig, generations: Sequence[str],
+               large_model_gen: Optional[str] = None) -> List[str]:
+    """Resident-model assignment for a replica list whose i-th entry
+    serves ``generations[i]``: each replica warms the largest model
+    that fits its generation (big HBM takes the big model — the
+    placement ``tune`` searches over), with ``large_model_gen``
+    optionally forcing where the largest model lands. Every replica
+    gets SOME resident model (the smallest always fits)."""
+    cals = {g: load_generation(g) for g in sorted(set(generations))}
+    by_weight = sorted(zoo.models, key=lambda m: -m.weight_mb)
+    largest = by_weight[0]
+    out: List[str] = []
+    for gen in generations:
+        cal = cals[gen]
+        if (large_model_gen is not None and gen == large_model_gen
+                and fits(largest, cal)):
+            out.append(largest.name)
+            continue
+        for m in by_weight:
+            if (large_model_gen is not None
+                    and m.name == largest.name
+                    and gen != large_model_gen):
+                continue
+            if fits(m, cal):
+                out.append(m.name)
+                break
+        else:
+            out.append(by_weight[-1].name)
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapEvent:
+    """One model swap in flight on the LANE_MODEL_SWAP lane: replica
+    ``replica_id`` is loading ``model`` (evicting ``evicted``), done
+    at ``ready_s``. Bookkeeping-only payload — the swap's latency is
+    already folded into the admitted slot's closed-form timeline, so
+    draining this lane early or late never moves a float."""
+
+    replica_id: int
+    model: str
+    evicted: str
+    ready_s: float
+
+    def as_dict(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "model": self.model,
+            "evicted": self.evicted,
+            "ready_s": round(self.ready_s, 9),
+        }

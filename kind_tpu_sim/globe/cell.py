@@ -96,6 +96,25 @@ class Cell:
         return (self.alive and not self.draining
                 and self.routable_replicas() > 0)
 
+    # -- model zoo (docs/ZOO.md) --------------------------------------
+
+    def serves(self, model: str) -> bool:
+        """Whether any healthy replica here can run ``model`` at all
+        (it fits the replica's generation HBM). Vacuously true for
+        unzooed traffic."""
+        if not model:
+            return True
+        return any(
+            getattr(r, "can_serve", lambda m: True)(model)
+            for r in self.sim.replicas if r.healthy)
+
+    def models_warm(self) -> set:
+        """Models resident (weights loaded) on at least one healthy
+        replica — the front door's warm-cell spill signal."""
+        return {r.resident_model
+                for r in self.sim.replicas
+                if r.healthy and getattr(r, "resident_model", "")}
+
     # -- the globe driver's surface ----------------------------------
 
     def admit(self, req: TraceRequest, deliver_s: float) -> None:
@@ -260,4 +279,11 @@ class Cell:
                 self.sim.sched.report()["event_counts"]
         if self.sim.trainer is not None:
             out["training"] = self.sim.trainer.report()
+        if self.sim._zoo is not None:
+            out["zoo"] = {
+                "generation": (self.sim._gen_of(0)
+                               if self.sim._generations else None),
+                "warm": sorted(self.models_warm()),
+                "swaps": len(self.sim._swap_log),
+            }
         return out

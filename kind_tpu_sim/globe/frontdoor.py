@@ -139,11 +139,16 @@ class FrontDoor:
         if self.overload is not None:
             self.overload.breaker_record(cell_name, slo_ok, now)
 
-    def _candidates(self, origin: str,
-                    now: float = 0.0) -> List[Cell]:
+    def _candidates(self, origin: str, now: float = 0.0,
+                    model: str = "") -> List[Cell]:
         """Routable cells under their hard limit, best first:
-        unsaturated before saturated, then DCN-latency + load cost,
-        then name — a pure function of (origin, cell states). An
+        unsaturated before saturated, then (zoo traffic) cells with
+        the model WARM before cells that would cold-swap, then
+        DCN-latency + load cost, then name — a pure function of
+        (origin, cell states). A model-stamped request only
+        considers cells that can serve the model at all (it fits
+        some healthy replica's generation); with no model the cold
+        bit is constant and the order is the historical one. An
         OPEN per-cell breaker removes its cell from the set (shed
         fast) unless every breaker is open — degraded candidates
         beat a global black hole, the same never-empty rule the
@@ -152,18 +157,22 @@ class FrontDoor:
         for cell in self.cells:
             if not cell.routable():
                 continue
+            if model and not cell.serves(model):
+                continue
             load = cell.outstanding()
             if load >= self._hard_limit(cell):
                 continue  # the herd bound: never flood past headroom
             saturated = (load >= self._nominal(cell)
                          or self._slo_breaching(cell))
+            cold = (1 if model
+                    and model not in cell.models_warm() else 0)
             cost = (self.rtt_s(origin, cell.zone)
                     + self.cfg.load_weight_s
                     * load / max(1, cell.capacity()))
-            scored.append((1 if saturated else 0, cost, cell.name,
-                           cell))
-        scored.sort(key=lambda t: t[:3])
-        out = [t[3] for t in scored]
+            scored.append((1 if saturated else 0, cold, cost,
+                           cell.name, cell))
+        scored.sort(key=lambda t: t[:4])
+        out = [t[4] for t in scored]
         if self.overload is not None:
             allowed = [c for c in out
                        if self.overload.breaker_allows(c.name, now)]
@@ -183,17 +192,26 @@ class FrontDoor:
 
     def pick(self, req: TraceRequest, origin: str,
              now: float = 0.0) -> Optional[Cell]:
-        candidates = self._candidates(origin, now)
+        model = getattr(req, "model", "")
+        candidates = self._candidates(origin, now, model)
         if not candidates:
             return None
+        chosen = candidates[0]
         home = self._home(req)
         if home is not None and home in candidates:
             floor = min(c.outstanding() for c in candidates)
             if home.outstanding() - floor <= self.cfg.affinity_spill:
                 self.affinity_hits += 1
                 metrics.globe_board().incr("affinity_hits")
-                return home
-        return candidates[0]
+                chosen = home
+        if model:
+            # warm-cell spill accounting (docs/ZOO.md) — zoo traffic
+            # only, so unzooed boards keep their historical bytes
+            metrics.zoo_board().incr(
+                "warm_cell_picks"
+                if model in chosen.models_warm()
+                else "cold_cell_picks")
+        return chosen
 
     # -- admission ----------------------------------------------------
 

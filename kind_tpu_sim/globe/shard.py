@@ -433,7 +433,9 @@ class ShardedGlobeSim(GlobeSim):
         for field, label in ((cfg.overload, "overload"),
                              (cfg.planner, "planner"),
                              (cfg.training, "training"),
-                             (cfg.tenancy, "tenancy")):
+                             (cfg.tenancy, "tenancy"),
+                             (cfg.zoo, "zoo"),
+                             (cfg.generations, "generations")):
             if field is not None:
                 raise ValueError(
                     f"sharded GlobeSim does not support "

@@ -163,6 +163,16 @@ class GlobeConfig:
     # (cells inherit the tenancy minus quotas — weighted-fair
     # queuing and KV budgets, no double metering)
     tenancy: Optional[TenancyConfig] = None
+    # model zoo (docs/ZOO.md): a ZooConfig stamps every zone trace
+    # with model names (fresh crc32 stream — zoo-off traces keep
+    # their bytes) and turns on warm-cell spill at the front door
+    zoo: Optional[object] = None
+    # heterogeneous cells (docs/ZOO.md): accelerator generation
+    # names cycled over cells in name order — scheduler-backed
+    # cells request the generation's accelerator label (the
+    # FleetSchedConfig.replica_accelerator path), analytic cells
+    # price its calibration directly. None keeps historical bytes.
+    generations: Optional[Tuple[str, ...]] = None
     workload: GlobeWorkloadSpec = GlobeWorkloadSpec()
     # one-way DCN latency unit between adjacent zones; zone pairs
     # farther apart in the zone list cost proportionally more
@@ -205,7 +215,7 @@ class GlobeConfig:
             "policy": self.policy,
             "tick_s": resolve_tick_s(self.tick_s),
             "max_virtual_s": self.max_virtual_s,
-            "sim": dataclasses.asdict(self.sim),
+            "sim": self.sim.as_dict(),
             "slo": {k: v for k, v in
                     dataclasses.asdict(self.slo).items()
                     if v is not None},
@@ -230,6 +240,10 @@ class GlobeConfig:
             out["training"] = self.training.as_dict()
             out["training_cells"] = sorted(
                 self.resolve_training_cells())
+        if self.zoo is not None:
+            out["zoo"] = self.zoo.as_dict()
+        if self.generations is not None:
+            out["generations"] = list(self.generations)
         return out
 
 
@@ -268,7 +282,8 @@ def generate_globe_traces(
             deadline_s=w.deadline_s,
             diurnal_period_s=w.diurnal_period_s,
             phase_s=phase,
-            tenancy=cfg.tenancy)
+            tenancy=cfg.tenancy,
+            zoo=cfg.zoo)
         out[zone] = [
             dataclasses.replace(r,
                                 request_id=f"{zone}/{r.request_id}")
@@ -304,11 +319,37 @@ def load_globe_trace(path: str) -> Dict[str, List[TraceRequest]]:
 
 
 def fleet_config_for(cfg: GlobeConfig, zone: str,
-                     training: bool = False) -> FleetConfig:
+                     training: bool = False,
+                     generation: Optional[str] = None
+                     ) -> FleetConfig:
     """The embedded FleetConfig one cell of ``cfg`` runs in ``zone``.
     Module-level (not a GlobeSim method) so shard workers
     (globe/shard.py) build byte-identical cells from the wire copy
-    of the config without a parent driver object."""
+    of the config without a parent driver object. ``generation``
+    makes this cell's replicas price against that accelerator
+    generation (docs/ZOO.md): scheduler-backed cells request the
+    generation's accelerator label — the end-to-end
+    ``replica_accelerator`` path — analytic cells carry the
+    generation name directly."""
+    sched_cfg = None
+    if cfg.sched:
+        kw: Dict[str, object] = {"policy": cfg.sched_policy,
+                                 "zone": zone}
+        if cfg.cell_pods is not None:
+            kw["pods"] = cfg.cell_pods
+        if generation is not None:
+            from kind_tpu_sim.fleet.costmodel import (
+                GENERATION_ACCELERATORS,
+                GENERATION_SCHED_TOPOLOGY,
+            )
+
+            accel = GENERATION_ACCELERATORS[generation]
+            pod_topo, rep_topo = GENERATION_SCHED_TOPOLOGY[accel]
+            kw["replica_accelerator"] = accel
+            kw["replica_topology"] = rep_topo
+            if cfg.cell_pods is None:
+                kw["pods"] = ((accel, pod_topo),)
+        sched_cfg = FleetSchedConfig(**kw)
     return FleetConfig(
         training=(cfg.training if training else None),
         replicas=cfg.replicas_per_cell, policy=cfg.policy,
@@ -321,12 +362,13 @@ def fleet_config_for(cfg: GlobeConfig, zone: str,
         autoscale=cfg.autoscale,
         slo=cfg.slo, sim=cfg.sim,
         autoscaler=cfg.autoscaler,
-        sched=(FleetSchedConfig(policy=cfg.sched_policy,
-                                zone=zone,
-                                **({"pods": cfg.cell_pods}
-                                   if cfg.cell_pods is not None
-                                   else {}))
-               if cfg.sched else None),
+        zoo=cfg.zoo,
+        # a scheduler-backed cell derives its generation from the
+        # accelerator label above; an analytic cell carries it
+        generations=((generation,)
+                     if generation is not None and not cfg.sched
+                     else None),
+        sched=sched_cfg,
         # cells keep the replica-tier controls (breakers,
         # brownout) but the CLIENT lives at the front door:
         # cell-level retries and hedges stay off
@@ -431,14 +473,19 @@ class GlobeSim:
     def _build_cells(self, training_cells: set) -> List[Cell]:
         """Cell construction, factored so the sharded driver
         (globe/shard.py) can override it with worker-resident cells
-        behind parent-side proxies."""
+        behind parent-side proxies. With ``generations`` set, cell i
+        (name order) runs generation i % len — the mixed-generation
+        fleet (docs/ZOO.md)."""
+        gens = self.cfg.generations
         return [
             Cell(CellConfig(name=name, zone=name.split("/")[0],
                             fleet=fleet_config_for(
                                 self.cfg, name.split("/")[0],
-                                training=name in training_cells)),
+                                training=name in training_cells,
+                                generation=(gens[i % len(gens)]
+                                            if gens else None))),
                  self.clock)
-            for name in self.cfg.cell_names()]
+            for i, name in enumerate(self.cfg.cell_names())]
 
     def _wire_cells(self) -> None:
         """Hook every cell's completion stream into the globe log /
@@ -615,6 +662,8 @@ class GlobeSim:
         }
         if getattr(req, "tenant", ""):
             entry["tenant"] = req.tenant
+        if getattr(req, "model", ""):
+            entry["model"] = req.model
         self.log.append(entry)
         self.tracker.observe(
             arrival_s=req.arrival_s, first_s=None, finish_s=now,
@@ -818,6 +867,7 @@ class GlobeSim:
     def run(self) -> Dict[str, object]:
         board_before = metrics.globe_board().counts()
         self._tenant_before = metrics.tenant_board().counts()
+        self._zoo_before = metrics.zoo_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
         # origin map first: displaced requests keep their origin
         # wherever they complete
@@ -949,6 +999,13 @@ class GlobeSim:
             }
             report["ok"] = bool(report["ok"]
                                 and report["training"]["ledger_ok"])
+        if self.cfg.zoo is not None:
+            report["zoo"] = {
+                "warm": {c.name: sorted(c.models_warm())
+                         for c in self.cells},
+                "counters": metrics.zoo_board().snapshot_since(
+                    self._zoo_before),
+            }
         if self.chaos_applied:
             report["chaos"] = self.chaos_applied
         if self.planner is not None:

@@ -294,6 +294,18 @@ def disagg_board() -> CounterBoard:
     return _DISAGG_BOARD
 
 
+_ZOO_BOARD = CounterBoard()
+
+
+def zoo_board() -> CounterBoard:
+    """The process-global model-zoo counter board (model swaps,
+    warm hits, warm/cold routes, warm-cell front-door picks —
+    kind_tpu_sim.fleet.{router,sim,zoo} and the globe front door
+    record into it; fleet/globe reports, chaos scenario reports,
+    and bench zoo extras snapshot it)."""
+    return _ZOO_BOARD
+
+
 _TENANT_BOARD = CounterBoard()
 
 

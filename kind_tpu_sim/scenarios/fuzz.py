@@ -62,6 +62,12 @@ def _eligible_kinds(topo: TopologySpec, training_gangs: int,
         if "tenancy" in schema.needs and not getattr(
                 topo, "tenancy", False):
             continue
+        if "zoo" in schema.needs:
+            # zoo kinds never enter the shared pool: draw_spec adds
+            # them from the dedicated zoo stream, so the base kind
+            # draws — and every pre-zoo fuzz report, zoo-flavored
+            # or not — keep their bytes
+            continue
         out.append(kind)
     return out
 
@@ -98,6 +104,17 @@ def draw_spec(seed: int, index: int,
             f"fuzz:tenant:{seed}:{index}".encode()))
         if tenant_rng.random() < 0.35:
             topo = dataclasses.replace(topo, tenancy=True)
+    # the model zoo rides its own stream as well (the disagg /
+    # tenancy precedent): every pre-zoo fuzz report for unzooed
+    # specs keeps its bytes. Zoo fleets are analytic (spec fleets
+    # pin generations directly; sched x zoo is a FleetConfig-level
+    # combination) and unified (no disagg).
+    zoo_rng = None
+    if not topo.disagg and not (topo.kind == "fleet" and topo.sched):
+        zoo_rng = random.Random(zlib.crc32(
+            f"fuzz:zoo:{seed}:{index}".encode()))
+        if zoo_rng.random() < 0.3:
+            topo = dataclasses.replace(topo, zoo=True)
     overload = rng.random() < 0.7
     training_gangs = 0
     if topo.kind == "fleet" and topo.sched:
@@ -130,6 +147,32 @@ def draw_spec(seed: int, index: int,
             kind=kind, start_frac=start, end_frac=end,
             target=rng.randint(0, 7),
             param=draw_param(kind, rng)))
+    # zoo faults ride the zoo stream end to end (window, target,
+    # and magnitude included): the shared `rng` never sees them,
+    # so the base fault draws above are byte-identical whether the
+    # topology is zoo-flavored or not
+    if topo.zoo and zoo_rng is not None:
+        has_exclusive = any(FAULT_SCHEMAS[f.kind].exclusive
+                            for f in faults)
+        for kind in sorted(FAULT_SCHEMAS):
+            schema = FAULT_SCHEMAS[kind]
+            if "zoo" not in schema.needs or not schema.fuzzable:
+                continue
+            if topo.kind not in schema.scopes:
+                continue
+            if schema.exclusive and has_exclusive:
+                continue
+            if zoo_rng.random() < 0.5:
+                start = round(zoo_rng.uniform(*_START), 3)
+                end = round(min(_END_CAP,
+                                start + zoo_rng.uniform(*_DURATION)),
+                            3)
+                faults.append(FaultWindow(
+                    kind=kind, start_frac=start, end_frac=end,
+                    target=zoo_rng.randint(0, 7),
+                    param=draw_param(kind, zoo_rng)))
+                if schema.exclusive:
+                    has_exclusive = True
     # window order is part of the drawn identity; sort for a stable
     # spec no matter the draw order
     faults.sort(key=lambda f: (f.start_frac, f.kind, f.target))

@@ -67,6 +67,8 @@ _LEGACY: Dict[str, tuple] = {
         ("prefill_pool_loss", "kv_transfer_degrade"), _FLEETV, True),
     "tenant-noisy-neighbor": (
         ("noisy_neighbor",), _FLEETV, True),
+    "zoo-swap-storm": (
+        ("model_swap_storm",), _FLEETV, True),
 }
 
 _SPECS: Optional[Dict[str, ScenarioSpec]] = None

@@ -38,6 +38,11 @@ SPEC_KINDS = ("spec", "legacy")
 _PROMPT_LEN = (4, 16)
 _MAX_NEW = (4, 10)
 
+# The generation mix every zoo-flavored spec runs on: replicas
+# (fleet) or cells (globe) cycle small-HBM/big-HBM so the
+# HBM-fit ladder in default_zoo() is actually exercised.
+_SPEC_GENERATIONS = ("v5e", "v5p")
+
 
 @dataclasses.dataclass(frozen=True)
 class WorkloadDims:
@@ -78,9 +83,13 @@ class TopologySpec:
     cells_per_zone: int = 1      # globe only
     disagg: bool = False         # fleet only; phase-split pools
     tenancy: bool = False        # fleet only; default_tenancy() pop
+    # model zoo (docs/ZOO.md): default_zoo() traffic on a mixed
+    # v5e/v5p fleet (fleet) or cells cycled over both generations
+    # (globe) — the prerequisite for the zoo fault kinds
+    zoo: bool = False
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "kind": self.kind,
             "replicas": self.replicas,
             "sched": self.sched,
@@ -89,6 +98,10 @@ class TopologySpec:
             "disagg": self.disagg,
             "tenancy": self.tenancy,
         }
+        # conditional so every pre-zoo pinned spec keeps its bytes
+        if self.zoo:
+            out["zoo"] = True
+        return out
 
     @classmethod
     def from_dict(cls, d: dict) -> "TopologySpec":
@@ -96,7 +109,8 @@ class TopologySpec:
                    sched=bool(d["sched"]), zones=int(d["zones"]),
                    cells_per_zone=int(d["cells_per_zone"]),
                    disagg=bool(d.get("disagg", False)),
-                   tenancy=bool(d.get("tenancy", False)))
+                   tenancy=bool(d.get("tenancy", False)),
+                   zoo=bool(d.get("zoo", False)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -275,6 +289,10 @@ def spec_problems(spec: ScenarioSpec) -> List[str]:
             problems.append(
                 f"fault kind {f.kind!r} needs a tenanted fleet "
                 "(topology.tenancy)")
+        if "zoo" in schema.needs and not topo.zoo:
+            problems.append(
+                f"fault kind {f.kind!r} needs a model-zoo "
+                "topology (topology.zoo)")
         if schema.exclusive:
             exclusive += 1
     if exclusive > 1:
@@ -293,6 +311,16 @@ def spec_problems(spec: ScenarioSpec) -> List[str]:
         problems.append(
             "topology.disagg is incompatible with a scheduler-"
             "backed fleet (phased pools pin their own placements)")
+    if topo.zoo and topo.disagg:
+        problems.append(
+            "topology.zoo is incompatible with a disaggregated "
+            "fleet (the zoo's warm-pool state is per unified "
+            "replica)")
+    if topo.zoo and topo.kind == "fleet" and topo.sched:
+        problems.append(
+            "topology.zoo spec fleets pin generations directly; "
+            "scheduler-backed zoo fleets run through FleetConfig "
+            "(FleetSchedConfig.replica_accelerator)")
     if spec.training_gangs and topo.kind == "fleet" and not topo.sched:
         problems.append(
             "training_gangs need a scheduler-backed fleet")
@@ -304,7 +332,8 @@ def spec_problems(spec: ScenarioSpec) -> List[str]:
         # zone-scale faults need a spill destination; the compiler
         # (_globe_events) always spares zone 0, which only works
         # when another zone exists
-        if any(f.kind in ("zone_loss", "herd_failover", "cell_drain")
+        if any(f.kind in ("zone_loss", "herd_failover", "cell_drain",
+                          "generation_cell_drain")
                for f in spec.faults):
             problems.append(
                 "zone-scale faults need at least 2 zones (zone 0 "
@@ -381,6 +410,16 @@ def _fleet_events(spec: ScenarioSpec, span: float):
         elif f.kind == "train_kill":
             gang = f.target % max(1, spec.training_gangs)
             events.append(fleet.ChaosEvent(t0, "train_kill", gang))
+        elif f.kind == "model_swap_storm":
+            # `param` eviction pulses spread evenly across the
+            # window — each one drops every resident model, so the
+            # warm pool rebuilds from scratch that many times
+            pulses = max(1, int(f.param))
+            for k in range(pulses):
+                frac = k / max(1, pulses - 1) if pulses > 1 else 0.0
+                events.append(fleet.ChaosEvent(
+                    round(t0 + (t1 - t0) * frac, 6),
+                    "model_swap_evict", 0))
         # demand_surge is a trace transform, not an event
     return events
 
@@ -411,6 +450,21 @@ def _globe_events(spec: ScenarioSpec, span: float, zones, cells):
                 t0, "cell_drain", cell))
             events.append(globe.GlobeChaosEvent(
                 t1, "cell_undrain", cell))
+        elif f.kind == "generation_cell_drain":
+            # generation-skewed capacity loss (docs/ZOO.md): every
+            # cell of the targeted generation drains at once — the
+            # models only that generation fits must ride out the
+            # window on warm survivors or shed loudly. Cell 0 is
+            # always spared (the spill-destination rule).
+            gens = _SPEC_GENERATIONS
+            gen = gens[f.target % len(gens)]
+            for i, cell in enumerate(cells):
+                if i == 0 or gens[i % len(gens)] != gen:
+                    continue
+                events.append(globe.GlobeChaosEvent(
+                    t0, "cell_drain", cell))
+                events.append(globe.GlobeChaosEvent(
+                    t1, "cell_undrain", cell))
     return events
 
 
@@ -456,12 +510,16 @@ def _run_fleet_spec(spec: ScenarioSpec, seed: int,
     if spec.topology.tenancy:
         from kind_tpu_sim.fleet.tenancy import default_tenancy
         tenancy = default_tenancy()
+    zoo = None
+    if spec.topology.zoo:
+        from kind_tpu_sim.fleet.zoo import default_zoo
+        zoo = default_zoo()
     wl = fleet.WorkloadSpec(
         process=spec.workload.process, rps=spec.workload.rps,
         n_requests=spec.workload.n_requests,
         prompt_len=_PROMPT_LEN, max_new=_MAX_NEW,
         deadline_s=spec.workload.deadline_s,
-        tenancy=tenancy)
+        tenancy=tenancy, zoo=zoo)
     base = fleet.generate_trace(wl, seed)
     span = _trace_span(base)
     surges = [f for f in spec.faults if f.kind == "demand_surge"]
@@ -508,6 +566,9 @@ def _run_fleet_spec(spec: ScenarioSpec, seed: int,
         training=_training_config(spec),
         disagg=disagg,
         tenancy=tenancy,
+        zoo=zoo,
+        generations=(_SPEC_GENERATIONS if zoo is not None
+                     else None),
         max_virtual_s=spec.max_virtual_s,
         event_core=event_core)
     events = _fleet_events(spec, span)
@@ -520,12 +581,19 @@ def _run_globe_spec(spec: ScenarioSpec, seed: int,
 
     zones = tuple(f"zone-{chr(ord('a') + i)}"
                   for i in range(spec.topology.zones))
+    zoo = None
+    if spec.topology.zoo:
+        from kind_tpu_sim.fleet.zoo import default_zoo
+        zoo = default_zoo()
     cfg = globe.GlobeConfig(
         zones=zones,
         cells_per_zone=spec.topology.cells_per_zone,
         replicas_per_cell=spec.topology.replicas,
         overload=(globe.OverloadConfig() if spec.overload
                   else None),
+        zoo=zoo,
+        generations=(_SPEC_GENERATIONS if zoo is not None
+                     else None),
         workload=globe.GlobeWorkloadSpec(
             process=spec.workload.process,
             rps=spec.workload.rps,

@@ -40,8 +40,10 @@ from kind_tpu_sim.tune.space import (  # noqa: F401
     candidate_spec,
     default_fleet_space,
     default_globe_space,
+    generation_cost_factor,
     price_factor,
     ratio_space,
     render_fleet,
     render_globe,
+    zoo_space,
 )

@@ -42,6 +42,7 @@ from kind_tpu_sim.tune import pareto as pareto_mod
 from kind_tpu_sim.tune.space import (TuneSpace, candidate_replicas,
                                      candidate_spec,
                                      fleet_workload_from_dict,
+                                     generation_cost_factor,
                                      globe_replicas,
                                      globe_workload_from_dict,
                                      price_factor, slo_from_dict,
@@ -244,6 +245,12 @@ def _evaluate_fleet(spec, candidate, fidelity, seed, slo,
                          chaos_events=chaos_events).run()
     replicas = candidate_replicas(candidate)
     price = price_factor(candidate)
+    # generation-weighted chip-seconds (docs/ZOO.md): a mixed-
+    # generation candidate pays each replica's relative chip-second
+    # price. The factor is exactly 1.0 without a generation_split,
+    # and x * 1.0 == x bitwise, so pre-zoo search reports keep
+    # their bytes.
+    gen_factor = generation_cost_factor(candidate)
     dtype = (cfg.disagg.dtype if cfg.disagg is not None else "bf16")
     out = {
         "ok": bool(rep["ok"]),
@@ -252,9 +259,11 @@ def _evaluate_fleet(spec, candidate, fidelity, seed, slo,
         "provisioned_replicas": replicas,
         "price_factor": price,
         "cost_chip_s": round(
-            replicas * rep["virtual_s"] * price, 6),
+            replicas * rep["virtual_s"] * price * gen_factor, 6),
         "work_chip_s": _work_chip_s(trace, dtype),
     }
+    if gen_factor != 1.0:
+        out["generation_cost_factor"] = gen_factor
     out.update(_slo_metrics(rep["slo"]))
     if cfg.disagg is not None:
         out["kv_handoffs"] = rep["disagg"]["kv"]["handoffs"]

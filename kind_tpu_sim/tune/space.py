@@ -171,6 +171,32 @@ def default_globe_space() -> TuneSpace:
         ))
 
 
+def zoo_space() -> TuneSpace:
+    """The heterogeneous-fleet design space (docs/ZOO.md): which
+    accelerator generations to buy (``generation_split``, a ``+``-
+    joined cycle rendered into ``FleetConfig.generations``), where
+    the zoo's largest model should live (``large_model_gen`` —
+    ``zoo.placements``' forced-placement lever), plus the usual
+    replica-count and policy levers. Candidates are priced with
+    :func:`generation_cost_factor`, so an all-v5p fleet must earn
+    its ~3.5x chip-second premium on the quality axes — bench
+    ``zoo_smoke`` shows the tuner discovering that the large model
+    belongs on the big-HBM generation anyway."""
+    return TuneSpace(
+        name="zoo-fleet",
+        target="fleet",
+        dims=(
+            TuneDim("generation_split", "choice",
+                    choices=("v5e", "v5p", "v5e+v5p", "v4+v5p",
+                             "v5e+v5e+v5p")),
+            TuneDim("large_model_gen", "choice",
+                    choices=("v5e", "v4", "v5p")),
+            TuneDim("replicas", "int", lo=3, hi=6),
+            TuneDim("policy", "choice",
+                    choices=("least-outstanding", "round-robin")),
+        ))
+
+
 def ratio_space(ratios: Tuple[str, ...],
                 policy: str = "least-outstanding") -> TuneSpace:
     """A one-dimension disagg-ratio space at a fixed policy — the
@@ -214,6 +240,22 @@ def render_fleet(candidate: Dict[str, object], slo,
     if ten is not None and "drr_quantum" in candidate:
         ten = dataclasses.replace(
             ten, drr_quantum=float(candidate["drr_quantum"]))
+    # heterogeneous-generation candidates (zoo_space): the split is
+    # the generation cycle, and the fleet serves the stock zoo so
+    # model placement is a searched lever. Only zoo candidates pass
+    # these keys — every other space renders the exact config it
+    # always did.
+    generations = None
+    zoo_cfg = None
+    large_gen = None
+    if "generation_split" in candidate:
+        from kind_tpu_sim.fleet.zoo import default_zoo
+
+        generations = tuple(
+            str(candidate["generation_split"]).split("+"))
+        zoo_cfg = default_zoo()
+        if "large_model_gen" in candidate:
+            large_gen = str(candidate["large_model_gen"])
     return fleet.FleetConfig(
         replicas=replicas,
         policy=str(candidate.get("policy", "least-outstanding")),
@@ -223,7 +265,10 @@ def render_fleet(candidate: Dict[str, object], slo,
         overload=(fleet.OverloadConfig()
                   if candidate.get("brownout") else None),
         disagg=disagg,
-        tenancy=ten)
+        tenancy=ten,
+        zoo=zoo_cfg,
+        generations=generations,
+        zoo_large_model_gen=large_gen)
 
 
 def render_globe(candidate: Dict[str, object], slo, workload,
@@ -266,6 +311,24 @@ def price_factor(candidate: Dict[str, object]) -> float:
     return round(1.0 - spot * (1.0 - SPOT_PRICE), 6)
 
 
+def generation_cost_factor(candidate: Dict[str, object]) -> float:
+    """Mean relative chip-second price over the candidate's replica
+    generation cycle (``GENERATION_FACTS[*]["chip_second_cost"]``,
+    v5e-anchored) — the generation-weighted term of the tune cost
+    axis. Exactly 1.0 for candidates without a ``generation_split``,
+    so every pre-zoo search report keeps its bytes."""
+    split = candidate.get("generation_split")
+    if not split:
+        return 1.0
+    from kind_tpu_sim.fleet.costmodel import GENERATION_FACTS
+
+    gens = str(split).split("+")
+    n = max(1, candidate_replicas(candidate))
+    total = sum(GENERATION_FACTS[gens[i % len(gens)]]
+                ["chip_second_cost"] for i in range(n))
+    return round(total / n, 6)
+
+
 # -- workload / slo (de)serialization ---------------------------------
 
 
@@ -291,6 +354,14 @@ def workload_to_dict(spec) -> dict:
             d[key] = list(d[key])
     if "tenancy" in d:
         d["tenancy"] = spec.tenancy is not None
+    # the zoo serializes in full (unlike tenancy, the model set IS
+    # a searched-over axis) but stays OFF the wire when absent so
+    # every unzooed tune spec/report keeps its bytes
+    if "zoo" in d:
+        if spec.zoo is None:
+            del d["zoo"]
+        else:
+            d["zoo"] = spec.zoo.as_dict()
     return d
 
 
@@ -303,6 +374,9 @@ def fleet_workload_from_dict(d: dict):
             d[key] = tuple(d[key])
     if d.pop("tenancy", False):
         d["tenancy"] = fleet.default_tenancy()
+    if d.get("zoo"):
+        from kind_tpu_sim.fleet.zoo import zoo_config_from_dict
+        d["zoo"] = zoo_config_from_dict(d["zoo"])
     return fleet.WorkloadSpec(**d)
 
 

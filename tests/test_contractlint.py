@@ -367,6 +367,7 @@ def test_cli_flags_bijection_clean_on_shipped_tree():
 def test_cross_checks_all_clean():
     checks = contractlint.cross_check_problems(REPO)
     assert sorted(checks) == ["cli_flags", "fault_schemas",
+                              "generation_coverage",
                               "knob_coverage", "lane_order",
                               "scenario_registry"]
     for family, problems in checks.items():

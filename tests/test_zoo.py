@@ -1,0 +1,362 @@
+"""Heterogeneous fleet + model zoo (docs/ZOO.md).
+
+The load-bearing properties (ISSUE 19 acceptance): the checked-in
+per-generation calibrations are exactly the roofline-ratio derivation
+of the measured v5e anchor (pricing has an oracle, not vibes); the
+sched/pods accelerator labels round-trip into generation names; the
+model-swap event lane is byte-identical under replay, event-core
+on/off, and the columnar mirror; a cold model admission pays a swap a
+warm one does not (warm-vs-cold TTFT ordering); the globe front door
+spills to the cell that has the model warm; unzooed specs, traces,
+configs, and reports carry no zoo keys at all (the byte-identity of
+every pre-zoo replay digest, pinned in test_disagg.py); and the
+generation-placement search discovers that the 60 GB model belongs on
+the only generation whose HBM holds it.
+"""
+
+import dataclasses
+import json
+import pathlib
+
+import pytest
+import yaml
+
+from kind_tpu_sim import chaos, fleet, globe, topology, tune
+from kind_tpu_sim.analysis import contractlint
+from kind_tpu_sim.fleet import costmodel
+from kind_tpu_sim.tune.space import workload_to_dict
+
+pytestmark = pytest.mark.zoo
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _zoo_spec(**kw):
+    base = dict(process="poisson", rps=60.0, n_requests=240,
+                prompt_len=(4, 16), max_new=(8, 24),
+                zoo=fleet.default_zoo())
+    base.update(kw)
+    return fleet.WorkloadSpec(**base)
+
+
+# -- the generation registry vs the roofline oracle --------------------
+
+
+def test_checked_in_calibrations_match_the_derivation():
+    """The pricing oracle: every derived generation file on disk is
+    byte-for-byte the roofline-ratio scaling of the v5e anchor, and
+    the anchor self-identifies."""
+    anchor = fleet.load_generation("v5e")
+    assert anchor["generation"] == "v5e"
+    assert anchor["chip_second_cost"] == 1.0
+    assert anchor["hbm_gib"] == 16.0
+    for gen in ("v4", "v5p"):
+        assert (fleet.load_generation(gen)
+                == costmodel.derive_generation(anchor, gen))
+
+
+def test_roofline_scaling_rule_and_error_preservation():
+    """Prefill (compute-bound) rates scale by the compute ratio,
+    decode (HBM-bound) bandwidths by the bandwidth ratio, and the
+    anchor's calibration error survives the scaling — every
+    generation keeps the ≤15% bound by construction."""
+    anchor = fleet.load_generation("v5e")
+    for gen in ("v4", "v5p"):
+        facts = fleet.GENERATION_FACTS[gen]
+        cal = fleet.load_generation(gen)
+        assert cal["prefill"]["analytic_tokens_per_s"] == round(
+            anchor["prefill"]["analytic_tokens_per_s"]
+            * facts["compute_ratio"], 3)
+        assert cal["prefill"]["error_frac"] == (
+            anchor["prefill"]["error_frac"])
+        for dtype, d in cal["decode"].items():
+            assert d["achieved_gbps"] == round(
+                anchor["decode"][dtype]["achieved_gbps"]
+                * facts["bandwidth_ratio"], 3)
+            assert abs(d["error_frac"]) <= 0.15
+        assert cal["hbm_gib"] == facts["hbm_gib"]
+        assert cal["chip_second_cost"] == facts["chip_second_cost"]
+
+
+def test_unregistered_names_fail_loudly():
+    with pytest.raises(ValueError, match="unknown generation"):
+        fleet.load_generation("v6")
+    with pytest.raises(ValueError, match="no registered generation"):
+        fleet.generation_of_accelerator("tpu-v6-podslice")
+
+
+# -- accelerator labels round-trip into generations --------------------
+
+
+def _yaml_accelerator_labels(doc):
+    found = []
+    if isinstance(doc, dict):
+        for key, val in doc.items():
+            if key == topology.LABEL_ACCELERATOR:
+                found.append(str(val))
+            else:
+                found.extend(_yaml_accelerator_labels(val))
+    elif isinstance(doc, list):
+        for item in doc:
+            found.extend(_yaml_accelerator_labels(item))
+    return found
+
+
+def test_pods_accelerator_labels_resolve_and_round_trip():
+    """Every gke-tpu-accelerator nodeSelector in pods/*.yaml prices
+    against a registered generation, and the generation maps back to
+    the same label (the contractlint generation_coverage family,
+    checked here end to end)."""
+    seen = {}
+    for path in sorted((REPO / "pods").glob("*.yaml")):
+        with open(path) as fh:
+            for doc in yaml.safe_load_all(fh):
+                for label in _yaml_accelerator_labels(doc):
+                    gen = fleet.generation_of_accelerator(label)
+                    assert (costmodel.GENERATION_ACCELERATORS[gen]
+                            == label)
+                    seen[path.name] = gen
+    # the batch-train job requests v5e pods today; a relabel is a
+    # deliberate repricing, not drift
+    assert seen["tpu-batch-train-job.yaml"] == "v5e"
+
+
+def test_topology_registry_round_trips_into_generations():
+    for accel, gen in sorted(
+            costmodel.ACCELERATOR_GENERATIONS.items()):
+        assert accel in topology.ACCELERATORS
+        _, slice_topo = costmodel.GENERATION_SCHED_TOPOLOGY[accel]
+        sl = topology.make_slice(accel, slice_topo)
+        assert (sl.node_labels(0)[topology.LABEL_ACCELERATOR]
+                == accel)
+        assert fleet.generation_of_accelerator(accel) == gen
+
+
+def test_generation_coverage_cross_check_is_clean():
+    assert contractlint.generation_coverage_problems(REPO) == []
+
+
+def test_model_swap_lane_is_canonical():
+    assert ("LANE_MODEL_SWAP", 7) in contractlint.CANONICAL_LANES
+    assert fleet.LANE_MODEL_SWAP == 7
+
+
+# -- zoo-off wire cleanliness ------------------------------------------
+
+
+def test_unzooed_wire_formats_carry_no_zoo_keys():
+    """The byte-identity contract: with the zoo off, no spec, trace
+    line, config, or report grows a key (pre-zoo replay digests in
+    test_disagg.py stay green)."""
+    spec = fleet.WorkloadSpec(process="poisson", rps=60.0,
+                              n_requests=40)
+    assert "zoo" not in workload_to_dict(spec)
+    trace = fleet.generate_trace(spec, 7)
+    for req in trace:
+        assert req.model == ""
+        assert "model" not in req.as_dict()
+    cfg = fleet.FleetConfig(replicas=2, policy="least-outstanding")
+    d = cfg.as_dict()
+    assert not any("zoo" in k or "generation" in k for k in d)
+    rep = fleet.FleetSim(cfg, trace).run()
+    assert "zoo" not in rep and "generations" not in rep
+    assert all("model" not in e for e in rep["completions"])
+
+
+def test_model_stamp_rides_a_fresh_stream():
+    """Stamping models is a pure overlay: the base trace's arrivals,
+    lengths, and ids are byte-identical with the zoo on and off, and
+    the stamp itself is deterministic."""
+    seed = 7
+    plain = fleet.generate_trace(
+        fleet.WorkloadSpec(process="poisson", rps=60.0,
+                           n_requests=240, prompt_len=(4, 16),
+                           max_new=(8, 24)), seed)
+    zooed = fleet.generate_trace(_zoo_spec(), seed)
+    assert len(plain) == len(zooed)
+    for p, z in zip(plain, zooed):
+        assert z.model in fleet.default_zoo().names()
+        assert dataclasses.replace(z, model="") == p
+    again = fleet.generate_trace(_zoo_spec(), seed)
+    assert [r.as_dict() for r in again] == [r.as_dict() for r in zooed]
+    assert len({r.model for r in zooed}) >= 2
+
+
+def test_zoo_config_round_trips():
+    z = fleet.default_zoo()
+    assert fleet.zoo_config_from_dict(z.as_dict()) == z
+
+
+# -- the swap lane under the determinism contract ----------------------
+
+
+def _zoo_run(columnar=None, event_core=None, replicas=4):
+    spec = _zoo_spec()
+    trace = fleet.generate_trace(spec, 7)
+    cfg = fleet.FleetConfig(
+        replicas=replicas, policy="least-outstanding",
+        zoo=spec.zoo, generations=("v5e", "v5p"),
+        max_queue=4096)
+    if columnar is not None:
+        cfg = dataclasses.replace(cfg, columnar=columnar)
+    if event_core is not None:
+        cfg = dataclasses.replace(cfg, event_core=event_core)
+    sim = fleet.FleetSim(cfg, trace)
+    rep = sim.run()
+    if columnar is not None:
+        assert (sim._cols is not None) is bool(columnar)
+    return json.dumps(rep, sort_keys=True)
+
+
+def test_swap_lane_replay_and_event_core_identity():
+    assert _zoo_run() == _zoo_run()
+    assert (_zoo_run(event_core=True)
+            == _zoo_run(event_core=False))
+
+
+def test_zoo_columnar_identity():
+    assert (_zoo_run(columnar=True, replicas=48)
+            == _zoo_run(columnar=False, replicas=48))
+
+
+# -- warm pools, swaps, and placement ----------------------------------
+
+
+def test_hbm_fit_ladder_and_placements():
+    """The default zoo's footprint ladder is a real constraint set:
+    medium overflows v5e once KV headroom is charged, large fits
+    only v5p — so placement warms the largest model each generation
+    can hold, and large_model_gen forces the big one's home."""
+    z = fleet.default_zoo()
+    cals = {g: fleet.load_generation(g) for g in fleet.GENERATIONS}
+    assert [fleet.fits(z.model("small"), cals[g])
+            for g in ("v5e", "v4", "v5p")] == [True, True, True]
+    assert [fleet.fits(z.model("medium"), cals[g])
+            for g in ("v5e", "v4", "v5p")] == [False, True, True]
+    assert [fleet.fits(z.model("large"), cals[g])
+            for g in ("v5e", "v4", "v5p")] == [False, False, True]
+    assert (fleet.placements(z, ("v5e", "v4", "v5p"))
+            == ["small", "medium", "large"])
+    assert (fleet.placements(z, ("v5e", "v5p"),
+                             large_model_gen="v5p")
+            == ["small", "large"])
+
+
+def test_warm_vs_cold_ttft_ordering():
+    """The same model on the same replica: the first (cold)
+    admission pays the modeled weight-load swap, the second (warm)
+    does not — and the paid latency is at least the calibration's
+    swap_s."""
+    z = fleet.default_zoo()
+    spec = fleet.WorkloadSpec(process="poisson", rps=0.2,
+                              n_requests=2, prompt_len=(8, 8),
+                              max_new=(4, 4))
+    trace = [dataclasses.replace(r, model="medium")
+             for r in fleet.generate_trace(spec, 3)]
+    cfg = fleet.FleetConfig(replicas=1, policy="least-outstanding",
+                            zoo=z, generations=("v5p",))
+    rep = fleet.FleetSim(cfg, trace).run()
+    assert rep["zoo"]["swaps"]["completed"] == 1
+    assert rep["zoo"]["residents"] == {"0": "medium"}
+    cold, warm = sorted(rep["completions"],
+                        key=lambda e: e["arrival_s"])
+    ttft_cold = cold["first_s"] - cold["arrival_s"]
+    ttft_warm = warm["first_s"] - warm["arrival_s"]
+    swap = fleet.swap_s(z.model("medium"),
+                        fleet.load_generation("v5p"))
+    assert ttft_cold > ttft_warm
+    assert ttft_cold - ttft_warm >= 0.9 * swap
+
+
+def test_mixed_fleet_report_labels_every_replica():
+    spec = _zoo_spec(n_requests=40)
+    cfg = fleet.FleetConfig(replicas=4, policy="least-outstanding",
+                            zoo=spec.zoo,
+                            generations=("v5e", "v5p"))
+    rep = fleet.FleetSim(cfg, fleet.generate_trace(spec, 0)).run()
+    assert rep["generations"] == {"0": "v5e", "1": "v5p",
+                                  "2": "v5e", "3": "v5p"}
+    # the resident snapshot is end-state (swaps move it), but the
+    # fit constraint is invariant: a v5e replica can only ever hold
+    # small, and every resident fits its replica's generation
+    residents = rep["zoo"]["residents"]
+    assert residents["0"] == "small" and residents["2"] == "small"
+    for rid, name in residents.items():
+        assert fleet.fits(
+            spec.zoo.model(name),
+            fleet.load_generation(rep["generations"][rid]))
+    assert len(set(residents.values())) >= 2
+    assert set(rep["zoo"]["per_model_slo"]) <= set(
+        spec.zoo.names())
+
+
+# -- the globe front door spills to the warm cell ----------------------
+
+
+def test_frontdoor_prefers_warm_cells():
+    """A v5e cell can only ever warm the small model, so traffic for
+    the bigger models must land on the v5p cell — and the front
+    door's picks are overwhelmingly warm ones."""
+    z = fleet.default_zoo()
+    cfg = globe.GlobeConfig(
+        zones=("us-a", "eu-b"), sched=False, zoo=z,
+        generations=("v5e", "v5p"),
+        workload=globe.GlobeWorkloadSpec(
+            process="poisson", rps=40.0, n_per_zone=60))
+    traces = globe.generate_globe_traces(cfg, 5)
+    a = globe.GlobeSim(cfg, traces=traces, seed=5).run()
+    b = globe.GlobeSim(cfg, traces=traces, seed=5).run()
+    assert (json.dumps(a, sort_keys=True)
+            == json.dumps(b, sort_keys=True))
+    assert a["ok"] is True
+    warm = a["zoo"]["warm"]
+    assert warm["us-a/c0"] == ["small"]
+    assert "large" in warm["eu-b/c0"]
+    counters = a["zoo"]["counters"]
+    assert counters["warm_cell_picks"] > counters.get(
+        "cold_cell_picks", 0)
+
+
+# -- chaos: the swap storm ---------------------------------------------
+
+
+def test_zoo_swap_storm_scenario():
+    rep = chaos.run_scenario("zoo-swap-storm", seed=7)
+    assert rep["ok"] is True
+    assert rep["replay_identical"] is True
+    assert rep["swaps_storm"] >= rep["swaps_steady"]
+    assert rep["p99_ratio"] <= 1.25
+
+
+# -- the placement search ----------------------------------------------
+
+
+def test_generation_cost_factor():
+    assert tune.generation_cost_factor({"replicas": 4}) == 1.0
+    assert tune.generation_cost_factor(
+        {"generation_split": "v5e+v5p", "replicas": 4}) == 2.25
+    assert tune.generation_cost_factor(
+        {"generation_split": "v5p", "replicas": 3}) == 3.5
+
+
+def test_zoo_space_tune_places_large_model_on_big_hbm():
+    """The pinned discovery (bench `zoo` extras): the knee-point
+    winner buys mostly cheap v5e capacity and pins the 60 GB model
+    on v5p — the only generation it fits — and its spec replays
+    byte-identically."""
+    spec = _zoo_spec()
+    slo = fleet.SloPolicy(ttft_s=1.0, e2e_s=8.0)
+    rep = tune.tune(tune.zoo_space(), spec, slo, seed=0, budget=12)
+    assert rep["ok"] is True
+    winner = rep["winner"]
+    assert winner["candidate"] == {
+        "generation_split": "v5e+v5e+v5p",
+        "large_model_gen": "v5p",
+        "replicas": 3,
+        "policy": "least-outstanding",
+    }
+    assert winner["metrics"]["attainment"] == 1.0
+    assert winner["metrics"]["generation_cost_factor"] == 1.833333
+    replayed = tune.replay(json.loads(json.dumps(winner["spec"])))
+    assert (json.dumps(replayed, sort_keys=True)
+            == json.dumps(winner["metrics"], sort_keys=True))
