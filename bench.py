@@ -34,6 +34,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import json
+import math
 import os
 import pathlib
 import shutil
@@ -2461,6 +2462,86 @@ def zoo_smoke() -> dict | None:
         return {"ok": False, "error": str(exc)[:200]}
 
 
+def sdc_smoke() -> dict | None:
+    """Silent-data-corruption extras (docs/SDC.md): the same seeded
+    trace served against one defective chip with the duplicate-
+    compute audit lane off and on — escapes vs detection/containment
+    plus the audit latency tax — and one defective 32-chip training
+    gang timed through loss-spike detection, checkpoint rollback,
+    and O(log chips) culprit bisection with ledger-priced re-runs."""
+    try:
+        from kind_tpu_sim import fleet, topology
+        from kind_tpu_sim.fleet import training as tr
+
+        t0 = time.monotonic()
+        trace = fleet.generate_trace(
+            fleet.WorkloadSpec(process="poisson", rps=40.0,
+                               n_requests=200, prompt_len=(8, 16),
+                               max_new=(4, 8)), seed=3)
+        span = max(r.arrival_s for r in trace)
+        serving: dict = {}
+        for frac in (0.0, 0.4):
+            rep = fleet.FleetSim(
+                fleet.FleetConfig(replicas=3, audit_frac=frac,
+                                  max_virtual_s=120.0),
+                list(trace),
+                chaos_events=[fleet.ChaosEvent(
+                    round(span * 0.25, 6), "sdc_chip", 1,
+                    0.4)]).run()
+            counters = rep["integrity"]["counters"]
+            serving[f"audit_{frac}"] = {
+                "ok": rep["ok"],
+                "corrupted_served": counters.get(
+                    "corrupted_served", 0),
+                "detections": len(rep["integrity"]["detections"]),
+                "audits": counters.get("audits", 0),
+                "chips_quarantined": counters.get(
+                    "chips_quarantined", 0),
+                "e2e_p50_s": rep["slo"]["e2e"].get("p50_s"),
+            }
+        contained = (
+            serving["audit_0.4"]["detections"] >= 1
+            and serving["audit_0.4"]["chips_quarantined"] >= 1
+            and (serving["audit_0.4"]["corrupted_served"]
+                 < serving["audit_0.0"]["corrupted_served"]))
+        # one 32-chip gang, defect planted mid-run on chip 21
+        chips = topology.make_slice(
+            topology.DEFAULT_ACCELERATOR, "4x8").num_chips
+        cfg = tr.TrainingGangConfig(
+            name="g0", topology="4x8", total_steps=30,
+            checkpoint_every=10, allreduce_bytes=0.0,
+            step_compute_chip_s=0.1 * chips)
+        gang = tr.TrainingGang(cfg, ckpt_every=10,
+                               ckpt_write_s=0.05, restart_s=0.2,
+                               elastic=False)
+        gang.bound(0.0, 1.0, bind_s=0.0)
+        gang.seed_defect(21, 1.0, gang.seg_t0 + 0.55)
+        gang.advance(10_000.0)
+        culprit = (gang.sdc_culprits[0] if gang.sdc_culprits
+                   else {})
+        bisects = [l for l in gang.ledger if l["kind"] == "bisect"]
+        training = {
+            "done": gang.state == "done",
+            "culprit_chip": culprit.get("chip"),
+            "bisection_rounds": culprit.get("bisection_rounds"),
+            "bisect_chip_s": round(
+                sum(b["chip_s"] for b in bisects), 6),
+            "lost_steps": culprit.get("lost_steps"),
+        }
+        bisected = (training["done"]
+                    and training["culprit_chip"] == 21
+                    and (training["bisection_rounds"] or 99)
+                    <= math.ceil(math.log2(chips)) + 1)
+        return {
+            "ok": bool(contained and bisected),
+            "seconds": round(time.monotonic() - t0, 3),
+            "serving": serving,
+            "training": training,
+        }
+    except Exception as exc:  # pragma: no cover - best effort
+        return {"ok": False, "error": str(exc)[:200]}
+
+
 def tenant_smoke() -> dict | None:
     """Multi-tenancy extras (docs/TENANCY.md): one seeded
     heavy-tailed tenant trace with a bronze aggressor surge, run
@@ -3355,6 +3436,10 @@ def main(argv=None) -> int:
             zoo_rep = zoo_smoke()
         if zoo_rep:
             phases["zoo"] = zoo_rep
+        with stopwatch("sdc"):
+            sdc_rep = sdc_smoke()
+        if sdc_rep:
+            phases["sdc"] = sdc_rep
         with stopwatch("tenant"):
             tenant_rep = tenant_smoke()
         if tenant_rep:

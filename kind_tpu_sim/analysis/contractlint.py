@@ -121,6 +121,7 @@ CANONICAL_LANES: Tuple[Tuple[str, int], ...] = (
     ("LANE_PLANNER", 5),
     ("LANE_KV_TRANSFER", 6),
     ("LANE_MODEL_SWAP", 7),
+    ("LANE_INTEGRITY_AUDIT", 8),
 )
 LANE_NAMES = frozenset(name for name, _ in CANONICAL_LANES)
 

@@ -314,6 +314,19 @@ HEALTH_SPEC_RATIO = _register(
     "In-flight cell age (x expected service time) that triggers "
     "speculative re-dispatch.")
 
+# silent data corruption (docs/SDC.md)
+SDC_RATE = _register(
+    "KIND_TPU_SIM_SDC_RATE", 0.4, "float", "sdc",
+    "Default corrupt fraction of a defective chip (share of its "
+    "work whose output CRC is silently wrong) when an `sdc_chip` "
+    "fault draws no explicit parameter.")
+SDC_AUDIT_FRAC = _register(
+    "KIND_TPU_SIM_SDC_AUDIT_FRAC", 0.0, "float", "sdc",
+    "Default sampled duplicate-compute audit fraction for serving "
+    "fleets: this share of completed requests re-executes on a "
+    "second replica and CRC-compares (audit copies are real "
+    "occupancy); `0` disables the audit lane.")
+
 # fuzz
 FUZZ_BUDGET = _register(
     "KIND_TPU_SIM_FUZZ_BUDGET", 25, "int", "fuzz",
@@ -358,7 +371,7 @@ BENCH_SLOW = _register(
 # alphabetical, so the page reads like the architecture diagram.
 LAYER_ORDER = ("runtime", "parallel", "chaos", "fleet", "disagg",
                "sched", "train", "globe", "overload", "tenant",
-               "zoo", "health", "fuzz", "tune", "bench")
+               "zoo", "health", "sdc", "fuzz", "tune", "bench")
 
 # Layer -> its doc page (links are relative to docs/, where the
 # generated KNOBS.md lives).
@@ -375,6 +388,7 @@ LAYER_DOCS = {
     "tenant": "TENANCY.md",
     "zoo": "ZOO.md",
     "health": "HEALTH.md",
+    "sdc": "SDC.md",
     "fuzz": "FUZZ.md",
     "tune": "TUNE.md",
     "bench": "PERFORMANCE.md",

@@ -101,6 +101,12 @@ FAULT_KINDS = (
     # loss are the faults the warm-pool machinery must absorb
     "model_swap_storm",  # resident models evicted in pulses (param)
     "generation_cell_drain",  # every cell of one generation drained
+    # silent data corruption (docs/SDC.md): the OUTPUT, not the
+    # schedule, is the casualty — a defective chip computes wrong
+    # while reporting healthy, and unlike every windowed fault above
+    # it persists until integrity checking names and quarantines it
+    "sdc_chip",          # one chip corrupts param frac of its work
+    "correlated_domain_fault",  # one rack/power domain fails whole
 )
 
 
@@ -254,6 +260,20 @@ FAULT_SCHEMAS: Dict[str, FaultSchema] = {s.kind: s for s in (
     FaultSchema("generation_cell_drain", "zoo",
                 scopes=("globe",), needs=("zoo",),
                 fuzzable=True),
+    # SDC kinds (docs/SDC.md) carry "sdc" in needs so the shared
+    # fuzz pool skips them (the zoo-stream precedent): they are
+    # drawn only from the dedicated fuzz:sdc sub-seed stream, which
+    # keeps every pre-SDC fuzz draw — and every pinned replay
+    # digest — byte-identical
+    FaultSchema("sdc_chip", "health",
+                param=("uniform", 0.2, 0.6),
+                param_doc="fraction of work the defective chip "
+                          "corrupts (persists until quarantined)",
+                scopes=("fleet",), needs=("sdc",),
+                fuzzable=True),
+    FaultSchema("correlated_domain_fault", "sched",
+                scopes=("fleet",), needs=("sdc", "sched"),
+                fuzzable=True, exclusive=True),
 )}
 
 
@@ -2490,6 +2510,337 @@ def _scenario_train_globe_spot(seed: int) -> dict:
                    and g["grows"] >= 1
                    and grants >= 1
                    and g["evictions"] >= 1
+                   and identical),
+    }
+
+
+@_scenario("sdc-training-bisect",
+           "a defective chip seeded into a training gang perturbs "
+           "the seeded loss stream; the closed-form loss-spike "
+           "checker fires, the gang rolls back at most one "
+           "checkpoint cadence of steps (the corrupted step never "
+           "commits), deterministic bisection re-runs — priced as "
+           "real chip-seconds in the ledger — name the exact seeded "
+           "culprit chip in ceil(log2(chips)) rounds, the chip is "
+           "quarantined chip-granularly, the ledger verifies clean, "
+           "and the report is byte-identical on replay AND with the "
+           "event core off")
+def _scenario_sdc_training_bisect(seed: int) -> dict:
+    import json as _json
+    import math as _math
+
+    from kind_tpu_sim import fleet
+
+    plan = ChaosSchedule(seed).plan(
+        kinds=("sdc_chip",), n_faults=1, horizon=8, targets=4)
+    spec = fleet.WorkloadSpec(process="poisson", rps=40.0,
+                              n_requests=120, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    sim_cfg = fleet.SimReplicaConfig(max_slots=4,
+                                     prefill_per_tok_s=0.002,
+                                     tpot_s=0.002)
+    sc = fleet.FleetSchedConfig(
+        pods=(("tpu-v5-lite-podslice", "4x8"),
+              ("tpu-v5-lite-podslice", "4x8")))
+    cadence = 10
+    gang = fleet.TrainingGangConfig(name="llm0", total_steps=90,
+                                    checkpoint_every=cadence)
+    tc = fleet.TrainingConfig(gangs=(gang,))
+    t_sdc = round(0.5 + 0.1 * plan.events[0].at, 6)
+    frac = max(0.2, plan.events[0].param)
+    events = [fleet.ChaosEvent(at_s=t_sdc, action="sdc_train_chip",
+                               target=plan.events[0].target,
+                               param=frac)]
+
+    def run(event_core=None):
+        fc = fleet.FleetConfig(
+            replicas=2, policy="least-outstanding", tick_s=0.01,
+            sim=sim_cfg, slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+            sched=sc, training=tc, max_virtual_s=120.0,
+            event_core=event_core,
+            fast_forward=(False if event_core is False else None))
+        return fleet.FleetSim(fc, trace,
+                              chaos_events=events).run()
+
+    rep = run()
+    replay = run()
+    off = run(event_core=False)
+    g = rep["training"]["gangs"]["llm0"]
+    sdc = g.get("sdc", {})
+    culprits = sdc.get("culprits", [])
+    # the culprit the bisection MUST name is a pure function of
+    # (gang, target): the same crc32 draw apply_sdc made
+    from kind_tpu_sim import topology as _topo
+    chips = _topo.make_slice(gang.accelerator,
+                             gang.topology).num_chips
+    expected_chip = zlib.crc32(
+        f"sdc:train-llm0:{plan.events[0].target}".encode(
+            "utf-8")) % chips
+    exact = (len(culprits) == 1
+             and culprits[0]["chip"] == expected_chip
+             and not sdc.get("active_defects"))
+    # rollback loses AT MOST one cadence of steps (the corrupted
+    # step itself never commits, so strictly < cadence)
+    lost_ok = all(c["lost_steps"] < cadence for c in culprits)
+    # binary search over a power-of-2 chip count: exactly
+    # ceil(log2(chips)) pricing rounds, every one in the ledger
+    want_rounds = int(_math.ceil(_math.log2(chips)))
+    bisects = [r for r in g["ledger"] if r["kind"] == "bisect"]
+    rounds_ok = (sdc.get("bisection_rounds") == want_rounds
+                 and len(bisects) == want_rounds
+                 and all(b["chip_s"] > 0 for b in bisects))
+    integ = rep.get("integrity", {})
+    counters = integ.get("counters", {})
+    identical = (_json.dumps(rep, sort_keys=True)
+                 == _json.dumps(replay, sort_keys=True))
+    core_identical = (_json.dumps(rep, sort_keys=True)
+                      == _json.dumps(off, sort_keys=True))
+    return {
+        "plan": plan.as_dict(),
+        "sdc_at_s": t_sdc,
+        "corrupt_frac": round(frac, 6),
+        "expected_chip": expected_chip,
+        "culprits": culprits,
+        "bisection_rounds": sdc.get("bisection_rounds"),
+        "expected_rounds": want_rounds,
+        "bisect_chip_s": round(sum(b["chip_s"]
+                                   for b in bisects), 6),
+        "lost_steps": g["lost_steps"],
+        "integrity": counters,
+        "ledger_ok": g["ledger_verify"]["ok"],
+        "gang_done": g["state"] == "done",
+        "replay_identical": bool(identical),
+        "event_core_identical": bool(core_identical),
+        "ok": bool(rep["ok"] and g["state"] == "done"
+                   and g["ledger_verify"]["ok"]
+                   and exact and lost_ok and rounds_ok
+                   and counters.get("sdc_detections", 0) >= 1
+                   and counters.get("chips_quarantined", 0) >= 1
+                   and identical and core_identical),
+    }
+
+
+@_scenario("sdc-serving-audit",
+           "a serving replica's chip silently corrupts its answers; "
+           "the sampled duplicate-compute audit lane catches the "
+           "mismatch, withholds the corrupted response, and "
+           "quarantines the chip — NOTHING corrupted serves after "
+           "detection — while the audit-off contrast run provably "
+           "serves every corrupted answer; and the audit tax keeps "
+           "p99 TTFT within 1.25x of audit-off, byte-identical on "
+           "replay")
+def _scenario_sdc_serving_audit(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import fleet
+
+    plan = ChaosSchedule(seed).plan(
+        kinds=("sdc_chip",), n_faults=1, horizon=8, targets=3)
+    spec = fleet.WorkloadSpec(process="poisson", rps=30.0,
+                              n_requests=200, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    span = max(r.arrival_s for r in trace)
+    sim_cfg = fleet.SimReplicaConfig(max_slots=4,
+                                     prefill_per_tok_s=0.002,
+                                     tpot_s=0.002)
+    victim = plan.events[0].target % 3
+    frac = max(0.3, plan.events[0].param)
+    t_sdc = round(span * 0.25, 6)
+    events = [fleet.ChaosEvent(at_s=t_sdc, action="sdc_chip",
+                               target=victim, param=frac)]
+
+    def run(audit_frac):
+        fc = fleet.FleetConfig(
+            replicas=3, policy="least-outstanding", tick_s=0.01,
+            sim=sim_cfg, slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+            audit_frac=audit_frac, max_virtual_s=120.0)
+        return fleet.FleetSim(fc, trace,
+                              chaos_events=events).run()
+
+    audit = run(0.4)
+    replay = run(0.4)
+    off = run(0.0)
+    c_on = audit["integrity"]["counters"]
+    c_off = off["integrity"]["counters"]
+    detections = audit["integrity"]["detections"]
+    # containment: the audit lane caught corrupted work before it
+    # served, named the defective chip, and pulled it — after
+    # detection NOTHING corrupted serves (an unsampled escape
+    # BEFORE detection is the audit_frac trade-off, and must stay
+    # strictly below the audit-off tally); audits off, the same
+    # seeded defect provably reaches users uncaught
+    detect_s = {d["replica"]: d["at_s"] for d in detections}
+    post = [e for e in audit["completions"]
+            if e.get("corrupted") and not e.get("sdc_caught")
+            and e["finish_s"] > detect_s.get(e["replica"],
+                                             float("inf"))]
+    # detection can come from EITHER side of the duplicate compute:
+    # a sampled corrupted original (corrupted_caught) or a clean
+    # original whose copy ran on the defective chip — both end in a
+    # mismatch and the quarantine, so the gate is mismatch-based
+    contained = (c_on.get("audit_mismatches", 0) >= 1
+                 and c_on.get("chips_quarantined", 0) >= 1
+                 and victim in detect_s
+                 and not post
+                 and c_on.get("corrupted_served", 0)
+                 < c_off.get("corrupted_served", 0))
+    escaped = (c_off.get("corrupted_served", 0) >= 1
+               and c_off.get("corrupted_caught", 0) == 0)
+    p99_on = _window_p99_ttft(audit["completions"], 0.0,
+                              span + 1.0)
+    p99_off = _window_p99_ttft(off["completions"], 0.0,
+                               span + 1.0)
+    tax_ok = (p99_on is not None and p99_off is not None
+              and p99_on <= 1.25 * p99_off)
+    identical = (_json.dumps(audit, sort_keys=True)
+                 == _json.dumps(replay, sort_keys=True))
+    return {
+        "plan": plan.as_dict(),
+        "sdc_at_s": t_sdc,
+        "victim_replica": victim,
+        "corrupt_frac": round(frac, 6),
+        "audit": {"frac": 0.4, "counters": c_on,
+                  "detections": detections},
+        "audit_off": {"counters": c_off},
+        "corrupted_served_on": c_on.get("corrupted_served", 0),
+        "corrupted_served_off": c_off.get("corrupted_served", 0),
+        "p99_audit_s": p99_on,
+        "p99_off_s": p99_off,
+        "p99_ratio": (round(p99_on / p99_off, 3)
+                      if p99_on and p99_off else None),
+        "replay_identical": bool(identical),
+        "ok": bool(audit["ok"] and off["ok"]
+                   and c_on.get("audits", 0) >= 1
+                   and contained and escaped and tax_ok
+                   and identical),
+    }
+
+
+@_scenario("correlated-rack-loss",
+           "one correlated domain fault takes out a whole rack's "
+           "nodes at once; the contrast run fails the SAME nodes "
+           "for the SAME per-node outage, drawn independently "
+           "(staggered) — the correlated draw is strictly worse: "
+           "more capacity dead simultaneously and a worse fault-"
+           "window p99 / SLO attainment, byte-identical on replay")
+def _scenario_correlated_rack_loss(seed: int) -> dict:
+    import json as _json
+
+    from kind_tpu_sim import fleet
+
+    plan = ChaosSchedule(seed).plan(
+        kinds=("correlated_domain_fault",), n_faults=1, horizon=8,
+        targets=2)
+    # heavy enough that losing a rack's worth of replicas SHOWS:
+    # at light load the crunch hides inside idle slot headroom
+    spec = fleet.WorkloadSpec(process="poisson", rps=90.0,
+                              n_requests=400, prompt_len=(8, 24),
+                              max_new=(4, 12))
+    trace = fleet.generate_trace(spec, seed)
+    span = max(r.arrival_s for r in trace)
+    sim_cfg = fleet.SimReplicaConfig(max_slots=4,
+                                     prefill_per_tok_s=0.002,
+                                     tpot_s=0.002)
+    # four 1-host pods, racked in pairs: every replica is a whole
+    # node, so a rack is exactly two replicas' worth of hardware
+    sc = fleet.FleetSchedConfig(
+        pods=(("tpu-v5-lite-podslice", "2x4"),) * 4, rack_pods=2)
+
+    def run(events):
+        fc = fleet.FleetConfig(
+            replicas=3, policy="least-outstanding", tick_s=0.01,
+            sim=sim_cfg, slo=fleet.SloPolicy(ttft_s=1.0, e2e_s=5.0),
+            sched=sc, max_virtual_s=120.0)
+        return fleet.FleetSim(fc, trace,
+                              chaos_events=events).run()
+
+    # a clean probe run resolves which rack actually HOSTS serving
+    # replicas — the blast radius must displace real capacity, not
+    # idle nodes — and the independent contrast must then fail the
+    # SAME hardware
+    probe = fleet.FleetSim(fleet.FleetConfig(replicas=3, sched=sc),
+                           [])
+    fds = probe.sched.inv.failure_domains()
+    node_names = sorted(
+        n["name"]
+        for d in probe.sched.inv.as_dict()["domains"].values()
+        for n in d["nodes"])
+    clean = run([])
+    replica_nodes = {
+        n for e in clean["scheduler"]["events"]
+        if e["type"] == "Scheduled"
+        and e["gang"].startswith("replica-")
+        for n in e["nodes"]}
+    fd = max(fds, key=lambda f: (len(
+        set(probe.sched.inv.failure_domain_nodes(f))
+        & replica_nodes), f))
+    target = fds.index(fd)
+    rack_nodes = sorted(probe.sched.inv.failure_domain_nodes(fd))
+    idxs = [node_names.index(n) for n in rack_nodes]
+    dur = round(span * 0.2, 6)
+    t0 = round(span * 0.3, 6)
+    correlated = [
+        fleet.ChaosEvent(at_s=t0, action="domain_fault",
+                         target=target),
+        fleet.ChaosEvent(at_s=round(t0 + dur, 6),
+                         action="domain_restore",
+                         target=target),
+    ]
+    # the independent draw: same nodes, same per-node outage DUR,
+    # but staggered — never more than one down at once
+    independent = []
+    for k, idx in enumerate(idxs):
+        at = round(t0 + k * dur, 6)
+        independent.append(fleet.ChaosEvent(
+            at_s=at, action="node_fail", target=idx))
+        independent.append(fleet.ChaosEvent(
+            at_s=round(at + dur, 6), action="node_restore",
+            target=idx))
+    rep_c = run(correlated)
+    replay = run(correlated)
+    rep_i = run(independent)
+    # worst window: requests arriving DURING the correlated outage
+    # — when the whole rack is dark vs one node of it
+    p99_c = _window_p99_ttft(rep_c["completions"], t0, t0 + dur)
+    p99_i = _window_p99_ttft(rep_i["completions"], t0, t0 + dur)
+
+    def _attain(rep):
+        comps = rep["completions"]
+        return (sum(1 for e in comps if e["slo_ok"])
+                / max(1, len(comps)))
+
+    att_c = round(_attain(rep_c), 6)
+    att_i = round(_attain(rep_i), 6)
+    # strictly worse: the whole rack is dead AT ONCE (len(idxs)
+    # simultaneous vs 1 staggered — structural, by construction)
+    # and the service FELT it — strictly worse fault-window p99,
+    # with whole-run attainment as the saturated-fleet fallback
+    worse = ((p99_c is not None and p99_i is not None
+              and p99_c > p99_i)
+             or att_c < att_i)
+    identical = (_json.dumps(rep_c, sort_keys=True)
+                 == _json.dumps(replay, sort_keys=True))
+    return {
+        "plan": plan.as_dict(),
+        "failure_domain": fd,
+        "rack_nodes": rack_nodes,
+        "outage_s": dur,
+        "fault_at_s": t0,
+        "max_simultaneous_dead": {"correlated": len(idxs),
+                                  "independent": 1},
+        "p99_window_s": {"correlated": p99_c,
+                         "independent": p99_i},
+        "slo_attainment": {"correlated": att_c,
+                           "independent": att_i},
+        "domain_faults": rep_c["integrity"]["counters"].get(
+            "domain_faults", 0),
+        "replay_identical": bool(identical),
+        "ok": bool(rep_c["ok"] and rep_i["ok"]
+                   and len(idxs) >= 2 and worse
+                   and rep_c["integrity"]["counters"].get(
+                       "domain_faults", 0) >= 1
                    and identical),
     }
 

@@ -75,6 +75,13 @@ def _add_tune_args(parser, target: str) -> None:
                  "pool ratios at the --policy placement (e.g. "
                  "1:3,2:2,3:1); default searches the full fleet "
                  "design space")
+        parser.add_argument(
+            "--sdc", action="store_true",
+            help="tune: search the integrity design space "
+                 "(docs/SDC.md) — audit_frac x replicas x policy, "
+                 "scored against dedicated sdc_chip storms when "
+                 "--chaos-budget > 0; survival demands zero "
+                 "uncontained corrupted responses")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -401,6 +408,18 @@ def build_parser() -> argparse.ArgumentParser:
              "warm-first; defaults --generations to v5e,v5p so "
              "every model has a generation it fits; knobs "
              "KIND_TPU_SIM_ZOO_*; report gains a 'zoo' section")
+    fl.add_argument(
+        "--audit-frac", type=float, default=None,
+        metavar="FRAC",
+        help="sample this fraction of served requests into the "
+             "duplicate-compute integrity audit lane (docs/SDC.md): "
+             "each audit re-executes on a second replica, a token-"
+             "crc mismatch triggers majority-of-three culprit "
+             "disambiguation and sticky chip quarantine; audit "
+             "occupancy is real (the integrity/throughput "
+             "trade-off is priced); default "
+             "KIND_TPU_SIM_SDC_AUDIT_FRAC or 0 = off; report "
+             "gains an 'integrity' section when SDC is active")
     fl.add_argument(
         "--generations", default=None, metavar="G1,G2",
         help="heterogeneous accelerator generations cycled over "
@@ -1206,6 +1225,10 @@ def _fleet_tune(args: argparse.Namespace) -> int:
         # which generations to buy and where the largest model
         # lives, priced by generation-weighted chip-seconds
         space = tune.zoo_space()
+    elif getattr(args, "sdc", False):
+        # the integrity search (docs/SDC.md): how much duplicate-
+        # compute auditing the cheapest zero-corruption fleet buys
+        space = tune.sdc_space()
     elif args.ratios:
         space = tune.ratio_space(
             tuple(args.ratios.split(",")), policy=args.policy)
@@ -1347,6 +1370,7 @@ def run_fleet(args: argparse.Namespace) -> int:
         tenancy=tenancy,
         zoo=zoo,
         generations=generations,
+        audit_frac=args.audit_frac,
         event_core=(False if args.no_event_core else None))
     clock = fleet.VirtualClock()
     factory = None

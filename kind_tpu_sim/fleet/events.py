@@ -51,10 +51,14 @@ LANE_AUTOSCALER = 4
 LANE_PLANNER = 5
 LANE_KV_TRANSFER = 6
 LANE_MODEL_SWAP = 7
+# sampled duplicate-compute integrity audits (docs/SDC.md): audit
+# copies of served requests re-execute on a second replica; the lane
+# orders them after every first-class occurrence at the same instant
+LANE_INTEGRITY_AUDIT = 8
 
 LANES = (LANE_ARRIVAL, LANE_COMPLETION, LANE_CHAOS,
          LANE_HEALTH_PROBE, LANE_AUTOSCALER, LANE_PLANNER,
-         LANE_KV_TRANSFER, LANE_MODEL_SWAP)
+         LANE_KV_TRANSFER, LANE_MODEL_SWAP, LANE_INTEGRITY_AUDIT)
 
 
 def resolve_event_core(value: Optional[bool] = None) -> bool:

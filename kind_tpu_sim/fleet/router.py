@@ -52,6 +52,11 @@ class ReplicaCompletion:
     tokens: int
     tokens_crc: int
     finish_reason: str  # length | stop | deadline_exceeded
+    # silent data corruption (docs/SDC.md): ground truth that this
+    # stream's fingerprint is wrong — consumers must NOT branch on it
+    # (detection works from tokens_crc comparison); it exists so the
+    # no-corruption-escapes invariant can audit the auditors
+    corrupted: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,6 +133,12 @@ class SimReplica:
         # service-time inflation — 1.0 is nominal; the slow_replica
         # chaos kind and degraded-ICI-domain placement both set it
         self.slowdown = 1.0
+        # silent-data-corruption lever (docs/SDC.md): the sdc_chip
+        # chaos kind sets the deterministic fraction of completions
+        # this replica corrupts — latency stays nominal, only the
+        # token fingerprint goes wrong, and unlike every windowed
+        # fault it stays set until quarantine pulls the chip
+        self.corrupt_frac = 0.0
         self.queue: List[TraceRequest] = []
         self._slots: List[Optional[dict]] = [None] * cfg.max_slots
         # group id -> True, LRU-bounded: the PrefixCache stand-in
@@ -175,6 +186,15 @@ class SimReplica:
         remainder-carry semantics the gray scenarios were built on);
         every subsequent token picks up the new factor."""
         self.slowdown = max(1.0, float(factor))
+        self._touch()
+
+    def set_corrupt(self, frac: float) -> None:
+        """Silent data corruption (docs/SDC.md): make this replica's
+        chip defective — a deterministic ``frac`` of the completions
+        it produces carry a wrong (replica-keyed) token fingerprint
+        while every timing stays nominal. 0.0 restores clean output
+        (the chip-replaced path)."""
+        self.corrupt_frac = max(0.0, min(1.0, float(frac)))
         self._touch()
 
     def cancel(self, request_id: str) -> bool:
@@ -507,8 +527,23 @@ class SimReplica:
     def _complete(self, slot: dict, finish_s: float,
                   reason: str) -> ReplicaCompletion:
         req = slot["req"]
-        crc = zlib.crc32(repr((req.request_id, req.seed,
+        # audit copies (``~a`` suffix, docs/SDC.md) fingerprint the
+        # BASE request so duplicate-compute comparison is apples to
+        # apples — a no-op for every other id (retries use ``~r``)
+        base_id = req.request_id.split("~a", 1)[0]
+        crc = zlib.crc32(repr((base_id, req.seed,
                                slot["tokens"])).encode("utf-8"))
+        corrupted = False
+        if (self.corrupt_frac > 0.0 and reason == "length"
+                and zlib.crc32(
+                    f"sdc:{self.replica_id}:{base_id}".encode(
+                        "utf-8")) / 2**32 < self.corrupt_frac):
+            # the defective chip is fast-but-wrong: timings stand,
+            # the fingerprint flips — perturbed by REPLICA identity
+            # so two defective chips never agree in error
+            crc ^= zlib.crc32(
+                f"sdcbits:{self.replica_id}".encode("utf-8"))
+            corrupted = True
         return ReplicaCompletion(
             request=req,
             dispatch_s=round(slot["dispatch_s"], 9),
@@ -517,7 +552,8 @@ class SimReplica:
             finish_s=round(finish_s, 9),
             tokens=slot["tokens"],
             tokens_crc=crc,
-            finish_reason=reason)
+            finish_reason=reason,
+            corrupted=corrupted)
 
     def fail(self, now: float) -> List[TraceRequest]:
         """Preempt this replica: every queued and in-flight request
@@ -552,6 +588,8 @@ class SimReplica:
             out["phase"] = self.phase
         if self.slowdown != 1.0:
             out["slowdown"] = round(self.slowdown, 6)
+        if self.corrupt_frac:
+            out["corrupt_frac"] = round(self.corrupt_frac, 6)
         if self.prefix_hits or self.prefix_misses:
             out["prefix"] = {"hits": self.prefix_hits,
                              "misses": self.prefix_misses}

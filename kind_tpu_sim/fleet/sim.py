@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import warnings
+import zlib
 from collections import deque
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -58,6 +59,7 @@ from kind_tpu_sim.fleet.events import (
     LANE_AUTOSCALER,
     LANE_CHAOS,
     LANE_COMPLETION,
+    LANE_INTEGRITY_AUDIT,
     LANE_KV_TRANSFER,
     LANE_MODEL_SWAP,
     DueSet,
@@ -132,6 +134,18 @@ def resolve_fast_forward(value: Optional[bool] = None) -> bool:
     return bool(knobs.get(FF_ENV))
 
 
+def resolve_audit_frac(value: Optional[float] = None) -> float:
+    """Explicit value > env (KIND_TPU_SIM_SDC_AUDIT_FRAC) > 0.0.
+
+    The sampled duplicate-compute integrity audit lane (docs/SDC.md):
+    this fraction of served requests re-execute on a second replica
+    and their token fingerprints are compared. 0.0 (the default)
+    keeps the lane off and every pre-SDC replay byte-identical."""
+    if value is not None:
+        return max(0.0, min(1.0, float(value)))
+    return max(0.0, min(1.0, float(knobs.get(knobs.SDC_AUDIT_FRAC))))
+
+
 @dataclasses.dataclass(frozen=True)
 class ChaosEvent:
     """A fleet-level fault: ``preempt`` displaces a replica's whole
@@ -149,7 +163,18 @@ class ChaosEvent:
     bandwidth factor to ``param`` (scheduler-backed fleets only —
     every replica placed there inflates by the modeled collective
     share, parallel/collectives.ici_slowdown), ``link_restore``
-    heals the domain."""
+    heals the domain.
+
+    SDC actions (docs/SDC.md) make the OUTPUT the casualty:
+    ``sdc_chip`` turns replica ``target``'s chip defective (it
+    corrupts fraction ``param`` of its completions while every
+    timing stays nominal — and unlike the windowed faults above
+    there is NO paired heal event; only integrity quarantine stops
+    it); ``sdc_train_chip`` plants the defect on a chip of training
+    gang ``target`` instead; ``domain_fault`` fails every node of
+    one rack/power failure domain at once (scheduler-backed fleets
+    with ``FleetSchedConfig.rack_pods``), ``domain_restore`` heals
+    the domain."""
 
     at_s: float
     action: str   # preempt | restore | node_* | slow | unslow | link_*
@@ -190,9 +215,15 @@ class FleetSchedConfig:
     # (parallel/collectives.ici_slowdown) applies to replicas placed
     # on a degraded domain, and to their warm-up on rebind
     ici_fraction: float = 0.35
+    # correlated-failure grouping (docs/SDC.md): every rack_pods
+    # consecutive pods share one rack/power failure_domain label —
+    # the blast radius domain_fault chaos takes out in one draw.
+    # None (the default) leaves the inventory ungrouped and every
+    # pre-SDC report byte-identical.
+    rack_pods: Optional[int] = None
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "pods": [list(p) for p in self.pods],
             "policy": self.policy,
             "bind_s": self.bind_s,
@@ -202,6 +233,9 @@ class FleetSchedConfig:
             "ici_fraction": self.ici_fraction,
             "zone": self.zone,
         }
+        if self.rack_pods is not None:
+            out["rack_pods"] = self.rack_pods
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -286,6 +320,12 @@ class FleetConfig:
     # diff clean on vs off, so it stays OUT of as_dict() too.
     # contractlint: ok(drift) -- execution strategy: heap-core on vs off reports must diff clean
     event_core: Optional[bool] = None
+    # integrity audit lane (docs/SDC.md): the fraction of served
+    # requests re-executed on a second replica with fingerprint
+    # comparison (None -> resolve_audit_frac(), default 0 = off).
+    # Audit copies are REAL occupancy — they take replica slots, so
+    # the integrity/throughput trade-off is priced, not free.
+    audit_frac: Optional[float] = None
     # columnar replica state (None -> resolve_columnar(), default
     # on): keeps the analytic fleet's wake scans / tick fan-out /
     # least-outstanding routing in numpy struct-of-arrays
@@ -330,6 +370,8 @@ class FleetConfig:
             out["generations"] = list(self.generations)
         if self.zoo_large_model_gen is not None:
             out["zoo_large_model_gen"] = self.zoo_large_model_gen
+        if self.audit_frac is not None:
+            out["audit_frac"] = self.audit_frac
         return out
 
 
@@ -599,6 +641,22 @@ class FleetSim:
         self._hedges: Dict[str, dict] = {}
         self._hedge_dropped: set = set()
         self._completed_ids: set = set()
+        # silent data corruption (docs/SDC.md): the sampled
+        # duplicate-compute audit lane plus integrity detections.
+        # All of it inert (and byte-invisible) at audit_frac 0 with
+        # no sdc chaos in the plan.
+        self._audit_frac = resolve_audit_frac(cfg.audit_frac)
+        if self._audit_frac > 0.0 and self._disagg is not None:
+            raise ValueError(
+                "FleetConfig.audit_frac does not compose with "
+                "disagg phase pools: audit copies are whole-request "
+                "re-executions on unified replicas")
+        self._audit_heap = EventHeap()
+        self._audits: Dict[str, dict] = {}
+        # replica id -> virtual time its corruption was DETECTED
+        # (the no-corruption-escapes-after-detection anchor)
+        self._sdc_detect_s: Dict[int, float] = {}
+        self._sdc_active = self._audit_frac > 0.0
         # training tenancy (docs/TRAINING.md): gangs co-scheduled
         # under serving on the same inventory, strict priority
         self.trainer: Optional[TrainingTenant] = None
@@ -666,7 +724,8 @@ class FleetSim:
         from kind_tpu_sim import sched as sched_mod
 
         self.sched = sched_mod.ClusterScheduler(
-            sched_mod.build_inventory(list(sc.pods), zone=sc.zone),
+            sched_mod.build_inventory(list(sc.pods), zone=sc.zone,
+                                      rack_pods=sc.rack_pods),
             sched_mod.SchedConfig(policy=sc.policy,
                                   bind_s=sc.bind_s),
             on_evict=self._on_gang_evict)
@@ -785,6 +844,34 @@ class FleetSim:
             metrics.recovery_log().record(
                 f"fleet_{ev.action}", node=node,
                 at_s=round(now, 6))
+
+    def _apply_domain_chaos(self, ev: "ChaosEvent",
+                            now: float) -> None:
+        """Correlated failure (docs/SDC.md): one draw fails EVERY
+        node sharing a rack/power failure domain — the point of
+        modeling correlation is that this is strictly worse than the
+        same chip count failing independently."""
+        from kind_tpu_sim import sched as sched_mod
+
+        fds = self.sched.inv.failure_domains()
+        if not fds:
+            raise ValueError(
+                "domain chaos needs correlated failure domains "
+                "(set FleetSchedConfig.rack_pods)")
+        fd = fds[ev.target % len(fds)]
+        action = ("node_fail" if ev.action == "domain_fault"
+                  else "node_restore")
+        nodes = self.sched.inv.failure_domain_nodes(fd)
+        for node in nodes:
+            sched_mod.apply_node_event(self.sched, action, node,
+                                       now)
+        self._sdc_active = True
+        metrics.integrity_board().incr(
+            "domain_faults" if action == "node_fail"
+            else "domain_restores")
+        metrics.recovery_log().record(
+            f"fleet_{ev.action}", failure_domain=fd,
+            nodes=len(nodes), at_s=round(now, 6))
 
     # -- gray failures (docs/HEALTH.md) -------------------------------
 
@@ -1276,6 +1363,19 @@ class FleetSim:
         hedge duplicates, not legitimate re-prefills — without this
         a request displaced mid-decode would re-prefill, hit the
         dedupe, and vanish)."""
+        if self._audits:
+            # a displaced audit copy dies with its replica (it is
+            # synthetic — never user work to preserve): the audit
+            # concludes on the results it already has
+            kept = []
+            for req in displaced:
+                rid = getattr(req, "request_id", "")
+                if "~a" in rid:
+                    self._conclude_audit(rid.split("~a", 1)[0],
+                                         self._now)
+                    continue
+                kept.append(req)
+            displaced = kept
         if self._disagg is not None:
             for req in displaced:
                 base = (req.request
@@ -1310,6 +1410,176 @@ class FleetSim:
         at = round(now + delay, 6)
         self._retry_heap.push(at, LANE_ARRIVAL, dataclasses.replace(
             req, request_id=f"{base}~r{attempt}", arrival_s=at))
+
+    # -- silent data corruption (docs/SDC.md) -------------------------
+
+    def _dispatch_audit(self, base_id: str, now: float) -> None:
+        """A due audit: re-execute the request on a replica that
+        produced NONE of its existing results. Copies are submitted
+        directly (the health-probe precedent) — real slot occupancy,
+        never SLO traffic. With no eligible second replica the audit
+        is inconclusive and the original answer stands."""
+        st = self._audits.get(base_id)
+        if st is None:
+            return
+        req = st["req"]
+        model = getattr(req, "model", "")
+        target = None
+        for r in self.replicas:
+            if not r.healthy or r.replica_id in st["results"]:
+                continue
+            if (self.health is not None
+                    and self.health.quarantined(
+                        f"replica-{r.replica_id}")):
+                continue
+            can = getattr(r, "can_serve", None)
+            if can is not None and not can(model):
+                continue
+            target = r
+            break
+        st["copies"] += 1
+        copy = dataclasses.replace(
+            req,
+            request_id=f"{base_id}~a{st['copies']}",
+            arrival_s=round(now, 6),
+            deadline_s=None)
+        if target is None or not target.submit(copy, now):
+            self._conclude_audit(base_id, now)
+            return
+        metrics.integrity_board().incr("audit_copies")
+
+    def _on_audit_result(self, replica,
+                         comp: ReplicaCompletion,
+                         now: float) -> None:
+        """An audit copy finished: compare fingerprints. Agreement
+        closes the audit; the first disagreement escalates to
+        majority-of-three (one more copy on a third replica)."""
+        base_id = comp.request.request_id.split("~a", 1)[0]
+        st = self._audits.get(base_id)
+        if st is None:
+            return
+        if comp.finish_reason != "length":
+            # the copy died (deadline, displacement): inconclusive
+            self._conclude_audit(base_id, now)
+            return
+        st["results"][replica.replica_id] = comp.tokens_crc
+        st["order"].append(replica.replica_id)
+        if (len(set(st["results"].values())) == 1
+                or len(st["order"]) >= 3):
+            self._conclude_audit(base_id, now)
+            return
+        # two answers disagree: somebody is corrupting — a third
+        # copy disambiguates (replica-keyed corruption means two
+        # defective chips can never agree in error)
+        self._audit_heap.push(comp.finish_s,
+                              LANE_INTEGRITY_AUDIT, base_id)
+
+    def _conclude_audit(self, base_id: str, now: float) -> None:
+        """Close one audit: majority names the culprit(s), ties
+        break deterministically, and the ground-truth counters
+        record whether a corrupted answer was caught before serve
+        or escaped."""
+        st = self._audits.pop(base_id, None)
+        if st is None:
+            return
+        results = st["results"]
+        order = st["order"]
+        caught = False
+        counts: Dict[int, int] = {}
+        for c in results.values():
+            counts[c] = counts.get(c, 0) + 1
+        if len(order) >= 2 and max(counts.values()) < len(order):
+            metrics.integrity_board().incr("audit_mismatches")
+            if len(order) >= 3 and max(counts.values()) >= 2:
+                good = next(c for c in counts
+                            if counts[c] >= 2)
+                culprits = [rid for rid in order
+                            if results[rid] != good]
+            elif len(order) >= 3:
+                # three-way disagreement: at least two defective
+                # chips — both ORIGINAL suspects are pulled and the
+                # freshest answer is served
+                culprits = order[:2]
+            else:
+                # no third replica was available: deterministic
+                # tie-break — the original producer is the suspect
+                # (conservative; a false positive here is charged
+                # to the audit lane, not hidden)
+                culprits = order[:1]
+            for rid in culprits:
+                self._sdc_quarantine(rid, now, cause="audit")
+            caught = order[0] in culprits
+        if st["corrupted"]:
+            if caught:
+                # the corrupted answer was withheld and replaced by
+                # the verified copy before reaching the user
+                st["entry"]["sdc_caught"] = True
+                metrics.integrity_board().incr("corrupted_caught")
+            else:
+                metrics.integrity_board().incr("corrupted_served")
+
+    def _sdc_quarantine(self, rid: int, now: float,
+                        cause: str) -> None:
+        """Integrity containment: the named replica's chip is
+        defective — pull it NOW. The replica fails (displaced work
+        requeues onto clean hardware; post-detection it can produce
+        nothing further), the detector gets the STICKY integrity
+        quarantine, and on a scheduler-backed fleet the defective
+        chip leaves the node's allocatable capacity before the gang
+        rebinds elsewhere."""
+        if rid in self._sdc_detect_s:
+            return
+        self._sdc_detect_s[rid] = round(now, 6)
+        self._sdc_active = True
+        metrics.integrity_board().incr("chips_quarantined")
+        metrics.recovery_log().record(
+            "fleet_sdc_quarantine", replica=rid, cause=cause,
+            at_s=round(now, 6))
+        if self.health is not None:
+            self.health.record_integrity(f"replica-{rid}", now,
+                                         cause=cause)
+        name = f"replica-{rid}"
+        if (self.sched is not None
+                and self.sched.bound.get(name) is not None):
+            gang = self.sched.bound[name]
+            # chip-granular, not whole-node: ONE chip leaves the
+            # anchor node's capacity; the rest of the host serves on
+            self.sched.inv.quarantine_chips(
+                gang.placement.node_names[0], 1)
+            self.sched.evict_gang(
+                name, now,
+                reason="sdc: integrity quarantine; rebinding off "
+                       "the defective chip")
+            return
+        victim = self._replica_by_id(rid)
+        if victim is not None and victim.healthy:
+            displaced = victim.fail(now)
+            self._requeue_front(displaced)
+            self.preemptions += 1
+            metrics.recovery_log().record(
+                "fleet_sdc_chip_pulled", replica=rid,
+                displaced=len(displaced), at_s=round(now, 6))
+
+    def _on_train_sdc(self, verdict: dict, now: float) -> None:
+        """A training gang's bisection named its culprit chip: hand
+        it to quarantine — sticky integrity quarantine on the chip
+        component, chip-granular capacity removal on its node."""
+        gang = verdict["gang"]
+        chip = verdict["chip"]
+        self._sdc_active = True
+        metrics.integrity_board().incr("chips_quarantined")
+        metrics.recovery_log().record(
+            "fleet_sdc_train_quarantine", gang=gang, chip=chip,
+            at_s=round(now, 6))
+        if self.health is not None:
+            self.health.record_integrity(f"{gang}-chip-{chip}", now,
+                                         cause="bisection")
+        bound = self.sched.bound.get(gang) if self.sched else None
+        if bound is not None:
+            names = bound.placement.node_names
+            per = max(1, bound.placement.chips_per_node)
+            node = names[min(chip // per, len(names) - 1)]
+            self.sched.inv.quarantine_chips(node, 1)
 
     # -- bookkeeping ---------------------------------------------------
 
@@ -1346,7 +1616,42 @@ class FleetSim:
             # same contract: unzooed completion logs stay
             # byte-identical
             entry["model"] = req.model
+        corrupted = getattr(comp, "corrupted", False)
+        if corrupted:
+            # ground truth (docs/SDC.md), conditional: pre-SDC logs
+            # keep their bytes
+            entry["corrupted"] = True
+            metrics.integrity_board().incr("corrupted_produced")
         self.log.append(entry)
+        if (self._audit_frac > 0.0 and replica_id >= 0
+                and comp.finish_reason == "length"
+                and req.request_id not in self._audits
+                and zlib.crc32(
+                    ("audit:%d" % zlib.crc32(
+                        req.request_id.encode("utf-8"))).encode(
+                        "utf-8")) / 2**32 < self._audit_frac):
+                # nested crc, NOT crc32(f"audit:{id}"): crc32 is
+                # affine in the id bits, so any single-pass draw
+                # over same-length ids differs from the replica's
+                # "sdc:{rid}:{id}" corruption draw by a CONSTANT
+                # XOR — deterministically (anti-)correlated, and
+                # corrupted work could dodge sampling forever. The
+                # inner crc's decimal re-encoding breaks linearity.
+            # sampled into the duplicate-compute audit lane: the
+            # response is withheld until a second replica's
+            # re-execution agrees (or the majority decides)
+            self._audits[req.request_id] = {
+                "req": req, "entry": entry,
+                "corrupted": corrupted,
+                "results": {replica_id: comp.tokens_crc},
+                "order": [replica_id], "copies": 0}
+            self._audit_heap.push(comp.finish_s,
+                                  LANE_INTEGRITY_AUDIT,
+                                  req.request_id)
+            metrics.integrity_board().incr("audits")
+        elif corrupted:
+            # not sampled: the wrong answer reaches the user
+            metrics.integrity_board().incr("corrupted_served")
         if self._zoo is not None and getattr(req, "model", ""):
             mtracker = self._model_trackers.get(req.model)
             if mtracker is None:
@@ -1448,6 +1753,23 @@ class FleetSim:
                     "fleet_model_swap_evict", evicted=evicted,
                     at_s=round(now, 6))
                 continue
+            if ev.action == "sdc_train_chip":
+                if self.trainer is None:
+                    raise ValueError(
+                        "sdc_train_chip chaos needs a training "
+                        "tenancy (FleetConfig.training)")
+                frac = (ev.param if ev.param > 0
+                        else float(knobs.get(knobs.SDC_RATE)))
+                self._sdc_active = True
+                self.trainer.apply_sdc(ev.target, frac, now)
+                continue
+            if ev.action in ("domain_fault", "domain_restore"):
+                if self.sched is None:
+                    raise ValueError(
+                        f"{ev.action} chaos needs a scheduler-"
+                        "backed fleet (FleetConfig.sched)")
+                self._apply_domain_chaos(ev, now)
+                continue
             if ev.action.startswith("node_"):
                 if self.sched is None:
                     raise ValueError(
@@ -1484,6 +1806,17 @@ class FleetSim:
                 metrics.recovery_log().record(
                     "fleet_replica_unslow", replica=ev.target,
                     at_s=round(now, 6))
+            elif ev.action == "sdc_chip":
+                # the defective chip (docs/SDC.md): no heal event
+                # exists — only integrity quarantine stops it
+                frac = (ev.param if ev.param > 0
+                        else float(knobs.get(knobs.SDC_RATE)))
+                if hasattr(victim, "set_corrupt"):
+                    victim.set_corrupt(frac)
+                    self._sdc_active = True
+                metrics.recovery_log().record(
+                    "fleet_sdc_chip", replica=ev.target,
+                    frac=round(frac, 6), at_s=round(now, 6))
             elif ev.action == "preempt" and victim.healthy:
                 displaced = victim.fail(now)
                 self._requeue_front(displaced)
@@ -1571,11 +1904,17 @@ class FleetSim:
                 # progress, release finished gangs' inventory —
                 # all BEFORE the scheduling pass sees the queue
                 self.trainer.tick(now)
+                for verdict in self.trainer.drain_sdc_verdicts():
+                    self._on_train_sdc(verdict, now)
             self._drain_migrations(now)
             self._sched_step(now)
             healed = self._rebinding.pop_due(now)
             for replica in healed:
                 replica.restore(now)
+                if getattr(replica, "corrupt_frac", 0.0):
+                    # the gang rebound onto replacement hardware —
+                    # the defective chip stayed behind in quarantine
+                    replica.set_corrupt(0.0)
                 metrics.recovery_log().record(
                     "fleet_gang_rebound",
                     replica=replica.replica_id,
@@ -1613,6 +1952,12 @@ class FleetSim:
             # drained in deterministic (ready, lane, seq) order
             for ev in self._swap_heap.pop_due(now):
                 self._swap_log.append(ev.as_dict())
+        if self._audits or self._audit_heap:
+            # due integrity audits: dispatch the duplicate-compute
+            # copy (or the round-3 tiebreaker) as REAL occupancy on
+            # a second replica — docs/SDC.md "audit economics"
+            for base_id in self._audit_heap.pop_due(now):
+                self._dispatch_audit(base_id, now)
         if self.health is not None and (pending
                                         or self.router.queue):
             # probe only while user traffic still flows — an
@@ -1642,6 +1987,11 @@ class FleetSim:
                     self._observe_health(
                         replica.replica_id, comp, now)
                     continue
+                if "~a" in comp.request.request_id:
+                    # integrity audit copy: feeds the vote, never
+                    # the SLO log (the original already did)
+                    self._on_audit_result(replica, comp, now)
+                    continue
                 if comp.finish_reason == "prefill_done":
                     # not a terminal outcome: the request's KV
                     # leaves for the decode pool; only the decode
@@ -1652,6 +2002,9 @@ class FleetSim:
                 self._handle_completion(replica, comp, now)
         for replica in list(self._draining):
             for comp in replica.tick(now, tick):
+                if "~a" in comp.request.request_id:
+                    self._on_audit_result(replica, comp, now)
+                    continue
                 if comp.finish_reason == "prefill_done":
                     self._on_prefill_done(replica, comp, now)
                     continue
@@ -1685,6 +2038,7 @@ class FleetSim:
             not pending and not self.router.queue
             and not self._kv_heap and not self.router.kv_queue
             and not self._swap_heap
+            and not self._audit_heap and not self._audits
             and not self._warming
             and (self._cols.all_idle() if self._cols is not None
                  else all(r.idle() for r in self.replicas
@@ -1714,6 +2068,8 @@ class FleetSim:
             return False
         if (self._kv_heap or self.router.kv_queue
                 or self._swap_heap):
+            return False
+        if self._audit_heap or self._audits:
             return False
         # slowdown != 1 disqualifies even an idle replica: an
         # EngineReplica's stride counter advances per tick() call,
@@ -1768,6 +2124,9 @@ class FleetSim:
         # a finished model swap applies at its weight-load-ready
         # instant (bookkeeping drain into the swap ledger)
         due.at(self._swap_heap.peek_time())
+        # a due integrity audit dispatches its duplicate-compute
+        # copy at the original completion's finish instant
+        due.at(self._audit_heap.peek_time())
         if self.trainer is not None:
             # gang arrivals and segment completions are boundary-
             # condition events; mid-segment progress is closed form
@@ -1909,6 +2268,7 @@ class FleetSim:
         disagg_before = metrics.disagg_board().counts()
         tenant_before = metrics.tenant_board().counts()
         zoo_before = metrics.zoo_board().counts()
+        integrity_before = metrics.integrity_board().counts()
         tick = resolve_tick_s(self.cfg.tick_s)
         pending = self._pending
         while True:
@@ -1985,6 +2345,19 @@ class FleetSim:
                 },
                 "counters": metrics.zoo_board().snapshot_since(
                     zoo_before),
+            }
+        if self._sdc_active:
+            # conditional: fleets that never saw an SDC fault (and
+            # never enabled audits) keep their historical report
+            # bytes — the byte-identical-replay contract
+            report["integrity"] = {
+                "audit_frac": round(self._audit_frac, 6),
+                "detections": [
+                    {"replica": rid, "at_s": t}
+                    for rid, t in sorted(
+                        self._sdc_detect_s.items())],
+                "counters": metrics.integrity_board()
+                .snapshot_since(integrity_before),
             }
         if self.preemptions:
             report["preemptions"] = self.preemptions

@@ -397,6 +397,18 @@ class TrainingGang:
         self.lost_steps = 0
         # one growth rung per outstanding spot grant
         self.spot_rungs = 0
+        # -- silent data corruption (docs/SDC.md): chip index ->
+        # corrupt fraction for every live defective chip in the
+        # gang; a defect PERSISTS until bisection names the chip
+        # and quarantine pulls it
+        self.sdc_chips: Dict[int, float] = {}
+        # first step index whose loss the live defects perturb —
+        # the closed-form detection event (None = clean horizon)
+        self._sdc_spike_step: Optional[int] = None
+        # named culprits (chip, detection step, bisection rounds)
+        self.sdc_culprits: List[dict] = []
+        # verdicts awaiting the fleet driver's quarantine drain
+        self.sdc_verdicts_out: List[dict] = []
 
     # -- the closed-form timeline ---------------------------------
 
@@ -451,7 +463,155 @@ class TrainingGang:
         noise = zlib.crc32(
             f"{self.cfg.name}:{self.cfg.loss_seed}:{step}"
             .encode("utf-8")) / 2.0 ** 32
-        return 4.0 / (1.0 + 0.05 * step) + 0.01 * noise
+        loss = 4.0 / (1.0 + 0.05 * step) + 0.01 * noise
+        if self._sdc_corrupts(step):
+            # a defective chip perturbed this step's gradient: the
+            # spike (+1.0 over a <=0.01 noise band) is what the
+            # closed-form checker detects (docs/SDC.md)
+            loss += 1.0
+        return loss
+
+    # -- silent data corruption (docs/SDC.md) ----------------------
+
+    def _sdc_corrupts(self, step: int) -> bool:
+        """Whether any LIVE defective chip corrupts ``step`` — a
+        pure function of (gang name, chip, step, loss_seed, frac),
+        so re-running the step during bisection reproduces the
+        identical verdict."""
+        for chip in sorted(self.sdc_chips):
+            frac = self.sdc_chips[chip]
+            draw = zlib.crc32(
+                f"sdc:{self.cfg.name}:{chip}:{step}:"
+                f"{self.cfg.loss_seed}".encode("utf-8")) / 2.0 ** 32
+            if draw < frac:
+                return True
+        return False
+
+    def _recompute_spike(self, from_step: int) -> None:
+        """First corrupted step at or after ``from_step`` (bounded
+        by total_steps) — the detection horizon advance() clamps
+        segment progress against."""
+        self._sdc_spike_step = None
+        if not self.sdc_chips:
+            return
+        for step in range(max(1, from_step),
+                          self.cfg.total_steps + 1):
+            if self._sdc_corrupts(step):
+                self._sdc_spike_step = step
+                return
+
+    def seed_defect(self, chip: int, frac: float,
+                    now: float) -> None:
+        """Chaos seeded a defective chip: from ``now`` on, a
+        ``frac`` share of this gang's steps compute a silently wrong
+        gradient. Progress through ``now`` commits clean first —
+        the defect cannot retroactively corrupt finished work."""
+        self.advance(now)
+        if self.state == "done":
+            return
+        self.sdc_chips[int(chip)] = max(0.0, min(1.0, float(frac)))
+        self._recompute_spike(self.steps_done + 1)
+
+    def next_event_s(self) -> Optional[float]:
+        """The segment's next boundary-condition instant for the
+        event core: the SDC detection point when a spike is ahead
+        (the loss checker fires there, docs/SDC.md), else the
+        ordinary segment completion."""
+        if self.state != "running":
+            return None
+        if self._sdc_spike_step is not None:
+            return self.seg_t0 + self._f(
+                self._sdc_spike_step - self.seg_step0)
+        return self.completion_s()
+
+    def _run_bisection(self, detect_step: int,
+                       ts: float) -> Tuple[int, int, float]:
+        """Deterministic culprit bisection: binary-search the gang's
+        chip range by re-running the suspect segment (the rolled-
+        back steps) on the candidate half-gang — the spike
+        reproduces iff the defective chip is in the half, because
+        :meth:`_sdc_corrupts` is a pure function of (chip, step).
+        Every round is priced as REAL chip-seconds in the ledger
+        (``bisect`` records). Returns (culprit chip, rounds, total
+        re-run virtual seconds) — rounds <= ceil(log2(chips))."""
+        chips = topo.make_slice(self.cfg.accelerator,
+                                self.topology).num_chips
+        culprit = min(self.sdc_chips)
+        rerun = max(1, detect_step - self.last_ckpt_step)
+        round_s = rerun * self.step_s
+        lo, hi = 0, chips
+        rounds = 0
+        t = ts
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            hit = lo <= culprit < mid
+            rounds += 1
+            t += round_s
+            self.ledger.append({
+                "kind": "bisect", "round": rounds,
+                "chips_lo": lo, "chips_hi": mid, "hit": hit,
+                "steps": rerun,
+                "chip_s": round(round_s * (mid - lo), 6),
+                "at_s": round(t, 6),
+            })
+            metrics.integrity_board().incr("bisection_steps")
+            if hit:
+                hi = mid
+            else:
+                lo = mid
+        return culprit, rounds, t - ts
+
+    def _sdc_detect(self, spike_step: int, ts: float) -> None:
+        """The loss checker fired at ``spike_step``'s completion:
+        roll back to the last cadence checkpoint (PreemptionGuard
+        semantics — the corrupted step itself never committed),
+        bisect to the culprit chip, hand the verdict to the fleet
+        driver's quarantine drain, and resume from the checkpoint
+        with the defect retired."""
+        self._close_segment(ts)
+        lost = self.steps_done - self.last_ckpt_step
+        if lost:
+            self.ledger.append({
+                "kind": "rollback",
+                "from_step": self.steps_done,
+                "to_step": self.last_ckpt_step,
+                "at_s": round(ts, 6),
+                "lost_steps": lost,
+                "cause": "sdc",
+            })
+            self.lost_steps += lost
+            self.steps_done = self.last_ckpt_step
+            metrics.integrity_board().incr("steps_rolled_back",
+                                           lost)
+        metrics.integrity_board().incr("sdc_detections")
+        culprit, rounds, bisect_s = self._run_bisection(
+            spike_step, ts)
+        frac = self.sdc_chips.pop(culprit)
+        record = {
+            "chip": culprit,
+            "corrupt_frac": round(frac, 6),
+            "detected_step": spike_step,
+            "detected_at_s": round(ts, 6),
+            "bisection_rounds": rounds,
+            "lost_steps": lost,
+        }
+        self.sdc_culprits.append(record)
+        self.sdc_verdicts_out.append(dict(record))
+        resume = ts + bisect_s + self.restart_s
+        self.restart_time_s += self.restart_s
+        self.seg_t0 = resume
+        self.seg_step0 = self.steps_done
+        self.ledger.append({
+            "kind": "sdc", "step": spike_step,
+            "culprit_chip": culprit, "rounds": rounds,
+            "at_s": round(ts, 6), "resume_s": round(resume, 6),
+        })
+        metrics.train_board().incr("sdc_detections")
+        metrics.recovery_log().record(
+            "train_sdc_detected", gang=self.cfg.name,
+            step=spike_step, chip=culprit, rounds=rounds,
+            at_s=round(ts, 6))
+        self._recompute_spike(self.steps_done + 1)
 
     # -- lifecycle -------------------------------------------------
 
@@ -459,7 +619,28 @@ class TrainingGang:
         """Commit progress through ``now``: closed-form step count,
         cadence checkpoint records for every boundary crossed, and
         the done transition (with its final checkpoint) when the
-        last step lands."""
+        last step lands. When a defective chip's loss spike lies in
+        the window, the clean prefix commits first, the detection /
+        rollback / bisection sequence runs at its closed-form
+        instants, and the loop resumes committing in the reopened
+        segment — one call or a hundred land on identical ledgers
+        (partition invariance, docs/SDC.md)."""
+        while self.state == "running":
+            spike = self._sdc_spike_step
+            if spike is None or self.seg_t0 is None:
+                break
+            rel = spike - self.seg_step0
+            ts = self.seg_t0 + self._f(rel)
+            if now < ts:
+                break
+            # commit exactly the clean prefix (through spike-1 —
+            # the corrupted step itself must never commit), then
+            # detect at the spike step's completion instant
+            self._advance_core(self.seg_t0 + self._f(rel - 1))
+            self._sdc_detect(spike, ts)
+        self._advance_core(now)
+
+    def _advance_core(self, now: float) -> None:
         if self.state != "running":
             return
         n = self._steps_at(now)
@@ -578,6 +759,10 @@ class TrainingGang:
         if self.first_bound_s is None:
             self.first_bound_s = round(ready, 6)
         self.state = "running"
+        # re-scan the corruption horizon from the resume step: a
+        # re-placed gang keeps its live defects (the chips moved
+        # with the topology — only quarantine retires one)
+        self._recompute_spike(self.steps_done + 1)
         self.ledger.append({
             "kind": "bind", "step": self.steps_done,
             "at_s": round(now, 6), "resume_s": round(ready, 6),
@@ -653,6 +838,16 @@ class TrainingGang:
             "ledger": self.ledger,
             "ledger_verify": verify,
         }
+        if self.sdc_culprits or self.sdc_chips:
+            # conditional: gangs that never saw an SDC fault keep
+            # their historical report bytes
+            out["sdc"] = {
+                "culprits": self.sdc_culprits,
+                "active_defects": sorted(self.sdc_chips),
+                "bisection_rounds": sum(
+                    c["bisection_rounds"]
+                    for c in self.sdc_culprits),
+            }
         if self.done_s is not None:
             out["done_s"] = self.done_s
             out["time_to_completion_s"] = round(span, 6)
@@ -846,6 +1041,40 @@ class TrainingTenant:
         finally:
             self._hard_kill = None
 
+    def apply_sdc(self, target: int, frac: float,
+                  now: float) -> None:
+        """``sdc_train_chip`` chaos: seed a defective chip into gang
+        index ``target`` (sorted-name order, the same addressing
+        ``apply_chaos`` uses). The chip index is a crc32 draw over
+        the gang's CURRENT chip count, so the culprit the bisection
+        must name is itself a pure function of (gang, target)."""
+        names = sorted(self.gangs)
+        name = names[target % len(names)]
+        gang = self.gangs[name]
+        if gang.state == "done":
+            return
+        chips = topo.make_slice(gang.cfg.accelerator,
+                                gang.topology).num_chips
+        chip = zlib.crc32(
+            f"sdc:{name}:{target}".encode("utf-8")) % chips
+        gang.seed_defect(chip, frac, now)
+        metrics.recovery_log().record(
+            "train_sdc_seeded", gang=gang.cfg.name, chip=chip,
+            frac=round(frac, 6), at_s=round(now, 6))
+
+    def drain_sdc_verdicts(self) -> List[dict]:
+        """Bisection verdicts since the last drain, in sorted gang
+        order — the fleet driver turns each into a chip-granular
+        quarantine (docs/SDC.md)."""
+        out: List[dict] = []
+        for name in sorted(self.gangs):
+            gang = self.gangs[name]
+            while gang.sdc_verdicts_out:
+                verdict = gang.sdc_verdicts_out.pop(0)
+                verdict["gang"] = name
+                out.append(verdict)
+        return out
+
     def evict_all(self, now: float, reason: str) -> None:
         """Blast-radius displacement (zone loss / cell failure,
         docs/GLOBE.md): every bound gang checkpoints and evicts; the
@@ -997,7 +1226,9 @@ class TrainingTenant:
         for name in self._arrivals[:1]:
             due_set.at(self.gangs[name].cfg.arrival_s)
         for name in sorted(self.gangs):
-            due_set.at(self.gangs[name].completion_s())
+            # spike-aware: an SDC detection point is a boundary-
+            # condition event exactly like a completion
+            due_set.at(self.gangs[name].next_event_s())
 
     # -- reporting ---------------------------------------------------
 

@@ -145,6 +145,11 @@ class _Component:
     streak: int = 0            # consecutive suspicious samples
     good_probes: int = 0
     ewma: Optional[_Ewma] = None
+    # integrity quarantine (docs/SDC.md) is STICKY: a defective chip
+    # is fast-but-wrong, so latency probes pass and would auto-restore
+    # it — only an explicit restore() (hardware replaced / gang
+    # rebound) lifts it
+    sticky: bool = False
 
 
 class FailureDetector:
@@ -313,10 +318,30 @@ class FailureDetector:
             return None
         if comp.state != QUARANTINED:
             return None
+        if comp.sticky:
+            # integrity quarantine: the chip answers probes quickly
+            # AND wrongly — clean latency probes are not evidence of
+            # integrity, so they never count toward restore
+            return self._transition(component, "probe_ok", now)
         comp.good_probes += 1
         if comp.good_probes >= self.cfg.probe_ok_required:
             return self.restore(component, now, reason="probes")
         return self._transition(component, "probe_ok", now)
+
+    def record_integrity(self, component: str, now: float,
+                         cause: str = "sdc") -> Optional[str]:
+        """Hard integrity evidence (docs/SDC.md): an audit mismatch
+        majority or a bisection verdict named this component as
+        corrupting output. Immediate STICKY quarantine — the
+        component computes wrong while reporting healthy, so the
+        latency channel can never clear it; only an explicit
+        :meth:`restore` (replaced hardware, rebound gang) lifts it."""
+        comp = self._comp(component)
+        comp.sticky = True
+        metrics.health_board().incr("integrity_quarantines")
+        if comp.state == QUARANTINED:
+            return None
+        return self._quarantine(component, now, PHI_CAP, cause=cause)
 
     def restore(self, component: str, now: float,
                 reason: str = "probes") -> str:
@@ -329,6 +354,7 @@ class FailureDetector:
         comp.state = HEALTHY
         comp.streak = 0
         comp.good_probes = 0
+        comp.sticky = False
         comp.ewma = _Ewma(self.cfg.ewma_alpha)
         metrics.recovery_log().record(
             "health_restore", component=component, reason=reason)
@@ -348,7 +374,7 @@ class FailureDetector:
         for ev in self.events:
             counts[ev["transition"]] = (
                 counts.get(ev["transition"], 0) + 1)
-        return {
+        out = {
             "config": self.cfg.as_dict(),
             "components": states,
             "transition_counts": dict(sorted(counts.items())),
@@ -357,6 +383,12 @@ class FailureDetector:
                                 if self._global.count else None),
             "samples": self._global.count,
         }
+        # conditional so every pre-SDC health report keeps its bytes
+        sticky = sorted(c for c, comp in self._comps.items()
+                        if comp.sticky)
+        if sticky:
+            out["integrity_quarantined"] = sticky
+        return out
 
 
 def detection_demo(seed: int = 0, components: int = 4,

@@ -318,6 +318,19 @@ def tenant_board() -> CounterBoard:
     return _TENANT_BOARD
 
 
+_INTEGRITY_BOARD = CounterBoard()
+
+
+def integrity_board() -> CounterBoard:
+    """The process-global silent-data-corruption counter board
+    (corrupted_produced / corrupted_served / corrupted_caught,
+    audits / audit_mismatches, bisection_steps, steps_rolled_back —
+    kind_tpu_sim.fleet.{router,sim,training} record into it;
+    fleet reports, chaos scenario reports, and bench SDC extras
+    snapshot it; docs/SDC.md)."""
+    return _INTEGRITY_BOARD
+
+
 def parse_k8s_time(stamp: str) -> float:
     """RFC3339 (kubernetes) timestamp -> unix seconds."""
     import datetime

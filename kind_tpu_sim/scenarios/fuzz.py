@@ -68,6 +68,11 @@ def _eligible_kinds(topo: TopologySpec, training_gangs: int,
             # draws — and every pre-zoo fuzz report, zoo-flavored
             # or not — keep their bytes
             continue
+        if "sdc" in schema.needs:
+            # SDC kinds ride their own dedicated stream too (the
+            # zoo precedent): the shared pool never sees them, so
+            # every pre-SDC fuzz report keeps its bytes
+            continue
         out.append(kind)
     return out
 
@@ -173,6 +178,49 @@ def draw_spec(seed: int, index: int,
                     param=draw_param(kind, zoo_rng)))
                 if schema.exclusive:
                     has_exclusive = True
+    # SDC faults ride a dedicated stream as well (docs/SDC.md): the
+    # shared `rng` never sees them, so every pre-SDC fuzz report —
+    # corruption-flavored or not — keeps its bytes. Defective chips
+    # live on unified, un-zooed fleets (the audit lane needs
+    # same-model duplicate compute); correlated domain faults
+    # additionally need the rack-aware scheduler.
+    if topo.kind == "fleet" and not topo.disagg and not topo.zoo:
+        sdc_rng = random.Random(zlib.crc32(
+            f"fuzz:sdc:{seed}:{index}".encode()))
+        if sdc_rng.random() < 0.5:
+            has_exclusive = any(FAULT_SCHEMAS[f.kind].exclusive
+                                for f in faults)
+            drew_sdc_chip = False
+            for kind in sorted(FAULT_SCHEMAS):
+                schema = FAULT_SCHEMAS[kind]
+                if "sdc" not in schema.needs or not schema.fuzzable:
+                    continue
+                if "sched" in schema.needs and not topo.sched:
+                    continue
+                if schema.exclusive and has_exclusive:
+                    continue
+                if sdc_rng.random() < 0.7:
+                    start = round(sdc_rng.uniform(*_START), 3)
+                    end = round(min(_END_CAP,
+                                    start
+                                    + sdc_rng.uniform(*_DURATION)),
+                                3)
+                    faults.append(FaultWindow(
+                        kind=kind, start_frac=start, end_frac=end,
+                        target=sdc_rng.randint(0, 7),
+                        param=draw_param(kind, sdc_rng)))
+                    if schema.exclusive:
+                        has_exclusive = True
+                    if kind == "sdc_chip":
+                        drew_sdc_chip = True
+            # a corruption-flavored draw sometimes buys the audit
+            # lane too, so the fuzzer exercises both detection
+            # (audits on) and tolerated escape (audits off) under
+            # the no-corruption-escapes invariant
+            if drew_sdc_chip and sdc_rng.random() < 0.5:
+                topo = dataclasses.replace(
+                    topo,
+                    audit_frac=round(sdc_rng.uniform(0.2, 0.6), 3))
     # window order is part of the drawn identity; sort for a stable
     # spec no matter the draw order
     faults.sort(key=lambda f: (f.start_frac, f.kind, f.target))

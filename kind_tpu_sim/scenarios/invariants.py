@@ -20,6 +20,9 @@ they are properties of the simulator itself, not of one experiment:
 * ``tenant-accounting`` — tenancy conservation: per-tenant admission
   books (admitted + quota/token sheds) reconcile with the distinct
   base ids reaching terminal outcomes.
+* ``no-corruption-escapes`` — once a replica's defective chip is
+  detected, no later completion it produced serves a corrupted
+  payload uncaught (docs/SDC.md containment).
 * ``recovery`` — after the faults lift, the control planes let go:
   no breaker still open, brownout back at level 0.
 * ``replay-identical`` — a second run of the same (spec, seed) is
@@ -139,7 +142,11 @@ def _check_no_lost_work(ctx: InvariantContext) -> Optional[str]:
 
 
 def _check_ledger(ctx: InvariantContext) -> Optional[str]:
-    allow_loss = "train_kill" in ctx.spec.all_fault_kinds()
+    kinds = ctx.spec.all_fault_kinds()
+    # hard kills roll back to the last cadence checkpoint; an SDC
+    # detection does the same (the corrupted segment is the loss the
+    # bisection re-runs, docs/SDC.md) — both are sanctioned
+    allow_loss = ("train_kill" in kinds or "sdc_chip" in kinds)
     for path, d in _walk(ctx.report):
         if "ledger_ok" in d:
             if d["ledger_ok"] is not True:
@@ -243,7 +250,51 @@ def _check_tenant_accounting(ctx: InvariantContext) -> Optional[str]:
     return None
 
 
+def _check_no_corruption_escape(ctx: InvariantContext
+                                ) -> Optional[str]:
+    """No corruption escapes after detection (docs/SDC.md): once a
+    replica's defective chip is detected (its entry in the integrity
+    section's ``detections``), no LATER completion it produced may
+    carry a corrupted payload that was served uncaught. Earlier
+    escapes are the detection latency the audit_frac knob prices;
+    later ones would mean quarantine failed to contain the chip."""
+    for path, d in _sim_reports(ctx.report):
+        integ = d.get("integrity")
+        if not isinstance(integ, dict):
+            continue
+        detected = {det["replica"]: det["at_s"]
+                    for det in integ.get("detections", ())
+                    if isinstance(det, dict)}
+        if not detected:
+            continue
+        for e in d["completions"]:
+            if not isinstance(e, dict) or not e.get("corrupted"):
+                continue
+            if e.get("sdc_caught"):
+                continue
+            rid = e.get("replica")
+            at = detected.get(rid)
+            if at is not None and e["finish_s"] > at:
+                return (f"{path or 'report'}: replica {rid} served "
+                        f"corrupted {e['request_id']!r} at "
+                        f"{e['finish_s']} — AFTER its detection at "
+                        f"{at} (containment failed)")
+    return None
+
+
 def _check_recovery(ctx: InvariantContext) -> Optional[str]:
+    # an SDC quarantine (docs/SDC.md) is a TERMINAL capacity loss:
+    # the defective chip has no heal event, so the overload layer's
+    # return-to-healthy contract (breakers closed, brownout
+    # released) no longer applies — the survivors may legitimately
+    # still be saturated at quiesce. Every other fault is windowed
+    # and must heal.
+    sdc_quarantined = any(
+        d["integrity"].get("detections")
+        for _, d in _walk(ctx.report)
+        if isinstance(d.get("integrity"), dict))
+    if sdc_quarantined:
+        return None
     for path, d in _walk(ctx.report):
         if "brownout" in d and isinstance(d["brownout"], dict):
             b = d["brownout"]
@@ -313,6 +364,15 @@ def _check_selftest_bug(ctx: InvariantContext) -> Optional[str]:
                         f"[{a.start_frac}, {a.end_frac}] overlaps "
                         f"replica_preempt [{b.start_frac}, "
                         f"{b.end_frac}]")
+    # the SDC flavor of the same plant: an sdc_chip composed with
+    # any replica_preempt — the pair the shrinker self-test must
+    # reduce a 4-fault SDC schedule down to (docs/SDC.md)
+    sdcs = [f for f in ctx.spec.faults if f.kind == "sdc_chip"]
+    if sdcs and preempts:
+        a, b = sdcs[0], preempts[0]
+        return ("planted bug: sdc_chip at "
+                f"{a.start_frac} composed with replica_preempt "
+                f"[{b.start_frac}, {b.end_frac}]")
     return None
 
 
@@ -336,6 +396,10 @@ CATALOG: Dict[str, Invariant] = {inv.name: inv for inv in (
               "per-tenant admission books (admitted + quota/token "
               "sheds) reconcile with distinct completed base ids",
               _check_tenant_accounting),
+    Invariant("no-corruption-escapes",
+              "after a replica's SDC detection, no later corrupted "
+              "completion it produced is served uncaught",
+              _check_no_corruption_escape),
     Invariant("recovery",
               "after quiesce no breaker is open and brownout is "
               "back at level 0",

@@ -69,6 +69,15 @@ _LEGACY: Dict[str, tuple] = {
         ("noisy_neighbor",), _FLEETV, True),
     "zoo-swap-storm": (
         ("model_swap_storm",), _FLEETV, True),
+    "sdc-training-bisect": (
+        ("sdc_chip",),
+        ("verdict-ok", "ledger-clean", "no-corruption-escapes"),
+        True),
+    "sdc-serving-audit": (
+        ("sdc_chip",),
+        ("verdict-ok", "no-corruption-escapes"), True),
+    "correlated-rack-loss": (
+        ("correlated_domain_fault",), _FLEETV, True),
 }
 
 _SPECS: Optional[Dict[str, ScenarioSpec]] = None

@@ -87,6 +87,12 @@ class TopologySpec:
     # v5e/v5p fleet (fleet) or cells cycled over both generations
     # (globe) — the prerequisite for the zoo fault kinds
     zoo: bool = False
+    # sampled duplicate-compute integrity audits (docs/SDC.md):
+    # this fraction of served requests re-executes on a second
+    # replica and CRC-compares — the serving-side SDC detection
+    # channel. 0 (the default) keeps the audit lane off and every
+    # pre-SDC pinned spec byte-identical.
+    audit_frac: float = 0.0
 
     def as_dict(self) -> dict:
         out = {
@@ -101,6 +107,8 @@ class TopologySpec:
         # conditional so every pre-zoo pinned spec keeps its bytes
         if self.zoo:
             out["zoo"] = True
+        if self.audit_frac:
+            out["audit_frac"] = self.audit_frac
         return out
 
     @classmethod
@@ -110,7 +118,8 @@ class TopologySpec:
                    cells_per_zone=int(d["cells_per_zone"]),
                    disagg=bool(d.get("disagg", False)),
                    tenancy=bool(d.get("tenancy", False)),
-                   zoo=bool(d.get("zoo", False)))
+                   zoo=bool(d.get("zoo", False)),
+                   audit_frac=float(d.get("audit_frac", 0.0)))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -293,6 +302,10 @@ def spec_problems(spec: ScenarioSpec) -> List[str]:
             problems.append(
                 f"fault kind {f.kind!r} needs a model-zoo "
                 "topology (topology.zoo)")
+        if "sdc" in schema.needs and topo.kind != "fleet":
+            problems.append(
+                f"fault kind {f.kind!r} only applies to fleet "
+                "topologies (the SDC machinery is per fleet)")
         if schema.exclusive:
             exclusive += 1
     if exclusive > 1:
@@ -316,6 +329,18 @@ def spec_problems(spec: ScenarioSpec) -> List[str]:
             "topology.zoo is incompatible with a disaggregated "
             "fleet (the zoo's warm-pool state is per unified "
             "replica)")
+    if topo.audit_frac and topo.kind != "fleet":
+        problems.append(
+            "topology.audit_frac only applies to fleet topologies")
+    if topo.audit_frac and topo.disagg:
+        problems.append(
+            "topology.audit_frac is incompatible with a "
+            "disaggregated fleet (audit copies are whole-request "
+            "re-executions on unified replicas)")
+    if not 0.0 <= topo.audit_frac <= 1.0:
+        problems.append(
+            f"topology.audit_frac {topo.audit_frac} must lie in "
+            "[0, 1]")
     if topo.zoo and topo.kind == "fleet" and topo.sched:
         problems.append(
             "topology.zoo spec fleets pin generations directly; "
@@ -410,6 +435,30 @@ def _fleet_events(spec: ScenarioSpec, span: float):
         elif f.kind == "train_kill":
             gang = f.target % max(1, spec.training_gangs)
             events.append(fleet.ChaosEvent(t0, "train_kill", gang))
+        elif f.kind == "sdc_chip":
+            # instantaneous strike, NO heal: the defect persists
+            # until detection quarantines the chip (docs/SDC.md) —
+            # on a training fleet it seeds a gang chip, else a
+            # serving replica's chip
+            if spec.training_gangs > 0:
+                # raw target: the trainer hashes it into a gang
+                # chip index, any value is a valid seed
+                events.append(fleet.ChaosEvent(
+                    t0, "sdc_train_chip", f.target,
+                    max(0.0, f.param)))
+            else:
+                # serving strikes must name a live replica — the
+                # sim matches replica_id exactly, so an unwrapped
+                # fuzz/tune target of 0..7 on a small fleet would
+                # silently miss
+                events.append(fleet.ChaosEvent(
+                    t0, "sdc_chip", f.target % replicas,
+                    max(0.0, f.param)))
+        elif f.kind == "correlated_domain_fault":
+            events.append(fleet.ChaosEvent(
+                t0, "domain_fault", f.target))
+            events.append(fleet.ChaosEvent(
+                t1, "domain_restore", f.target))
         elif f.kind == "model_swap_storm":
             # `param` eviction pulses spread evenly across the
             # window — each one drops every resident model, so the
@@ -546,8 +595,18 @@ def _run_fleet_spec(spec: ScenarioSpec, seed: int,
             round(span * s.end_frac, 6), max(1.0, s.param), who)
     else:
         trace = base
-    sched = (fleet.FleetSchedConfig() if spec.topology.sched
-             else None)
+    if any(f.kind == "correlated_domain_fault"
+           for f in spec.faults):
+        # domain faults need labeled failure domains: a 4-pod
+        # inventory grouped 2 pods per rack (docs/SDC.md), so one
+        # draw takes out half the fleet's placements at once
+        sched = fleet.FleetSchedConfig(
+            pods=(("tpu-v5-lite-podslice", "4x8"),) * 4,
+            rack_pods=2)
+    elif spec.topology.sched:
+        sched = fleet.FleetSchedConfig()
+    else:
+        sched = None
     disagg = None
     if spec.topology.disagg:
         # even split, prefill-heavy remainder; spec_problems already
@@ -569,6 +628,8 @@ def _run_fleet_spec(spec: ScenarioSpec, seed: int,
         zoo=zoo,
         generations=(_SPEC_GENERATIONS if zoo is not None
                      else None),
+        audit_frac=(spec.topology.audit_frac
+                    if spec.topology.audit_frac else None),
         max_virtual_s=spec.max_virtual_s,
         event_core=event_core)
     events = _fleet_events(spec, span)

@@ -53,6 +53,13 @@ class Node:
     cordoned: bool = False         # drained: no new bindings
     broken: bool = False           # failed: capacity gone entirely
     avoid: bool = False            # gray-suspect: schedulable, scored last
+    # correlated-failure grouping (docs/SDC.md): the rack / power
+    # domain this host shares with others, "" when ungrouped — one
+    # correlated_domain_fault takes out every node with the label
+    failure_domain: str = ""
+    # chip-granular quarantine (docs/SDC.md): defective chips pulled
+    # out of allocatable capacity while the rest of the host serves
+    quarantined_chips: int = 0
 
     def __post_init__(self) -> None:
         if self.free < 0:
@@ -68,7 +75,7 @@ class Node:
         return self.schedulable and self.free == self.capacity
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "name": self.name,
             "domain": self.domain,
             "coord": list(self.coord),
@@ -80,6 +87,12 @@ class Node:
             "broken": self.broken,
             "avoid": self.avoid,
         }
+        # conditional so every pre-SDC inventory report keeps its bytes
+        if self.failure_domain:
+            out["failure_domain"] = self.failure_domain
+        if self.quarantined_chips:
+            out["quarantined_chips"] = self.quarantined_chips
+        return out
 
 
 @dataclasses.dataclass
@@ -285,6 +298,46 @@ class Inventory:
         else:
             node.labels.pop(LABEL_AVOID, None)
 
+    def quarantine_chips(self, node_name: str,
+                         count: int = 1) -> None:
+        """Chip-granular quarantine (docs/SDC.md): pull ``count``
+        defective chips out of the node's allocatable capacity —
+        finer than cordon/fail, the rest of the host keeps working —
+        and mark the host avoid so new placements steer elsewhere."""
+        node = self.nodes[node_name]
+        count = min(count, node.capacity)
+        node.capacity -= count
+        node.free = min(node.free, node.capacity)
+        node.quarantined_chips += count
+        self.mark_avoid(node_name, True)
+
+    def restore_chips(self, node_name: str,
+                      count: Optional[int] = None) -> None:
+        """Return quarantined chips to service (all by default) —
+        the hardware-replaced path; clears avoid once the host is
+        whole again."""
+        node = self.nodes[node_name]
+        back = (node.quarantined_chips if count is None
+                else min(count, node.quarantined_chips))
+        node.quarantined_chips -= back
+        node.capacity += back
+        node.free = min(node.capacity, node.free + back)
+        if node.quarantined_chips == 0:
+            self.mark_avoid(node_name, False)
+
+    def failure_domain_nodes(self, failure_domain: str) -> List[str]:
+        """Names of every node sharing one rack/power domain — the
+        blast radius of a correlated_domain_fault (docs/SDC.md)."""
+        return sorted(n.name for n in self.nodes.values()
+                      if n.failure_domain == failure_domain)
+
+    def failure_domains(self) -> List[str]:
+        """Sorted distinct rack/power domain labels in the fleet
+        ("" means no correlated grouping was declared)."""
+        return sorted({n.failure_domain
+                       for n in self.nodes.values()
+                       if n.failure_domain})
+
     def set_link_factor(self, domain_id: str,
                         factor: float) -> None:
         if not 0.0 < factor <= 1.0:
@@ -325,6 +378,7 @@ def build_inventory(
     pods: List[Tuple[str, str]],
     *, pool: str = "default", zone: str = "zone-a",
     name_prefix: str = "tpu-node",
+    rack_pods: Optional[int] = None,
 ) -> Inventory:
     """Inventory from physical pod shapes: ``pods`` is a list of
     (accelerator, topology) — each entry one ICI domain whose host
@@ -334,11 +388,17 @@ def build_inventory(
     overrides ``zone`` for THAT pod — how a multi-zone inventory
     (one failure domain per zone, docs/GLOBE.md) is declared. Node
     names/labels mirror what the orchestrator applies to kind
-    workers."""
+    workers. ``rack_pods`` groups every ``rack_pods`` consecutive
+    pods into one rack/power ``failure_domain`` label
+    (``rack-0``, ``rack-1``, ...) so correlated_domain_fault
+    (docs/SDC.md) has a blast radius to draw; None (the default)
+    leaves nodes ungrouped and every pre-SDC report byte-identical."""
     domains: List[IciDomain] = []
     for idx, pod in enumerate(pods):
         accelerator, topology = pod[0], pod[1]
         pod_zone = pod[2] if len(pod) > 2 else zone
+        rack = (f"rack-{idx // rack_pods}"
+                if rack_pods and rack_pods > 0 else "")
         s = topo.make_slice(accelerator, topology)
         did = f"pod-{idx}"
         nodes: Dict[Tuple[int, ...], Node] = {}
@@ -355,6 +415,7 @@ def build_inventory(
                 pool=pool,
                 zone=pod_zone,
                 labels=labels,
+                failure_domain=rack,
             )
         domains.append(IciDomain(
             domain_id=did, accelerator=accelerator,

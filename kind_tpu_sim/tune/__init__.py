@@ -16,6 +16,7 @@ from kind_tpu_sim.tune.driver import (  # noqa: F401
     CHAOS_ATTAINMENT,
     FLEET_CHAOS_KINDS,
     GLOBE_CHAOS_KINDS,
+    SDC_FLEET_CHAOS_KINDS,
     draw_fault_schedule,
     evaluate,
     evaluate_candidates,
@@ -45,5 +46,6 @@ from kind_tpu_sim.tune.space import (  # noqa: F401
     ratio_space,
     render_fleet,
     render_globe,
+    sdc_space,
     zoo_space,
 )

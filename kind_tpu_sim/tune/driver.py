@@ -73,6 +73,11 @@ DRAW_CAP_FACTOR = 16
 FLEET_CHAOS_KINDS = ("replica_flap", "replica_preempt",
                      "slow_replica")
 GLOBE_CHAOS_KINDS = ("cell_drain", "dcn_degrade", "zone_loss")
+# spaces that search an `audit_frac` dim (sdc_space) are scored
+# against pure defective-chip storms instead — every schedule
+# exerts corruption pressure, so "zero uncontained corrupted
+# responses" discriminates candidates rather than holding vacuously
+SDC_FLEET_CHAOS_KINDS = ("sdc_chip",)
 
 _WINDOW_START = (0.15, 0.5)
 _WINDOW_DURATION = (0.1, 0.25)
@@ -110,14 +115,18 @@ def draw_fault_schedule(target: str, seed: int, index: int):
     """Fault schedule ``index`` of chaos stream ``seed`` — a pure
     function of its arguments, one crc32 sub-seeded rng per index
     (the fuzz discipline), candidate-independent so every finalist
-    faces the same storms."""
+    faces the same storms. ``target`` picks the kind pool ("fleet",
+    "globe", or "fleet-sdc" for integrity searches) and is part of
+    the rng key, so each pool is its own stream."""
     from kind_tpu_sim.chaos import draw_param
     from kind_tpu_sim.scenarios.spec import FaultWindow
 
     rng = random.Random(zlib.crc32(
         f"tune:chaos:{target}:{seed}:{index}".encode()))
-    pool = (FLEET_CHAOS_KINDS if target == "fleet"
-            else GLOBE_CHAOS_KINDS)
+    pools = {"fleet": FLEET_CHAOS_KINDS,
+             "fleet-sdc": SDC_FLEET_CHAOS_KINDS,
+             "globe": GLOBE_CHAOS_KINDS}
+    pool = pools[target]
     windows = []
     for _ in range(rng.randint(1, 2)):
         kind = pool[rng.randrange(len(pool))]
@@ -237,7 +246,9 @@ def _evaluate_fleet(spec, candidate, fidelity, seed, slo,
     chaos_events = ()
     if chaos_index is not None:
         span = max(r.arrival_s for r in trace) if trace else 0.0
-        windows = draw_fault_schedule("fleet", seed,
+        chaos_target = ("fleet-sdc" if "audit_frac" in candidate
+                        else "fleet")
+        windows = draw_fault_schedule(chaos_target, seed,
                                       int(chaos_index))
         chaos_events = _fleet_chaos_events(windows, cfg.replicas,
                                            span)
@@ -267,6 +278,27 @@ def _evaluate_fleet(spec, candidate, fidelity, seed, slo,
     out.update(_slo_metrics(rep["slo"]))
     if cfg.disagg is not None:
         out["kv_handoffs"] = rep["disagg"]["kv"]["handoffs"]
+    integ = rep.get("integrity")
+    if isinstance(integ, dict):
+        # integrity scoring (docs/SDC.md), keyed only when the run
+        # was SDC-active so pre-SDC metrics rows keep their bytes.
+        # An "uncontained" corrupted response was served by a chip
+        # that was never caught, or after its detection — the
+        # pre-detection escapes an audit_frac prices are the lane's
+        # accepted latency cost, everything else is a dead fleet.
+        counters = integ.get("counters") or {}
+        detect_s = {d["replica"]: d["at_s"]
+                    for d in integ.get("detections", ())}
+        out["corrupted_served"] = int(
+            counters.get("corrupted_served", 0))
+        out["corrupted_uncontained"] = sum(
+            1 for e in rep["completions"]
+            if e.get("corrupted") and not e.get("sdc_caught")
+            and (e["replica"] not in detect_s
+                 or e["finish_s"] > detect_s[e["replica"]]))
+        out["audits"] = int(counters.get("audits", 0))
+        out["chips_quarantined"] = int(
+            counters.get("chips_quarantined", 0))
     return out
 
 
@@ -488,7 +520,13 @@ def tune(space: TuneSpace, workload, slo,
             survived = [
                 bool(m["ok"]
                      and (m.get("attainment") or 0.0)
-                     >= CHAOS_ATTAINMENT)
+                     >= CHAOS_ATTAINMENT
+                     # integrity searches (docs/SDC.md): surviving
+                     # an SDC storm additionally means zero
+                     # uncontained corrupted responses. Absent on
+                     # every non-SDC row (None -> passes), so
+                     # pre-SDC reports keep their bytes.
+                     and not m.get("corrupted_uncontained"))
                 for m in mine]
             survived_all[i] = all(survived)
             per_finalist[str(i)] = {
@@ -502,12 +540,16 @@ def tune(space: TuneSpace, workload, slo,
                      "survived": s}
                     for m, s in zip(mine, survived)],
             }
+        if space.target != "fleet":
+            chaos_kinds = GLOBE_CHAOS_KINDS
+        elif any(d.name == "audit_frac" for d in space.dims):
+            chaos_kinds = SDC_FLEET_CHAOS_KINDS
+        else:
+            chaos_kinds = FLEET_CHAOS_KINDS
         chaos_section = {
             "budget": chaos_budget,
             "min_attainment": CHAOS_ATTAINMENT,
-            "kinds": list(FLEET_CHAOS_KINDS
-                          if space.target == "fleet"
-                          else GLOBE_CHAOS_KINDS),
+            "kinds": list(chaos_kinds),
             "finalists": per_finalist,
         }
 
@@ -517,6 +559,17 @@ def tune(space: TuneSpace, workload, slo,
     if chaos_section is not None:
         surviving = [p for p in front
                      if survived_all.get(int(p["index"]))]
+        if not surviving and any(survived_all.values()):
+            # no fault-free-front point rode out every storm, but
+            # some finalist did (typical of integrity searches:
+            # audits only pay off under faults, so the fault-free
+            # front is all cheap no-audit configs). "Cheapest fleet
+            # that survives" outranks fault-free Pareto membership:
+            # rebuild the front over the survivors alone and pick
+            # the knee there.
+            surviving = pareto_mod.pareto_front(_pareto_points(
+                [by_index[i] for i in finalists
+                 if survived_all.get(i)]))
         if surviving:
             pick_from = surviving
         chaos_section["front_survivors"] = [

@@ -197,6 +197,28 @@ def zoo_space() -> TuneSpace:
         ))
 
 
+def sdc_space() -> TuneSpace:
+    """The integrity design space (docs/SDC.md): how much sampled
+    duplicate-compute auditing to buy (``audit_frac`` — 0.0 is the
+    do-nothing baseline) alongside the usual replica-count and
+    policy levers. The driver scores spaces carrying an
+    ``audit_frac`` dim against a dedicated ``sdc_chip`` storm pool,
+    and chaos survival additionally demands zero uncontained
+    corrupted responses — so "cheapest fleet serving zero corrupted
+    responses under SDC chaos" is the query the knee answers, and
+    the winner has to buy audits to answer it."""
+    return TuneSpace(
+        name="sdc-fleet",
+        target="fleet",
+        dims=(
+            TuneDim("audit_frac", "choice",
+                    choices=(0.0, 0.25, 0.5)),
+            TuneDim("replicas", "int", lo=2, hi=4),
+            TuneDim("policy", "choice",
+                    choices=("least-outstanding", "round-robin")),
+        ))
+
+
 def ratio_space(ratios: Tuple[str, ...],
                 policy: str = "least-outstanding") -> TuneSpace:
     """A one-dimension disagg-ratio space at a fixed policy — the
@@ -256,6 +278,12 @@ def render_fleet(candidate: Dict[str, object], slo,
         zoo_cfg = default_zoo()
         if "large_model_gen" in candidate:
             large_gen = str(candidate["large_model_gen"])
+    # integrity candidates (sdc_space): the searched audit fraction
+    # becomes the fleet's duplicate-compute sampling rate. None (not
+    # 0.0) when the dim is absent, so every pre-SDC space renders
+    # the exact config it always did.
+    audit_frac = (float(candidate["audit_frac"])
+                  if "audit_frac" in candidate else None)
     return fleet.FleetConfig(
         replicas=replicas,
         policy=str(candidate.get("policy", "least-outstanding")),
@@ -268,7 +296,8 @@ def render_fleet(candidate: Dict[str, object], slo,
         tenancy=ten,
         zoo=zoo_cfg,
         generations=generations,
-        zoo_large_model_gen=large_gen)
+        zoo_large_model_gen=large_gen,
+        audit_frac=audit_frac)
 
 
 def render_globe(candidate: Dict[str, object], slo, workload,

@@ -212,6 +212,35 @@ def test_shrink_direct_on_planted_violation():
     assert got.workload.n_requests <= spec.workload.n_requests
 
 
+def test_shrink_sdc_schedule_to_minimal_corrupting_pair():
+    """The SDC flavor of the shrinker self-test (docs/SDC.md): a
+    4-fault schedule around a defective chip reduces to exactly the
+    sdc_chip x replica_preempt pair the planted bug keys on — the
+    bystander faults (a drain, a non-overlapping slowdown) are
+    dropped, and the repro is 1-minimal."""
+    spec = _small_spec(
+        name="sdc-planted",
+        faults=(FaultWindow("node_drain", 0.2, 0.35, target=0),
+                FaultWindow("sdc_chip", 0.3, 0.45, target=0,
+                            param=0.4),
+                FaultWindow("replica_preempt", 0.5, 0.6, target=1),
+                # clear of the preempt window, so the slow x
+                # preempt clause can never fire first
+                FaultWindow("slow_replica", 0.62, 0.7, target=0,
+                            param=3.0)))
+    out = shrink.shrink(spec, ("fuzz-selftest-bug",))
+    got = ScenarioSpec.from_dict(out["spec"])
+    assert sorted(f.kind for f in got.faults) == [
+        "replica_preempt", "sdc_chip"]
+    assert out["violated"] == ["fuzz-selftest-bug"]
+    # 1-minimal: dropping either survivor loses the violation
+    names = ("fuzz-selftest-bug",)
+    for i in range(len(got.faults)):
+        less = dataclasses.replace(
+            got, faults=got.faults[:i] + got.faults[i + 1:])
+        assert invariants.check(less, {}, names=names) == []
+
+
 # -- pinned repros ----------------------------------------------------
 
 

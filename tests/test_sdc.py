@@ -1,0 +1,151 @@
+"""Silent-data-corruption layer tests (docs/SDC.md).
+
+The load-bearing properties: culprit bisection is deterministic and
+O(log chips) — for EVERY chip index of 8/16/32-chip gangs the named
+culprit is exact, found in <= ceil(log2(chips)) + 1 re-run segments,
+and the ledger prices exactly those re-runs as real chip-seconds; the
+serving audit lane detects a defective replica chip, quarantine is
+sticky (no corrupted response escapes after detection), and the whole
+run is byte-deterministic.
+"""
+
+import json
+import math
+
+import pytest
+
+from kind_tpu_sim import fleet, topology
+from kind_tpu_sim.fleet import training as tr
+
+pytestmark = pytest.mark.sdc
+
+
+def dumps(obj) -> str:
+    return json.dumps(obj, sort_keys=True, default=str)
+
+
+# -- training: culprit bisection ---------------------------------------
+
+
+def _gang_with_defect(topo_str: str, chip: int) -> tr.TrainingGang:
+    """One pure-timeline gang (no ring term) with a certain defect
+    (frac 1.0 -> the very next step spikes) planted on ``chip`` a
+    little into the run."""
+    chips = topology.make_slice(topology.DEFAULT_ACCELERATOR,
+                                topo_str).num_chips
+    cfg = tr.TrainingGangConfig(
+        name="g0", topology=topo_str, total_steps=30,
+        checkpoint_every=10, allreduce_bytes=0.0,
+        step_compute_chip_s=0.1 * chips)
+    gang = tr.TrainingGang(cfg, ckpt_every=10, ckpt_write_s=0.05,
+                           restart_s=0.2, elastic=False)
+    gang.bound(0.0, 1.0, bind_s=0.0)
+    gang.seed_defect(chip, 1.0, gang.seg_t0 + 0.55)
+    gang.advance(10_000.0)
+    return gang
+
+
+@pytest.mark.parametrize("topo_str", ["2x4", "4x4", "4x8"])
+def test_bisection_exact_log_bounded_and_priced(topo_str):
+    """For every chip index of the gang: bisection names exactly
+    that chip, in <= ceil(log2(chips)) + 1 re-run segments, and the
+    ledger carries one priced ``bisect`` record per segment — the
+    re-runs are real chip-seconds, not free."""
+    chips = topology.make_slice(topology.DEFAULT_ACCELERATOR,
+                                topo_str).num_chips
+    log2 = math.ceil(math.log2(chips))
+    for chip in range(chips):
+        gang = _gang_with_defect(topo_str, chip)
+        assert gang.state == "done"
+        assert not gang.sdc_chips
+        assert len(gang.sdc_culprits) == 1
+        rec = gang.sdc_culprits[0]
+        assert rec["chip"] == chip
+        rounds = rec["bisection_rounds"]
+        assert rounds <= log2 + 1
+        bisects = [l for l in gang.ledger if l["kind"] == "bisect"]
+        assert len(bisects) == rounds
+        for b in bisects:
+            # each round re-runs the rolled-back steps on the
+            # candidate half-gang and is priced accordingly
+            assert b["steps"] >= 1
+            span = b["chips_hi"] - b["chips_lo"]
+            assert span >= 1
+            assert b["chip_s"] == round(
+                b["steps"] * gang.step_s * span, 6)
+            assert b["chip_s"] > 0
+        # the halving invariant: candidate ranges strictly shrink
+        spans = [b["chips_hi"] - b["chips_lo"] for b in bisects]
+        assert all(s2 < s1 for s1, s2 in zip(spans, spans[1:]))
+        # the corrupted step never committed: the rollback lost less
+        # than one checkpoint cadence
+        rollbacks = [l for l in gang.ledger
+                     if l["kind"] == "rollback"
+                     and l.get("cause") == "sdc"]
+        for rb in rollbacks:
+            assert rb["lost_steps"] < gang.ckpt_every
+
+
+@pytest.mark.parametrize("topo_str", ["2x4", "4x8"])
+def test_bisection_is_deterministic(topo_str):
+    """Two identical runs land on byte-identical ledgers and
+    culprit records — bisection re-runs are pure functions of
+    (gang, chip, step), never wall-clock or rng state."""
+    chips = topology.make_slice(topology.DEFAULT_ACCELERATOR,
+                                topo_str).num_chips
+    for chip in (0, chips // 2, chips - 1):
+        a = _gang_with_defect(topo_str, chip)
+        b = _gang_with_defect(topo_str, chip)
+        assert dumps(a.ledger) == dumps(b.ledger)
+        assert dumps(a.sdc_culprits) == dumps(b.sdc_culprits)
+        assert a.done_s == b.done_s
+
+
+# -- serving: audit lane containment -----------------------------------
+
+
+def _audit_run(audit_frac: float):
+    trace = fleet.generate_trace(
+        fleet.WorkloadSpec(process="poisson", rps=40.0,
+                           n_requests=160, prompt_len=(8, 16),
+                           max_new=(4, 8)), seed=3)
+    span = max(r.arrival_s for r in trace)
+    cfg = fleet.FleetConfig(replicas=3, audit_frac=audit_frac,
+                            max_virtual_s=120.0)
+    events = [fleet.ChaosEvent(round(span * 0.25, 6), "sdc_chip",
+                               1, 0.4)]
+    return fleet.FleetSim(cfg, trace, chaos_events=events).run()
+
+
+def test_audit_lane_detects_and_contains():
+    rep = _audit_run(0.4)
+    integ = rep["integrity"]
+    assert integ["audit_frac"] == 0.4
+    counters = integ["counters"]
+    assert counters["audits"] >= 1
+    assert counters["audit_mismatches"] >= 1
+    assert counters["chips_quarantined"] >= 1
+    detect_s = {d["replica"]: d["at_s"]
+                for d in integ["detections"]}
+    assert 1 in detect_s
+    # sticky quarantine: NO corrupted response escapes after its
+    # replica's detection (the universal invariant, docs/SDC.md)
+    for e in rep["completions"]:
+        if e.get("corrupted") and not e.get("sdc_caught"):
+            assert e["finish_s"] <= detect_s.get(
+                e["replica"], float("inf"))
+    # byte determinism of the whole report
+    assert dumps(rep) == dumps(_audit_run(0.4))
+
+
+def test_no_audits_means_no_detection_and_open_escapes():
+    rep = _audit_run(0.0)
+    integ = rep["integrity"]
+    counters = integ["counters"]
+    assert not integ["detections"]
+    assert counters.get("audits", 0) == 0
+    assert counters["corrupted_served"] >= 1
+    # the audited run serves strictly fewer corrupted responses
+    audited = _audit_run(0.4)["integrity"]["counters"]
+    assert (audited.get("corrupted_served", 0)
+            < counters["corrupted_served"])

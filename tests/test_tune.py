@@ -337,6 +337,48 @@ def test_chaos_ranks_zone_loss_survivor_above_cheaper_config():
     assert rep["winner"]["survived_all"]
 
 
+def test_sdc_chaos_winner_buys_audits():
+    """The pinned integrity-search scenario (docs/SDC.md): under
+    dedicated sdc_chip storms, audit-free candidates serve
+    uncontained corrupted responses and die; the chaos-aware winner
+    must buy a non-zero audit_frac even though cheaper no-audit
+    configs own the fault-free Pareto front."""
+    wl = fleet.WorkloadSpec(process="poisson", rps=50.0,
+                            n_requests=120, prompt_len=(8, 16),
+                            max_new=(4, 8))
+    rep = tune.tune(tune.sdc_space(), wl, SLO, seed=1, budget=6,
+                    chaos_budget=2, workload_seed=1)
+    assert rep["ok"]
+    # the storm pool is pure defective-chip pressure
+    assert rep["chaos"]["kinds"] == ["sdc_chip"]
+    for j in range(2):
+        for w in tune.draw_fault_schedule("fleet-sdc", 1, j):
+            assert w.kind == "sdc_chip"
+    finalists = rep["chaos"]["finalists"]
+    by_idx = {int(i): rep["candidates"][i] for i in finalists}
+    # audit-free finalists exist and every one of them died: their
+    # corruption was never detected, so it escaped uncontained
+    bare = [i for i, c in by_idx.items() if c["audit_frac"] == 0.0]
+    assert bare
+    for i in bare:
+        assert not finalists[str(i)]["survived_all"]
+    chaos_rows = [r["metrics"] for r in rep["runs"]
+                  if r["rung"] == "chaos"]
+    assert any(m.get("corrupted_uncontained")
+               for m in chaos_rows if m["index"] in bare)
+    # the winner bought audits, rode out every storm, and its spec
+    # replays byte-identically
+    winner = rep["winner"]
+    assert winner["candidate"]["audit_frac"] > 0.0
+    assert winner["survived_all"]
+    spec = json.loads(tune.winner_spec_text(rep))
+    assert dumps(tune.replay(spec)) == dumps(winner["metrics"])
+    # and the whole search is deterministic
+    rep2 = tune.tune(tune.sdc_space(), wl, SLO, seed=1, budget=6,
+                     chaos_budget=2, workload_seed=1)
+    assert dumps(rep) == dumps(rep2)
+
+
 # -- knobs -------------------------------------------------------------
 
 
